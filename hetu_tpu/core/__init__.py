@@ -1,0 +1,3 @@
+from hetu_tpu.core.mesh import MeshConfig, create_mesh, current_mesh, use_mesh
+from hetu_tpu.core import dtypes
+from hetu_tpu.core.symbol import IntSymbol

@@ -1,0 +1,57 @@
+"""Canonical dtype policy for the framework.
+
+The reference carries its own float16/bfloat16 host types and a per-graph
+autocast context (reference: hetu/core/dtype.h, hetu/graph/autocast/autocast.h).
+On TPU the natural policy is: parameters and optimizer state in float32,
+compute (activations, matmuls) in bfloat16, reductions/softmax/loss in float32.
+This module centralizes that policy so models and the trainer agree.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any
+
+import jax.numpy as jnp
+
+# Mirrors reference DataType surface (hetu/core/dtype.h) where meaningful on TPU.
+float32 = jnp.float32
+float16 = jnp.float16
+bfloat16 = jnp.bfloat16
+float64 = jnp.float64
+int32 = jnp.int32
+int64 = jnp.int64
+int8 = jnp.int8
+uint8 = jnp.uint8
+bool_ = jnp.bool_
+
+
+@dataclasses.dataclass(frozen=True)
+class DTypePolicy:
+    """Mixed-precision policy (the TPU analog of reference autocast.h:17).
+
+    param_dtype:   dtype parameters are stored in (and optimizer runs in).
+    compute_dtype: dtype activations/matmuls run in.
+    reduce_dtype:  dtype for softmax / loss / large reductions.
+    """
+
+    param_dtype: Any = jnp.float32
+    compute_dtype: Any = jnp.bfloat16
+    reduce_dtype: Any = jnp.float32
+
+    def cast_to_compute(self, x):
+        if x.dtype in (jnp.float32, jnp.bfloat16, jnp.float16):
+            return x.astype(self.compute_dtype)
+        return x
+
+
+# Default policy used by models unless overridden (bf16 AMP, fp32 master).
+DEFAULT_POLICY = DTypePolicy()
+FULL_PRECISION = DTypePolicy(compute_dtype=jnp.float32)
+
+
+def finfo(dtype):
+    return jnp.finfo(dtype)
+
+
+def is_floating(dtype) -> bool:
+    return jnp.issubdtype(dtype, jnp.floating)

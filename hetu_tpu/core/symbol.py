@@ -1,0 +1,94 @@
+"""Symbolic integers for shape plans.
+
+The reference uses a lazy integer-expression DAG (`SymbolDef`/`IntSymbol`,
+reference: hetu/core/symbol.h:19) so one compiled graph serves many dynamic
+sequence lengths.  XLA wants static shapes, so on TPU the same role is played
+by a *shape plan*: symbols are set per step (from the data pipeline's bucket
+choice) and the resolved integer tuple keys a cache of compiled executables
+(see hetu_tpu.engine.plan_pool).  The expression DAG is retained so configs can
+express derived quantities (e.g. seq_len // cp) exactly like the reference.
+"""
+from __future__ import annotations
+
+import operator
+from typing import Callable, Optional
+
+
+class IntSymbol:
+    """A lazily-evaluated integer.  Leaf symbols are `set_data(v)` at runtime;
+    composite symbols evaluate their expression DAG on demand
+    (reference: symbol.h leaf/expression semantics)."""
+
+    __slots__ = ("_value", "_fn", "_args", "name")
+
+    def __init__(self, value: Optional[int] = None, *, name: str = ""):
+        self._value: Optional[int] = None if value is None else int(value)
+        self._fn: Optional[Callable] = None
+        self._args: tuple = ()
+        self.name = name
+
+    @classmethod
+    def _expr(cls, fn: Callable, *args) -> "IntSymbol":
+        s = cls()
+        s._fn = fn
+        s._args = args
+        return s
+
+    def set_data(self, value: int) -> "IntSymbol":
+        if self._fn is not None:
+            raise ValueError("cannot set data on a composite IntSymbol")
+        self._value = int(value)
+        return self
+
+    def is_leaf(self) -> bool:
+        return self._fn is None
+
+    def get(self) -> int:
+        if self._fn is None:
+            if self._value is None:
+                raise ValueError(f"IntSymbol {self.name or id(self)} is unset")
+            return self._value
+        return int(self._fn(*[a.get() if isinstance(a, IntSymbol) else a for a in self._args]))
+
+    # Python int protocol — resolves eagerly.
+    def __int__(self) -> int:
+        return self.get()
+
+    def __index__(self) -> int:
+        return self.get()
+
+    def _binop(self, other, fn):
+        return IntSymbol._expr(fn, self, other)
+
+    def __add__(self, o):
+        return self._binop(o, operator.add)
+
+    def __radd__(self, o):
+        return IntSymbol._expr(operator.add, o, self)
+
+    def __sub__(self, o):
+        return self._binop(o, operator.sub)
+
+    def __rsub__(self, o):
+        return IntSymbol._expr(operator.sub, o, self)
+
+    def __mul__(self, o):
+        return self._binop(o, operator.mul)
+
+    def __rmul__(self, o):
+        return IntSymbol._expr(operator.mul, o, self)
+
+    def __floordiv__(self, o):
+        return self._binop(o, operator.floordiv)
+
+    def __mod__(self, o):
+        return self._binop(o, operator.mod)
+
+    # Identity-based eq/hash (symbols are nodes in an expression DAG; value
+    # comparison is explicit via int(sym) — keeps dict/set semantics sane for
+    # the shape-plan pool, which keys on resolved integer tuples, not symbols).
+    def __repr__(self):
+        try:
+            return f"IntSymbol({self.get()})"
+        except ValueError:
+            return f"IntSymbol(<unset{':' + self.name if self.name else ''}>)"

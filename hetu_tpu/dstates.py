@@ -1,0 +1,345 @@
+"""DistributedStates: the distributed-layout algebra.
+
+This is the TPU-native rebuild of the reference's central abstraction
+(reference: hetu/graph/distributed_states.h:13-138): a tensor layout described
+as a map {tensor dim -> shard factor} with dim -1 = replicate and dim -2 =
+partial(pending-reduce), plus an `order` vector tying state dims to device-group
+positions.
+
+On TPU the device-group + order pair is subsumed by a named `jax.sharding.Mesh`:
+a layout here is *per-tensor-dim tuples of mesh axis names* (exactly the
+information in a `PartitionSpec`) **plus** an explicit set of mesh axes over
+which the value is a partial sum.  GSPMD has no user-visible "partial", so we
+keep partial in our layer (as the reference keeps dim -2) and emit the correct
+collective — psum vs psum_scatter vs all_gather — at conversion points, the
+way the reference lowers CommOp via get_comm_type
+(reference: hetu/graph/ops/Communication.cc get_comm_type +
+hetu/graph/executable_graph.cc:366 SubstituteCommOp).
+
+Two execution contexts consume this algebra:
+  * GSPMD context (inside jit):   `named_sharding()` / `constrain()` — XLA
+    inserts the collectives.
+  * Explicit context (inside shard_map): `convert()` — we emit
+    psum / all_gather / psum_scatter / all_to_all / slice ourselves; used by
+    ring attention, pipeline, MoE dispatch, and the hot-switch engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+import enum
+from typing import Dict, FrozenSet, Optional, Sequence, Tuple, Union
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+AxisName = str
+DimSpec = Tuple[AxisName, ...]  # mesh axes sharding one tensor dim (outer→inner)
+
+
+def _norm_dimspec(s) -> DimSpec:
+    if s is None:
+        return ()
+    if isinstance(s, str):
+        return (s,)
+    return tuple(s)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedStates:
+    """A distributed tensor layout over a named mesh.
+
+    spec[d]  = mesh axes sharding tensor dim d (empty tuple = not sharded).
+    partial  = mesh axes over which the value is an unreduced partial sum
+               (the reference's dim -2 state, distributed_states.h:133).
+    Axes appearing in neither are replicated (the reference's dim -1 "dup").
+    """
+
+    spec: Tuple[DimSpec, ...]
+    partial: FrozenSet[AxisName] = frozenset()
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def make(ndim: int, splits: Optional[Dict[int, Union[str, Sequence[str]]]] = None,
+             partial: Sequence[str] = ()) -> "DistributedStates":
+        spec = [()] * ndim
+        for d, axes in (splits or {}).items():
+            if d < 0:
+                d += ndim
+            spec[d] = _norm_dimspec(axes)
+        return DistributedStates(tuple(spec), frozenset(partial))
+
+    @staticmethod
+    def dup(ndim: int) -> "DistributedStates":
+        return DistributedStates(tuple(() for _ in range(ndim)))
+
+    @staticmethod
+    def from_pspec(pspec: P, ndim: Optional[int] = None) -> "DistributedStates":
+        dims = [_norm_dimspec(s) for s in tuple(pspec)]
+        if ndim is not None:
+            dims += [()] * (ndim - len(dims))
+        return DistributedStates(tuple(dims))
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def ndim(self) -> int:
+        return len(self.spec)
+
+    def sharded_axes(self) -> FrozenSet[AxisName]:
+        return frozenset(a for dim in self.spec for a in dim)
+
+    def used_axes(self) -> FrozenSet[AxisName]:
+        return self.sharded_axes() | self.partial
+
+    def dim_of(self, axis: AxisName) -> Optional[int]:
+        """Tensor dim sharded by `axis`, or None (replicated/partial)."""
+        for d, axes in enumerate(self.spec):
+            if axis in axes:
+                return d
+        return None
+
+    def num_shards(self, dim: int, mesh: Mesh) -> int:
+        return int(
+            _prod(int(mesh.shape.get(a, 1)) for a in self.spec[dim])
+        )
+
+    def is_resolved(self) -> bool:
+        return not self.partial
+
+    def validate(self):
+        seen = set()
+        for axes in self.spec:
+            for a in axes:
+                if a in seen:
+                    raise ValueError(f"mesh axis {a!r} shards two tensor dims: {self}")
+                seen.add(a)
+        if seen & self.partial:
+            raise ValueError(f"axes {seen & self.partial} both shard and partial: {self}")
+        return self
+
+    # -- derivations (the reference's combine/reduce state transitions) -----
+    def with_split(self, dim: int, axis: Union[str, Sequence[str]]) -> "DistributedStates":
+        if dim < 0:
+            dim += self.ndim
+        spec = list(self.spec)
+        spec[dim] = spec[dim] + _norm_dimspec(axis)
+        return dataclasses.replace(self, spec=tuple(spec)).validate()
+
+    def without_split(self, dim: int) -> "DistributedStates":
+        if dim < 0:
+            dim += self.ndim
+        spec = list(self.spec)
+        spec[dim] = ()
+        return dataclasses.replace(self, spec=tuple(spec))
+
+    def without_axis(self, axis: AxisName) -> "DistributedStates":
+        spec = tuple(tuple(a for a in axes if a != axis) for axes in self.spec)
+        return dataclasses.replace(self, spec=spec)
+
+    def with_partial(self, axes: Union[str, Sequence[str]]) -> "DistributedStates":
+        return dataclasses.replace(
+            self, partial=self.partial | set(_norm_dimspec(axes))
+        ).validate()
+
+    def reduced(self) -> "DistributedStates":
+        """Layout after the pending partial sum is reduced (psum)."""
+        return dataclasses.replace(self, partial=frozenset())
+
+    # -- emission to JAX ----------------------------------------------------
+    def partition_spec(self) -> P:
+        return P(*[axes if axes else None for axes in self.spec])
+
+    def named_sharding(self, mesh: Mesh) -> NamedSharding:
+        if self.partial:
+            raise ValueError(
+                f"cannot emit NamedSharding for partial layout {self}; "
+                "reduce first (insert a comm op)"
+            )
+        return NamedSharding(mesh, self.partition_spec())
+
+    def constrain(self, x, mesh: Optional[Mesh] = None):
+        """GSPMD context: annotate `x` with this layout (partial must be resolved).
+        A fully-unsharded layout is a no-op so single-device code never needs a
+        mesh in context."""
+        if self.partial:
+            raise ValueError(f"cannot constrain to partial layout {self}")
+        if not self.sharded_axes():
+            return x
+        if mesh is not None:
+            return lax.with_sharding_constraint(x, self.named_sharding(mesh))
+        return lax.with_sharding_constraint(x, self.partition_spec())
+
+    # -- hetu ds-parallel JSON interop --------------------------------------
+    @staticmethod
+    def from_hetu(states: Dict[int, int], ndim: int,
+                  dim_to_axis: Dict[int, Union[str, Sequence[str]]]) -> "DistributedStates":
+        """Translate a reference-style states map {dim: split_num, -1: dup, -2:
+        partial} (reference: engine/parallel_config.py:206 config2ds) given the
+        mapping from tensor dims to mesh axes used by the current strategy."""
+        splits = {}
+        partial: Tuple[str, ...] = ()
+        for d, n in states.items():
+            if int(n) <= 1:
+                continue
+            d = int(d)
+            if d == -2:
+                partial = _norm_dimspec(dim_to_axis.get(-2, "tp"))
+            elif d >= 0:
+                splits[d] = dim_to_axis[d]
+        return DistributedStates.make(ndim, splits, partial)
+
+    def __str__(self):
+        dims = ",".join("+".join(a) if a else "·" for a in self.spec)
+        p = f"|partial({','.join(sorted(self.partial))})" if self.partial else ""
+        return f"DS[{dims}{p}]"
+
+
+def _prod(it):
+    r = 1
+    for v in it:
+        r *= v
+    return r
+
+
+# ---------------------------------------------------------------------------
+# Comm deduction — the analog of get_comm_type
+# (reference: hetu/graph/ops/Communication.cc; lowering table at
+#  executable_graph.cc:366-760 SubstituteCommOp).
+# ---------------------------------------------------------------------------
+
+class CommType(enum.Enum):
+    NONE = "none"                    # layouts equal
+    ALL_REDUCE = "all_reduce"        # partial -> replicated        (psum)
+    REDUCE_SCATTER = "reduce_scatter"  # partial -> split           (psum_scatter)
+    ALL_GATHER = "all_gather"        # split -> replicated          (all_gather)
+    SPLIT = "split"                  # replicated -> split          (local slice)
+    ALL_TO_ALL = "all_to_all"        # split(d1) -> split(d2)       (all_to_all)
+    GENERIC = "generic"              # multi-step resharding
+
+
+@dataclasses.dataclass(frozen=True)
+class CommPlan:
+    kind: CommType
+    axis: Optional[AxisName] = None   # mesh axis the collective runs over
+    src_dim: Optional[int] = None     # tensor dim (gather/scatter/a2a source)
+    dst_dim: Optional[int] = None
+
+
+def deduce_comm(src: DistributedStates, dst: DistributedStates) -> Tuple[CommPlan, ...]:
+    """Plan the collectives converting layout `src` into `dst`.
+
+    Returns a sequence of single-axis CommPlans (executed in order inside a
+    shard_map region, or used as documentation of what GSPMD will insert).
+    Mirrors the decision table of the reference's get_comm_type: partial is
+    resolved first (all-reduce or fused reduce-scatter), then per-axis
+    gather/slice/all-to-all moves.
+    """
+    if src.ndim != dst.ndim:
+        raise ValueError(f"rank mismatch: {src} vs {dst}")
+    if src == dst:
+        return (CommPlan(CommType.NONE),)
+
+    plans = []
+    cur = src
+
+    # 1. Resolve partial sums. Fuse into reduce-scatter when the destination
+    #    shards a currently-unsharded dim over the same axis (the TP/SP and
+    #    ZeRO-bridge pattern, reference: ops/Communication.h:786 SplitReduceScatter).
+    for axis in sorted(cur.partial):
+        if axis in dst.partial:
+            continue  # stays partial
+        ddim = dst.dim_of(axis)
+        if ddim is not None and axis not in cur.spec[ddim]:
+            plans.append(CommPlan(CommType.REDUCE_SCATTER, axis=axis, dst_dim=ddim))
+            cur = dataclasses.replace(cur, partial=cur.partial - {axis}).with_split(ddim, axis)
+        else:
+            plans.append(CommPlan(CommType.ALL_REDUCE, axis=axis))
+            cur = dataclasses.replace(cur, partial=cur.partial - {axis})
+
+    # 2. Per-axis moves between dims. Ordering matters for correctness:
+    #    (a) all-to-all moves (axis stays sharded, dim changes);
+    #    (b) all-gathers, innermost axis of each dim first (gathering an outer
+    #        axis while an inner one is still sharded would interleave blocks);
+    #    (c) splits last, once the value is replicated over the split axes.
+    moves, gathers, splits_ = [], [], []
+    for axis in sorted(cur.sharded_axes() | dst.sharded_axes()):
+        sdim, ddim = cur.dim_of(axis), dst.dim_of(axis)
+        if sdim == ddim:
+            continue
+        if sdim is not None and ddim is not None:
+            moves.append(axis)
+        elif sdim is not None:
+            gathers.append(axis)
+        else:
+            splits_.append(axis)
+
+    for axis in moves:
+        sdim, ddim = cur.dim_of(axis), dst.dim_of(axis)
+        plans.append(CommPlan(CommType.ALL_TO_ALL, axis=axis, src_dim=sdim, dst_dim=ddim))
+        cur = cur.without_axis(axis).with_split(ddim, axis)
+    # innermost-first: sort by (dim, -position in that dim's axis tuple)
+    gathers.sort(key=lambda a: (cur.dim_of(a), -cur.spec[cur.dim_of(a)].index(a)))
+    for axis in gathers:
+        sdim = cur.dim_of(axis)
+        plans.append(CommPlan(CommType.ALL_GATHER, axis=axis, src_dim=sdim))
+        cur = cur.without_axis(axis)
+    for axis in splits_:
+        ddim = dst.dim_of(axis)
+        plans.append(CommPlan(CommType.SPLIT, axis=axis, dst_dim=ddim))
+        cur = cur.with_split(ddim, axis)
+
+    # 3. Any partial axes the destination *wants* that source lacks are illegal.
+    if dst.partial - src.partial:
+        raise ValueError(f"cannot introduce partial: {src} -> {dst}")
+
+    return tuple(plans) if plans else (CommPlan(CommType.NONE),)
+
+
+# ---------------------------------------------------------------------------
+# Explicit conversion inside shard_map (the CommOp lowering itself).
+# ---------------------------------------------------------------------------
+
+def convert(x, src: DistributedStates, dst: DistributedStates):
+    """Apply the collectives converting `x` from layout src to dst.
+
+    Must be called inside a shard_map region whose mesh binds every axis named
+    by the layouts.  This is the executable form of SubstituteCommOp
+    (reference: executable_graph.cc:366): each CommPlan lowers to one XLA
+    collective on the bound axis.
+    """
+    for plan in deduce_comm(src, dst):
+        if plan.kind is CommType.NONE:
+            continue
+        elif plan.kind is CommType.ALL_REDUCE:
+            x = lax.psum(x, plan.axis)
+        elif plan.kind is CommType.REDUCE_SCATTER:
+            x = lax.psum_scatter(x, plan.axis, scatter_dimension=plan.dst_dim, tiled=True)
+        elif plan.kind is CommType.ALL_GATHER:
+            x = lax.all_gather(x, plan.axis, axis=plan.src_dim, tiled=True)
+        elif plan.kind is CommType.ALL_TO_ALL:
+            x = lax.all_to_all(x, plan.axis, split_axis=plan.dst_dim,
+                               concat_axis=plan.src_dim, tiled=True)
+        elif plan.kind is CommType.SPLIT:
+            idx = lax.axis_index(plan.axis)
+            size = lax.axis_size(plan.axis)
+            dim = plan.dst_dim
+            if x.shape[dim] % size != 0:
+                raise ValueError(
+                    f"cannot split dim {dim} of size {x.shape[dim]} over "
+                    f"axis {plan.axis!r} of size {size} (not divisible)")
+            chunk = x.shape[dim] // size
+            x = lax.dynamic_slice_in_dim(x, idx * chunk, chunk, axis=dim)
+        else:
+            raise NotImplementedError(plan)
+    return x
+
+
+# Convenience preset layouts mirroring the reference's ds_union_map presets
+# ('dup', 'split0', 'split0_dup', 'dup_split0' —
+#  reference: python/hetu/nn/modules/parallel_multi_ds.py).
+def dup(ndim: int) -> DistributedStates:
+    return DistributedStates.dup(ndim)
+
+
+def split0(ndim: int, axis: Union[str, Sequence[str]] = "tp") -> DistributedStates:
+    return DistributedStates.make(ndim, {0: axis})

@@ -1,0 +1,10 @@
+from hetu_tpu.nn.module import Module, ModuleList, ModuleDict, Sequential, ParamSpec
+from hetu_tpu.nn import initializers
+from hetu_tpu.nn.layers import (
+    Linear, Embedding, RMSNorm, LayerNorm, Dropout, Conv2d, MaxPool2d,
+    AvgPool2d, GELU, ReLU, SiLU,
+)
+from hetu_tpu.nn.parallel import (
+    ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,
+    ParallelRMSNorm, ParallelLayerNorm,
+)

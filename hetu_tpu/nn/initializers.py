@@ -1,0 +1,81 @@
+"""Parameter initializers (reference: hetu/graph/init/initializer.h)."""
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+
+
+def zeros(key, shape, dtype=jnp.float32):
+    return jnp.zeros(shape, dtype)
+
+
+def ones(key, shape, dtype=jnp.float32):
+    return jnp.ones(shape, dtype)
+
+
+def constant(value):
+    def init(key, shape, dtype=jnp.float32):
+        return jnp.full(shape, value, dtype)
+    return init
+
+
+def normal(stddev=0.02, mean=0.0):
+    def init(key, shape, dtype=jnp.float32):
+        return mean + stddev * jax.random.normal(key, shape, jnp.float32).astype(dtype)
+    return init
+
+
+def truncated_normal(stddev=0.02):
+    def init(key, shape, dtype=jnp.float32):
+        return (stddev * jax.random.truncated_normal(key, -2.0, 2.0, shape,
+                                                     jnp.float32)).astype(dtype)
+    return init
+
+
+def uniform(scale=0.05):
+    def init(key, shape, dtype=jnp.float32):
+        return jax.random.uniform(key, shape, jnp.float32, -scale, scale).astype(dtype)
+    return init
+
+
+def _fans(shape):
+    if len(shape) == 1:
+        return shape[0], shape[0]
+    if len(shape) == 2:
+        return shape[0], shape[1]
+    # conv kernels: (h, w, in, out) — receptive field multiplies both fans
+    rf = math.prod(shape[:-2])
+    return shape[-2] * rf, shape[-1] * rf
+
+
+def xavier_uniform(gain=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, fan_out = _fans(shape)
+        limit = gain * math.sqrt(6.0 / (fan_in + fan_out))
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+    return init
+
+
+def xavier_normal(gain=1.0):
+    def init(key, shape, dtype=jnp.float32):
+        std = gain * math.sqrt(2.0 / sum(_fans(shape)))
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+    return init
+
+
+def he_uniform():
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        limit = math.sqrt(6.0 / fan_in)
+        return jax.random.uniform(key, shape, jnp.float32, -limit, limit).astype(dtype)
+    return init
+
+
+def he_normal():
+    def init(key, shape, dtype=jnp.float32):
+        fan_in, _ = _fans(shape)
+        std = math.sqrt(2.0 / fan_in)
+        return (std * jax.random.normal(key, shape, jnp.float32)).astype(dtype)
+    return init

@@ -1,0 +1,166 @@
+"""Basic layers (reference: python/hetu/nn/modules/{linear,conv,normalization,
+dropout,activation,loss}.py).
+
+All layers follow the functional Module protocol: construction declares
+ParamSpecs (with optional DistributedStates layouts), forward is pure.
+"""
+from __future__ import annotations
+
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.nn import initializers as init
+from hetu_tpu.nn.module import Module
+from hetu_tpu import ops
+from hetu_tpu.dstates import DistributedStates
+
+
+class Linear(Module):
+    def __init__(self, in_features: int, out_features: int, bias: bool = True,
+                 param_dtype=jnp.float32, weight_init=None,
+                 weight_ds: Optional[DistributedStates] = None,
+                 bias_ds: Optional[DistributedStates] = None):
+        super().__init__()
+        self.in_features, self.out_features = in_features, out_features
+        # Weight stored [in, out] — row-major matmul feeds the MXU directly
+        # without the transpose the torch [out, in] convention would need.
+        self.param("weight", (in_features, out_features),
+                   weight_init or init.xavier_uniform(), dtype=param_dtype,
+                   ds=weight_ds)
+        self.use_bias = bias
+        if bias:
+            self.param("bias", (out_features,), init.zeros, dtype=param_dtype,
+                       ds=bias_ds)
+
+    def forward(self, params, x):
+        w = params["weight"].astype(x.dtype)
+        y = x @ w
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+class Embedding(Module):
+    def __init__(self, num_embeddings: int, embedding_dim: int,
+                 param_dtype=jnp.float32, weight_init=None,
+                 weight_ds: Optional[DistributedStates] = None):
+        super().__init__()
+        self.num_embeddings, self.embedding_dim = num_embeddings, embedding_dim
+        self.param("weight", (num_embeddings, embedding_dim),
+                   weight_init or init.normal(0.02), dtype=param_dtype,
+                   ds=weight_ds)
+
+    def forward(self, params, ids):
+        return jnp.take(params["weight"], ids, axis=0)
+
+
+class RMSNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-5, param_dtype=jnp.float32,
+                 weight_ds: Optional[DistributedStates] = None):
+        super().__init__()
+        self.eps = eps
+        self.param("weight", (dim,), init.ones, dtype=param_dtype, ds=weight_ds)
+
+    def forward(self, params, x):
+        return ops.rms_norm(x, params["weight"], self.eps)
+
+
+class LayerNorm(Module):
+    def __init__(self, dim: int, eps: float = 1e-5, bias: bool = True,
+                 param_dtype=jnp.float32):
+        super().__init__()
+        self.eps, self.use_bias = eps, bias
+        self.param("weight", (dim,), init.ones, dtype=param_dtype)
+        if bias:
+            self.param("bias", (dim,), init.zeros, dtype=param_dtype)
+
+    def forward(self, params, x):
+        return ops.layer_norm(x, params["weight"],
+                              params["bias"] if self.use_bias else None, self.eps)
+
+
+class Dropout(Module):
+    """Functional dropout: pass `rng=` and `deterministic=` at call time
+    (the reference keeps per-device RNG state for recompute determinism,
+    reference: hetu/impl/random/CUDARandomState.h; JAX PRNG keys subsume it)."""
+
+    def __init__(self, rate: float):
+        super().__init__()
+        self.rate = rate
+
+    def forward(self, params, x, *, rng: Optional[jax.Array] = None,
+                deterministic: bool = True):
+        if deterministic or self.rate == 0.0:
+            return x
+        keep = 1.0 - self.rate
+        mask = jax.random.bernoulli(rng, keep, x.shape)
+        return jnp.where(mask, x / keep, 0).astype(x.dtype)
+
+
+class Conv2d(Module):
+    """NHWC conv (TPU-native layout; reference Conv2d is NCHW CUDA,
+    hetu/graph/ops/Conv2d.cc)."""
+
+    def __init__(self, in_channels: int, out_channels: int, kernel_size: int,
+                 stride: int = 1, padding: str | int = "SAME", bias: bool = True,
+                 param_dtype=jnp.float32):
+        super().__init__()
+        k = kernel_size
+        self.stride = (stride, stride)
+        self.padding = padding if isinstance(padding, str) else [(padding, padding)] * 2
+        self.param("weight", (k, k, in_channels, out_channels), init.he_normal(),
+                   dtype=param_dtype)
+        self.use_bias = bias
+        if bias:
+            self.param("bias", (out_channels,), init.zeros, dtype=param_dtype)
+
+    def forward(self, params, x):
+        y = jax.lax.conv_general_dilated(
+            x, params["weight"].astype(x.dtype), window_strides=self.stride,
+            padding=self.padding, dimension_numbers=("NHWC", "HWIO", "NHWC"))
+        if self.use_bias:
+            y = y + params["bias"].astype(x.dtype)
+        return y
+
+
+class MaxPool2d(Module):
+    def __init__(self, kernel_size: int, stride: Optional[int] = None):
+        super().__init__()
+        self.k = kernel_size
+        self.s = stride or kernel_size
+
+    def forward(self, params, x):
+        return jax.lax.reduce_window(
+            x, -jnp.inf, jax.lax.max, (1, self.k, self.k, 1),
+            (1, self.s, self.s, 1), "VALID")
+
+
+class AvgPool2d(Module):
+    def __init__(self, kernel_size: int, stride: Optional[int] = None):
+        super().__init__()
+        self.k = kernel_size
+        self.s = stride or kernel_size
+
+    def forward(self, params, x):
+        summed = jax.lax.reduce_window(
+            x, 0.0, jax.lax.add, (1, self.k, self.k, 1),
+            (1, self.s, self.s, 1), "VALID")
+        return summed / float(self.k * self.k)
+
+
+class GELU(Module):
+    def forward(self, params, x):
+        return ops.gelu(x)
+
+
+class ReLU(Module):
+    def forward(self, params, x):
+        return ops.relu(x)
+
+
+class SiLU(Module):
+    def forward(self, params, x):
+        return ops.silu(x)

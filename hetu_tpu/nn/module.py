@@ -1,0 +1,199 @@
+"""Functional Module system.
+
+The reference exposes a torch-like stateful `hetu.nn.Module`
+(reference: python/hetu/nn/modules/module.py) whose parameters are graph
+variables.  On TPU the idiomatic form is functional: a Module instance is a
+*static description* (architecture + parameter specs + layouts) and parameters
+live in a pytree threaded through jit-compiled functions.  The API keeps the
+torch-ish construction style (attribute assignment auto-registers children,
+`ModuleList`, `Sequential`) while init/apply are pure:
+
+    model = Linear(4, 8)
+    params = model.init(jax.random.key(0))       # pytree of arrays
+    y = model.apply(params, x)                   # == model(params, x)
+
+Parameter layouts are `DistributedStates`; `model.shardings(mesh)` yields the
+matching NamedSharding pytree, and `model.init(key, mesh=mesh)` materializes
+parameters already sharded (via jit out_shardings), so trillion-parameter
+models never fully exist on one host.
+"""
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.dstates import DistributedStates
+
+
+@dataclasses.dataclass
+class ParamSpec:
+    """Declaration of one parameter (shape/dtype/init/distributed layout)."""
+
+    shape: Tuple[int, ...]
+    dtype: Any
+    init: Callable[[jax.Array, Tuple[int, ...], Any], jax.Array]
+    ds: Optional[DistributedStates] = None
+
+    def abstract(self) -> jax.ShapeDtypeStruct:
+        return jax.ShapeDtypeStruct(self.shape, self.dtype)
+
+
+class Module:
+    """Base module. Subclasses declare params/children in __init__ and
+    implement `forward(self, params, *args, **kwargs)`."""
+
+    def __init__(self):
+        object.__setattr__(self, "_params", {})
+        object.__setattr__(self, "_children", {})
+
+    # -- registration -------------------------------------------------------
+    def __setattr__(self, name, value):
+        if isinstance(value, Module):
+            self._children[name] = value
+        object.__setattr__(self, name, value)
+
+    def param(self, name: str, shape: Tuple[int, ...], init: Callable,
+              dtype=jnp.float32, ds: Optional[DistributedStates] = None) -> str:
+        """Declare a parameter; returns its key into the params pytree."""
+        self._params[name] = ParamSpec(tuple(int(s) for s in shape), dtype, init, ds)
+        return name
+
+    def add_module(self, name: str, module: "Module") -> "Module":
+        self._children[name] = module
+        object.__setattr__(self, name, module)
+        return module
+
+    # -- traversal ----------------------------------------------------------
+    def param_specs(self) -> Dict[str, Any]:
+        """Nested dict of ParamSpec mirroring the params pytree."""
+        out: Dict[str, Any] = dict(self._params)
+        for cname, child in self._children.items():
+            sub = child.param_specs()
+            if sub:
+                out[cname] = sub
+        return out
+
+    def named_modules(self, prefix: str = ""):
+        yield prefix or "", self
+        for cname, child in self._children.items():
+            yield from child.named_modules(f"{prefix}.{cname}" if prefix else cname)
+
+    # -- init / shardings ---------------------------------------------------
+    def abstract_params(self):
+        return jax.tree.map(
+            lambda spec: spec.abstract(), self.param_specs(),
+            is_leaf=lambda s: isinstance(s, ParamSpec))
+
+    def shardings(self, mesh):
+        """NamedSharding pytree for all params (replicated when no ds)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        def one(spec: ParamSpec):
+            if spec.ds is None:
+                return NamedSharding(mesh, P())
+            return spec.ds.named_sharding(mesh)
+
+        return jax.tree.map(one, self.param_specs(),
+                            is_leaf=lambda s: isinstance(s, ParamSpec))
+
+    def partition_specs(self):
+        from jax.sharding import PartitionSpec as P
+
+        def one(spec: ParamSpec):
+            return spec.ds.partition_spec() if spec.ds is not None else P()
+
+        return jax.tree.map(one, self.param_specs(),
+                            is_leaf=lambda s: isinstance(s, ParamSpec))
+
+    def init(self, key: jax.Array, mesh=None):
+        """Materialize parameters. With a mesh, init runs under jit with
+        sharded outputs so each device only materializes its shard
+        (the analog of reference ParallelVariableOp local init,
+        reference: hetu/graph/ops/variable.cc)."""
+        specs = self.param_specs()
+        leaves, treedef = jax.tree.flatten(
+            specs, is_leaf=lambda s: isinstance(s, ParamSpec))
+
+        def build(key):
+            keys = jax.random.split(key, len(leaves))
+            return treedef.unflatten([
+                spec.init(k, spec.shape, spec.dtype)
+                for k, spec in zip(keys, leaves)
+            ])
+
+        if mesh is None:
+            return build(key)
+        shardings = self.shardings(mesh)
+        with mesh:
+            return jax.jit(build, out_shardings=shardings)(key)
+
+    def num_params(self) -> int:
+        total = 0
+        for leaf in jax.tree.leaves(self.param_specs(),
+                                    is_leaf=lambda s: isinstance(s, ParamSpec)):
+            n = 1
+            for s in leaf.shape:
+                n *= s
+            total += n
+        return total
+
+    # -- forward ------------------------------------------------------------
+    def forward(self, params, *args, **kwargs):
+        raise NotImplementedError
+
+    def apply(self, params, *args, **kwargs):
+        return self.forward(params, *args, **kwargs)
+
+    def __call__(self, params, *args, **kwargs):
+        return self.forward(params, *args, **kwargs)
+
+
+class ModuleList(Module):
+    def __init__(self, modules: Optional[List[Module]] = None):
+        super().__init__()
+        self._list: List[Module] = []
+        for m in modules or []:
+            self.append(m)
+
+    def append(self, module: Module):
+        name = str(len(self._list))
+        self._list.append(module)
+        self._children[name] = module
+        return self
+
+    def __iter__(self):
+        return iter(self._list)
+
+    def __len__(self):
+        return len(self._list)
+
+    def __getitem__(self, i):
+        return self._list[i]
+
+    def items(self):
+        return [(str(i), m) for i, m in enumerate(self._list)]
+
+
+class Sequential(ModuleList):
+    def forward(self, params, x, **kwargs):
+        for name, m in self.items():
+            # param-less children (activations, pooling) have no subtree
+            x = m(params.get(name, {}), x, **kwargs)
+        return x
+
+
+class ModuleDict(Module):
+    def __init__(self, modules: Optional[Dict[str, Module]] = None):
+        super().__init__()
+        for k, v in (modules or {}).items():
+            self.add_module(k, v)
+
+    def __getitem__(self, k):
+        return self._children[k]
+
+    def items(self):
+        return self._children.items()

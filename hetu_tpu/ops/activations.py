@@ -1,0 +1,45 @@
+"""Activations (reference: hetu/graph/ops/{Gelu,Silu,SwiGLU,...}.cc).
+
+Plain jax.numpy — XLA fuses these into adjacent matmuls on TPU, which is why
+the reference's fused CUDA kernels (FusedUnary.cu, SwiGLU.cu) need no Pallas
+counterpart for the epilogue case.
+"""
+import jax
+import jax.numpy as jnp
+
+
+def relu(x):
+    return jnp.maximum(x, 0)
+
+
+def leaky_relu(x, negative_slope=0.01):
+    return jnp.where(x >= 0, x, negative_slope * x)
+
+
+def gelu(x, approximate=True):
+    return jax.nn.gelu(x, approximate=approximate)
+
+
+def silu(x):
+    return x * jax.nn.sigmoid(x)
+
+
+def sigmoid(x):
+    return jax.nn.sigmoid(x)
+
+
+def swiglu(gate, up):
+    """SwiGLU combine (reference: ops/SwiGLU.cc): silu(gate) * up."""
+    return silu(gate) * up
+
+
+def mish(x):
+    return x * jnp.tanh(jax.nn.softplus(x))
+
+
+def softplus(x):
+    return jax.nn.softplus(x)
+
+
+def hardswish(x):
+    return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0

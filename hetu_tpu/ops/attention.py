@@ -1,0 +1,68 @@
+"""Attention ops.
+
+`attention` is the reference composition (reference: hetu/graph/ops/Attention.cc)
+— a pure-XLA softmax attention used for golden tests and small models.
+
+`flash_attention` is the dispatcher for the fused path (reference:
+hetu/impl/kernel/FlashAttention.cu wrapping flash-attn 2): on TPU it routes to
+the Pallas flash kernel (hetu_tpu.ops.pallas.flash_attention) when shapes
+permit, else falls back to the XLA composition — XLA's own fusion of this
+pattern is already strong on TPU, so the fallback is safe, just more HBM
+traffic for long sequences.
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+
+
+def attention(q, k, v, *, causal: bool = True, bias: Optional[jnp.ndarray] = None,
+              segment_ids: Optional[jnp.ndarray] = None, softmax_scale: Optional[float] = None):
+    """Softmax attention. q,k,v: [batch, seq, heads, head_dim] (kv heads may be
+    fewer for GQA — broadcast here). Returns [batch, seq, heads, head_dim]."""
+    orig_dtype = q.dtype
+    b, sq, hq, d = q.shape
+    sk, hk = k.shape[1], k.shape[2]
+    if hk != hq:
+        rep = hq // hk
+        k = jnp.repeat(k, rep, axis=2)
+        v = jnp.repeat(v, rep, axis=2)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    # [b, h, sq, sk]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                        k.astype(jnp.float32)) * scale
+    if bias is not None:
+        scores = scores + bias
+    neg = jnp.finfo(jnp.float32).min
+    if causal:
+        mask = jnp.tril(jnp.ones((sq, sk), dtype=bool), k=sk - sq)
+        scores = jnp.where(mask[None, None], scores, neg)
+    if segment_ids is not None:
+        seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
+        scores = jnp.where(seg_mask[:, None], scores, neg)
+    probs = jax.nn.softmax(scores, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
+    return out.astype(orig_dtype)
+
+
+try:
+    from hetu_tpu.ops.pallas.flash_attention import flash_attention as _pallas_fa
+except ImportError:  # pallas kernel not built yet / not importable on CPU
+    _pallas_fa = None
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    softmax_scale: Optional[float] = None,
+                    use_pallas: Optional[bool] = None):
+    """Fused attention entry point. Routes to the Pallas TPU kernel when
+    running on TPU with compatible shapes; XLA composition otherwise."""
+    if use_pallas is None:
+        use_pallas = jax.default_backend() == "tpu" and _pallas_fa is not None
+    if use_pallas:
+        if _pallas_fa is None:
+            raise RuntimeError("use_pallas=True but the Pallas kernel is unavailable")
+        return _pallas_fa(q, k, v, causal=causal, segment_ids=segment_ids,
+                          softmax_scale=softmax_scale)
+    return attention(q, k, v, causal=causal, segment_ids=segment_ids,
+                     softmax_scale=softmax_scale)

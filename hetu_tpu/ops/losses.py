@@ -1,0 +1,93 @@
+"""Loss ops (reference: hetu/graph/ops/{SoftmaxCrossEntropy,
+SoftmaxCrossEntropySparse,VocabParallelCrossEntropyLoss,NLLLoss,KLDivLoss,
+MSELoss,BinaryCrossEntropy}.cc).
+
+`vocab_parallel_cross_entropy` is the TP-sharded vocab CE: logits arrive
+sharded on the vocab dim across the `tp` mesh axis and the max/denominator/
+target-logit terms are combined with psums — the same three-collective scheme
+as the reference's VocabParallelCrossEntropyLoss, expressed with lax collectives
+inside shard_map (or left to GSPMD in gspmd mode via the plain sparse CE).
+"""
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def softmax_cross_entropy(logits, labels_onehot, reduction: str = "mean"):
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1, keepdims=True)
+    loss = jnp.sum(labels_onehot * (logz - logits), axis=-1)
+    return _reduce(loss, reduction)
+
+
+def softmax_cross_entropy_sparse(logits, labels, ignore_index: int = -100,
+                                 reduction: str = "mean"):
+    """Sparse-label CE with ignored positions (the LM loss)."""
+    logits = logits.astype(jnp.float32)
+    logz = jax.nn.logsumexp(logits, axis=-1)
+    safe_labels = jnp.where(labels == ignore_index, 0, labels)
+    target = jnp.take_along_axis(logits, safe_labels[..., None], axis=-1)[..., 0]
+    loss = logz - target
+    mask = (labels != ignore_index).astype(jnp.float32)
+    loss = loss * mask
+    if reduction == "mean":
+        return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+    return _reduce(loss, reduction)
+
+
+def vocab_parallel_cross_entropy(local_logits, labels, vocab_start: int,
+                                 vocab_size_local: int, axis: str = "tp",
+                                 ignore_index: int = -100):
+    """CE over vocab-sharded logits inside a shard_map region.
+
+    local_logits: [tokens, vocab/tp] this shard's logits.
+    labels: [tokens] global vocab ids (replicated across tp).
+    Three collectives over `axis`: max, sum-exp, target-logit — mirroring the
+    reference kernel's allreduce(max)/allreduce(denom) scheme.
+    """
+    x = local_logits.astype(jnp.float32)
+    gmax = lax.pmax(jnp.max(x, axis=-1), axis)
+    sumexp = jnp.sum(jnp.exp(x - gmax[..., None]), axis=-1)
+    denom = lax.psum(sumexp, axis)
+    logz = jnp.log(denom) + gmax
+
+    in_range = (labels >= vocab_start) & (labels < vocab_start + vocab_size_local)
+    local_idx = jnp.clip(labels - vocab_start, 0, vocab_size_local - 1)
+    tgt = jnp.take_along_axis(x, local_idx[..., None], axis=-1)[..., 0]
+    tgt = jnp.where(in_range, tgt, 0.0)
+    target = lax.psum(tgt, axis)
+
+    mask = (labels != ignore_index).astype(jnp.float32)
+    loss = (logz - target) * mask
+    return jnp.sum(loss) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
+def mse_loss(pred, target, reduction: str = "mean"):
+    return _reduce(jnp.square(pred.astype(jnp.float32) - target.astype(jnp.float32)),
+                   reduction)
+
+
+def nll_loss(log_probs, labels, reduction: str = "mean"):
+    loss = -jnp.take_along_axis(log_probs, labels[..., None], axis=-1)[..., 0]
+    return _reduce(loss, reduction)
+
+
+def kl_div_loss(log_pred, target, reduction: str = "mean"):
+    loss = target * (jnp.log(jnp.maximum(target, 1e-20)) - log_pred)
+    return _reduce(jnp.sum(loss, axis=-1), reduction)
+
+
+def binary_cross_entropy(pred, target, eps: float = 1e-7, reduction: str = "mean"):
+    p = jnp.clip(pred.astype(jnp.float32), eps, 1.0 - eps)
+    loss = -(target * jnp.log(p) + (1.0 - target) * jnp.log1p(-p))
+    return _reduce(loss, reduction)
+
+
+def _reduce(loss, reduction: str):
+    if reduction == "mean":
+        return jnp.mean(loss)
+    if reduction == "sum":
+        return jnp.sum(loss)
+    return loss

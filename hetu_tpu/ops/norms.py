@@ -1,0 +1,28 @@
+"""Normalization ops (reference: hetu/impl/kernel/{RMSNorm,FusedLayerNorm}.cu).
+
+Computed in float32 regardless of input dtype (the reference's fused kernels
+accumulate in fp32), cast back to the input dtype at the end; XLA fuses the
+whole body into one VPU loop so a Pallas kernel is only warranted when fusing
+across op boundaries (see ops/pallas for the fused residual+norm variant).
+"""
+import jax.numpy as jnp
+
+
+def rms_norm(x, weight, eps: float = 1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(xf), axis=-1, keepdims=True)
+    y = xf * jnp.reciprocal(jnp.sqrt(var + eps))
+    return (y * weight.astype(jnp.float32)).astype(dtype)
+
+
+def layer_norm(x, weight, bias, eps: float = 1e-5):
+    dtype = x.dtype
+    xf = x.astype(jnp.float32)
+    mean = jnp.mean(xf, axis=-1, keepdims=True)
+    var = jnp.var(xf, axis=-1, keepdims=True)
+    y = (xf - mean) * jnp.reciprocal(jnp.sqrt(var + eps))
+    y = y * weight.astype(jnp.float32)
+    if bias is not None:
+        y = y + bias.astype(jnp.float32)
+    return y.astype(dtype)

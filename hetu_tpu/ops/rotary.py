@@ -1,0 +1,40 @@
+"""Rotary position embeddings (reference: hetu/impl/kernel/rotary.cu +
+python/hetu/models/llama/llama_model.py:10 RotaryEmbedding).
+
+Supports packed varlen batches via per-token position ids (the TPU analog of
+the reference's cu_seqlens-aware fused rotary): the data pipeline emits
+position ids that restart at each packed-sequence boundary, so one gather
+replaces the cu_seqlens offset logic.
+"""
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+def build_rope_cache(max_len: int, head_dim: int, base: float = 10000.0,
+                     dtype=jnp.float32):
+    """Precompute cos/sin tables [max_len, head_dim//2]."""
+    inv_freq = 1.0 / (base ** (jnp.arange(0, head_dim, 2, dtype=jnp.float32) / head_dim))
+    t = jnp.arange(max_len, dtype=jnp.float32)
+    freqs = jnp.outer(t, inv_freq)
+    return jnp.cos(freqs).astype(dtype), jnp.sin(freqs).astype(dtype)
+
+
+def apply_rotary(x, cos, sin, position_ids: Optional[jnp.ndarray] = None):
+    """Apply RoPE. x: [..., seq, heads, head_dim]; cos/sin: [max_len, hd//2];
+    position_ids: [..., seq] int32 (defaults to arange)."""
+    seq = x.shape[-3]
+    if position_ids is None:
+        cos_t = cos[:seq]
+        sin_t = sin[:seq]
+        # [seq, 1, hd/2] broadcasting over heads
+        cos_t = cos_t[:, None, :]
+        sin_t = sin_t[:, None, :]
+    else:
+        cos_t = cos[position_ids][..., None, :]
+        sin_t = sin[position_ids][..., None, :]
+    x1, x2 = jnp.split(x, 2, axis=-1)
+    xf1, xf2 = x1.astype(jnp.float32), x2.astype(jnp.float32)
+    out1 = xf1 * cos_t - xf2 * sin_t
+    out2 = xf2 * cos_t + xf1 * sin_t
+    return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)

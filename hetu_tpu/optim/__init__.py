@@ -1,0 +1,2 @@
+from hetu_tpu.optim.optimizer import Optimizer, AdamW, Adam, SGD, clip_by_global_norm
+from hetu_tpu.optim.grad_scaler import GradScaler

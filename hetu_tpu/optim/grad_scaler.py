@@ -1,0 +1,52 @@
+"""Dynamic loss scaling (reference: hetu/graph/autocast/gradscaler.h:33 +
+ops/CheckFinite.cc, ops/update_scale.cc).
+
+Only needed for float16 compute; bfloat16 (the TPU default) has fp32's range
+so the trainer leaves this off unless compute_dtype == float16 — kept for
+parity with the reference's AMP surface.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class GradScaler:
+    init_scale: float = 2.0 ** 16
+    growth_factor: float = 2.0
+    backoff_factor: float = 0.5
+    growth_interval: int = 2000
+
+    def init(self):
+        return {
+            "scale": jnp.asarray(self.init_scale, jnp.float32),
+            "growth_tracker": jnp.zeros((), jnp.int32),
+        }
+
+    def scale_loss(self, loss, state):
+        return loss * state["scale"].astype(loss.dtype)
+
+    def unscale_and_check(self, grads, state) -> Tuple[Any, jnp.ndarray]:
+        """Unscale grads; return (grads, all_finite) — CheckFinite analog."""
+        inv = 1.0 / state["scale"]
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        finite = jnp.all(jnp.stack([
+            jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)
+        ]))
+        return grads, finite
+
+    def update(self, state, all_finite):
+        """update_scale op: grow on streaks of finite steps, back off on inf."""
+        tracker = jnp.where(all_finite, state["growth_tracker"] + 1, 0)
+        grow = tracker >= self.growth_interval
+        scale = jnp.where(
+            all_finite,
+            jnp.where(grow, state["scale"] * self.growth_factor, state["scale"]),
+            state["scale"] * self.backoff_factor,
+        )
+        tracker = jnp.where(grow, 0, tracker)
+        return {"scale": scale, "growth_tracker": tracker}

@@ -1,0 +1,1 @@
+from hetu_tpu.parallel.strategy import ParallelStrategy

@@ -1,0 +1,129 @@
+"""Parallel strategy: the per-run binding of model dims to mesh axes.
+
+The reference expresses a strategy as a ds-parallel JSON (per-layer-block
+device groups + split/dup/zero maps, reference: python/hetu/utils/parallel/
+generate_ds.py:253) consumed by parallel nn modules.  Here a strategy is a
+small object that (a) names the mesh shape, (b) hands out DistributedStates
+for every parameter/activation role, and (c) knows the SP/ZeRO switches.
+Models ask the strategy for layouts instead of hard-coding them, so the same
+model code runs dense single-chip, TP, TP+SP, DP×TP×PP×CP, etc.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+from hetu_tpu.core.mesh import MeshConfig, create_mesh
+from hetu_tpu.dstates import DistributedStates as DS
+
+
+@dataclasses.dataclass(frozen=True)
+class ParallelStrategy:
+    """Strategy = mesh shape + behavior flags.
+
+    sequence_parallel: Megatron-SP — between-block activations sharded on the
+      seq dim over tp (reference: parallel_multi_ds.py:90 sequence_parallel).
+    zero: shard optimizer state (and master params) over dp — ZeRO-1
+      (reference: distributed_states.h:15 zero flag + bridge subgraphs).
+    """
+
+    mesh: MeshConfig = MeshConfig()
+    sequence_parallel: bool = False
+    zero: bool = True
+
+    # -- mesh ---------------------------------------------------------------
+    def build_mesh(self, devices=None):
+        return create_mesh(self.mesh, devices=devices)
+
+    @property
+    def tp(self) -> int:
+        return self.mesh.tp
+
+    @property
+    def dp(self) -> int:
+        return self.mesh.dp
+
+    @property
+    def cp(self) -> int:
+        return self.mesh.cp
+
+    @property
+    def pp(self) -> int:
+        return self.mesh.pp
+
+    @property
+    def ep(self) -> int:
+        return self.mesh.ep
+
+    # -- parameter layouts (Megatron-style TP over the tp axis) -------------
+    def col_weight(self, ndim: int = 2) -> Optional[DS]:
+        """Column-parallel weight [in, out]: out dim sharded.
+        (reference: HtMultiColumnParallelLinear, parallel_multi_ds.py:328)"""
+        return DS.make(ndim, {ndim - 1: "tp"}) if self.tp > 1 else None
+
+    def row_weight(self, ndim: int = 2) -> Optional[DS]:
+        """Row-parallel weight [in, out]: in dim sharded."""
+        return DS.make(ndim, {ndim - 2: "tp"}) if self.tp > 1 else None
+
+    def col_bias(self) -> Optional[DS]:
+        return DS.make(1, {0: "tp"}) if self.tp > 1 else None
+
+    def vocab_weight(self) -> Optional[DS]:
+        """Vocab-parallel embedding [vocab, hidden]
+        (reference: HtMultiVocabParallelEmbedding, parallel_multi_ds.py:268)."""
+        return DS.make(2, {0: "tp"}) if self.tp > 1 else None
+
+    def replicated(self, ndim: int) -> Optional[DS]:
+        return None
+
+    # -- activation layouts --------------------------------------------------
+    # Activations are [batch, seq, hidden]; batch shards over dp, seq over cp
+    # (the reference's fused "dcp" input dim, trainer.py:208-260), and over tp
+    # too in SP regions.
+    def act_hidden(self) -> DS:
+        """Between-block activations."""
+        seq_axes: Tuple[str, ...] = ("cp",) if self.cp > 1 else ()
+        if self.sequence_parallel and self.tp > 1:
+            seq_axes = seq_axes + ("tp",)
+        splits = {}
+        if self.dp > 1:
+            splits[0] = "dp"
+        if seq_axes:
+            splits[1] = seq_axes
+        return DS.make(3, splits)
+
+    def act_inner(self) -> DS:
+        """Activations inside attention/MLP: last dim tp-sharded."""
+        splits = {}
+        if self.dp > 1:
+            splits[0] = "dp"
+        if self.cp > 1:
+            splits[1] = "cp"
+        if self.tp > 1:
+            splits[2] = "tp"
+        return DS.make(3, splits)
+
+    def act_tokens(self) -> DS:
+        """Token-id tensors [batch, seq]."""
+        splits = {}
+        if self.dp > 1:
+            splits[0] = "dp"
+        if self.cp > 1:
+            splits[1] = "cp"
+        return DS.make(2, splits)
+
+    def constrain(self, x, ds: Optional[DS]):
+        if ds is None:
+            return x
+        return ds.constrain(x)
+
+    def describe(self) -> str:
+        bits = [str(self.mesh)]
+        if self.sequence_parallel:
+            bits.append("sp")
+        if self.zero:
+            bits.append("zero1")
+        return "+".join(bits)
+
+
+SINGLE = ParallelStrategy()

@@ -1,0 +1,160 @@
+"""DistributedStates algebra tests.
+
+Mirrors the reference's DS semantics checks (reference: tests/test_parallel.py:8-12
+layout table; hetu/graph/distributed_states.h:110-116 check_* predicates) but
+runs hardware-free on the virtual CPU mesh.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+from jax import shard_map
+
+import hetu_tpu as ht
+from hetu_tpu.dstates import CommPlan, CommType, DistributedStates as DS, deduce_comm, convert
+
+
+def test_make_and_pspec():
+    ds = DS.make(3, {0: "dp", 2: "tp"})
+    assert ds.partition_spec() == P("dp", None, "tp")
+    assert ds.dim_of("tp") == 2 and ds.dim_of("dp") == 0
+    assert ds.dim_of("pp") is None
+    assert ds.is_resolved()
+
+
+def test_partial_blocks_sharding_emission():
+    ds = DS.make(2, {0: "dp"}, partial=("tp",))
+    assert not ds.is_resolved()
+    mesh = ht.create_mesh(dp=2, tp=2)
+    with pytest.raises(ValueError):
+        ds.named_sharding(mesh)
+    assert ds.reduced().named_sharding(mesh) is not None
+
+
+def test_axis_cannot_shard_two_dims():
+    with pytest.raises(ValueError):
+        DS.make(2, {0: "tp", 1: "tp"}).validate()
+
+
+def test_deduce_allreduce():
+    # Row-parallel linear output: partial over tp -> replicated (Megatron g).
+    src = DS.make(2, {0: "dp"}, partial=("tp",))
+    dst = DS.make(2, {0: "dp"})
+    (plan,) = deduce_comm(src, dst)
+    assert plan.kind is CommType.ALL_REDUCE and plan.axis == "tp"
+
+
+def test_deduce_reduce_scatter_for_sp():
+    # Megatron-SP: partial over tp -> sequence dim sharded over tp.
+    src = DS.make(3, {0: "dp"}, partial=("tp",))
+    dst = DS.make(3, {0: "dp", 1: "tp"})
+    (plan,) = deduce_comm(src, dst)
+    assert plan.kind is CommType.REDUCE_SCATTER and plan.axis == "tp" and plan.dst_dim == 1
+
+
+def test_deduce_allgather_and_split():
+    src = DS.make(2, {0: "tp"})
+    dst = DS.dup(2)
+    (plan,) = deduce_comm(src, dst)
+    assert plan.kind is CommType.ALL_GATHER and plan.src_dim == 0
+    plans = deduce_comm(dst, src)
+    assert plans[0].kind is CommType.SPLIT and plans[0].dst_dim == 0
+
+
+def test_deduce_all_to_all():
+    src = DS.make(2, {0: "cp"})
+    dst = DS.make(2, {1: "cp"})
+    (plan,) = deduce_comm(src, dst)
+    assert plan.kind is CommType.ALL_TO_ALL and plan.src_dim == 0 and plan.dst_dim == 1
+
+
+def test_deduce_none():
+    ds = DS.make(2, {0: "dp"})
+    (plan,) = deduce_comm(ds, ds)
+    assert plan.kind is CommType.NONE
+
+
+# ---------------------------------------------------------------------------
+# Executable conversion inside shard_map: numeric golden tests.
+# ---------------------------------------------------------------------------
+
+def _run_convert(mesh, x, src, dst):
+    fn = shard_map(
+        lambda v: convert(v, src, dst),
+        mesh=mesh,
+        in_specs=src.reduced().partition_spec(),
+        out_specs=dst.partition_spec(),
+        check_vma=False,
+    )
+    return jax.jit(fn)(x)
+
+
+def test_convert_allreduce_numeric():
+    mesh = ht.create_mesh(dp=2, tp=4)
+    x = jnp.arange(16, dtype=jnp.float32).reshape(4, 4)
+    # value replicated per-shard; partial over tp means global value = psum
+    src = DS.make(2, {}, partial=("tp",))
+    dst = DS.dup(2)
+    out = _run_convert(mesh, x, src, dst)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 4)
+
+
+def test_convert_allgather_roundtrip():
+    mesh = ht.create_mesh(tp=4)
+    x = jnp.arange(32, dtype=jnp.float32).reshape(8, 4)
+    src, dst = DS.make(2, {0: "tp"}), DS.dup(2)
+    out = _run_convert(mesh, x, src, dst)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+    back = _run_convert(mesh, out, dst, src)
+    np.testing.assert_allclose(np.asarray(back), np.asarray(x))
+
+
+def test_convert_all_to_all_numeric():
+    mesh = ht.create_mesh(cp=4)
+    x = jnp.arange(4 * 8, dtype=jnp.float32).reshape(4, 8)
+    src, dst = DS.make(2, {0: "cp"}), DS.make(2, {1: "cp"})
+    out = _run_convert(mesh, x, src, dst)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_convert_reduce_scatter_matches_allreduce_slice():
+    mesh = ht.create_mesh(tp=4)
+    x = jnp.arange(8 * 4, dtype=jnp.float32).reshape(8, 4)
+    src = DS.make(2, {}, partial=("tp",))
+    dst = DS.make(2, {0: "tp"})
+    out = _run_convert(mesh, x, src, dst)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x) * 4)
+
+
+def test_mesh_axis_order_and_sizes():
+    mesh = ht.create_mesh(dp=2, tp=2, pp=2)
+    assert mesh.shape["dp"] == 2 and mesh.shape["tp"] == 2 and mesh.shape["pp"] == 2
+    assert mesh.shape["cp"] == 1 and mesh.shape["ep"] == 1
+    assert ht.mesh_axis_size(mesh, "tp") == 2
+
+
+def test_int_symbol():
+    s = ht.IntSymbol(name="seq")
+    t = (s + 16) * 2
+    s.set_data(48)
+    assert int(t) == 128
+    assert int(s // 4) == 12
+
+
+def test_convert_tp_to_dp_reshard_preserves_order():
+    # Regression: gather must precede split or rows come back interleaved.
+    mesh = ht.create_mesh(dp=2, tp=2)
+    x = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+    src, dst = DS.make(2, {0: "tp"}), DS.make(2, {0: "dp"})
+    out = _run_convert(mesh, x, src, dst)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_convert_multi_axis_gather_order():
+    # dim0 sharded (dp outer, tp inner) -> replicated: inner gathered first.
+    mesh = ht.create_mesh(dp=2, tp=2)
+    x = jnp.arange(8 * 2, dtype=jnp.float32).reshape(8, 2)
+    src, dst = DS.make(2, {0: ("dp", "tp")}), DS.dup(2)
+    out = _run_convert(mesh, x, src, dst)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(x))

@@ -1,0 +1,93 @@
+"""Benchmark: LLaMA training throughput on the available TPU chip(s).
+
+Prints ONE JSON line: {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}.
+
+Metric: model FLOPs utilization (MFU) of a bf16 LLaMA training step at the
+largest config that fits the chip.  vs_baseline is measured MFU / 0.45 — the
+45%-MFU-on-v5p target recorded in BASELINE.md (the reference repo publishes no
+absolute numbers, BASELINE.md "Published numbers: None").
+"""
+from __future__ import annotations
+
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+
+    import hetu_tpu as ht
+    from hetu_tpu import optim
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    # Single v5e-class chip (16G HBM): ~440M params fp32 Adam + bf16 compute.
+    if on_tpu:
+        cfg = LlamaConfig(
+            vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+            num_hidden_layers=12, num_attention_heads=12,
+            num_key_value_heads=12, max_position_embeddings=2048,
+            remat=True, use_scan=True)
+        batch, seq, iters = 8, 2048, 6
+        # v5e: 197 TFLOP/s bf16 peak; v5p would be 459.
+        peak_flops = 197e12
+    else:  # CPU smoke mode so the script always runs
+        cfg = LlamaConfig.tiny()
+        batch, seq, iters = 2, 128, 3
+        peak_flops = 1e12
+
+    model = LlamaLMHeadModel(cfg)
+    opt = optim.AdamW(lr=1e-4)
+    params = model.init(jax.random.key(0))
+    opt_state = opt.init(params)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch, seq)), jnp.int32)
+
+    def _step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: model(p, ids, labels=ids))(params)
+        params, opt_state = opt.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    step = jax.jit(_step, donate_argnums=(0, 1))
+
+    # warmup/compile. NOTE: on the axon remote-TPU backend block_until_ready
+    # is effectively a no-op; a host fetch of the scalar loss is the reliable
+    # sync point, so time with float(loss) every iteration.
+    params, opt_state, loss = step(params, opt_state, ids)
+    float(loss)
+
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, ids)
+        float(loss)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+
+    tokens_per_sec = batch * seq / dt
+    flops_per_token = cfg.flops_per_token(seq)
+    mfu = tokens_per_sec * flops_per_token / peak_flops
+
+    print(json.dumps({
+        "metric": "llama_train_mfu",
+        "value": round(float(mfu), 4),
+        "unit": "fraction_of_peak",
+        "vs_baseline": round(float(mfu) / 0.45, 4),
+        "detail": {
+            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+            "step_time_s": round(dt, 4),
+            "model_params_m": round(cfg.num_params() / 1e6, 1),
+            "batch": batch, "seq": seq,
+            "backend": jax.default_backend(),
+        },
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

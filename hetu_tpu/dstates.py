@@ -145,6 +145,12 @@ class DistributedStates:
         """Layout after the pending partial sum is reduced (psum)."""
         return dataclasses.replace(self, partial=frozenset())
 
+    def shifted(self, n: int = 1, lead: Tuple[DimSpec, ...] = ((),)) -> "DistributedStates":
+        """Layout with `n` new leading dims prepended (for stacked/scanned
+        params: per-layer weights gain a leading layer dim)."""
+        assert len(lead) == n
+        return dataclasses.replace(self, spec=tuple(lead) + self.spec)
+
     # -- emission to JAX ----------------------------------------------------
     def partition_spec(self) -> P:
         return P(*[axes if axes else None for axes in self.spec])
@@ -239,60 +245,68 @@ def deduce_comm(src: DistributedStates, dst: DistributedStates) -> Tuple[CommPla
     if src == dst:
         return (CommPlan(CommType.NONE),)
 
+    if dst.partial - src.partial:
+        raise ValueError(f"cannot introduce partial: {src} -> {dst}")
+
     plans = []
     cur = src
 
     # 1. Resolve partial sums. Fuse into reduce-scatter when the destination
-    #    shards a currently-unsharded dim over the same axis (the TP/SP and
-    #    ZeRO-bridge pattern, reference: ops/Communication.h:786 SplitReduceScatter).
+    #    appends exactly this axis (innermost) to an otherwise-unchanged dim
+    #    (the TP/SP and ZeRO-bridge pattern, reference:
+    #    ops/Communication.h:786 SplitReduceScatter); else plain all-reduce.
     for axis in sorted(cur.partial):
         if axis in dst.partial:
             continue  # stays partial
         ddim = dst.dim_of(axis)
-        if ddim is not None and axis not in cur.spec[ddim]:
+        fuse = (
+            ddim is not None
+            and dst.spec[ddim] == cur.spec[ddim] + (axis,)
+        )
+        if fuse:
             plans.append(CommPlan(CommType.REDUCE_SCATTER, axis=axis, dst_dim=ddim))
             cur = dataclasses.replace(cur, partial=cur.partial - {axis}).with_split(ddim, axis)
         else:
             plans.append(CommPlan(CommType.ALL_REDUCE, axis=axis))
             cur = dataclasses.replace(cur, partial=cur.partial - {axis})
 
-    # 2. Per-axis moves between dims. Ordering matters for correctness:
-    #    (a) all-to-all moves (axis stays sharded, dim changes);
-    #    (b) all-gathers, innermost axis of each dim first (gathering an outer
-    #        axis while an inner one is still sharded would interleave blocks);
-    #    (c) splits last, once the value is replicated over the split axes.
-    moves, gathers, splits_ = [], [], []
-    for axis in sorted(cur.sharded_axes() | dst.sharded_axes()):
+    # 2a. Pure single-axis dim transposes lower to one all-to-all
+    #     (the CP token<->head move); anything fancier uses gather+split.
+    for axis in sorted(cur.sharded_axes()):
         sdim, ddim = cur.dim_of(axis), dst.dim_of(axis)
-        if sdim == ddim:
-            continue
-        if sdim is not None and ddim is not None:
-            moves.append(axis)
-        elif sdim is not None:
-            gathers.append(axis)
-        else:
-            splits_.append(axis)
+        if (sdim is not None and ddim is not None and sdim != ddim
+                and cur.spec[sdim] == (axis,) and dst.spec[sdim] == ()
+                and cur.spec[ddim] == () and dst.spec[ddim] == (axis,)):
+            plans.append(CommPlan(CommType.ALL_TO_ALL, axis=axis, src_dim=sdim, dst_dim=ddim))
+            cur = cur.without_axis(axis).with_split(ddim, axis)
 
-    for axis in moves:
-        sdim, ddim = cur.dim_of(axis), dst.dim_of(axis)
-        plans.append(CommPlan(CommType.ALL_TO_ALL, axis=axis, src_dim=sdim, dst_dim=ddim))
-        cur = cur.without_axis(axis).with_split(ddim, axis)
-    # innermost-first: sort by (dim, -position in that dim's axis tuple)
-    gathers.sort(key=lambda a: (cur.dim_of(a), -cur.spec[cur.dim_of(a)].index(a)))
-    for axis in gathers:
-        sdim = cur.dim_of(axis)
-        plans.append(CommPlan(CommType.ALL_GATHER, axis=axis, src_dim=sdim))
-        cur = cur.without_axis(axis)
-    for axis in splits_:
-        ddim = dst.dim_of(axis)
-        plans.append(CommPlan(CommType.SPLIT, axis=axis, dst_dim=ddim))
-        cur = cur.with_split(ddim, axis)
+    # 2b. Per dim: gather (innermost first) until the current axes are a
+    #     prefix of the destination's — gathering an outer axis while an
+    #     inner one is still sharded would interleave blocks.
+    for d in range(cur.ndim):
+        while cur.spec[d] and not _is_prefix(cur.spec[d], dst.spec[d]):
+            axis = cur.spec[d][-1]
+            plans.append(CommPlan(CommType.ALL_GATHER, axis=axis, src_dim=d))
+            cur = cur.without_axis(axis)
 
-    # 3. Any partial axes the destination *wants* that source lacks are illegal.
-    if dst.partial - src.partial:
-        raise ValueError(f"cannot introduce partial: {src} -> {dst}")
+    # 2c. Per dim: split the missing destination axes outer-to-inner, so the
+    #     final per-dim axis order matches dst exactly.
+    for d in range(cur.ndim):
+        for axis in dst.spec[d][len(cur.spec[d]):]:
+            if cur.dim_of(axis) is not None:
+                raise NotImplementedError(
+                    f"generic reshard not planned: {src} -> {dst} (axis {axis})")
+            plans.append(CommPlan(CommType.SPLIT, axis=axis, dst_dim=d))
+            cur = cur.with_split(d, axis)
+
+    if cur.spec != dst.spec:
+        raise NotImplementedError(f"reshard plan failed: {src} -> {dst} (got {cur})")
 
     return tuple(plans) if plans else (CommPlan(CommType.NONE),)
+
+
+def _is_prefix(a: Tuple, b: Tuple) -> bool:
+    return len(a) <= len(b) and b[: len(a)] == a
 
 
 # ---------------------------------------------------------------------------

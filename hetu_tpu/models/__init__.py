@@ -1,0 +1,1 @@
+from hetu_tpu.models.llama import LlamaConfig, LlamaModel, LlamaLMHeadModel

@@ -1,0 +1,4 @@
+from hetu_tpu.models.llama.config import LlamaConfig
+from hetu_tpu.models.llama.model import (
+    LlamaAttention, LlamaMLP, LlamaBlock, LlamaModel, LlamaLMHeadModel,
+)

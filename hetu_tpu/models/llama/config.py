@@ -1,0 +1,89 @@
+"""LLaMA configuration (reference: python/hetu/models/llama/llama_config.py +
+HF-compatible PreTrainedConfig, models/utils/config_utils.py:9)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class LlamaConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_hidden_layers: int = 32
+    num_attention_heads: int = 32
+    num_key_value_heads: Optional[int] = None  # None -> MHA
+    max_position_embeddings: int = 4096
+    rms_norm_eps: float = 1e-5
+    rope_theta: float = 10000.0
+    tie_word_embeddings: bool = False
+    initializer_range: float = 0.02
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+
+    # TPU-build knobs
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.bfloat16
+    use_scan: bool = True          # lax.scan over layers (compile-time + pipeline friendly)
+    remat: bool = True             # gradient checkpointing per block
+                                   # (reference: recompute/recompute.cc pass)
+    use_flash_attention: bool = True
+
+    def __post_init__(self):
+        if self.num_key_value_heads is None:
+            self.num_key_value_heads = self.num_attention_heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    # -- canonical sizes ----------------------------------------------------
+    @staticmethod
+    def tiny(**kw) -> "LlamaConfig":
+        """Test-scale config."""
+        d = dict(vocab_size=256, hidden_size=64, intermediate_size=176,
+                 num_hidden_layers=2, num_attention_heads=4,
+                 num_key_value_heads=2, max_position_embeddings=256)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
+    def llama2_7b(**kw) -> "LlamaConfig":
+        d = dict(vocab_size=32000, hidden_size=4096, intermediate_size=11008,
+                 num_hidden_layers=32, num_attention_heads=32,
+                 num_key_value_heads=32, max_position_embeddings=4096)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
+    def llama2_13b(**kw) -> "LlamaConfig":
+        d = dict(vocab_size=32000, hidden_size=5120, intermediate_size=13824,
+                 num_hidden_layers=40, num_attention_heads=40,
+                 num_key_value_heads=40, max_position_embeddings=4096)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    @staticmethod
+    def llama3_8b(**kw) -> "LlamaConfig":
+        d = dict(vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+                 num_hidden_layers=32, num_attention_heads=32,
+                 num_key_value_heads=8, max_position_embeddings=8192,
+                 rope_theta=500000.0)
+        d.update(kw)
+        return LlamaConfig(**d)
+
+    def num_params(self) -> int:
+        h, i, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_hidden_layers
+        kvh = self.num_key_value_heads * self.head_dim
+        per_layer = h * (h + 2 * kvh + h) + 3 * h * i + 2 * h  # attn + mlp + norms
+        emb = v * h * (1 if self.tie_word_embeddings else 2)
+        return L * per_layer + emb + h
+
+    def flops_per_token(self, seq_len: int) -> float:
+        """Approximate training FLOPs/token (fwd+bwd = 6*N + attention term)."""
+        n = self.num_params()
+        attn = 12 * self.num_hidden_layers * self.hidden_size * seq_len
+        return 6.0 * n + attn

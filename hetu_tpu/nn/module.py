@@ -152,6 +152,27 @@ class Module:
         return self.forward(params, *args, **kwargs)
 
 
+def stacked_spec(spec: ParamSpec, num: int) -> ParamSpec:
+    """Lift a ParamSpec to a stack of `num` independent copies with a leading
+    layer dim — used by scan-over-layers decoder stacks.  Init vmaps the base
+    initializer over per-layer keys; the layout gains an unsharded leading dim
+    (pipeline stages shard it later via the pipeline engine)."""
+    base_init = spec.init
+
+    def init(key, shape, dtype):
+        keys = jax.random.split(key, shape[0])
+        return jax.vmap(lambda k: base_init(k, shape[1:], dtype))(keys)
+
+    ds = spec.ds.shifted(1) if spec.ds is not None else None
+    return ParamSpec((num,) + spec.shape, spec.dtype, init, ds)
+
+
+def stack_param_specs(specs, num: int):
+    """Map stacked_spec over a nested spec dict."""
+    return jax.tree.map(lambda s: stacked_spec(s, num), specs,
+                        is_leaf=lambda s: isinstance(s, ParamSpec))
+
+
 class ModuleList(Module):
     def __init__(self, modules: Optional[List[Module]] = None):
         super().__init__()

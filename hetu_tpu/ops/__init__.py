@@ -7,7 +7,7 @@ functional forms plus the hand-written Pallas kernels for the hot ops
 (flash attention, fused norms, rotary) and the collective-based ops
 (ring attention, vocab-parallel CE).
 """
-from hetu_tpu.ops.activations import gelu, silu, swiglu, relu, leaky_relu, mish, softplus, hardswish, sigmoid
+from hetu_tpu.ops.activations import gelu, silu, swiglu, relu, leaky_relu, mish, softplus, hardswish, sigmoid, dropout
 from hetu_tpu.ops.norms import rms_norm, layer_norm
 from hetu_tpu.ops.rotary import build_rope_cache, apply_rotary
 from hetu_tpu.ops.losses import (

@@ -43,3 +43,12 @@ def softplus(x):
 
 def hardswish(x):
     return x * jnp.clip(x + 3.0, 0.0, 6.0) / 6.0
+
+
+def dropout(x, rate: float, rng=None, deterministic: bool = True):
+    """Functional dropout (reference: hetu/graph/ops/Dropout.cc)."""
+    if deterministic or rate == 0.0 or rng is None:
+        return x
+    keep = 1.0 - rate
+    mask = jax.random.bernoulli(rng, keep, x.shape)
+    return jnp.where(mask, x / keep, 0).astype(x.dtype)

@@ -17,7 +17,8 @@ import jax.numpy as jnp
 
 
 def attention(q, k, v, *, causal: bool = True, bias: Optional[jnp.ndarray] = None,
-              segment_ids: Optional[jnp.ndarray] = None, softmax_scale: Optional[float] = None):
+              segment_ids: Optional[jnp.ndarray] = None, softmax_scale: Optional[float] = None,
+              dropout_rate: float = 0.0, dropout_rng: Optional[jnp.ndarray] = None):
     """Softmax attention. q,k,v: [batch, seq, heads, head_dim] (kv heads may be
     fewer for GQA — broadcast here). Returns [batch, seq, heads, head_dim]."""
     orig_dtype = q.dtype
@@ -41,6 +42,10 @@ def attention(q, k, v, *, causal: bool = True, bias: Optional[jnp.ndarray] = Non
         seg_mask = segment_ids[:, :, None] == segment_ids[:, None, :]
         scores = jnp.where(seg_mask[:, None], scores, neg)
     probs = jax.nn.softmax(scores, axis=-1)
+    if dropout_rate > 0.0 and dropout_rng is not None:
+        keep = 1.0 - dropout_rate
+        mask2 = jax.random.bernoulli(dropout_rng, keep, probs.shape)
+        probs = jnp.where(mask2, probs / keep, 0.0)
     out = jnp.einsum("bhqk,bkhd->bqhd", probs, v.astype(jnp.float32))
     return out.astype(orig_dtype)
 

@@ -28,7 +28,8 @@ class GradScaler:
         }
 
     def scale_loss(self, loss, state):
-        return loss * state["scale"].astype(loss.dtype)
+        # promote to fp32 before scaling: 2**16 overflows float16's max
+        return loss.astype(jnp.float32) * state["scale"]
 
     def unscale_and_check(self, grads, state) -> Tuple[Any, jnp.ndarray]:
         """Unscale grads; return (grads, all_finite) — CheckFinite analog."""

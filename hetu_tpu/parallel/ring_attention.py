@@ -1,0 +1,31 @@
+"""Context-parallel attention.
+
+The reference's CP engine is ring attention with hetero rings
+(reference: hetu/graph/ops/ParallelAttention.{h,cc} — AttnCommRing ring
+KV-passing with online-softmax LSE merge, overlap, and STRIPE/SYM causal
+balance).  Two TPU implementations live here:
+
+1. `ring_attention` (shard_map + ppermute + per-block flash attention with
+   LSE accumulation) — the faithful ring, comm overlapped by XLA's async
+   collective-permute.  [M4]
+2. `ring_attention_gspmd` — global-view fallback: computation is written
+   globally and GSPMD materializes KV via all-gather over the cp axis.
+   Correct for any layout; O(seq) memory for KV on each cp shard, so it is
+   the fallback, not the destination.
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+
+from hetu_tpu import ops
+from hetu_tpu.parallel.strategy import ParallelStrategy
+
+
+def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
+                         segment_ids: Optional[jnp.ndarray] = None):
+    """Global-view CP attention: inputs seq-sharded over cp; GSPMD inserts
+    the all-gather of K/V. Output constrained back to cp-sharded."""
+    out = ops.attention(q, k, v, causal=True, segment_ids=segment_ids)
+    return strategy.constrain(out, strategy.act_attn())

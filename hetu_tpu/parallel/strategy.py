@@ -77,40 +77,55 @@ class ParallelStrategy:
         return None
 
     # -- activation layouts --------------------------------------------------
-    # Activations are [batch, seq, hidden]; batch shards over dp, seq over cp
+    # Activations are [batch, seq, ...]; batch shards over dp, seq over cp
     # (the reference's fused "dcp" input dim, trainer.py:208-260), and over tp
-    # too in SP regions.
-    def act_hidden(self) -> DS:
-        """Between-block activations."""
-        seq_axes: Tuple[str, ...] = ("cp",) if self.cp > 1 else ()
-        if self.sequence_parallel and self.tp > 1:
-            seq_axes = seq_axes + ("tp",)
-        splits = {}
+    # too in SP regions.  All layouts flow through _act so the axis policy
+    # lives in exactly one place.
+    def _act(self, ndim: int, tp_dim: Optional[int],
+             seq_tp: bool = False) -> DS:
+        """[batch, seq, ...rest] layout: dp on dim 0, cp on dim 1, tp on
+        `tp_dim` (or on the seq dim when seq_tp — SP regions)."""
+        splits: dict = {}
         if self.dp > 1:
-            splits[0] = "dp"
+            splits[0] = ("dp",)
+        seq_axes: Tuple[str, ...] = ("cp",) if self.cp > 1 else ()
+        if seq_tp and self.sequence_parallel and self.tp > 1:
+            seq_axes = seq_axes + ("tp",)
         if seq_axes:
             splits[1] = seq_axes
-        return DS.make(3, splits)
+        if not seq_tp and tp_dim is not None and self.tp > 1:
+            splits[tp_dim] = ("tp",)
+        return DS.make(ndim, splits)
+
+    def act_hidden(self) -> DS:
+        """Between-block activations [b, s, h] (seq tp-sharded in SP)."""
+        return self._act(3, None, seq_tp=True)
 
     def act_inner(self) -> DS:
-        """Activations inside attention/MLP: last dim tp-sharded."""
-        splits = {}
-        if self.dp > 1:
-            splits[0] = "dp"
-        if self.cp > 1:
-            splits[1] = "cp"
-        if self.tp > 1:
-            splits[2] = "tp"
-        return DS.make(3, splits)
+        """Activations inside attention/MLP [b, s, f]: last dim tp-sharded."""
+        return self._act(3, 2)
+
+    def act_attn(self) -> DS:
+        """Per-head activations [b, s, heads, hd]: heads shard over tp
+        (inside attention the seq dim is only cp-sharded — SP ends at the
+        qkv projection)."""
+        return self._act(4, 2)
+
+    def act_qkv(self) -> DS:
+        """Fused qkv activations [b, s, n_kv, group+2, hd]: kv-head dim tp."""
+        return self._act(5, 2)
+
+    def act_gate_up(self) -> DS:
+        """Fused gate/up activations [b, s, 2, intermediate]: last dim tp."""
+        return self._act(4, 3)
+
+    def act_logits(self) -> DS:
+        """LM logits [b, s, vocab]: vocab dim tp-sharded."""
+        return self._act(3, 2)
 
     def act_tokens(self) -> DS:
         """Token-id tensors [batch, seq]."""
-        splits = {}
-        if self.dp > 1:
-            splits[0] = "dp"
-        if self.cp > 1:
-            splits[1] = "cp"
-        return DS.make(2, splits)
+        return self._act(2, None)
 
     def constrain(self, x, ds: Optional[DS]):
         if ds is None:

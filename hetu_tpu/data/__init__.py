@@ -1,0 +1,4 @@
+from hetu_tpu.data.bucket import Bucket, pad_batch, pack_sequences, cp_split_batch
+from hetu_tpu.data.dataset import JsonDataset, TokenizedDataset
+from hetu_tpu.data.dataloader import DataLoader, build_data_loader
+from hetu_tpu.data.data_collator import DataCollatorForLanguageModel

@@ -1,0 +1,177 @@
+"""Bucketing, padding and sequence packing.
+
+Rebuild of the reference data bucket (reference: python/hetu/data/bucket.py:8 —
+pad_data :67, pack_data :86 greedy packing, generate_cp_pack_data :193
+head/tail-symmetric CP split, cu_seqlens generation), adapted to XLA's
+static-shape world: every batch is padded/packed to a length from a fixed
+bucket ladder so the compiled-executable cache (plan pool) stays small.
+
+TPU adaptations:
+- cu_seqlens become per-token `position_ids` (restart at each packed sequence)
+  and `segment_ids` (sequence index per token) — the Pallas flash kernel and
+  the XLA attention both mask cross-sequence attention via segment_ids, which
+  is the static-shape equivalent of varlen cu_seqlens.
+- the CP split keeps the reference's head+tail symmetric assignment
+  (rank r gets chunk r and chunk 2*cp-1-r of 2*cp chunks) so causal load is
+  balanced across the ring, matching HETU_PARALLEL_ATTN_SPLIT=SYM.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+DEFAULT_BUCKET_SIZES = (256, 512, 1024, 2048, 4096, 8192, 16384, 32768)
+
+
+def choose_bucket(length: int, buckets: Sequence[int] = DEFAULT_BUCKET_SIZES) -> int:
+    for b in buckets:
+        if length <= b:
+            return b
+    return buckets[-1]
+
+
+@dataclasses.dataclass
+class Bucket:
+    """A batch of sequences padded/packed to one static length
+    (reference: bucket.py:8 Bucket with pad_data/pack_data)."""
+
+    max_seq_len: int
+    pad_id: int = 0
+
+    def __post_init__(self):
+        self._seqs: List[np.ndarray] = []
+
+    def add(self, ids: np.ndarray):
+        self._seqs.append(np.asarray(ids, np.int32)[: self.max_seq_len])
+
+    def __len__(self):
+        return len(self._seqs)
+
+    # -- padding mode (reference pad_data :67) ------------------------------
+    def pad_batch(self) -> Dict[str, np.ndarray]:
+        return pad_batch(self._seqs, self.max_seq_len, self.pad_id)
+
+    # -- packing mode (reference pack_data :86) -----------------------------
+    def pack_batch(self, num_packed: Optional[int] = None) -> Dict[str, np.ndarray]:
+        return pack_sequences(self._seqs, self.max_seq_len, self.pad_id,
+                              num_packed=num_packed)
+
+
+def pad_batch(seqs: Sequence[np.ndarray], max_len: int, pad_id: int = 0) -> Dict[str, np.ndarray]:
+    """Pad each sequence to max_len. labels = ids with pads masked to -100."""
+    n = len(seqs)
+    ids = np.full((n, max_len), pad_id, np.int32)
+    labels = np.full((n, max_len), -100, np.int32)
+    position_ids = np.zeros((n, max_len), np.int32)
+    segment_ids = np.zeros((n, max_len), np.int32)
+    for i, s in enumerate(seqs):
+        L = min(len(s), max_len)
+        ids[i, :L] = s[:L]
+        labels[i, :L] = s[:L]
+        position_ids[i, :L] = np.arange(L)
+        segment_ids[i, :L] = 1
+    return {"input_ids": ids, "labels": labels,
+            "position_ids": position_ids, "segment_ids": segment_ids}
+
+
+def pack_sequences(seqs: Sequence[np.ndarray], max_len: int, pad_id: int = 0,
+                   num_packed: Optional[int] = None,
+                   on_overflow: str = "warn") -> Dict[str, np.ndarray]:
+    """Greedy first-fit packing into rows of length max_len
+    (reference: bucket.py:86 pack_data).  Returns ids/labels/position_ids/
+    segment_ids; segment 0 = padding, packed sequences are 1-indexed.
+
+    When `num_packed` caps the row count, overflow rows are dropped;
+    on_overflow: "warn" logs the loss, "error" raises, "silent" drops."""
+    order = np.argsort([-len(s) for s in seqs], kind="stable")
+    rows: List[List[np.ndarray]] = []
+    used: List[int] = []
+    for idx in order:
+        s = seqs[idx]
+        L = len(s)
+        placed = False
+        for r in range(len(rows)):
+            if used[r] + L <= max_len:
+                rows[r].append(s)
+                used[r] += L
+                placed = True
+                break
+        if not placed:
+            rows.append([s])
+            used.append(min(L, max_len))
+    if num_packed is not None:
+        while len(rows) < num_packed:
+            rows.append([])
+            used.append(0)
+        if len(rows) > num_packed:
+            dropped = sum(len(s) for row in rows[num_packed:] for s in row)
+            if on_overflow == "error":
+                raise ValueError(
+                    f"packing overflow: {len(rows) - num_packed} rows "
+                    f"({dropped} tokens) do not fit in num_packed={num_packed}")
+            if on_overflow == "warn":
+                from hetu_tpu.utils.logging import get_logger
+                get_logger("data").warning(
+                    f"packing dropped {dropped} tokens "
+                    f"({len(rows) - num_packed} overflow rows)")
+        rows = rows[:num_packed]
+
+    n = len(rows)
+    ids = np.full((n, max_len), pad_id, np.int32)
+    labels = np.full((n, max_len), -100, np.int32)
+    position_ids = np.zeros((n, max_len), np.int32)
+    segment_ids = np.zeros((n, max_len), np.int32)
+    for r, row in enumerate(rows):
+        off = 0
+        for j, s in enumerate(row):
+            L = min(len(s), max_len - off)
+            ids[r, off:off + L] = s[:L]
+            labels[r, off:off + L] = s[:L]
+            position_ids[r, off:off + L] = np.arange(L)
+            segment_ids[r, off:off + L] = j + 1
+            # first token of each sequence can't be predicted from the
+            # previous sequence: mask its label
+            labels[r, off] = -100
+            off += L
+    return {"input_ids": ids, "labels": labels,
+            "position_ids": position_ids, "segment_ids": segment_ids}
+
+
+def cp_split_batch(batch: Dict[str, np.ndarray], cp: int) -> List[Dict[str, np.ndarray]]:
+    """Split a packed/padded batch along seq into per-CP-rank slices with the
+    head+tail symmetric assignment (reference: bucket.py:193
+    generate_cp_pack_data): of 2*cp equal chunks, rank r owns chunks r and
+    2*cp-1-r, so every rank sees a balanced share of causal work.
+
+    Returns a list of cp dicts, each with seq_len = total/cp; the `cp_index`
+    arrays give each token's global position (used as position_ids)."""
+    out = []
+    seq = batch["input_ids"].shape[1]
+    assert seq % (2 * cp) == 0, f"seq {seq} must divide by 2*cp={2*cp}"
+    chunk = seq // (2 * cp)
+    for r in range(cp):
+        lo = slice(r * chunk, (r + 1) * chunk)
+        hi_start = (2 * cp - 1 - r) * chunk
+        hi = slice(hi_start, hi_start + chunk)
+        shard = {}
+        for k, v in batch.items():
+            shard[k] = np.concatenate([v[:, lo], v[:, hi]], axis=1)
+        out.append(shard)
+    return out
+
+
+def merge_cp_batch(shards: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+    """Inverse of cp_split_batch (for tests / unsharded eval)."""
+    cp = len(shards)
+    chunk = shards[0]["input_ids"].shape[1] // 2
+    parts = [None] * (2 * cp)
+    merged = {}
+    for k in shards[0]:
+        for r, sh in enumerate(shards):
+            parts[r] = sh[k][:, :chunk]
+            parts[2 * cp - 1 - r] = sh[k][:, chunk:]
+        merged[k] = np.concatenate(parts, axis=1)
+    return merged

@@ -1,0 +1,81 @@
+"""Data loaders (reference: python/hetu/data/dataloader.py:46
+build_data_loader + SampleLevelBatchSampler :162; C++ prefetch loader
+hetu/graph/data/dataloader.h:18).
+
+The dp-rank sharding of the reference (set_dp_rank) is replaced by
+whole-batch global arrays handed to jit with a (dp, cp)-sharded
+NamedSharding — each host only materializes its slice when running
+multi-host (jax.make_array_from_process_local_data)."""
+from __future__ import annotations
+
+import threading
+import queue as queue_mod
+from typing import Callable, Dict, Iterator, Optional, Sequence
+
+import numpy as np
+
+
+class DataLoader:
+    """Batches + collates a dataset; optional background prefetch thread
+    (the reference's C++ prefetching loader becomes a host thread feeding
+    device puts)."""
+
+    def __init__(self, dataset, batch_size: int, collator,
+                 shuffle: bool = True, seed: int = 0, drop_last: bool = True,
+                 prefetch: int = 2):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self.collator = collator
+        self.shuffle = shuffle
+        self.seed = seed
+        self.drop_last = drop_last
+        self.prefetch = prefetch
+
+    def __len__(self):
+        n = len(self.dataset)
+        return n // self.batch_size if self.drop_last else (n + self.batch_size - 1) // self.batch_size
+
+    def _index_iter(self, epoch: int) -> Iterator[np.ndarray]:
+        idx = np.arange(len(self.dataset))
+        if self.shuffle:
+            np.random.default_rng(self.seed + epoch).shuffle(idx)
+        n_full = len(idx) // self.batch_size
+        for b in range(n_full):
+            yield idx[b * self.batch_size:(b + 1) * self.batch_size]
+        if not self.drop_last and len(idx) % self.batch_size:
+            yield idx[n_full * self.batch_size:]
+
+    def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
+        def produce(q):
+            for batch_idx in self._index_iter(epoch):
+                seqs = [self.dataset[int(i)] for i in batch_idx]
+                q.put(self.collator(seqs))
+            q.put(None)
+
+        if self.prefetch <= 0:
+            for batch_idx in self._index_iter(epoch):
+                seqs = [self.dataset[int(i)] for i in batch_idx]
+                yield self.collator(seqs)
+            return
+
+        q: queue_mod.Queue = queue_mod.Queue(maxsize=self.prefetch)
+        t = threading.Thread(target=produce, args=(q,), daemon=True)
+        t.start()
+        while True:
+            item = q.get()
+            if item is None:
+                break
+            yield item
+
+    def __iter__(self):
+        return self.epoch(0)
+
+
+def build_data_loader(dataset, batch_size: int, max_seq_len: int,
+                      pad_id: int = 0, packing: bool = False,
+                      shuffle: bool = True, seed: int = 0,
+                      prefetch: int = 2) -> DataLoader:
+    from hetu_tpu.data.data_collator import DataCollatorForLanguageModel
+    collator = DataCollatorForLanguageModel(max_seq_len, pad_id, packing)
+    return DataLoader(dataset, batch_size, collator, shuffle=shuffle,
+                      seed=seed, prefetch=prefetch)

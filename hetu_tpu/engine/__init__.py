@@ -1,0 +1,3 @@
+from hetu_tpu.engine.trainer_config import TrainingConfig
+from hetu_tpu.engine.trainer import Trainer
+from hetu_tpu.engine.plan_pool import PlanPool

@@ -1,0 +1,63 @@
+"""Compiled-executable plan pool.
+
+The reference's DefineAndRunGraph keeps a pool of ExecGraphPlans keyed by
+(strategy, shape plan) and instantiates/compiles lazily
+(reference: hetu/graph/define_and_run_graph.cc:1174 Run — plan pool lookup,
+DeduceShapePlan :303).  The TPU analog: one AOT-compiled pjit executable per
+(strategy id, abstract input shapes), cached here.  Shape plans come from the
+data pipeline's bucket ladder, so the pool stays small and step dispatch is
+a dict lookup — the same amortization the reference gets from _execute_plan.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Dict, Optional, Tuple
+
+import jax
+
+
+def _shape_key(tree) -> Tuple:
+    leaves = jax.tree.leaves(tree)
+    return tuple((tuple(l.shape), str(l.dtype)) for l in leaves
+                 if hasattr(l, "shape"))
+
+
+@dataclasses.dataclass
+class PlanPool:
+    """Caches AOT-compiled executables of one traceable step function per
+    (strategy_id, input shape signature)."""
+
+    fn: Callable
+    jit_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        self._plans: Dict[Tuple, Any] = {}
+        self._jitted = jax.jit(self.fn, **self.jit_kwargs)
+
+    def get(self, strategy_id: int, *args) -> Any:
+        key = (strategy_id,) + _shape_key(args)
+        plan = self._plans.get(key)
+        if plan is None:
+            plan = self._jitted.lower(*args).compile()
+            self._plans[key] = plan
+        return plan
+
+    def __call__(self, *args, strategy_id: int = 0):
+        return self.get(strategy_id, *args)(*args)
+
+    @property
+    def num_plans(self) -> int:
+        return len(self._plans)
+
+    def compile_stats(self):
+        out = {}
+        for key, plan in self._plans.items():
+            try:
+                mem = plan.memory_analysis()
+                out[key] = {
+                    "output_bytes": getattr(mem, "output_size_in_bytes", None),
+                    "temp_bytes": getattr(mem, "temp_size_in_bytes", None),
+                }
+            except Exception:
+                out[key] = {}
+        return out

@@ -1,0 +1,44 @@
+"""Training configuration (reference: python/hetu/engine/trainer_config.py
+TrainingConfig; Hydra YAML sections rpc/ds_parallel/trainer/model map onto
+this + ParallelStrategy + model config)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+
+@dataclasses.dataclass
+class TrainingConfig:
+    # batch geometry
+    global_batch_size: int = 32
+    micro_batch_size: int = 4          # per-dp-replica micro batch
+    seq_len: int = 1024
+    packing: bool = False
+
+    # optimization
+    lr: float = 3e-4
+    min_lr_ratio: float = 0.1
+    warmup_steps: int = 100
+    total_steps: int = 1000
+    weight_decay: float = 0.1
+    beta1: float = 0.9
+    beta2: float = 0.95
+    eps: float = 1e-8
+    grad_clip: float = 1.0
+
+    # logging / checkpoint
+    log_every: int = 10
+    ckpt_dir: Optional[str] = None
+    ckpt_every: int = 1000
+    ckpt_keep: int = 3
+
+    seed: int = 0
+    dropout_deterministic: bool = True  # pretraining default: no dropout
+
+    def num_micro_batches(self, dp: int) -> int:
+        denom = self.micro_batch_size * dp
+        if self.global_batch_size % denom:
+            raise ValueError(
+                f"global_batch_size={self.global_batch_size} must divide by "
+                f"micro_batch_size*dp={denom}")
+        return self.global_batch_size // denom

@@ -273,7 +273,8 @@ class LlamaLMHeadModel(Module):
         return self.strategy.constrain(logits, self.strategy.act_logits())
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
-                segment_ids=None, rng=None, deterministic=True):
+                segment_ids=None, rng=None, deterministic=True,
+                loss_reduction: str = "mean"):
         hidden = self.model(params["model"], input_ids,
                             position_ids=position_ids, segment_ids=segment_ids,
                             rng=rng, deterministic=deterministic)
@@ -281,6 +282,17 @@ class LlamaLMHeadModel(Module):
         if labels is None:
             return logits
         # next-token objective: logits[t] predicts labels[t+1]
+        tgt = labels[:, 1:]
+        if loss_reduction not in ("mean", "sum"):
+            raise ValueError(f"loss_reduction must be 'mean' or 'sum', got "
+                             f"{loss_reduction!r}")
+        if loss_reduction == "sum":
+            # (sum, token_count) — lets grad accumulation / DP weight micro
+            # batches by their true token counts instead of mean-of-means
+            loss = ops.softmax_cross_entropy_sparse(
+                logits[:, :-1, :], tgt, ignore_index=-100, reduction="sum")
+            count = jnp.sum((tgt != -100).astype(jnp.float32))
+            return loss, count
         loss = ops.softmax_cross_entropy_sparse(
-            logits[:, :-1, :], labels[:, 1:], ignore_index=-100)
+            logits[:, :-1, :], tgt, ignore_index=-100)
         return loss

@@ -155,3 +155,28 @@ def zero_shardings(param_shardings, abstract_params, mesh, axis: str = "dp"):
         return ns
 
     return jax.tree.map(shard_one, param_shardings, abstract_params)
+
+
+# ---------------------------------------------------------------------------
+# LR schedules (reference trainer passes scalar lr; schedules are the TPU-side
+# convenience so the jitted update closes over a step->lr function)
+# ---------------------------------------------------------------------------
+
+def cosine_schedule(peak_lr: float, warmup_steps: int, total_steps: int,
+                    min_ratio: float = 0.1):
+    def lr(step):
+        step = jnp.asarray(step, jnp.float32)
+        warm = peak_lr * step / max(warmup_steps, 1)
+        prog = jnp.clip((step - warmup_steps) /
+                        max(total_steps - warmup_steps, 1), 0.0, 1.0)
+        cos = peak_lr * (min_ratio + (1 - min_ratio) * 0.5 *
+                         (1.0 + jnp.cos(jnp.pi * prog)))
+        return jnp.where(step < warmup_steps, warm, cos)
+
+    return lr
+
+
+def constant_schedule(lr_value: float):
+    def lr(step):
+        return jnp.full((), lr_value, jnp.float32)
+    return lr

@@ -1,0 +1,21 @@
+"""Leveled logging (reference: hetu/common/logging.* HT_LOG_* macros +
+python/hetu/logger.py).  Per-process prefix carries the jax process index the
+way the reference prefixes device ids."""
+from __future__ import annotations
+
+import logging
+import os
+import sys
+
+_FMT = "[%(asctime)s %(name)s %(levelname).1s] %(message)s"
+
+
+def get_logger(name: str = "hetu_tpu") -> logging.Logger:
+    logger = logging.getLogger(f"hetu_tpu.{name}")
+    if not logger.handlers:
+        h = logging.StreamHandler(sys.stderr)
+        h.setFormatter(logging.Formatter(_FMT, datefmt="%H:%M:%S"))
+        logger.addHandler(h)
+        logger.setLevel(os.environ.get("HETU_TPU_LOG_LEVEL", "INFO"))
+        logger.propagate = False
+    return logger

@@ -1,0 +1,72 @@
+"""Data stack tests (bucketing/packing/CP split — reference: bucket.py tests
+implied by trainer usage; we test the invariants directly)."""
+import numpy as np
+
+from hetu_tpu.data import (
+    DataCollatorForLanguageModel, DataLoader, TokenizedDataset,
+    pad_batch, pack_sequences, cp_split_batch,
+)
+from hetu_tpu.data.bucket import merge_cp_batch, choose_bucket
+
+
+def test_pad_batch_shapes_and_masks():
+    seqs = [np.arange(5), np.arange(9)]
+    b = pad_batch(seqs, 16, pad_id=0)
+    assert b["input_ids"].shape == (2, 16)
+    assert (b["labels"][0, 5:] == -100).all()
+    assert b["segment_ids"][0, :5].tolist() == [1] * 5
+    assert b["position_ids"][1, :9].tolist() == list(range(9))
+
+
+def test_pack_sequences_invariants():
+    rng = np.random.default_rng(0)
+    seqs = [rng.integers(1, 100, size=L) for L in (60, 50, 40, 30, 20, 10)]
+    b = pack_sequences(seqs, 128)
+    ids, seg, pos = b["input_ids"], b["segment_ids"], b["position_ids"]
+    # every token of every input sequence appears exactly once
+    total_in = sum(len(s) for s in seqs)
+    assert int((seg > 0).sum()) == total_in
+    # positions restart at each segment
+    for r in range(ids.shape[0]):
+        for s_id in np.unique(seg[r]):
+            if s_id == 0:
+                continue
+            mask = seg[r] == s_id
+            assert pos[r][mask].tolist() == list(range(mask.sum()))
+    # first token of each segment is label-masked (no cross-sequence pred)
+    for r in range(ids.shape[0]):
+        starts = np.flatnonzero(np.diff(np.concatenate([[0], seg[r]])) != 0)
+        for s in starts:
+            if seg[r][s] > 0:
+                assert b["labels"][r][s] == -100
+
+
+def test_cp_split_roundtrip_and_balance():
+    batch = pad_batch([np.arange(64), np.arange(64)], 64)
+    shards = cp_split_batch(batch, cp=4)
+    assert all(s["input_ids"].shape == (2, 16) for s in shards)
+    merged = merge_cp_batch(shards)
+    for k in batch:
+        np.testing.assert_array_equal(merged[k], batch[k])
+    # symmetric split: rank 0 gets chunks 0 and 7 of 8
+    np.testing.assert_array_equal(shards[0]["position_ids"][0],
+                                  np.concatenate([np.arange(0, 8),
+                                                  np.arange(56, 64)]))
+
+
+def test_dataloader_prefetch_and_determinism():
+    ds = TokenizedDataset.synthetic(30, vocab=50, min_len=5, max_len=20)
+    coll = DataCollatorForLanguageModel(max_seq_len=32)
+    dl1 = DataLoader(ds, 4, coll, shuffle=True, seed=7, prefetch=2)
+    dl2 = DataLoader(ds, 4, coll, shuffle=True, seed=7, prefetch=0)
+    b1 = [b["input_ids"] for b in dl1.epoch(0)]
+    b2 = [b["input_ids"] for b in dl2.epoch(0)]
+    assert len(b1) == len(b2) == 7
+    for a, b in zip(b1, b2):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_choose_bucket():
+    assert choose_bucket(100) == 256
+    assert choose_bucket(257) == 512
+    assert choose_bucket(10 ** 9) == 32768

@@ -79,7 +79,8 @@ class LlamaAttention(Module):
         if st.cp > 1:
             from hetu_tpu.parallel.ring_attention import ring_attention_gspmd
             attn = ring_attention_gspmd(q, k, v, strategy=st,
-                                        segment_ids=segment_ids)
+                                        segment_ids=segment_ids,
+                                        position_ids=position_ids)
         elif use_attn_dropout:
             # dropout on attention probs only exists in the XLA composition
             attn = ops.attention(q, k, v, causal=True, segment_ids=segment_ids,

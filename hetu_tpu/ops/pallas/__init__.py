@@ -1,0 +1,3 @@
+"""Hand-written Pallas TPU kernels for the hot ops
+(reference: hetu/impl/kernel/*.cu — the ~10% of kernels XLA fusion does not
+already cover; SURVEY.md §2.5 item 2)."""

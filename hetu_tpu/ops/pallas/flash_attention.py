@@ -1,0 +1,498 @@
+"""Flash attention for TPU in Pallas.
+
+Rebuild of the reference's fused attention
+(reference: hetu/impl/kernel/FlashAttention.cu:150 run_mha_fwd wrapping the
+vendored flash-attn 2; varlen/cu_seqlens handled by the kernel there).
+TPU-first design decisions:
+
+- online-softmax forward with float32 accumulators in VMEM scratch; the grid
+  is (batch, q_heads, q_blocks, k_blocks) with the k dim innermost —
+  sequential on a TensorCore, so scratch carries running (m, l, acc) across
+  k blocks exactly like flash-attn's inner loop.
+- packed varlen batches are masked by **segment ids**, the static-shape
+  equivalent of cu_seqlens; causality is masked by **global positions**, which
+  are explicit inputs so ring-attention context parallelism (chunks owned by
+  other cp ranks, head+tail symmetric split) reuses this same kernel for every
+  ring step (reference: ParallelAttention.cc ExecFlashAttn :660).
+- GQA folds the kv-head broadcast into the k/v BlockSpec index maps (no
+  materialized repeat); dk/dv come back per q-head and are group-summed
+  outside the kernel.
+- forward also emits LSE so the ring's online-softmax merge
+  (reference ExecCorr :606) can combine partial attentions.
+- backward = two Pallas kernels (dq over k-blocks; dkv over q-blocks) using
+  the saved LSE + delta trick from flash-attn 2.
+"""
+from __future__ import annotations
+
+import functools
+import math
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+
+def _interpret() -> bool:
+    # CPU (the virtual test mesh) runs kernels in interpret mode
+    return jax.default_backend() == "cpu"
+
+
+DEFAULT_BLOCK_Q = 512
+DEFAULT_BLOCK_K = 512
+
+
+def _mask(s, q_pos, k_pos, q_seg, k_seg, causal):
+    """Combined causal+segment mask for one (Bq, Bk) score tile."""
+    m = None
+    if causal:
+        m = q_pos[:, None] >= k_pos[None, :]
+    if q_seg is not None:
+        seg = q_seg[:, None] == k_seg[None, :]
+        m = seg if m is None else (m & seg)
+    if m is not None:
+        s = jnp.where(m, s, NEG_INF)
+    return s
+
+
+# ---------------------------------------------------------------------------
+# forward
+# ---------------------------------------------------------------------------
+
+def _fwd_kernel(qpos_ref, kpos_ref, qseg_ref, kseg_ref, q_ref, k_ref, v_ref,
+                o_ref, lse_ref, m_scr, l_scr, acc_scr, *, scale, causal,
+                use_seg, nk, block_q, block_k, skip_blocks):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    # contiguous-causal block skip: block fully above the diagonal
+    live = (ki * block_k <= qi * block_q + block_q - 1) if skip_blocks else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)            # [Bq, d]
+        k = k_ref[0, 0].astype(jnp.float32)            # [Bk, d]
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qpos_ref[0, 0]
+        k_pos = kpos_ref[0, 0]
+        q_seg = qseg_ref[0, 0] if use_seg else None
+        k_seg = kseg_ref[0, 0] if use_seg else None
+        s = _mask(s, q_pos, k_pos, q_seg, k_seg, causal)
+
+        m_prev = m_scr[:]                               # [Bq, 1]
+        m_cur = jnp.max(s, axis=1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_cur)
+        # fully-masked row: m_new == NEG_INF and exp(s - m_new) would be 1;
+        # shift the reference point so p underflows to 0 instead
+        m_exp = jnp.where(m_new <= NEG_INF / 2, 0.0, m_new)
+        p = jnp.exp(s - m_exp)                          # [Bq, Bk]
+        corr = jnp.exp(m_prev - m_new)                  # [Bq, 1]
+        l_new = l_scr[:] * corr + jnp.sum(p, axis=1, keepdims=True)
+        v = v_ref[0, 0].astype(jnp.float32)             # [Bk, d]
+        pv = jax.lax.dot_general(p, v, (((1,), (0,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        acc_scr[:] = acc_scr[:] * corr + pv
+        m_scr[:] = m_new
+        l_scr[:] = l_new
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        l = l_scr[:]
+        # rows with no visible key (l==0) output 0, lse = -inf-ish
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0, 0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+        lse = (m_scr[:] + jnp.log(l_safe))[:, 0]
+        lse_ref[0, 0, 0] = jnp.where(l[:, 0] == 0.0, NEG_INF, lse)
+
+
+def _fwd(q, k, v, q_pos, k_pos, q_seg, k_seg, *, scale, causal,
+         block_q, block_k, skip_blocks=False, debug=False):
+    """q: [b, hq, sq, d]; k/v: [b, hkv, sk, d]; positions/segments: [b, s].
+    Returns (o [b,hq,sq,d], lse [b,hq,sq])."""
+    b, hq, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    group = hq // hkv
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) must divide by blocks "
+                         f"({block_q},{block_k})")
+    nq, nk = sq // block_q, sk // block_k
+    use_seg = q_seg is not None
+    if not use_seg:
+        q_seg = jnp.zeros((b, sq), jnp.int32)
+        k_seg = jnp.zeros((b, sk), jnp.int32)
+
+    grid = (b, hq, nq, nk)
+    kernel = functools.partial(
+        _fwd_kernel, scale=scale, causal=causal, use_seg=use_seg, nk=nk,
+        block_q=block_q, block_k=block_k,
+        skip_blocks=skip_blocks and causal)
+
+    q_pos = q_pos.reshape(b, 1, sq)
+    k_pos = k_pos.reshape(b, 1, sk)
+    q_seg = q_seg.reshape(b, 1, sq)
+    k_seg = k_seg.reshape(b, 1, sk)
+
+    if skip_blocks and causal:
+        # clamp the k index so skipped (above-diagonal) iterations re-fetch
+        # the diagonal block — Mosaic elides the duplicate DMA
+        def kidx(qi, ki):
+            return jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
+    else:
+        def kidx(qi, ki):
+            return ki
+
+    o, lse = pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, 0, kidx(qi, ki))),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, 0, kidx(qi, ki))),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // group, kidx(qi, ki), 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // group, kidx(qi, ki), 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, 1, sq), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        debug=debug,
+        interpret=_interpret(),
+    )(q_pos, k_pos, q_seg, k_seg, q, k, v)
+    return o, lse.reshape(b, hq, sq)
+
+
+# ---------------------------------------------------------------------------
+# backward
+# ---------------------------------------------------------------------------
+
+def _bwd_dq_kernel(qpos_ref, kpos_ref, qseg_ref, kseg_ref, q_ref, k_ref,
+                   v_ref, do_ref, lse_ref, delta_ref, dq_ref, dq_scr, *,
+                   scale, causal, use_seg, nk, block_q, block_k, skip_blocks):
+    qi = pl.program_id(2)
+    ki = pl.program_id(3)
+
+    @pl.when(ki == 0)
+    def _init():
+        dq_scr[:] = jnp.zeros_like(dq_scr)
+
+    live = (ki * block_k <= qi * block_q + block_q - 1) if skip_blocks else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0][:, None]                 # [Bq,1]
+        lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)   # masked-row guard
+        delta = delta_ref[0, 0, 0][:, None]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qpos_ref[0, 0]
+        k_pos = kpos_ref[0, 0]
+        q_seg = qseg_ref[0, 0] if use_seg else None
+        k_seg = kseg_ref[0, 0] if use_seg else None
+        s = _mask(s, q_pos, k_pos, q_seg, k_seg, causal)
+        p = jnp.exp(s - lse)                            # [Bq, Bk]
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)                           # [Bq, Bk]
+        dq_scr[:] += scale * jax.lax.dot_general(
+            ds, k, (((1,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(ki == nk - 1)
+    def _fin():
+        dq_ref[0, 0] = dq_scr[:].astype(dq_ref.dtype)
+
+
+def _bwd_dkv_kernel(qpos_ref, kpos_ref, qseg_ref, kseg_ref, q_ref, k_ref,
+                    v_ref, do_ref, lse_ref, delta_ref, dk_ref, dv_ref,
+                    dk_scr, dv_scr, *, scale, causal, use_seg, nq, block_q,
+                    block_k, skip_blocks):
+    ki = pl.program_id(2)
+    qi = pl.program_id(3)
+
+    @pl.when(qi == 0)
+    def _init():
+        dk_scr[:] = jnp.zeros_like(dk_scr)
+        dv_scr[:] = jnp.zeros_like(dv_scr)
+
+    # skip q blocks entirely above the diagonal (q ends before k begins)
+    live = (qi * block_q + block_q - 1 >= ki * block_k) if skip_blocks else True
+
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0, 0].astype(jnp.float32)
+        k = k_ref[0, 0].astype(jnp.float32)
+        v = v_ref[0, 0].astype(jnp.float32)
+        do = do_ref[0, 0].astype(jnp.float32)
+        lse = lse_ref[0, 0, 0][:, None]
+        lse = jnp.where(lse <= NEG_INF / 2, 0.0, lse)   # masked-row guard
+        delta = delta_ref[0, 0, 0][:, None]
+
+        s = jax.lax.dot_general(q, k, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32) * scale
+        q_pos = qpos_ref[0, 0]
+        k_pos = kpos_ref[0, 0]
+        q_seg = qseg_ref[0, 0] if use_seg else None
+        k_seg = kseg_ref[0, 0] if use_seg else None
+        s = _mask(s, q_pos, k_pos, q_seg, k_seg, causal)
+        p = jnp.exp(s - lse)                            # [Bq, Bk]
+        dv_scr[:] += jax.lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+        dp = jax.lax.dot_general(do, v, (((1,), (1,)), ((), ())),
+                                 preferred_element_type=jnp.float32)
+        ds = p * (dp - delta)
+        dk_scr[:] += scale * jax.lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())), preferred_element_type=jnp.float32)
+
+    @pl.when(qi == nq - 1)
+    def _fin():
+        dk_ref[0, 0] = dk_scr[:].astype(dk_ref.dtype)
+        dv_ref[0, 0] = dv_scr[:].astype(dv_ref.dtype)
+
+
+def _bwd(q, k, v, o, lse, do, q_pos, k_pos, q_seg, k_seg, *, scale, causal,
+         block_q, block_k, skip_blocks=False, delta=None):
+    b, hq, sq, d = q.shape
+    hkv, sk = k.shape[1], k.shape[2]
+    group = hq // hkv
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) must divide by blocks "
+                         f"({block_q},{block_k})")
+    nq, nk = sq // block_q, sk // block_k
+    use_seg = q_seg is not None
+    if not use_seg:
+        q_seg = jnp.zeros((b, sq), jnp.int32)
+        k_seg = jnp.zeros((b, sk), jnp.int32)
+
+    if delta is None:  # loop-invariant for ring callers — pass it in
+        delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+
+    q_pos = q_pos.reshape(b, 1, sq)
+    k_pos = k_pos.reshape(b, 1, sk)
+    q_seg = q_seg.reshape(b, 1, sq)
+    k_seg = k_seg.reshape(b, 1, sk)
+    lse4 = lse.reshape(b, hq, 1, sq)
+    delta4 = delta.reshape(b, hq, 1, sq)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
+                          use_seg=use_seg, nk=nk, block_q=block_q,
+                          block_k=block_k, skip_blocks=skip_blocks and causal),
+        grid=(b, hq, nq, nk),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda bi, hi, qi, ki: (bi, hi, 0, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d),
+                               lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((b, hq, sq, d), jnp.float32),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q_pos, k_pos, q_seg, k_seg, q, k, v, do, lse4, delta4)
+
+    # dk/dv per Q HEAD (grid over k blocks, inner loop over q blocks), then
+    # group-summed to kv heads outside.
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
+                          use_seg=use_seg, nq=nq, block_q=block_q,
+                          block_k=block_k, skip_blocks=skip_blocks and causal),
+        grid=(b, hq, nk, nq),
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, ki, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, block_q), lambda bi, hi, ki, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
+            pl.BlockSpec((1, 1, block_q, d),
+                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+            pl.BlockSpec((1, 1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+            pl.BlockSpec((1, 1, block_k, d),
+                         lambda bi, hi, ki, qi: (bi, hi, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
+            jax.ShapeDtypeStruct((b, hq, sk, d), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "parallel", "parallel",
+                                 "arbitrary")),
+        interpret=_interpret(),
+    )(q_pos, k_pos, q_seg, k_seg, q, k, v, do, lse4, delta4)
+
+    if group > 1:
+        dk = dk.reshape(b, hkv, group, sk, d).sum(axis=2)
+        dv = dv.reshape(b, hkv, group, sk, d).sum(axis=2)
+    # fp32 out — single-device callers cast once; the ring accumulates fp32
+    return dq, dk, dv
+
+
+# ---------------------------------------------------------------------------
+# public API (custom VJP)
+# ---------------------------------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
+def _flash(q, k, v, q_pos, k_pos, q_seg, k_seg, scale, causal, block_q,
+           block_k, skip_blocks):
+    o, _ = _fwd(q, k, v, q_pos, k_pos, q_seg, k_seg, scale=scale,
+                causal=causal, block_q=block_q, block_k=block_k,
+                skip_blocks=skip_blocks)
+    return o
+
+
+def _flash_fwd(q, k, v, q_pos, k_pos, q_seg, k_seg, scale, causal, block_q,
+               block_k, skip_blocks):
+    o, lse = _fwd(q, k, v, q_pos, k_pos, q_seg, k_seg, scale=scale,
+                  causal=causal, block_q=block_q, block_k=block_k,
+                  skip_blocks=skip_blocks)
+    return o, (q, k, v, o, lse, q_pos, k_pos, q_seg, k_seg)
+
+
+def _flash_bwd(scale, causal, block_q, block_k, skip_blocks, res, do):
+    q, k, v, o, lse, q_pos, k_pos, q_seg, k_seg = res
+    dq, dk, dv = _bwd(q, k, v, o, lse, do, q_pos, k_pos, q_seg, k_seg,
+                      scale=scale, causal=causal, block_q=block_q,
+                      block_k=block_k, skip_blocks=skip_blocks)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None, None, None)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, *, causal: bool = True,
+                    segment_ids: Optional[jnp.ndarray] = None,
+                    kv_segment_ids: Optional[jnp.ndarray] = None,
+                    q_positions: Optional[jnp.ndarray] = None,
+                    kv_positions: Optional[jnp.ndarray] = None,
+                    softmax_scale: Optional[float] = None,
+                    block_q: int = DEFAULT_BLOCK_Q,
+                    block_k: int = DEFAULT_BLOCK_K):
+    """Flash attention. q/k/v: [batch, seq, heads, head_dim] (kv heads may
+    divide q heads — GQA). segment_ids: [batch, seq] packed-batch ids
+    (0 = pad); positions: [batch, seq] global positions for causal masking
+    (default arange — pass explicit ones under CP).  Returns [b, s, hq, d]."""
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    if sq % block_q or sk % block_k:
+        raise ValueError(f"seq lens ({sq},{sk}) must divide by blocks "
+                         f"({block_q},{block_k}); pad via the bucket ladder")
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    # contiguous positions on both sides -> blocks above the diagonal can be
+    # statically skipped (the causal 2x)
+    skip_blocks = (causal and q_positions is None and kv_positions is None
+                   and sq == sk)
+    # [b, s, h, d] -> [b, h, s, d]
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    o = _flash(qt, kt, vt, q_positions.astype(jnp.int32),
+               kv_positions.astype(jnp.int32),
+               segment_ids.astype(jnp.int32) if segment_ids is not None else None,
+               kv_segment_ids.astype(jnp.int32) if kv_segment_ids is not None else None,
+               scale, causal, block_q, block_k, skip_blocks)
+    return o.transpose(0, 2, 1, 3)
+
+
+def flash_attention_with_lse(q, k, v, *, causal: bool = True,
+                             segment_ids=None, kv_segment_ids=None,
+                             q_positions=None, kv_positions=None,
+                             softmax_scale: Optional[float] = None,
+                             block_q: int = DEFAULT_BLOCK_Q,
+                             block_k: int = DEFAULT_BLOCK_K) -> Tuple:
+    """Forward-only variant returning (out [b,s,h,d], lse [b,h,s]) for the
+    ring-attention merge. Differentiation is handled by the ring layer."""
+    b, sq, hq, d = q.shape
+    sk = k.shape[1]
+    block_q = min(block_q, sq)
+    block_k = min(block_k, sk)
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    if q_positions is None:
+        q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+    if kv_positions is None:
+        kv_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
+    if segment_ids is not None and kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    o, lse = _fwd(qt, kt, vt, q_positions.astype(jnp.int32),
+                  kv_positions.astype(jnp.int32),
+                  segment_ids.astype(jnp.int32) if segment_ids is not None else None,
+                  kv_segment_ids.astype(jnp.int32) if kv_segment_ids is not None else None,
+                  scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    return o.transpose(0, 2, 1, 3), lse

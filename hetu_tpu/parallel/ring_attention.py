@@ -1,31 +1,222 @@
-"""Context-parallel attention.
+"""Ring-attention context parallelism.
 
-The reference's CP engine is ring attention with hetero rings
-(reference: hetu/graph/ops/ParallelAttention.{h,cc} — AttnCommRing ring
-KV-passing with online-softmax LSE merge, overlap, and STRIPE/SYM causal
-balance).  Two TPU implementations live here:
+Rebuild of the reference CP engine (reference: hetu/graph/ops/
+ParallelAttention.{h,cc} — AttnCommRing ring KV-passing :945, online-softmax
+LSE merge ExecCorr :606, comm/compute overlap, piggyback dKV on the backward
+ring AttnBlock :172, causal balance via head+tail splits).
 
-1. `ring_attention` (shard_map + ppermute + per-block flash attention with
-   LSE accumulation) — the faithful ring, comm overlapped by XLA's async
-   collective-permute.  [M4]
-2. `ring_attention_gspmd` — global-view fallback: computation is written
-   globally and GSPMD materializes KV via all-gather over the cp axis.
-   Correct for any layout; O(seq) memory for KV on each cp shard, so it is
-   the fallback, not the destination.
+TPU mapping:
+- the ring lives inside a shard_map over the `cp` mesh axis; KV blocks rotate
+  with `lax.ppermute` (XLA compiles async collective-permutes that overlap
+  the per-block flash kernel — the reference overlaps rounds by hand on a
+  dedicated stream, ExecComm :849).
+- per-block attention is the Pallas flash kernel with **global positions +
+  segment ids** doing all masking, so arbitrary CP layouts (the head+tail
+  symmetric split of hetu_tpu.data.bucket.cp_split_batch, packed varlen rows)
+  need no special ring-step mask enumeration (the reference precomputes
+  per-rank-pair AttnInfo mask kinds :212 — positions subsume that table).
+- backward is a second ring: each rank computes its (dq; dk,dv-of-the-passing
+  -block) with the flash-attn2 global-LSE trick, and dk/dv accumulate ON the
+  rotating block until it returns home — exactly the reference's
+  piggyback_grad.
+- merge numerics follow ExecCorr: out = sum_i out_i * exp(lse_i - lse_tot),
+  lse_tot = logsumexp_i lse_i, with empty blocks at lse = -inf.
+
+`ring_attention` is the shard_map-internal function; `ring_attention_gspmd`
+wraps it for use from global-view (jit) model code.
 """
 from __future__ import annotations
 
+import functools
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
 
-from hetu_tpu import ops
+from hetu_tpu.ops.pallas.flash_attention import NEG_INF, _bwd, _fwd
 from hetu_tpu.parallel.strategy import ParallelStrategy
 
 
+def _merge(o_acc, lse_acc, o_i, lse_i):
+    """Online-softmax merge of two partial attentions (ExecCorr :606).
+    o: [b, h, s, d]; lse: [b, h, s]."""
+    lse_new = jnp.logaddexp(lse_acc, lse_i)
+    # exp(-inf - -inf) -> nan; empty rows keep weight 0
+    w_acc = jnp.where(lse_acc == NEG_INF, 0.0, jnp.exp(lse_acc - lse_new))
+    w_i = jnp.where(lse_i == NEG_INF, 0.0, jnp.exp(lse_i - lse_new))
+    o_new = o_acc * w_acc[..., None] + o_i * w_i[..., None]
+    return o_new, lse_new
+
+
+def _rotate(xs, axis_name):
+    n = lax.axis_size(axis_name)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    return [lax.ppermute(x, axis_name, perm) for x in xs]
+
+
+def _pick_block(seq: int, want: int) -> int:
+    """Largest block <= want that divides seq (lane-aligned when possible) —
+    avoids the silent-tail-drop hazard of a non-dividing block."""
+    bs = min(want, seq)
+    while seq % bs:
+        bs -= 128 if bs > 128 else 1
+        if bs <= 0:
+            raise ValueError(f"cannot block seq len {seq}")
+    return bs
+
+
+# All arrays here are LOCAL shards: q/k/v [b, h, s_loc, d] (head-major, the
+# kernel's native layout); positions/segments [b, s_loc].
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+def _ring(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale, causal,
+          block_sizes):
+    o, _ = _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
+                          scale, causal, block_sizes)
+    return o
+
+
+def _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale,
+                   causal, block_sizes):
+    b, h, sq, d = q.shape
+    cp = lax.axis_size(axis_name)
+    block_q = _pick_block(sq, block_sizes[0])
+    block_k = _pick_block(k.shape[2], block_sizes[1])
+    use_seg = q_seg is not None
+    o = jnp.zeros((b, h, sq, d), jnp.float32)
+    lse = jnp.full((b, h, sq), NEG_INF, jnp.float32)
+    k_i, v_i, kpos_i = k, v, kv_pos
+    kseg_i = kv_seg
+    for i in range(cp):
+        o_i, lse_i = _fwd(q, k_i, v_i, q_pos, kpos_i,
+                          q_seg if use_seg else None,
+                          kseg_i if use_seg else None,
+                          scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        o, lse = _merge(o, lse, o_i.astype(jnp.float32), lse_i)
+        if i != cp - 1:
+            if use_seg:
+                k_i, v_i, kpos_i, kseg_i = _rotate(
+                    [k_i, v_i, kpos_i, kseg_i], axis_name)
+            else:
+                k_i, v_i, kpos_i = _rotate([k_i, v_i, kpos_i], axis_name)
+    return o.astype(q.dtype), lse
+
+
+def _ring_vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale,
+                  causal, block_sizes):
+    o, lse = _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
+                            scale, causal, block_sizes)
+    return o, (q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg)
+
+
+def _ring_vjp_bwd(axis_name, scale, causal, block_sizes, res, do):
+    q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg = res
+    b, h, sq, d = q.shape
+    cp = lax.axis_size(axis_name)
+    block_q = _pick_block(sq, block_sizes[0])
+    block_k = _pick_block(k.shape[2], block_sizes[1])
+    use_seg = q_seg is not None
+    # loop-invariant across ring steps: compute once
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    # the rotating block: (k, v, their metadata, their accumulating grads)
+    k_i, v_i, kpos_i, kseg_i = k, v, kv_pos, kv_seg
+    dk_i = jnp.zeros(k.shape, jnp.float32)
+    dv_i = jnp.zeros(v.shape, jnp.float32)
+    for i in range(cp):
+        dq_c, dk_c, dv_c = _bwd(
+            q, k_i, v_i, o, lse, do, q_pos, kpos_i,
+            q_seg if use_seg else None, kseg_i if use_seg else None,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            delta=delta)
+        dq = dq + dq_c
+        dk_i = dk_i + dk_c
+        dv_i = dv_i + dv_c
+        # rotate the block + piggybacked grads; after cp rotations total the
+        # block (with its full dk/dv) is home again
+        rot = [k_i, v_i, kpos_i, dk_i, dv_i] + ([kseg_i] if use_seg else [])
+        rot = _rotate(rot, axis_name)
+        if use_seg:
+            k_i, v_i, kpos_i, dk_i, dv_i, kseg_i = rot
+        else:
+            k_i, v_i, kpos_i, dk_i, dv_i = rot
+    return (dq.astype(q.dtype), dk_i.astype(k.dtype), dv_i.astype(v.dtype),
+            None, None, None, None)
+
+
+_ring.defvjp(_ring_vjp_fwd, _ring_vjp_bwd)
+
+
+def ring_attention(q, k, v, *, axis_name: str = "cp",
+                   q_positions=None, kv_positions=None,
+                   segment_ids=None, kv_segment_ids=None,
+                   causal: bool = True, softmax_scale: Optional[float] = None,
+                   block_q: int = 512, block_k: int = 512):
+    """Ring attention over `axis_name`. shard_map-internal: all args are the
+    LOCAL shard, layout [b, s_loc, heads_loc, d]; positions are GLOBAL token
+    positions of the local tokens (per-segment positions for packed rows)."""
+    b, s, hh, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    cp_rank = lax.axis_index(axis_name)
+    if q_positions is None:
+        # contiguous chunks: global offset = rank * s_loc
+        base = cp_rank * s + jnp.arange(s, dtype=jnp.int32)
+        q_positions = jnp.broadcast_to(base, (b, s))
+    if kv_positions is None:
+        kv_positions = q_positions
+    if kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _ring(qt, kt, vt, q_positions.astype(jnp.int32),
+              kv_positions.astype(jnp.int32),
+              segment_ids.astype(jnp.int32) if segment_ids is not None else None,
+              kv_segment_ids.astype(jnp.int32) if kv_segment_ids is not None else None,
+              axis_name, scale, causal, (block_q, block_k))
+    return o.transpose(0, 2, 1, 3)
+
+
 def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
-                         segment_ids: Optional[jnp.ndarray] = None):
-    """Global-view CP attention: inputs seq-sharded over cp; GSPMD inserts
-    the all-gather of K/V. Output constrained back to cp-sharded."""
-    out = ops.attention(q, k, v, causal=True, segment_ids=segment_ids)
-    return strategy.constrain(out, strategy.act_attn())
+                         segment_ids=None, position_ids=None,
+                         causal: bool = True, mesh=None):
+    """Global-view wrapper: q/k/v [b, s, h, d] logically sharded
+    (dp, cp, tp, -) — runs the ring inside a shard_map over the strategy mesh
+    (reference: ParallelAttentionOpImpl::DoCompute dispatching AttnCommRing).
+
+    position_ids: per-segment positions (packed rows) or None for contiguous;
+    combined with segment_ids they encode exactly the causal+membership mask.
+    """
+    from hetu_tpu.core.mesh import current_mesh
+    mesh = mesh or current_mesh()
+    if mesh is None:
+        raise ValueError("ring_attention_gspmd needs a mesh "
+                         "(use hetu_tpu.use_mesh)")
+
+    # layouts come from the strategy — one source of truth with the model
+    qkv_spec = strategy.act_attn().partition_spec()
+    tok_spec = strategy.act_tokens().partition_spec()
+    use_seg = segment_ids is not None
+    use_pos = position_ids is not None
+
+    def local(q, k, v, seg, pos):
+        return ring_attention(
+            q, k, v, axis_name="cp",
+            segment_ids=seg if use_seg else None,
+            q_positions=pos if use_pos else None,
+            kv_positions=pos if use_pos else None,
+            causal=causal)
+
+    if not use_seg:
+        segment_ids = jnp.zeros(q.shape[:2], jnp.int32)
+    if not use_pos:
+        position_ids = jnp.zeros(q.shape[:2], jnp.int32)
+
+    fn = jax.shard_map(
+        local, mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec),
+        out_specs=qkv_spec, check_vma=False)
+    return fn(q, k, v, segment_ids, position_ids)

@@ -105,21 +105,43 @@ class Trainer:
         c = self.config
         n_micro = jax.tree.leaves(batches)[0].shape[0]
 
-        def micro(acc, xs):
-            batch, key = xs
-            (lsum, count), grads = jax.value_and_grad(
-                self._loss_fn, has_aux=True)(params, batch, key)
-            acc_g, acc_l, acc_c = acc
-            acc_g = jax.tree.map(jnp.add, acc_g, grads)
-            return (acc_g, acc_l + lsum, acc_c + count), None
+        if self.strategy.pp > 1:
+            # pipeline mode: micro-batching happens INSIDE the model's
+            # circular pipeline (reference CrucialRun micro loop); feed the
+            # whole global batch at once
+            if not c.dropout_deterministic:
+                raise NotImplementedError(
+                    "dropout is not supported inside the pipeline "
+                    "(dropout_deterministic=False with pp > 1)")
+            flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in batches.items()}
 
-        zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32), params)
-        zero = jnp.zeros((), jnp.float32)
-        keys = jax.random.split(rng, n_micro)
-        (gsum, lsum, csum), _ = jax.lax.scan(
-            micro, (zero_g, zero, zero), (batches, keys))
+            def pp_loss(p):
+                return self.model(
+                    p, flat["input_ids"], labels=flat["labels"],
+                    position_ids=flat.get("position_ids"),
+                    segment_ids=flat.get("segment_ids"),
+                    deterministic=True, loss_reduction="sum",
+                    n_micro=n_micro)
+
+            (lsum, csum), grads = jax.value_and_grad(pp_loss, has_aux=True)(params)
+        else:
+            def micro(acc, xs):
+                batch, key = xs
+                (l, count), g = jax.value_and_grad(
+                    self._loss_fn, has_aux=True)(params, batch, key)
+                acc_g, acc_l, acc_c = acc
+                return (jax.tree.map(jnp.add, acc_g, g), acc_l + l,
+                        acc_c + count), None
+
+            zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                                  params)
+            zero = jnp.zeros((), jnp.float32)
+            keys = jax.random.split(rng, n_micro)
+            (grads, lsum, csum), _ = jax.lax.scan(
+                micro, (zero_g, zero, zero), (batches, keys))
+
         denom = jnp.maximum(csum, 1.0)
-        grads = jax.tree.map(lambda g: g / denom, gsum)
+        grads = jax.tree.map(lambda g: g / denom, grads)
         grads, gnorm = optim.clip_by_global_norm(grads, c.grad_clip)
         params, opt_state = self.optimizer.update(grads, opt_state, params)
         metrics = {"loss": lsum / denom, "grad_norm": gnorm,

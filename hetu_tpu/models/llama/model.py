@@ -168,15 +168,32 @@ class LlamaDecoderStack(Module):
     def param_specs(self):
         block_specs = self.block.param_specs()
         if self.config.use_scan:
-            return {"layers": stack_param_specs(block_specs, self.num_layers)}
+            # pp shards the layer dim -> each stage holds its layer slice
+            lead = "pp" if self.strategy.pp > 1 else None
+            return {"layers": stack_param_specs(block_specs, self.num_layers,
+                                                lead_axis=lead)}
         import copy
         return {f"layer_{i}": copy.deepcopy(block_specs)
                 for i in range(self.num_layers)}
 
     def forward(self, params, x, *, cos, sin, position_ids=None,
-                segment_ids=None, rng=None, deterministic=True):
+                segment_ids=None, rng=None, deterministic=True,
+                n_micro: Optional[int] = None):
         c = self.config
+        st = self.strategy
         use_drop = not deterministic and rng is not None
+        if st.pp > 1:
+            if use_drop:
+                raise NotImplementedError("dropout inside the pipeline")
+            if st.cp > 1:
+                raise NotImplementedError("pp x cp composition (nested "
+                                          "manual collectives) — planned")
+            if not c.use_scan:
+                raise ValueError("pipeline parallelism requires use_scan")
+            return self._pipeline_forward(params, x, cos=cos, sin=sin,
+                                          position_ids=position_ids,
+                                          segment_ids=segment_ids,
+                                          n_micro=n_micro)
         layer_rngs = (jax.random.split(rng, self.num_layers)
                       if use_drop else None)
 
@@ -212,6 +229,49 @@ class LlamaDecoderStack(Module):
             x = blk(params[f"layer_{i}"], x)
         return x
 
+    def _pipeline_forward(self, params, x, *, cos, sin, position_ids,
+                          segment_ids, n_micro: Optional[int]):
+        """pp > 1: run the decoder stack through the circular SPMD pipeline
+        (hetu_tpu.parallel.pipeline; reference: executable_graph.cc:803/:836
+        pipeline schedules)."""
+        from hetu_tpu.core.mesh import current_mesh
+        from hetu_tpu.parallel.pipeline import pipeline_apply
+
+        st, c = self.strategy, self.config
+        mesh = current_mesh()
+        if mesh is None:
+            raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
+        pp = st.pp
+        if n_micro is None:
+            n_micro = pp
+        L = self.num_layers
+        if L % pp:
+            raise ValueError(f"num_layers={L} must divide by pp={pp}")
+        per = L // pp
+        stage_params = jax.tree.map(
+            lambda a: a.reshape((pp, per) + a.shape[1:]), params["layers"])
+
+        use_pos = position_ids is not None
+        use_seg = segment_ids is not None
+
+        def stage_body(local_params, x_mb, tok):
+            def body(carry, layer_params):
+                out = self.block(layer_params, carry, cos=cos, sin=sin,
+                                 position_ids=tok["position_ids"] if use_pos else None,
+                                 segment_ids=tok["segment_ids"] if use_seg else None)
+                return out, None
+            out, _ = lax.scan(body, x_mb, local_params)
+            return out
+
+        token_data = {}
+        if use_pos:
+            token_data["position_ids"] = position_ids
+        if use_seg:
+            token_data["segment_ids"] = segment_ids
+        return pipeline_apply(stage_body, stage_params, x, token_data,
+                              n_micro=n_micro, mesh=mesh,
+                              remat=c.remat)
+
 
 class LlamaModel(Module):
     """Backbone: embed + decoder stack + final norm
@@ -232,7 +292,8 @@ class LlamaModel(Module):
                                           param_dtype=c.param_dtype)
 
     def forward(self, params, input_ids, *, position_ids=None,
-                segment_ids=None, rng=None, deterministic=True):
+                segment_ids=None, rng=None, deterministic=True,
+                n_micro=None):
         c, st = self.config, self.strategy
         x = self.embed(params["embed"], input_ids).astype(c.compute_dtype)
         x = st.constrain(x, st.act_hidden())
@@ -241,7 +302,7 @@ class LlamaModel(Module):
             dtype=jnp.float32)
         x = self.layers(params["layers"], x, cos=cos, sin=sin,
                         position_ids=position_ids, segment_ids=segment_ids,
-                        rng=rng, deterministic=deterministic)
+                        rng=rng, deterministic=deterministic, n_micro=n_micro)
         return self.final_norm(params["final_norm"], x)
 
 
@@ -275,10 +336,11 @@ class LlamaLMHeadModel(Module):
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, rng=None, deterministic=True,
-                loss_reduction: str = "mean"):
+                loss_reduction: str = "mean", n_micro=None):
         hidden = self.model(params["model"], input_ids,
                             position_ids=position_ids, segment_ids=segment_ids,
-                            rng=rng, deterministic=deterministic)
+                            rng=rng, deterministic=deterministic,
+                            n_micro=n_micro)
         logits = self.logits(params, hidden)
         if labels is None:
             return logits

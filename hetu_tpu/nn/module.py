@@ -152,24 +152,32 @@ class Module:
         return self.forward(params, *args, **kwargs)
 
 
-def stacked_spec(spec: ParamSpec, num: int) -> ParamSpec:
+def stacked_spec(spec: ParamSpec, num: int,
+                 lead_axis: Optional[str] = None) -> ParamSpec:
     """Lift a ParamSpec to a stack of `num` independent copies with a leading
     layer dim — used by scan-over-layers decoder stacks.  Init vmaps the base
-    initializer over per-layer keys; the layout gains an unsharded leading dim
-    (pipeline stages shard it later via the pipeline engine)."""
+    initializer over per-layer keys.  `lead_axis` shards the layer dim (the
+    pipeline-stage placement: each pp rank holds its own layer slice)."""
     base_init = spec.init
 
     def init(key, shape, dtype):
         keys = jax.random.split(key, shape[0])
         return jax.vmap(lambda k: base_init(k, shape[1:], dtype))(keys)
 
-    ds = spec.ds.shifted(1) if spec.ds is not None else None
+    lead = ((lead_axis,) if lead_axis else (),)
+    if spec.ds is not None:
+        ds = spec.ds.shifted(1, lead=lead)
+    elif lead_axis:
+        from hetu_tpu.dstates import DistributedStates
+        ds = DistributedStates.make(len(spec.shape) + 1, {0: lead_axis})
+    else:
+        ds = None
     return ParamSpec((num,) + spec.shape, spec.dtype, init, ds)
 
 
-def stack_param_specs(specs, num: int):
+def stack_param_specs(specs, num: int, lead_axis: Optional[str] = None):
     """Map stacked_spec over a nested spec dict."""
-    return jax.tree.map(lambda s: stacked_spec(s, num), specs,
+    return jax.tree.map(lambda s: stacked_spec(s, num, lead_axis), specs,
                         is_leaf=lambda s: isinstance(s, ParamSpec))
 
 

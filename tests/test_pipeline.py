@@ -1,0 +1,87 @@
+"""Pipeline parallelism tests on the virtual mesh (the reference's pipeline
+needs >=8 real GPUs — tests/ci_test dp2·tp2·pp2; here the same topology runs
+hardware-free)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel import ParallelStrategy
+
+
+def _ids(b=4, s=64, vocab=256, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(0, vocab, (b, s)),
+                       jnp.int32)
+
+
+def test_pp_forward_matches_single_device():
+    ids = _ids()
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    golden_model = LlamaLMHeadModel(cfg, ParallelStrategy())
+    gp = golden_model.init(jax.random.key(2))
+    golden = golden_model(gp, ids)
+
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    mesh = st.build_mesh()
+    model = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(2), mesh=mesh)
+        out = jax.jit(lambda p, x: model(p, x, n_micro=2))(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_pp_tp_dp_train_step():
+    # the reference CI topology: dp2 x tp2 x pp2 on 8 devices
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    cfg = LlamaConfig.tiny(remat=True)
+    st = ParallelStrategy(mesh=MeshConfig(dp=2, tp=2, pp=2),
+                          sequence_parallel=True)
+    model = LlamaLMHeadModel(cfg, st)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=64,
+                        lr=3e-3, warmup_steps=2, total_steps=20, log_every=100)
+    tr = Trainer(model, tc, st).build()
+    from hetu_tpu.data import pad_batch
+    rng = np.random.default_rng(0)
+    batch = pad_batch([rng.integers(1, 250, size=60) for _ in range(8)], 64)
+    losses = [float(tr.train_step(batch)["loss"]) for _ in range(8)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.3, losses
+
+
+def test_pp_grads_match_single_device():
+    ids = _ids(b=4, s=32)
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    golden_model = LlamaLMHeadModel(cfg, ParallelStrategy())
+    gp = golden_model.init(jax.random.key(5))
+    ggrads = jax.grad(lambda p: golden_model(p, ids, labels=ids))(gp)
+
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    mesh = st.build_mesh()
+    model = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(5), mesh=mesh)
+        grads = jax.jit(jax.grad(
+            lambda p: model(p, ids, labels=ids, n_micro=2)))(params)
+    for a, b in zip(jax.tree.leaves(grads), jax.tree.leaves(ggrads)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-4)
+
+
+def test_pp_requires_divisible_layers():
+    cfg = LlamaConfig.tiny()  # 2 layers
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    model = LlamaLMHeadModel(cfg, st)
+    mesh = st.build_mesh()
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(0), mesh=mesh)
+    # 2 layers / pp2 ok; 3-layer config fails at sharded init (layer dim
+    # not divisible over pp)
+    cfg3 = LlamaConfig.tiny(num_hidden_layers=3)
+    m3 = LlamaLMHeadModel(cfg3, st)
+    with ht.use_mesh(mesh):
+        with pytest.raises(Exception):
+            m3.init(jax.random.key(0), mesh=mesh)

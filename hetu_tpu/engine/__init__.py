@@ -1,3 +1,4 @@
 from hetu_tpu.engine.trainer_config import TrainingConfig
 from hetu_tpu.engine.trainer import Trainer
 from hetu_tpu.engine.plan_pool import PlanPool
+from hetu_tpu.engine.hot_switch import HotSwitchTrainer

@@ -1,0 +1,130 @@
+"""Hot-switching trainer.
+
+Rebuild of the reference's multi-strategy training flow
+(reference: examples/hotspa/llama_hot_switch_trainer.py — per-seq-len-bucket
+strategies selected per batch, --hot_switch :58; DefineAndRunGraph's plan
+pool + SwitchExecGraph under the hood, define_and_run_graph.cc:1258-1272).
+
+The trainer keeps one compiled train step per strategy (the plan pool) and
+reshards (params, opt_state) with the switch engine whenever the requested
+strategy differs from the live one.  Switch latency is one resharding
+device_put — the reference's batched-P2P ParamSlice program, compiler-planned.
+"""
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+import jax
+import numpy as np
+
+import hetu_tpu  # noqa: F401  (package context)
+from hetu_tpu.core.mesh import use_mesh
+from hetu_tpu.engine.trainer import Trainer
+from hetu_tpu.engine.trainer_config import TrainingConfig
+from hetu_tpu.parallel.strategy import ParallelStrategy
+from hetu_tpu.parallel.switch import StrategyHandle, StrategySwitcher, SwitchMode
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("hot_switch")
+
+
+class HotSwitchTrainer(Trainer):
+    """Trainer over a pool of strategies (one model instance per strategy,
+    same architecture/config, different layouts)."""
+
+    def __init__(self, model_factory, config: TrainingConfig,
+                 strategies: List[ParallelStrategy], **kw):
+        """model_factory(strategy) -> model instance."""
+        self.model_factory = model_factory
+        self.strategies = list(strategies)
+        self.active_id = 0
+        self._handles: Dict[int, StrategyHandle] = {}
+        self._steps: Dict[int, object] = {}
+        model0 = model_factory(strategies[0])
+        super().__init__(model0, config, strategies[0], **kw)
+
+    # ------------------------------------------------------------------
+    def _handle(self, sid: int) -> StrategyHandle:
+        h = self._handles.get(sid)
+        if h is None:
+            st = self.strategies[sid]
+            model = (self.model if sid == self.active_id and self.params is not None
+                     else self.model_factory(st))
+            mesh = st.build_mesh()
+            pshard = model.shardings(mesh)
+            abstract = model.abstract_params()
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            from hetu_tpu.optim.optimizer import zero_shardings
+            if st.zero:
+                sshard = {
+                    "step": NamedSharding(mesh, P()),
+                    "m": zero_shardings(pshard, abstract, mesh, "dp"),
+                    "v": zero_shardings(pshard, abstract, mesh, "dp"),
+                }
+            else:
+                sshard = {"step": NamedSharding(mesh, P()),
+                          "m": pshard, "v": pshard}
+            h = StrategyHandle(st, model, mesh, pshard, sshard)
+            self._handles[sid] = h
+        return h
+
+    def switch_to(self, sid: int,
+                  mode: SwitchMode = SwitchMode.PARAM_AND_OPTIMIZER):
+        """Hot-switch the live training state to strategy `sid`
+        (reference: SwitchExecGraph::SwitchParams)."""
+        if sid == self.active_id:
+            return self
+        if self.params is None:
+            raise RuntimeError("HotSwitchTrainer.build() must run before "
+                               "switching strategies")
+        t0 = time.perf_counter()
+        dst = self._handle(sid)
+        switcher = StrategySwitcher(self._handles)
+        self.params, new_state = switcher.switch(
+            self.params, self.opt_state, sid, mode=mode)
+        if new_state is None:  # PARAM mode: rebuild optimizer moments
+            old_step = self.opt_state["step"] if self.opt_state else None
+            with use_mesh(dst.mesh):
+                self.opt_state = jax.jit(
+                    self.optimizer.init,
+                    out_shardings=dst.state_shardings)(self.params)
+            if old_step is not None:
+                # keep the schedule position (the reference's param-mode
+                # switch does not rewind training progress)
+                self.opt_state["step"] = jax.device_put(
+                    old_step, dst.state_shardings["step"])
+        else:
+            self.opt_state = new_state
+        self.active_id = sid
+        self.model = dst.model
+        self.strategy = dst.strategy
+        self.mesh = dst.mesh
+        self._pshard, self._sshard = dst.param_shardings, dst.state_shardings
+        self._step_fn = self._steps.get(sid)
+        if self._step_fn is None:
+            with use_mesh(dst.mesh):
+                self._step_fn = jax.jit(
+                    self._train_step,
+                    out_shardings=(dst.param_shardings, dst.state_shardings,
+                                   None),
+                    donate_argnums=(0, 1))
+            self._steps[sid] = self._step_fn
+        logger.info(f"hot-switch -> strategy {sid} ({dst.strategy.describe()}) "
+                    f"in {time.perf_counter() - t0:.3f}s")
+        return self
+
+    def build(self, rng=None):
+        super().build(rng)
+        self._handles[self.active_id] = StrategyHandle(
+            self.strategy, self.model, self.mesh, self._pshard, self._sshard)
+        self._steps[self.active_id] = self._step_fn
+        return self
+
+    def train_step(self, host_batch, strategy_id: Optional[int] = None):
+        """Per-batch strategy dispatch (the Hydraulis/HotSPa pattern:
+        pick the strategy for this batch's seq-len bucket, switch if needed,
+        then step)."""
+        if strategy_id is not None:
+            self.switch_to(strategy_id)
+        return super().train_step(host_batch)

@@ -1,1 +1,2 @@
 from hetu_tpu.models.llama import LlamaConfig, LlamaModel, LlamaLMHeadModel
+from hetu_tpu.models.gpt import GPTConfig, GPTModel, GPTLMHeadModel

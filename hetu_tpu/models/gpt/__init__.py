@@ -1,0 +1,1 @@
+from hetu_tpu.models.gpt.model import GPTConfig, GPTModel, GPTLMHeadModel

@@ -1,0 +1,298 @@
+"""GPT-2/3 model family.
+
+Rebuild of the reference GPT (reference: python/hetu/models/gpt/gpt_model.py +
+tests/ci_test/hetu_gpt_ds_parallel.py — the CI workload model): learned
+position embeddings, pre-LN blocks, GELU MLP, MHA with biases, tied LM head
+by default.  Shares the TPU-first machinery of the LLaMA family (strategy-
+driven layouts, scan-over-layers + remat, flash attention, pipeline, CP).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hetu_tpu import ops
+from hetu_tpu.dstates import DistributedStates as DS
+from hetu_tpu.nn import initializers as init
+from hetu_tpu.nn.module import Module, stack_param_specs
+from hetu_tpu.nn.parallel import (ParallelLayerNorm, RowParallelLinear,
+                                  VocabParallelEmbedding)
+from hetu_tpu.parallel.strategy import ParallelStrategy
+
+
+@dataclasses.dataclass
+class GPTConfig:
+    vocab_size: int = 50257
+    hidden_size: int = 768
+    num_hidden_layers: int = 12
+    num_attention_heads: int = 12
+    max_position_embeddings: int = 1024
+    layer_norm_eps: float = 1e-5
+    tie_word_embeddings: bool = True
+    initializer_range: float = 0.02
+    attention_dropout: float = 0.0
+    hidden_dropout: float = 0.0
+
+    param_dtype: object = jnp.float32
+    compute_dtype: object = jnp.bfloat16
+    use_scan: bool = True
+    remat: bool = True
+    use_flash_attention: bool = True
+
+    @property
+    def head_dim(self) -> int:
+        return self.hidden_size // self.num_attention_heads
+
+    @property
+    def intermediate_size(self) -> int:
+        return 4 * self.hidden_size
+
+    @staticmethod
+    def tiny(**kw) -> "GPTConfig":
+        d = dict(vocab_size=256, hidden_size=64, num_hidden_layers=2,
+                 num_attention_heads=4, max_position_embeddings=256)
+        d.update(kw)
+        return GPTConfig(**d)
+
+    @staticmethod
+    def gpt2_large(**kw) -> "GPTConfig":
+        d = dict(hidden_size=1280, num_hidden_layers=36,
+                 num_attention_heads=20)
+        d.update(kw)
+        return GPTConfig(**d)
+
+    def num_params(self) -> int:
+        h, L, v = self.hidden_size, self.num_hidden_layers, self.vocab_size
+        per_layer = 4 * h * h + 2 * 4 * h * h + 9 * h + 4 * h  # qkv/o + mlp + biases/norms
+        emb = v * h + self.max_position_embeddings * h
+        return L * per_layer + emb + 2 * h
+
+    def flops_per_token(self, seq_len: int) -> float:
+        n = self.num_params()
+        return 6.0 * n + 12 * self.num_hidden_layers * self.hidden_size * seq_len
+
+
+class GPTAttention(Module):
+    """MHA with biases (reference: gpt_model.py GPTAttention)."""
+
+    def __init__(self, config: GPTConfig, strategy: ParallelStrategy):
+        super().__init__()
+        self.config, self.strategy = config, strategy
+        c, hd = config, config.head_dim
+        self.n_heads = c.num_attention_heads
+        if self.n_heads % max(strategy.tp, 1):
+            raise ValueError(f"heads={self.n_heads} vs tp={strategy.tp}")
+        # [h, heads, 3, hd]: per head [q|k|v] — TP splits the heads dim
+        qkv_ds = DS.make(4, {1: "tp"}) if strategy.tp > 1 else None
+        self.param("wqkv", (c.hidden_size, self.n_heads, 3, hd),
+                   init.normal(c.initializer_range), dtype=c.param_dtype,
+                   ds=qkv_ds)
+        self.param("bqkv", (self.n_heads, 3, hd), init.zeros,
+                   dtype=c.param_dtype,
+                   ds=DS.make(3, {0: "tp"}) if strategy.tp > 1 else None)
+        self.o_proj = RowParallelLinear(
+            c.hidden_size, c.hidden_size, strategy, bias=True,
+            param_dtype=c.param_dtype,
+            weight_init=init.normal(c.initializer_range))
+
+    def forward(self, params, x, *, position_ids=None, segment_ids=None,
+                rng=None, deterministic=True):
+        c, st = self.config, self.strategy
+        b, s, h = x.shape
+        hd = c.head_dim
+        qkv = jnp.einsum("bsh,hngd->bsngd", x, params["wqkv"].astype(x.dtype))
+        qkv = qkv + params["bqkv"].astype(x.dtype)
+        qkv = st.constrain(qkv, st.act_qkv())
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        use_attn_dropout = (c.attention_dropout > 0.0 and not deterministic
+                            and rng is not None)
+        if st.cp > 1:
+            from hetu_tpu.parallel.ring_attention import ring_attention_gspmd
+            attn = ring_attention_gspmd(q, k, v, strategy=st,
+                                        segment_ids=segment_ids,
+                                        position_ids=position_ids)
+        elif use_attn_dropout:
+            attn = ops.attention(q, k, v, causal=True, segment_ids=segment_ids,
+                                 dropout_rate=c.attention_dropout,
+                                 dropout_rng=jax.random.fold_in(rng, 1))
+        else:
+            attn = ops.flash_attention(
+                q, k, v, causal=True, segment_ids=segment_ids,
+                use_pallas=None if c.use_flash_attention else False)
+        attn = st.constrain(attn, st.act_attn())
+        return self.o_proj(params["o_proj"], attn.reshape(b, s, h))
+
+
+class GPTMLP(Module):
+    def __init__(self, config: GPTConfig, strategy: ParallelStrategy):
+        super().__init__()
+        self.strategy = strategy
+        c = config
+        i = c.intermediate_size
+        self.param("w_up", (c.hidden_size, i),
+                   init.normal(c.initializer_range), dtype=c.param_dtype,
+                   ds=strategy.col_weight())
+        self.param("b_up", (i,), init.zeros, dtype=c.param_dtype,
+                   ds=strategy.col_bias())
+        self.down = RowParallelLinear(i, c.hidden_size, strategy, bias=True,
+                                      param_dtype=c.param_dtype,
+                                      weight_init=init.normal(c.initializer_range))
+
+    def forward(self, params, x):
+        st = self.strategy
+        y = x @ params["w_up"].astype(x.dtype) + params["b_up"].astype(x.dtype)
+        y = st.constrain(y, st.act_inner())
+        return self.down(params["down"], ops.gelu(y))
+
+
+class GPTBlock(Module):
+    def __init__(self, config: GPTConfig, strategy: ParallelStrategy):
+        super().__init__()
+        self.config = config
+        c = config
+        self.ln1 = ParallelLayerNorm(c.hidden_size, strategy,
+                                     eps=c.layer_norm_eps,
+                                     param_dtype=c.param_dtype)
+        self.attn = GPTAttention(c, strategy)
+        self.ln2 = ParallelLayerNorm(c.hidden_size, strategy,
+                                     eps=c.layer_norm_eps,
+                                     param_dtype=c.param_dtype)
+        self.mlp = GPTMLP(c, strategy)
+
+    def forward(self, params, x, *, position_ids=None, segment_ids=None,
+                rng=None, deterministic=True):
+        c = self.config
+        h = self.attn(params["attn"], self.ln1(params["ln1"], x),
+                      position_ids=position_ids, segment_ids=segment_ids,
+                      rng=rng, deterministic=deterministic)
+        if not deterministic and rng is not None:
+            h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 2),
+                            deterministic)
+        x = x + h
+        h = self.mlp(params["mlp"], self.ln2(params["ln2"], x))
+        if not deterministic and rng is not None:
+            h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 3),
+                            deterministic)
+        return x + h
+
+
+class GPTModel(Module):
+    """Backbone (reference: gpt_model.py GPTModel)."""
+
+    def __init__(self, config: GPTConfig,
+                 strategy: Optional[ParallelStrategy] = None):
+        super().__init__()
+        strategy = strategy or ParallelStrategy()
+        self.config, self.strategy = config, strategy
+        c = config
+        self.wte = VocabParallelEmbedding(
+            c.vocab_size, c.hidden_size, strategy, param_dtype=c.param_dtype,
+            weight_init=init.normal(c.initializer_range))
+        self.param("wpe", (c.max_position_embeddings, c.hidden_size),
+                   init.normal(c.initializer_range), dtype=c.param_dtype)
+        self.block = GPTBlock(c, strategy)
+        self.final_ln = ParallelLayerNorm(c.hidden_size, strategy,
+                                          eps=c.layer_norm_eps,
+                                          param_dtype=c.param_dtype)
+
+    def param_specs(self):
+        out = dict(self._params)
+        out["wte"] = self.wte.param_specs()
+        out["final_ln"] = self.final_ln.param_specs()
+        block_specs = self.block.param_specs()
+        if self.config.use_scan:
+            lead = "pp" if self.strategy.pp > 1 else None
+            out["blocks"] = stack_param_specs(
+                block_specs, self.config.num_hidden_layers, lead_axis=lead)
+        else:
+            import copy
+            for i in range(self.config.num_hidden_layers):
+                out[f"block_{i}"] = copy.deepcopy(block_specs)
+        return out
+
+    def forward(self, params, input_ids, *, position_ids=None,
+                segment_ids=None, rng=None, deterministic=True):
+        c, st = self.config, self.strategy
+        if st.pp > 1:
+            raise NotImplementedError(
+                "GPT pipeline parallelism: use the LLaMA family or pp=1 "
+                "(planned)")
+        b, s = input_ids.shape
+        pos = position_ids if position_ids is not None else \
+            jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
+        x = self.wte(params["wte"], input_ids)
+        x = x + jnp.take(params["wpe"], pos, axis=0)
+        x = x.astype(c.compute_dtype)
+        x = st.constrain(x, st.act_hidden())
+
+        use_drop = not deterministic and rng is not None
+        layer_rngs = (jax.random.split(rng, c.num_hidden_layers)
+                      if use_drop else None)
+        if c.use_scan:
+            def body(carry, xs):
+                layer_params, layer_rng = xs
+                return self.block(layer_params, carry,
+                                  position_ids=position_ids,
+                                  segment_ids=segment_ids,
+                                  rng=layer_rng if use_drop else None,
+                                  deterministic=deterministic), None
+            fn = body
+            if c.remat:
+                fn = jax.checkpoint(
+                    body, policy=jax.checkpoint_policies.nothing_saveable)
+            xs = (params["blocks"],
+                  layer_rngs if use_drop else
+                  jnp.zeros((c.num_hidden_layers,), jnp.uint32))
+            x, _ = lax.scan(fn, x, xs)
+        else:
+            for i in range(c.num_hidden_layers):
+                x = self.block(params[f"block_{i}"], x,
+                               position_ids=position_ids,
+                               segment_ids=segment_ids,
+                               rng=layer_rngs[i] if use_drop else None,
+                               deterministic=deterministic)
+        return self.final_ln(params["final_ln"], x)
+
+
+class GPTLMHeadModel(Module):
+    """LM head (tied by default — reference GPTLMHeadModel)."""
+
+    def __init__(self, config: GPTConfig,
+                 strategy: Optional[ParallelStrategy] = None):
+        super().__init__()
+        strategy = strategy or ParallelStrategy()
+        self.config, self.strategy = config, strategy
+        self.model = GPTModel(config, strategy)
+        if not config.tie_word_embeddings:
+            lm_ds = DS.make(2, {1: "tp"}) if strategy.tp > 1 else None
+            self.param("lm_head", (config.hidden_size, config.vocab_size),
+                       init.normal(config.initializer_range),
+                       dtype=config.param_dtype, ds=lm_ds)
+
+    def forward(self, params, input_ids, labels=None, *, position_ids=None,
+                segment_ids=None, loss_reduction: str = "mean", rng=None,
+                deterministic=True, n_micro=None):
+        hidden = self.model(params["model"], input_ids,
+                            position_ids=position_ids,
+                            segment_ids=segment_ids, rng=rng,
+                            deterministic=deterministic)
+        if self.config.tie_word_embeddings:
+            w = params["model"]["wte"]["weight"].astype(hidden.dtype).T
+        else:
+            w = params["lm_head"].astype(hidden.dtype)
+        logits = hidden @ w
+        logits = self.strategy.constrain(logits, self.strategy.act_logits())
+        if labels is None:
+            return logits
+        tgt = labels[:, 1:]
+        if loss_reduction == "sum":
+            loss = ops.softmax_cross_entropy_sparse(
+                logits[:, :-1, :], tgt, ignore_index=-100, reduction="sum")
+            count = jnp.sum((tgt != -100).astype(jnp.float32))
+            return loss, count
+        return ops.softmax_cross_entropy_sparse(
+            logits[:, :-1, :], tgt, ignore_index=-100)

@@ -24,6 +24,12 @@ class LlamaConfig:
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
 
+    # MoE (0 experts = dense; reference: v1 HetuMoE semantics, SURVEY §2.4)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
+    moe_gate: str = "topk"
+
     # TPU-build knobs
     param_dtype: object = jnp.float32
     compute_dtype: object = jnp.bfloat16
@@ -78,7 +84,8 @@ class LlamaConfig:
     def num_params(self) -> int:
         h, i, v, L = self.hidden_size, self.intermediate_size, self.vocab_size, self.num_hidden_layers
         kvh = self.num_key_value_heads * self.head_dim
-        per_layer = h * (h + 2 * kvh + h) + 3 * h * i + 2 * h  # attn + mlp + norms
+        ffn = 3 * h * i * max(self.num_experts, 1)
+        per_layer = h * (h + 2 * kvh + h) + ffn + 2 * h  # attn + ffn + norms
         emb = v * h * (1 if self.tie_word_embeddings else 2)
         return L * per_layer + emb + h
 

@@ -134,10 +134,21 @@ class LlamaBlock(Module):
         self.post_norm = ParallelRMSNorm(c.hidden_size, strategy,
                                          eps=c.rms_norm_eps,
                                          param_dtype=c.param_dtype)
-        self.mlp = LlamaMLP(c, strategy)
+        if c.num_experts > 0:
+            from hetu_tpu.nn.moe import MoEConfig, MoELayer
+            self.mlp = MoELayer(
+                c.hidden_size, c.intermediate_size,
+                MoEConfig(num_experts=c.num_experts, top_k=c.moe_top_k,
+                          capacity_factor=c.moe_capacity_factor,
+                          gate=c.moe_gate),
+                strategy, param_dtype=c.param_dtype,
+                initializer_range=c.initializer_range)
+        else:
+            self.mlp = LlamaMLP(c, strategy)
 
     def forward(self, params, x, *, cos, sin, position_ids=None,
-                segment_ids=None, rng=None, deterministic=True):
+                segment_ids=None, rng=None, deterministic=True,
+                token_ids=None):
         c = self.config
         h = self.attn(params["attn"],
                       self.input_norm(params["input_norm"], x),
@@ -148,11 +159,17 @@ class LlamaBlock(Module):
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 2),
                             deterministic)
         x = x + h
-        h = self.mlp(params["mlp"], self.post_norm(params["post_norm"], x))
+        aux = jnp.zeros((), jnp.float32)
+        if c.num_experts > 0:
+            h, aux = self.mlp(params["mlp"],
+                              self.post_norm(params["post_norm"], x),
+                              token_ids=token_ids)
+        else:
+            h = self.mlp(params["mlp"], self.post_norm(params["post_norm"], x))
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 3),
                             deterministic)
-        return x + h
+        return x + h, aux
 
 
 class LlamaDecoderStack(Module):
@@ -178,7 +195,7 @@ class LlamaDecoderStack(Module):
 
     def forward(self, params, x, *, cos, sin, position_ids=None,
                 segment_ids=None, rng=None, deterministic=True,
-                n_micro: Optional[int] = None):
+                n_micro: Optional[int] = None, token_ids=None):
         c = self.config
         st = self.strategy
         use_drop = not deterministic and rng is not None
@@ -190,21 +207,24 @@ class LlamaDecoderStack(Module):
                                           "manual collectives) — planned")
             if not c.use_scan:
                 raise ValueError("pipeline parallelism requires use_scan")
-            return self._pipeline_forward(params, x, cos=cos, sin=sin,
-                                          position_ids=position_ids,
-                                          segment_ids=segment_ids,
-                                          n_micro=n_micro)
+            return (self._pipeline_forward(params, x, cos=cos, sin=sin,
+                                           position_ids=position_ids,
+                                           segment_ids=segment_ids,
+                                           n_micro=n_micro),
+                    jnp.zeros((), jnp.float32))
         layer_rngs = (jax.random.split(rng, self.num_layers)
                       if use_drop else None)
 
         def body(carry, xs):
+            x_c, aux_c = carry
             layer_params, layer_rng = xs
-            out = self.block(layer_params, carry, cos=cos, sin=sin,
-                             position_ids=position_ids,
-                             segment_ids=segment_ids,
-                             rng=layer_rng if use_drop else None,
-                             deterministic=deterministic)
-            return out, None
+            out, aux = self.block(layer_params, x_c, cos=cos, sin=sin,
+                                  position_ids=position_ids,
+                                  segment_ids=segment_ids,
+                                  rng=layer_rng if use_drop else None,
+                                  deterministic=deterministic,
+                                  token_ids=token_ids)
+            return (out, aux_c + aux), None
 
         if c.use_scan:
             fn = body
@@ -214,20 +234,23 @@ class LlamaDecoderStack(Module):
             xs = (params["layers"],
                   layer_rngs if use_drop else
                   jnp.zeros((self.num_layers,), jnp.uint32))
-            x, _ = lax.scan(fn, x, xs)
-            return x
+            (x, aux), _ = lax.scan(fn, (x, jnp.zeros((), jnp.float32)), xs)
+            return x, aux
 
+        aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.num_layers):
             def blk(p, y, i=i):
                 return self.block(p, y, cos=cos, sin=sin,
                                   position_ids=position_ids,
                                   segment_ids=segment_ids,
                                   rng=layer_rngs[i] if use_drop else None,
-                                  deterministic=deterministic)
+                                  deterministic=deterministic,
+                                  token_ids=token_ids)
             if c.remat:
                 blk = jax.checkpoint(blk)
-            x = blk(params[f"layer_{i}"], x)
-        return x
+            x, aux = blk(params[f"layer_{i}"], x)
+            aux_total = aux_total + aux
+        return x, aux_total
 
     def _pipeline_forward(self, params, x, *, cos, sin, position_ids,
                           segment_ids, n_micro: Optional[int]):
@@ -254,11 +277,15 @@ class LlamaDecoderStack(Module):
         use_pos = position_ids is not None
         use_seg = segment_ids is not None
 
+        if self.config.num_experts > 0:
+            raise NotImplementedError("MoE inside the pipeline — planned")
+
         def stage_body(local_params, x_mb, tok):
             def body(carry, layer_params):
-                out = self.block(layer_params, carry, cos=cos, sin=sin,
-                                 position_ids=tok["position_ids"] if use_pos else None,
-                                 segment_ids=tok["segment_ids"] if use_seg else None)
+                out, _aux = self.block(
+                    layer_params, carry, cos=cos, sin=sin,
+                    position_ids=tok["position_ids"] if use_pos else None,
+                    segment_ids=tok["segment_ids"] if use_seg else None)
                 return out, None
             out, _ = lax.scan(body, x_mb, local_params)
             return out
@@ -300,10 +327,12 @@ class LlamaModel(Module):
         cos, sin = ops.build_rope_cache(
             c.max_position_embeddings, c.head_dim, c.rope_theta,
             dtype=jnp.float32)
-        x = self.layers(params["layers"], x, cos=cos, sin=sin,
-                        position_ids=position_ids, segment_ids=segment_ids,
-                        rng=rng, deterministic=deterministic, n_micro=n_micro)
-        return self.final_norm(params["final_norm"], x)
+        x, aux = self.layers(params["layers"], x, cos=cos, sin=sin,
+                             position_ids=position_ids,
+                             segment_ids=segment_ids,
+                             rng=rng, deterministic=deterministic,
+                             n_micro=n_micro, token_ids=input_ids)
+        return self.final_norm(params["final_norm"], x), aux
 
 
 class LlamaLMHeadModel(Module):
@@ -336,11 +365,15 @@ class LlamaLMHeadModel(Module):
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, rng=None, deterministic=True,
-                loss_reduction: str = "mean", n_micro=None):
-        hidden = self.model(params["model"], input_ids,
-                            position_ids=position_ids, segment_ids=segment_ids,
-                            rng=rng, deterministic=deterministic,
-                            n_micro=n_micro)
+                loss_reduction: str = "mean", n_micro=None,
+                include_aux_loss: bool = True):
+        """include_aux_loss: fold MoE router losses into the returned loss
+        (disable for evaluation so perplexity stays comparable to dense)."""
+        hidden, aux = self.model(params["model"], input_ids,
+                                 position_ids=position_ids,
+                                 segment_ids=segment_ids,
+                                 rng=rng, deterministic=deterministic,
+                                 n_micro=n_micro)
         logits = self.logits(params, hidden)
         if labels is None:
             return logits
@@ -355,7 +388,11 @@ class LlamaLMHeadModel(Module):
             loss = ops.softmax_cross_entropy_sparse(
                 logits[:, :-1, :], tgt, ignore_index=-100, reduction="sum")
             count = jnp.sum((tgt != -100).astype(jnp.float32))
+            # aux (MoE router losses) scales with the token count so that
+            # sum/count recovers mean-loss + aux
+            if include_aux_loss:
+                loss = loss + aux * count
             return loss, count
         loss = ops.softmax_cross_entropy_sparse(
             logits[:, :-1, :], tgt, ignore_index=-100)
-        return loss
+        return loss + aux if include_aux_loss else loss

@@ -1,0 +1,150 @@
+"""Mixture-of-Experts with expert parallelism.
+
+Rebuild of the reference MoE (reference: hetu/v1/python/hetu/layers/
+moe_layer.py + gates Top/KTop1/Hash/Balance + Dispatch.py and hierarchical
+all-to-all HAllToAll.py — v1-only features per SURVEY.md §2.4 EP row).
+
+TPU-first design (GShard/Switch style):
+- experts are ONE stacked parameter [E, ...] sharded over the `ep` mesh axis.
+- dispatch/combine are einsums against a one-hot routing mask with a fixed
+  per-expert capacity — static shapes, MXU-friendly, and GSPMD lowers the
+  token->expert movement to all-to-all over ep (the reference's explicit
+  HAllToAll becomes compiler-inserted; mesh axis order already makes it
+  hierarchical: ICI within a slice, DCN across).
+- router: softmax gate with top-k (k=1/2), capacity dropping, load-balance
+  auxiliary loss (Switch-style) and router z-loss; a HashGate mirrors the
+  reference's hash gate for ablations.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import ops
+from hetu_tpu.dstates import DistributedStates as DS
+from hetu_tpu.nn import initializers as init
+from hetu_tpu.nn.module import Module
+from hetu_tpu.parallel.strategy import ParallelStrategy
+
+
+@dataclasses.dataclass
+class MoEConfig:
+    num_experts: int = 8
+    top_k: int = 2
+    capacity_factor: float = 1.25
+    router_z_loss_coef: float = 1e-3
+    load_balance_coef: float = 1e-2
+    gate: str = "topk"  # "topk" | "hash"
+
+
+def _router_probs(logits):
+    return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+
+
+def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
+    """Returns (dispatch [T, E, C] bool, combine [T, E, C] f32, aux_loss).
+
+    T = tokens, E = experts, C = capacity.  Top-k softmax routing with
+    position-in-expert capacity dropping (GShard); aux = load-balance +
+    z-loss (reference gate variants: v1 gates Top/KTop1/Balance)."""
+    T, E = logits.shape
+    probs = _router_probs(logits)                      # [T, E]
+
+    if moe.gate == "hash":
+        # reference HashGate: expert = token_id % E (no learned routing)
+        expert_idx = (ids % E)[:, None]                # [T, 1]
+        gate_vals = jnp.ones((T, 1), jnp.float32)
+        k = 1
+    else:
+        k = moe.top_k
+        gate_vals, expert_idx = jax.lax.top_k(probs, k)   # [T, k]
+        # renormalize the kept gates
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+
+    # position of each token within its expert (for capacity) — computed per
+    # k-slot sequentially so slot-0 assignments take priority
+    dispatch = jnp.zeros((T, E, capacity), jnp.bool_)
+    combine = jnp.zeros((T, E, capacity), jnp.float32)
+    fill = jnp.zeros((E,), jnp.int32)
+    for slot in range(k):
+        e = expert_idx[:, slot]                        # [T]
+        onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)  # [T, E]
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - onehot)  # arrivals before t
+        pos = jnp.take_along_axis(pos_in_e, e[:, None], axis=1)[:, 0] + fill[e]
+        keep = pos < capacity
+        pos_c = jnp.clip(pos, 0, capacity - 1)
+        upd = (jax.nn.one_hot(e, E, dtype=jnp.float32)[:, :, None] *
+               jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)[:, None, :])
+        upd = upd * keep[:, None, None]
+        dispatch = dispatch | (upd > 0)
+        combine = combine + upd * gate_vals[:, slot][:, None, None]
+        fill = fill + jnp.sum(
+            jax.nn.one_hot(e, E, dtype=jnp.int32) * keep[:, None], axis=0)
+
+    # aux losses
+    me = jnp.mean(probs, axis=0)                       # mean prob per expert
+    ce = jnp.mean(
+        jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
+    load_balance = E * jnp.sum(me * ce)
+    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32),
+                                             axis=-1)))
+    aux = moe.load_balance_coef * load_balance + moe.router_z_loss_coef * z
+    return dispatch, combine, aux
+
+
+class MoELayer(Module):
+    """Sparse SwiGLU FFN: router + E experts, expert dim sharded over ep
+    (reference: v1 moe_layer.py MoELayer; dense path = LlamaMLP)."""
+
+    def __init__(self, hidden_size: int, intermediate_size: int,
+                 moe: MoEConfig, strategy: ParallelStrategy,
+                 param_dtype=jnp.float32, initializer_range: float = 0.02):
+        super().__init__()
+        self.moe, self.strategy = moe, strategy
+        self.hidden, self.inter = hidden_size, intermediate_size
+        E = moe.num_experts
+        if E % max(strategy.ep, 1):
+            raise ValueError(f"num_experts={E} must divide by ep={strategy.ep}")
+        ep_ds = DS.make(4, {0: "ep", 3: "tp"}) if strategy.ep > 1 or strategy.tp > 1 else None
+        dn_ds = DS.make(3, {0: "ep", 1: "tp"}) if strategy.ep > 1 or strategy.tp > 1 else None
+        self.param("router", (hidden_size, E), init.normal(initializer_range),
+                   dtype=jnp.float32)
+        self.param("w_gate_up", (E, hidden_size, 2, intermediate_size),
+                   init.normal(initializer_range), dtype=param_dtype, ds=ep_ds)
+        self.param("w_down", (E, intermediate_size, hidden_size),
+                   init.normal(initializer_range), dtype=param_dtype, ds=dn_ds)
+
+    def forward(self, params, x, *, token_ids: Optional[jnp.ndarray] = None):
+        """x: [b, s, h] -> ([b, s, h], aux_loss)."""
+        moe, st = self.moe, self.strategy
+        b, s, h = x.shape
+        T = b * s
+        E = moe.num_experts
+        capacity = int(moe.capacity_factor * T * max(moe.top_k, 1) / E)
+        capacity = max(8, min(T, -(-capacity // 8) * 8))  # mult of 8
+
+        xt = x.reshape(T, h)
+        logits = xt.astype(jnp.float32) @ params["router"]
+        ids = (token_ids.reshape(T) if token_ids is not None
+               else jnp.arange(T, dtype=jnp.int32))
+        dispatch, combine, aux = topk_routing(logits, ids, moe, capacity)
+
+        # dispatch tokens into per-expert buffers [E, C, h]
+        buf = jnp.einsum("th,tec->ech", xt, dispatch.astype(x.dtype))
+        if st.ep > 1:
+            buf = DS.make(3, {0: "ep"}).constrain(buf)
+        # expert FFN (batched over E; ep-sharded -> local experts only)
+        gu = jnp.einsum("ecd,edki->ecki", buf,
+                        params["w_gate_up"].astype(x.dtype))
+        hidden = ops.swiglu(gu[:, :, 0, :], gu[:, :, 1, :])
+        out = jnp.einsum("eci,eih->ech", hidden,
+                         params["w_down"].astype(x.dtype))
+        if st.ep > 1:
+            out = DS.make(3, {0: "ep"}).constrain(out)
+        # combine back to tokens
+        y = jnp.einsum("ech,tec->th", out, combine.astype(x.dtype))
+        return y.reshape(b, s, h), aux

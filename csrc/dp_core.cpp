@@ -1,0 +1,122 @@
+// Dynamic-programming core for the auto-parallel strategy search.
+//
+// Rebuild of the reference's C++ search kernel (reference:
+// tools/Galvatron/csrc/dp_core.cpp:22 dynamic_programming_core — per-layer
+// strategy DP with a device-memory cap, pybind11-bound there).  Here the
+// binding is ctypes (no pybind11 in the image): plain C ABI.
+//
+// Problem: L homogeneous layer slots, S candidate strategies per layer.
+//   time[s]        — per-layer step-time contribution of strategy s
+//   mem[s]         — per-layer memory units of strategy s
+//   trans[s*S+s2]  — transition cost between consecutive layers s -> s2
+//                    (activation resharding between per-layer strategies)
+//   budget         — total memory units available per device
+// Minimize total time subject to sum(mem) <= budget.
+// DP over (layer, mem_used, last_strategy); O(L * budget * S^2).
+//
+// Build: make -C csrc   (produces libdp_core.so; loaded via ctypes with a
+// pure-python fallback in hetu_tpu/search/dp.py)
+
+#include <algorithm>
+#include <cstddef>
+#include <cstdint>
+#include <limits>
+#include <vector>
+
+extern "C" {
+
+// Returns 0 on success, -1 if infeasible. Writes the chosen strategy per
+// layer into out_choice[L] and the total time into *out_time.
+int dynamic_programming_core(
+    int32_t L, int32_t S, const double* time, const int32_t* mem,
+    const double* trans, int32_t budget, int32_t* out_choice,
+    double* out_time) {
+  const double INF = std::numeric_limits<double>::infinity();
+  // dp[m][s] = best time using exactly the first `layer` layers with m
+  // memory units consumed and layer-1 assigned strategy s.
+  std::vector<double> dp((budget + 1) * S, INF);
+  std::vector<double> nxt((budget + 1) * S, INF);
+  // parent pointers: layer * (budget+1) * S
+  std::vector<int32_t> parent((std::size_t)L * (budget + 1) * S, -1);
+
+  for (int32_t s = 0; s < S; ++s) {
+    if (mem[s] <= budget) dp[mem[s] * S + s] = time[s];
+  }
+
+  for (int32_t layer = 1; layer < L; ++layer) {
+    std::fill(nxt.begin(), nxt.end(), INF);
+    for (int32_t m = 0; m <= budget; ++m) {
+      for (int32_t s = 0; s < S; ++s) {
+        double cur = dp[m * S + s];
+        if (cur == INF) continue;
+        for (int32_t s2 = 0; s2 < S; ++s2) {
+          int32_t m2 = m + mem[s2];
+          if (m2 > budget) continue;
+          double cand = cur + time[s2] + trans[s * S + s2];
+          double& slot = nxt[m2 * S + s2];
+          if (cand < slot) {
+            slot = cand;
+            parent[((std::size_t)layer * (budget + 1) + m2) * S + s2] = s;
+          }
+        }
+      }
+    }
+    dp.swap(nxt);
+  }
+
+  // best terminal state
+  double best = INF;
+  int32_t bm = -1, bs = -1;
+  for (int32_t m = 0; m <= budget; ++m)
+    for (int32_t s = 0; s < S; ++s)
+      if (dp[m * S + s] < best) { best = dp[m * S + s]; bm = m; bs = s; }
+  if (bs < 0) return -1;
+  *out_time = best;
+
+  // backtrack
+  int32_t m = bm, s = bs;
+  for (int32_t layer = L - 1; layer >= 0; --layer) {
+    out_choice[layer] = s;
+    if (layer == 0) break;
+    int32_t ps = parent[((std::size_t)layer * (budget + 1) + m) * S + s];
+    m -= mem[s];
+    s = ps;
+  }
+  return 0;
+}
+
+// Hetero pipeline-stage partition: given per-device speed ratios (higher =
+// faster) and L layers over P stages, assign layer counts proportional to
+// speed (the Malleus planner's stage-balancing step, reference:
+// python/hetu/engine/strategy.py StrategyModel).
+int balance_stages(int32_t L, int32_t P, const double* speed,
+                   int32_t* out_layers) {
+  double total = 0;
+  for (int32_t p = 0; p < P; ++p) total += speed[p];
+  if (total <= 0) return -1;
+  int32_t assigned = 0;
+  for (int32_t p = 0; p < P; ++p) {
+    int32_t n = (int32_t)(L * speed[p] / total + 0.5);
+    if (n < 1) n = 1;
+    out_layers[p] = n;
+    assigned += n;
+  }
+  // fix rounding drift: add/remove from the fastest/slowest stages
+  while (assigned != L) {
+    int32_t idx = 0;
+    if (assigned < L) {
+      for (int32_t p = 1; p < P; ++p)
+        if (speed[p] > speed[idx]) idx = p;
+      out_layers[idx]++; assigned++;
+    } else {
+      for (int32_t p = 1; p < P; ++p)
+        if (out_layers[p] > 1 &&
+            (out_layers[idx] <= 1 || speed[p] < speed[idx])) idx = p;
+      if (out_layers[idx] <= 1) return -1;
+      out_layers[idx]--; assigned--;
+    }
+  }
+  return 0;
+}
+
+}  // extern "C"

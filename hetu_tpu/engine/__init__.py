@@ -2,3 +2,5 @@ from hetu_tpu.engine.trainer_config import TrainingConfig
 from hetu_tpu.engine.trainer import Trainer
 from hetu_tpu.engine.plan_pool import PlanPool
 from hetu_tpu.engine.hot_switch import HotSwitchTrainer
+from hetu_tpu.engine.sft_trainer import SFTTrainer, mask_prompt_labels
+from hetu_tpu.engine.malleus import MalleusPlanner, StragglerProfile

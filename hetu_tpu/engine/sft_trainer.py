@@ -1,0 +1,69 @@
+"""Supervised fine-tuning trainer.
+
+Rebuild of the reference SFTTrainer (reference: python/hetu/engine/
+sft_trainer.py:13): next-token loss masked to response tokens only, optional
+LoRA so only adapters train.
+"""
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import optim
+from hetu_tpu.core.mesh import use_mesh
+from hetu_tpu.engine.trainer import Trainer
+from hetu_tpu.engine.trainer_config import TrainingConfig
+from hetu_tpu.peft.lora import LoRAConfig, LoRAWrappedModel
+
+
+def mask_prompt_labels(input_ids: np.ndarray, prompt_lens: Sequence[int],
+                       pad_id: int = 0) -> np.ndarray:
+    """labels with prompt positions (and pads) set to -100 — only response
+    tokens contribute loss (the SFT objective)."""
+    labels = np.asarray(input_ids, np.int32).copy()
+    for i, plen in enumerate(prompt_lens):
+        labels[i, :plen] = -100
+    labels[np.asarray(input_ids) == pad_id] = -100
+    return labels
+
+
+class SFTTrainer(Trainer):
+    """Trainer whose batches carry prompt-masked labels; with `lora`, the
+    base model is frozen and only adapters (+ their tiny optimizer state)
+    train."""
+
+    def __init__(self, model, config: TrainingConfig, strategy=None,
+                 lora: Optional[LoRAConfig] = None, base_params=None, **kw):
+        self.lora_cfg = lora
+        if lora is not None:
+            assert base_params is not None, \
+                "LoRA SFT needs pretrained base_params"
+            model = LoRAWrappedModel(model, base_params, lora)
+        super().__init__(model, config, strategy, **kw)
+
+    def build(self, rng=None):
+        if self.lora_cfg is None:
+            return super().build(rng)
+        # LoRA: params = adapter tree (replicated — it is tiny); base stays
+        # in the wrapper closure with its own shardings
+        rng = rng if rng is not None else jax.random.key(self.config.seed)
+        with use_mesh(self.mesh):
+            self.params = self.model.init(rng, mesh=self.mesh)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep = NamedSharding(self.mesh, P())
+            self._pshard = jax.tree.map(lambda _: rep, self.params)
+            self._sshard = {
+                "step": rep,
+                "m": jax.tree.map(lambda _: rep, self.params),
+                "v": jax.tree.map(lambda _: rep, self.params),
+            }
+            self.opt_state = jax.jit(
+                self.optimizer.init, out_shardings=self._sshard)(self.params)
+            self._step_fn = jax.jit(
+                self._train_step,
+                out_shardings=(self._pshard, self._sshard, None),
+                donate_argnums=(0, 1))
+        return self

@@ -1,0 +1,1 @@
+from hetu_tpu.peft.lora import LoRAConfig, init_lora_params, merge_lora_params, LoRAWrappedModel

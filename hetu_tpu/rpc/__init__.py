@@ -1,0 +1,2 @@
+from hetu_tpu.rpc.server import CoordinationServer
+from hetu_tpu.rpc.client import CoordinationClient

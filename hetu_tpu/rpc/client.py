@@ -1,0 +1,119 @@
+"""Coordination client (reference: hetu/impl/communication/rpc_client.cc —
+the C++ DeviceClient with Connect/GetRank/Barrier/KV/HeartBeat; and
+python/hetu/rpc/kv_store/client.py:101 KeyValueStoreClient).
+
+Worker-side API used by distributed_init, the elastic trainer, and the
+Hydraulis-style dynamic dispatch (KV producer/consumer)."""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional
+
+from hetu_tpu.rpc.server import _recv, _send
+
+
+class CoordinationClient:
+    def __init__(self, host: str, port: int, info: Optional[Dict] = None,
+                 heartbeat_interval: float = 2.0, auto_heartbeat: bool = True):
+        self._addr = (host, port)
+        self._lock = threading.Lock()
+        self._conn = socket.create_connection(self._addr, timeout=30)
+        resp = self._call({"op": "connect", "info": info or {}})
+        self.rank = resp["rank"]
+        self.world_size = resp.get("world_size")
+        self.should_stop = False
+        self._hb_interval = heartbeat_interval
+        self._shutdown = False
+        if auto_heartbeat:
+            self._hb = threading.Thread(target=self._heartbeat_loop,
+                                        daemon=True)
+            self._hb.start()
+
+    # ------------------------------------------------------------------
+    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        with self._lock:
+            _send(self._conn, req)
+            resp = _recv(self._conn)
+        if resp is None:
+            raise ConnectionError("coordination server closed the connection")
+        if not resp.get("ok"):
+            raise RuntimeError(f"rpc error: {resp.get('error')}")
+        return resp
+
+    def _heartbeat_loop(self):
+        while not self._shutdown:
+            try:
+                resp = self._call({"op": "heartbeat", "rank": self.rank})
+                if resp.get("stop"):
+                    self.should_stop = True
+            except (ConnectionError, OSError, RuntimeError):
+                return
+            time.sleep(self._hb_interval)
+
+    # -- KV store (reference: KeyValueStoreClient) ----------------------
+    def put(self, key: str, value: Any):
+        self._call({"op": "put", "key": key, "value": value})
+
+    def get(self, key: str, block: bool = False,
+            timeout: float = 60.0) -> Any:
+        deadline = time.time() + timeout
+        while True:
+            resp = self._call({"op": "get", "key": key})
+            if resp["found"]:
+                return resp["value"]
+            if not block:
+                raise KeyError(key)
+            if time.time() > deadline:
+                raise TimeoutError(f"kv key {key!r} not available")
+            time.sleep(0.05)
+
+    # -- barrier / consensus -------------------------------------------
+    def barrier(self, name: str, count: int, timeout: float = 120.0):
+        resp = self._call({"op": "barrier", "name": name, "rank": self.rank,
+                           "count": count})
+        if resp["released"]:
+            return
+        gen = resp["gen"]
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            resp = self._call({"op": "barrier_poll", "name": name, "gen": gen})
+            if resp["released"]:
+                return
+            time.sleep(0.02)
+        raise TimeoutError(f"barrier {name!r} timed out")
+
+    def consistent(self, name: str, value: Any, count: int,
+                   timeout: float = 60.0) -> Any:
+        """All `count` participants must agree on `value`
+        (reference: elastic server Consistent :389)."""
+        deadline = time.time() + timeout
+        while True:
+            resp = self._call({"op": "consistent", "name": name,
+                               "rank": self.rank, "value": value,
+                               "count": count})
+            if resp["done"]:
+                if not resp["agreed"]:
+                    raise RuntimeError(f"consistency vote {name!r} failed")
+                return resp["value"]
+            if time.time() > deadline:
+                raise TimeoutError(f"consistent {name!r} timed out")
+            time.sleep(0.05)
+
+    # -- elastic membership --------------------------------------------
+    def membership(self):
+        return self._call({"op": "membership"})["alive"]
+
+    def worker_stop(self, ranks=None):
+        self._call({"op": "worker_stop", "ranks": ranks})
+
+    def exit(self):
+        try:
+            self._call({"op": "exit", "rank": self.rank})
+        except (ConnectionError, OSError):
+            pass
+        self._shutdown = True
+        self._conn.close()

@@ -1,0 +1,224 @@
+"""Cluster coordination server.
+
+Rebuild of the reference's gRPC DeviceController service
+(reference: protos/heturpc.proto:10-69 — Connect, GetRank, Commit/GetHostName,
+Commit/GetDeviceInfo, Barrier, Consistent, HeartBeat, Put/Get KV, Exit,
+WorkerStop; python servers rpc/heturpc_polling_server.py:17 and the elastic
+variant heturpc_elastic_server.py:39 with heartbeat monitor :463).
+
+TPU-native role: jax.distributed handles low-level multi-host bootstrap; this
+service supplies what the reference layers ON TOP over DCN — a KV store,
+named barriers, liveness (heartbeats + dead-worker detection), consistency
+votes, and stop/relaunch signaling for the elastic trainer.  Implemented as
+length-prefixed JSON over TCP (stdlib-only; the reference's proto surface,
+minus protoc codegen).
+"""
+from __future__ import annotations
+
+import json
+import socket
+import struct
+import threading
+import time
+from typing import Any, Dict, Optional, Set
+
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("rpc.server")
+
+
+def _send(conn: socket.socket, obj: Any):
+    data = json.dumps(obj).encode()
+    conn.sendall(struct.pack("<I", len(data)) + data)
+
+
+def _recv(conn: socket.socket) -> Optional[Any]:
+    hdr = b""
+    while len(hdr) < 4:
+        chunk = conn.recv(4 - len(hdr))
+        if not chunk:
+            return None
+        hdr += chunk
+    (n,) = struct.unpack("<I", hdr)
+    buf = b""
+    while len(buf) < n:
+        chunk = conn.recv(min(65536, n - len(buf)))
+        if not chunk:
+            return None
+        buf += chunk
+    return json.loads(buf.decode())
+
+
+class CoordinationServer:
+    """One instance per cluster (reference: DeviceController server)."""
+
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 world_size: Optional[int] = None,
+                 heartbeat_timeout: float = 10.0):
+        self.world_size = world_size
+        self.heartbeat_timeout = heartbeat_timeout
+        self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._sock.bind((host, port))
+        self._sock.listen(128)
+        self.host, self.port = self._sock.getsockname()
+
+        self._lock = threading.Lock()
+        self._next_rank = 0
+        self._workers: Dict[int, Dict[str, Any]] = {}   # rank -> info
+        self._kv: Dict[str, Any] = {}
+        self._barriers: Dict[str, Set[int]] = {}
+        self._barrier_gen: Dict[str, int] = {}
+        self._votes: Dict[str, Dict[int, Any]] = {}
+        self._stop_flags: Set[int] = set()
+        self._shutdown = False
+        self._threads = []
+        self._accept_thread = threading.Thread(target=self._accept_loop,
+                                               daemon=True)
+        self._accept_thread.start()
+        self._monitor_thread = threading.Thread(target=self._monitor_loop,
+                                                daemon=True)
+        self._monitor_thread.start()
+
+    # ------------------------------------------------------------------
+    def _accept_loop(self):
+        while not self._shutdown:
+            try:
+                conn, _ = self._sock.accept()
+            except OSError:
+                return
+            t = threading.Thread(target=self._serve_conn, args=(conn,),
+                                 daemon=True)
+            t.start()
+            self._threads.append(t)
+
+    def _monitor_loop(self):
+        """Dead-worker detection (reference: elastic server HeartBeat monitor
+        :463 — on loss, mark dead and signal WorkerStop to the others)."""
+        while not self._shutdown:
+            time.sleep(self.heartbeat_timeout / 4)
+            now = time.time()
+            with self._lock:
+                for rank, info in list(self._workers.items()):
+                    if info.get("alive") and \
+                            now - info["last_beat"] > self.heartbeat_timeout:
+                        info["alive"] = False
+                        logger.warning(f"worker {rank} lost (heartbeat "
+                                       f"timeout); signaling stop to "
+                                       f"survivors")
+                        self._kv["__membership_change__"] = now
+                        # stop surviving workers so they can re-mesh
+                        # (reference: WorkerStop broadcast on worker loss)
+                        for r, w in self._workers.items():
+                            if w.get("alive"):
+                                self._stop_flags.add(r)
+
+    # ------------------------------------------------------------------
+    def _serve_conn(self, conn: socket.socket):
+        with conn:
+            while not self._shutdown:
+                try:
+                    req = _recv(conn)
+                except OSError as e:
+                    logger.debug(f"conn recv error: {e}")
+                    return
+                if req is None:
+                    return
+                try:
+                    resp = self._handle(req)
+                except Exception as e:  # never kill the server on bad input
+                    logger.warning(f"handler error for {req.get('op')}: {e!r}")
+                    resp = {"ok": False, "error": str(e)}
+                try:
+                    _send(conn, resp)
+                except OSError as e:
+                    logger.warning(f"conn send error: {e}")
+                    return
+
+    def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        op = req.get("op")
+        with self._lock:
+            if op == "connect":        # Connect + GetRank
+                rank = self._next_rank
+                self._next_rank += 1
+                self._workers[rank] = {
+                    "info": req.get("info", {}), "alive": True,
+                    "last_beat": time.time()}
+                return {"ok": True, "rank": rank,
+                        "world_size": self.world_size}
+            if op == "heartbeat":      # HeartBeat
+                rank = req["rank"]
+                if rank in self._workers:
+                    self._workers[rank]["last_beat"] = time.time()
+                    self._workers[rank]["alive"] = True
+                stop = rank in self._stop_flags
+                return {"ok": True, "stop": stop}
+            if op == "put":            # PutJson/PutBytes...
+                self._kv[req["key"]] = req["value"]
+                return {"ok": True}
+            if op == "get":            # GetJson (blocking handled client-side)
+                key = req["key"]
+                if key in self._kv:
+                    return {"ok": True, "found": True, "value": self._kv[key]}
+                return {"ok": True, "found": False}
+            if op == "barrier":        # Barrier
+                name, rank, count = req["name"], req["rank"], req["count"]
+                gen = self._barrier_gen.setdefault(name, 0)
+                members = self._barriers.setdefault(name, set())
+                members.add(rank)
+                if len(members) >= count:
+                    self._barrier_gen[name] = gen + 1
+                    self._barriers[name] = set()
+                    return {"ok": True, "released": True, "gen": gen + 1}
+                return {"ok": True, "released": False, "gen": gen}
+            if op == "barrier_poll":
+                name, gen = req["name"], req["gen"]
+                return {"ok": True,
+                        "released": self._barrier_gen.get(name, 0) > gen}
+            if op == "consistent":     # Consistent consensus (:389)
+                name, rank, value, count = (req["name"], req["rank"],
+                                            req["value"], req["count"])
+                st = self._votes.setdefault(
+                    name, {"votes": {}, "result": None, "collected": set()})
+                if st["result"] is not None:
+                    # a completed round: hand out the result; clear the round
+                    # once every participant has collected it, so the name is
+                    # reusable for the next vote
+                    st["collected"].add(rank)
+                    agreed, val = st["result"]
+                    if st["collected"] >= set(st["votes"].keys()):
+                        del self._votes[name]
+                    return {"ok": True, "done": True, "agreed": agreed,
+                            "value": val}
+                st["votes"][rank] = value
+                if len(st["votes"]) >= count:
+                    vals = list(st["votes"].values())
+                    agreed = all(v == vals[0] for v in vals)
+                    st["result"] = (agreed, vals[0] if agreed else None)
+                    st["collected"] = {rank}
+                    return {"ok": True, "done": True, "agreed": agreed,
+                            "value": vals[0] if agreed else None}
+                return {"ok": True, "done": False}
+            if op == "membership":     # alive set (elastic re-mesh input)
+                return {"ok": True, "alive": sorted(
+                    r for r, w in self._workers.items() if w["alive"])}
+            if op == "worker_stop":    # WorkerStop broadcast
+                ranks = req.get("ranks")
+                if ranks is None:
+                    ranks = list(self._workers)
+                for r in ranks:
+                    self._stop_flags.add(r)
+                return {"ok": True}
+            if op == "exit":
+                rank = req["rank"]
+                if rank in self._workers:
+                    self._workers[rank]["alive"] = False
+                return {"ok": True}
+        raise ValueError(f"unknown op {op!r}")
+
+    def close(self):
+        self._shutdown = True
+        try:
+            self._sock.close()
+        except OSError:
+            pass

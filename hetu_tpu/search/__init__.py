@@ -1,0 +1,4 @@
+from hetu_tpu.search.dp import dynamic_programming_core, balance_stages
+from hetu_tpu.search.profiler import HardwareProfile, profile_hardware, profile_model_layer
+from hetu_tpu.search.cost_model import CostModel, StrategyCandidate
+from hetu_tpu.search.searcher import search_strategy

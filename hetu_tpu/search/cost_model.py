@@ -1,0 +1,133 @@
+"""Analytical cost model for strategy search.
+
+Rebuild of Galvatron's cost model (reference: tools/Galvatron/galvatron/core/
+hybrid_parallel_config.py:13 + profiler-calibrated per-layer costs),
+re-targeted at TPU: compute rides the MXU at a measured efficiency, TP/SP
+comms ride ICI allreduce bandwidth, DP grad sync is amortized reduce-scatter +
+all-gather (ZeRO) or allreduce, pipeline adds the GPipe bubble, remat trades
+~1/3 more FLOPs for activation memory.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+from hetu_tpu.search.profiler import HardwareProfile
+
+
+@dataclasses.dataclass(frozen=True)
+class StrategyCandidate:
+    dp: int = 1
+    tp: int = 1
+    pp: int = 1
+    cp: int = 1
+    sequence_parallel: bool = True
+    zero: bool = True
+    remat: bool = True
+    n_micro: int = 1
+
+    @property
+    def num_devices(self):
+        return self.dp * self.tp * self.pp * self.cp
+
+    def describe(self):
+        bits = []
+        for k in ("dp", "tp", "pp", "cp"):
+            v = getattr(self, k)
+            if v > 1:
+                bits.append(f"{k}{v}")
+        if self.sequence_parallel:
+            bits.append("sp")
+        if self.zero:
+            bits.append("zero1")
+        if self.remat:
+            bits.append("rc")
+        return "x".join(bits) or "single"
+
+
+@dataclasses.dataclass
+class CostModel:
+    """Estimate (step_time_s, per_device_mem_bytes) for a candidate."""
+
+    hw: HardwareProfile
+    # model description (per the LLaMA/GPT configs)
+    num_layers: int
+    hidden: int
+    intermediate: int
+    vocab: int
+    num_params: int
+    # workload
+    global_batch: int
+    seq_len: int
+    mxu_efficiency: float = 0.5   # fraction of peak the model sustains
+
+    # ---------------- compute ----------------
+    def _flops_per_token(self) -> float:
+        return 6.0 * self.num_params + \
+            12 * self.num_layers * self.hidden * self.seq_len
+
+    def step_time(self, c: StrategyCandidate) -> float:
+        tokens = self.global_batch * self.seq_len
+        flops = self._flops_per_token() * tokens
+        if c.remat:
+            flops *= 4.0 / 3.0  # recompute forward once
+        eff = self.hw.measured.get("matmul_tflops",
+                                   self.hw.bf16_tflops * self.mxu_efficiency)
+        eff = min(eff, self.hw.bf16_tflops * 0.85)
+        compute = flops / (c.num_devices * eff * 1e12)
+
+        # TP comm: 4 allreduces of [b_local, s, h] bf16 per layer (2 fwd+2 bwd),
+        # halved arithmetic but same bytes under SP (reduce-scatter+allgather)
+        t_comm = 0.0
+        if c.tp > 1:
+            b_local = self.global_batch / max(c.dp * c.cp, 1)
+            bytes_per = b_local * self.seq_len * self.hidden * 2
+            ring = 2 * (c.tp - 1) / c.tp * bytes_per
+            t_comm += 4 * self.num_layers * ring / (
+                self.hw.ici_allreduce_gbps * 1e9) / max(c.pp, 1)
+
+        # DP/ZeRO grad sync: reduce-scatter + all-gather of the local shard
+        if c.dp > 1:
+            shard_bytes = 4 * self.num_params / max(c.tp * c.pp, 1)
+            ring = 2 * (c.dp - 1) / c.dp * shard_bytes
+            t_comm += ring / (self.hw.ici_allreduce_gbps * 1e9)
+
+        # CP ring: kv blocks circulate cp-1 times
+        if c.cp > 1:
+            b_local = self.global_batch / max(c.dp, 1)
+            kv_bytes = 2 * b_local * (self.seq_len / c.cp) * self.hidden * 2
+            t_comm += self.num_layers * (c.cp - 1) * kv_bytes / (
+                self.hw.ici_p2p_gbps * 1e9)
+
+        # pipeline bubble
+        busy = compute + t_comm
+        if c.pp > 1:
+            m = max(c.n_micro, c.pp)
+            busy *= (m + c.pp - 1) / m
+        return busy
+
+    # ---------------- memory ----------------
+    def per_device_memory(self, c: StrategyCandidate) -> float:
+        shard = max(c.tp * c.pp, 1)
+        params = 4.0 * self.num_params / shard           # fp32 master
+        opt = 8.0 * self.num_params / shard              # adam m+v fp32
+        if c.zero and c.dp > 1:
+            opt /= c.dp
+        grads = 4.0 * self.num_params / shard
+        b_local = self.global_batch / max(c.dp * c.cp, 1)
+        seq_local = self.seq_len / max(c.cp, 1)
+        layers_local = self.num_layers / max(c.pp, 1)
+        act_per_layer = b_local * seq_local * self.hidden * 2
+        if c.sequence_parallel and c.tp > 1:
+            act_per_layer /= c.tp
+        if c.remat:
+            acts = act_per_layer * layers_local  # boundaries only
+        else:
+            acts = act_per_layer * layers_local * 12  # rough multiplier
+        if c.pp > 1:
+            acts *= min(c.n_micro, c.pp)  # in-flight micros
+        logits = b_local * seq_local * self.vocab * 4 / max(c.tp, 1)
+        return params + opt + grads + acts + logits
+
+    def evaluate(self, c: StrategyCandidate):
+        return self.step_time(c), self.per_device_memory(c)

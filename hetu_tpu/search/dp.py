@@ -1,0 +1,137 @@
+"""ctypes binding for the C++ search core (csrc/dp_core.cpp), with a pure-
+python fallback (reference: tools/Galvatron/csrc/dp_core.cpp bound via
+pybind11; ctypes here — no pybind11 in the TPU image)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+_LIB = None
+
+
+def _lib() -> Optional[ctypes.CDLL]:
+    global _LIB
+    if _LIB is not None:
+        return _LIB or None
+    root = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
+    so = os.path.abspath(os.path.join(root, "libdp_core.so"))
+    if not os.path.exists(so):
+        try:  # build on demand
+            subprocess.run(["make", "-C", os.path.abspath(root)], check=True,
+                           capture_output=True)
+        except Exception:
+            _LIB = False
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+        lib.dynamic_programming_core.restype = ctypes.c_int
+        lib.balance_stages.restype = ctypes.c_int
+        _LIB = lib
+        return lib
+    except OSError:
+        _LIB = False
+        return None
+
+
+def dynamic_programming_core(time: Sequence[float], mem: Sequence[int],
+                             trans: np.ndarray, num_layers: int,
+                             budget: int) -> Tuple[List[int], float]:
+    """Choose a strategy per layer minimizing total time under the memory
+    budget. Returns (choices[num_layers], total_time). Raises ValueError if
+    infeasible."""
+    S = len(time)
+    time_a = np.ascontiguousarray(time, np.float64)
+    mem_a = np.ascontiguousarray(mem, np.int32)
+    trans_a = np.ascontiguousarray(trans, np.float64).reshape(S * S)
+    lib = _lib()
+    if lib is not None:
+        out = np.zeros(num_layers, np.int32)
+        out_t = ctypes.c_double()
+        rc = lib.dynamic_programming_core(
+            ctypes.c_int32(num_layers), ctypes.c_int32(S),
+            time_a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            mem_a.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            trans_a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            ctypes.c_int32(budget),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)),
+            ctypes.byref(out_t))
+        if rc != 0:
+            raise ValueError("no feasible strategy assignment under budget")
+        return out.tolist(), out_t.value
+    return _dp_python(time_a, mem_a, trans_a.reshape(S, S), num_layers, budget)
+
+
+def _dp_python(time, mem, trans, L, budget):
+    INF = float("inf")
+    S = len(time)
+    dp = np.full((budget + 1, S), INF)
+    parent = np.full((L, budget + 1, S), -1, np.int32)
+    for s in range(S):
+        if mem[s] <= budget:
+            dp[mem[s], s] = time[s]
+    for layer in range(1, L):
+        nxt = np.full_like(dp, INF)
+        for m in range(budget + 1):
+            for s in range(S):
+                cur = dp[m, s]
+                if cur == INF:
+                    continue
+                for s2 in range(S):
+                    m2 = m + mem[s2]
+                    if m2 > budget:
+                        continue
+                    cand = cur + time[s2] + trans[s, s2]
+                    if cand < nxt[m2, s2]:
+                        nxt[m2, s2] = cand
+                        parent[layer, m2, s2] = s
+        dp = nxt
+    flat = np.argmin(dp)
+    bm, bs = divmod(int(flat), S)
+    if dp[bm, bs] == INF:
+        raise ValueError("no feasible strategy assignment under budget")
+    total = float(dp[bm, bs])
+    choice = [0] * L
+    m, s = bm, bs
+    for layer in range(L - 1, -1, -1):
+        choice[layer] = s
+        if layer:
+            ps = int(parent[layer, m, s])
+            m -= mem[s]
+            s = ps
+    return choice, total
+
+
+def balance_stages(num_layers: int, speeds: Sequence[float]) -> List[int]:
+    """Per-stage layer counts proportional to device speeds (Malleus-style
+    hetero pipeline balancing; reference: engine/strategy.py StrategyModel)."""
+    P = len(speeds)
+    sp = np.ascontiguousarray(speeds, np.float64)
+    lib = _lib()
+    if lib is not None:
+        out = np.zeros(P, np.int32)
+        rc = lib.balance_stages(
+            ctypes.c_int32(num_layers), ctypes.c_int32(P),
+            sp.ctypes.data_as(ctypes.POINTER(ctypes.c_double)),
+            out.ctypes.data_as(ctypes.POINTER(ctypes.c_int32)))
+        if rc != 0:
+            raise ValueError("cannot balance stages")
+        return out.tolist()
+    # python fallback
+    total = float(sp.sum())
+    raw = [max(1, round(num_layers * s / total)) for s in sp]
+    while sum(raw) != num_layers:
+        if sum(raw) < num_layers:
+            raw[int(np.argmax(sp))] += 1
+        else:
+            idx = sorted(range(P), key=lambda p: sp[p])
+            for p in idx:
+                if raw[p] > 1:
+                    raw[p] -= 1
+                    break
+            else:
+                raise ValueError("cannot balance stages")
+    return raw

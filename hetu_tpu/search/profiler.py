@@ -1,0 +1,149 @@
+"""Hardware + model profiling for the strategy search.
+
+Rebuild of the Galvatron profiler (reference: tools/Galvatron/galvatron/core/
+profiler.py:8-530 — per-layer time/memory profiling and allreduce/p2p
+bandwidth measurement, persisted as hardware_configs/*.json).  TPU version:
+measures MXU matmul throughput and per-axis collective bandwidth on whatever
+mesh is available, and ships calibrated defaults for the chips we know.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import time
+from typing import Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass
+class HardwareProfile:
+    """The TPU analog of hardware_configs/*.json."""
+    chip: str = "v5e"
+    bf16_tflops: float = 197.0          # per chip peak
+    hbm_gbytes: float = 16.0
+    hbm_gbps: float = 820.0
+    ici_allreduce_gbps: float = 45.0    # bus bandwidth per chip (1D ring)
+    ici_p2p_gbps: float = 90.0
+    dcn_gbps: float = 6.25
+    measured: Dict[str, float] = dataclasses.field(default_factory=dict)
+
+    PRESETS = {
+        "v5e": dict(bf16_tflops=197.0, hbm_gbytes=16.0, hbm_gbps=820.0,
+                    ici_allreduce_gbps=45.0, ici_p2p_gbps=90.0),
+        "v5p": dict(bf16_tflops=459.0, hbm_gbytes=95.0, hbm_gbps=2765.0,
+                    ici_allreduce_gbps=90.0, ici_p2p_gbps=180.0),
+        "v4": dict(bf16_tflops=275.0, hbm_gbytes=32.0, hbm_gbps=1228.0,
+                   ici_allreduce_gbps=50.0, ici_p2p_gbps=100.0),
+    }
+
+    @staticmethod
+    def preset(chip: str) -> "HardwareProfile":
+        return HardwareProfile(chip=chip, **HardwareProfile.PRESETS[chip])
+
+    def save(self, path: str):
+        with open(path, "w") as f:
+            json.dump(dataclasses.asdict(self), f, indent=2)
+
+    @staticmethod
+    def load(path: str) -> "HardwareProfile":
+        with open(path) as f:
+            return HardwareProfile(**json.load(f))
+
+
+def _sync(x):
+    # host fetch — the only reliable sync on the axon backend
+    return float(np.asarray(jax.tree.leaves(x)[0]).reshape(-1)[0])
+
+
+def measure_matmul_tflops(n: int = 4096, iters: int = 8,
+                          dtype=jnp.bfloat16) -> float:
+    """Measured MXU throughput (the per-layer compute calibration input)."""
+    a = jnp.ones((n, n), dtype)
+    b = jnp.ones((n, n), dtype)
+    reps = 64  # amortize dispatch + remote-tunnel latency
+
+    def body(a, b):
+        out = jnp.zeros((), jnp.float32)
+        x = a
+        for _ in range(reps):
+            x = (x @ b).astype(dtype)
+        return out + jnp.sum(x.astype(jnp.float32))
+
+    f = jax.jit(body)
+    _sync(f(a, b))
+    times = []
+    for _ in range(iters):
+        t = time.perf_counter()
+        _sync(f(a, b))
+        times.append(time.perf_counter() - t)
+    return reps * 2 * n ** 3 / min(times) / 1e12
+
+
+def measure_collective_gbps(mesh, axis: str = "tp",
+                            mbytes: int = 64) -> Optional[float]:
+    """psum bus bandwidth over one mesh axis (reference: allreduce_bandwidth
+    json files). Returns None when the axis has a single member."""
+    size = int(mesh.shape.get(axis, 1))
+    if size <= 1:
+        return None
+    n = mbytes * 1024 * 1024 // 4
+    x = jnp.ones((n,), jnp.float32)
+    from jax.sharding import PartitionSpec as P
+
+    fn = jax.jit(jax.shard_map(
+        lambda v: jax.lax.psum(v, axis), mesh=mesh, in_specs=P(),
+        out_specs=P(), check_vma=False))
+    _sync(fn(x))
+    times = []
+    for _ in range(5):
+        t = time.perf_counter()
+        _sync(fn(x))
+        times.append(time.perf_counter() - t)
+    # bus bytes for ring allreduce: 2 * (size-1)/size * payload
+    bus = 2 * (size - 1) / size * n * 4
+    return bus / min(times) / 1e9
+
+
+def profile_hardware(mesh=None, chip: Optional[str] = None) -> HardwareProfile:
+    """Measure what is measurable on the current devices, fill the rest from
+    the chip preset (reference: galvatron profile_hardware scripts)."""
+    kind = jax.devices()[0].device_kind.lower()
+    if chip is None:
+        chip = ("v5p" if "v5p" in kind or "v5 p" in kind else
+                "v5e" if "v5" in kind else
+                "v4" if "v4" in kind else "v5e")
+    prof = HardwareProfile.preset(chip)
+    try:
+        prof.measured["matmul_tflops"] = round(measure_matmul_tflops(), 1)
+    except Exception:
+        pass
+    if mesh is not None:
+        for axis in mesh.axis_names:
+            bw = None
+            try:
+                bw = measure_collective_gbps(mesh, axis)
+            except Exception:
+                pass
+            if bw is not None:
+                prof.measured[f"allreduce_gbps_{axis}{mesh.shape[axis]}"] = \
+                    round(bw, 2)
+    return prof
+
+
+def profile_model_layer(block_fn, params, x, iters: int = 5) -> Dict[str, float]:
+    """Per-layer fwd+bwd wall time (reference: galvatron per-layer profiling).
+    block_fn(params, x) -> y with y.shape == x.shape."""
+    def loss(p, x):
+        return jnp.sum(block_fn(p, x).astype(jnp.float32))
+
+    g = jax.jit(jax.grad(loss))
+    _sync(g(params, x))
+    times = []
+    for _ in range(iters):
+        t = time.perf_counter()
+        _sync(g(params, x))
+        times.append(time.perf_counter() - t)
+    return {"fwd_bwd_s": min(times)}

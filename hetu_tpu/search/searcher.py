@@ -1,0 +1,104 @@
+"""Strategy search: candidates -> cost model -> ds-parallel JSON.
+
+Rebuild of Galvatron's search driver (reference: tools/Galvatron — DP search
+over per-layer strategies with memory cap; output consumed by the runtime as
+the ds-parallel config).  Two levels:
+
+1. global search: enumerate (dp, tp, pp, cp) factorizations of the device
+   count x {sp, zero, remat}, filter by the per-device HBM cap, rank by the
+   cost model. -> best StrategyCandidate.
+2. per-layer DP (C++ core): with the global strategy fixed, choose per-layer
+   recompute on/off under the remaining activation-memory budget — the same
+   layerwise knapsack Galvatron's dp_core solves
+   (reference: csrc/dp_core.cpp:22).
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import List, Optional, Tuple
+
+import numpy as np
+
+from hetu_tpu.search.cost_model import CostModel, StrategyCandidate
+from hetu_tpu.search.dp import dynamic_programming_core
+from hetu_tpu.search.profiler import HardwareProfile
+from hetu_tpu.utils.parallel_config import generate_ds_parallel_config
+
+
+def _factorizations(n: int):
+    """All (dp, tp, pp, cp) with dp*tp*pp*cp == n, power-of-two factors."""
+    def divs(x):
+        d = 1
+        while d <= x:
+            if x % d == 0:
+                yield d
+            d *= 2
+    for tp in divs(n):
+        for pp in divs(n // tp):
+            for cp in divs(n // tp // pp):
+                dp = n // tp // pp // cp
+                yield dp, tp, pp, cp
+
+
+def search_strategy(cost: CostModel, num_devices: int,
+                    max_tp: int = 8, max_pp: int = 8, max_cp: int = 8,
+                    topk: int = 5) -> List[Tuple[StrategyCandidate, float, float]]:
+    """Rank feasible candidates by predicted step time.
+    Returns [(candidate, time_s, mem_bytes)] best-first."""
+    hbm = cost.hw.hbm_gbytes * 1e9 * 0.9  # headroom
+    results = []
+    for dp, tp, pp, cp in _factorizations(num_devices):
+        if tp > max_tp or pp > max_pp or cp > max_cp:
+            continue
+        if cost.num_layers % pp:
+            continue
+        if cost.global_batch % max(dp * cp, 1):
+            continue
+        for sp in ((True, False) if tp > 1 else (False,)):
+            for remat in (True, False):
+                n_micro = max(2 * pp, 1) if pp > 1 else 1
+                c = StrategyCandidate(dp=dp, tp=tp, pp=pp, cp=cp,
+                                      sequence_parallel=sp, zero=dp > 1,
+                                      remat=remat, n_micro=n_micro)
+                t, m = cost.evaluate(c)
+                if m <= hbm:
+                    results.append((c, t, m))
+    results.sort(key=lambda r: r[1])
+    return results[:topk]
+
+
+def choose_recompute_layers(cost: CostModel, c: StrategyCandidate,
+                            act_budget_bytes: float) -> List[bool]:
+    """Per-layer recompute choice via the C++ DP core: strategy 0 = remat
+    (cheap memory, +1/3 fwd time), strategy 1 = keep activations."""
+    b_local = cost.global_batch / max(c.dp * c.cp, 1)
+    seq_local = cost.seq_len / max(c.cp, 1)
+    act_unit = b_local * seq_local * cost.hidden * 2  # one boundary
+    layer_flops_t = (cost._flops_per_token() / cost.num_layers *
+                     cost.global_batch * cost.seq_len /
+                     (c.num_devices * cost.hw.bf16_tflops * 1e12 * 0.5))
+    # memory quantized in act_units
+    time = [layer_flops_t * 4 / 3, layer_flops_t]
+    mem = [1, 13]  # boundary-only vs full activations (rough 12x + boundary)
+    trans = np.zeros((2, 2))
+    budget = max(1, int(act_budget_bytes / act_unit))
+    L = int(cost.num_layers // max(c.pp, 1))
+    if budget < L:
+        # even boundary-only activations exceed the budget: recompute
+        # everything (the layer choice is not the lever here)
+        from hetu_tpu.utils.logging import get_logger
+        get_logger("search").warning(
+            f"activation budget ({budget} units) below layer count ({L}); "
+            "forcing full recompute")
+        return [True] * L
+    choice, _ = dynamic_programming_core(time, mem, trans, L, budget)
+    return [bool(s == 0) for s in choice]
+
+
+def emit_ds_config(cost: CostModel, c: StrategyCandidate) -> dict:
+    """The searcher's contract with the runtime (reference: ds-parallel JSON
+    produced by planners, generate_ds.py:253)."""
+    return generate_ds_parallel_config(
+        num_layers=cost.num_layers, dp=c.dp, cp=c.cp, tp=c.tp, pp=c.pp,
+        sequence_parallel=c.sequence_parallel, zero=c.zero, recompute=c.remat)

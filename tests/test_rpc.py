@@ -1,0 +1,133 @@
+"""Coordination service tests (reference: the DeviceController surface —
+untestable there without a cluster; here it's localhost threads)."""
+import threading
+import time
+
+import pytest
+
+from hetu_tpu.rpc import CoordinationClient, CoordinationServer
+
+
+@pytest.fixture
+def server():
+    s = CoordinationServer(world_size=4, heartbeat_timeout=1.0)
+    yield s
+    s.close()
+
+
+def _client(server, **kw):
+    return CoordinationClient("127.0.0.1", server.port, auto_heartbeat=False,
+                              **kw)
+
+
+def test_connect_assigns_ranks(server):
+    c0, c1, c2 = (_client(server) for _ in range(3))
+    assert [c0.rank, c1.rank, c2.rank] == [0, 1, 2]
+    assert c0.world_size == 4
+
+
+def test_kv_store(server):
+    c0, c1 = _client(server), _client(server)
+    c0.put("strategy", {"tp": 4, "dp": 2})
+    assert c1.get("strategy") == {"tp": 4, "dp": 2}
+    with pytest.raises(KeyError):
+        c1.get("missing")
+    # blocking get woken by a later put
+    out = {}
+
+    def waiter():
+        out["v"] = c1.get("late", block=True, timeout=5)
+
+    t = threading.Thread(target=waiter)
+    t.start()
+    time.sleep(0.1)
+    c0.put("late", 42)
+    t.join(timeout=5)
+    assert out["v"] == 42
+
+
+def test_barrier(server):
+    clients = [_client(server) for _ in range(3)]
+    order = []
+
+    def enter(c, i):
+        c.barrier("sync", count=3)
+        order.append(i)
+
+    threads = [threading.Thread(target=enter, args=(c, i))
+               for i, c in enumerate(clients)]
+    for t in threads[:2]:
+        t.start()
+    time.sleep(0.2)
+    assert order == []          # nobody released yet
+    threads[2].start()
+    for t in threads:
+        t.join(timeout=10)
+    assert sorted(order) == [0, 1, 2]
+
+
+def test_consistent_vote(server):
+    c0, c1 = _client(server), _client(server)
+    res = {}
+
+    def vote(c, v, key):
+        res[key] = c.consistent("plan", v, count=2)
+
+    t0 = threading.Thread(target=vote, args=(c0, "tp4", "a"))
+    t1 = threading.Thread(target=vote, args=(c1, "tp4", "b"))
+    t0.start(); t1.start()
+    t0.join(5); t1.join(5)
+    assert res == {"a": "tp4", "b": "tp4"}
+
+
+def test_heartbeat_failure_detection(server):
+    c0 = CoordinationClient("127.0.0.1", server.port,
+                            heartbeat_interval=0.2)  # auto heartbeat
+    c1 = _client(server)  # never beats after connect
+    time.sleep(2.0)       # > heartbeat_timeout (1s)
+    alive = c0.membership()
+    assert 0 in alive and 1 not in alive
+    c0.exit()
+
+
+def test_worker_stop_broadcast(server):
+    c0 = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.1)
+    c1 = _client(server)
+    c1.worker_stop([0])
+    time.sleep(0.5)
+    assert c0.should_stop
+    c0.exit()
+
+
+def test_worker_stop_all(server):
+    c0 = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.1)
+    c1 = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.1)
+    c1.worker_stop()  # regression: broadcast (ranks=None) must stop everyone
+    time.sleep(0.5)
+    assert c0.should_stop and c1.should_stop
+    c0.exit(); c1.exit()
+
+
+def test_consistent_vote_name_reuse(server):
+    # regression: a second round under the same name must not see stale votes
+    c0, c1 = _client(server), _client(server)
+    res = {}
+
+    def vote(c, v, key):
+        res[key] = c.consistent("plan", v, count=2)
+
+    for rnd, val in enumerate(["tp4", "tp8"]):
+        ts = [threading.Thread(target=vote, args=(c, val, f"{rnd}:{i}"))
+              for i, c in enumerate([c0, c1])]
+        [t.start() for t in ts]
+        [t.join(5) for t in ts]
+    assert res == {"0:0": "tp4", "0:1": "tp4", "1:0": "tp8", "1:1": "tp8"}
+
+
+def test_dead_worker_stops_survivors(server):
+    # regression: losing a worker must signal stop to the survivors
+    c0 = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.2)
+    c1 = _client(server)  # never heartbeats -> declared dead
+    time.sleep(2.5)
+    assert c0.should_stop  # survivor told to stop for re-mesh
+    c0.exit()

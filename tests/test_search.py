@@ -1,0 +1,76 @@
+"""Auto-parallel search tests (reference: Galvatron search + dp_core;
+the C++ core is exercised through ctypes with the python fallback checked
+for agreement)."""
+import numpy as np
+import pytest
+
+from hetu_tpu.search import (CostModel, HardwareProfile, StrategyCandidate,
+                             balance_stages, dynamic_programming_core,
+                             search_strategy)
+from hetu_tpu.search.dp import _dp_python, _lib
+from hetu_tpu.search.searcher import choose_recompute_layers, emit_ds_config
+
+
+def test_cpp_core_loads_and_agrees_with_python():
+    assert _lib() is not None, "C++ dp core failed to build/load"
+    time = [1.0, 0.6, 0.3]
+    mem = [1, 2, 4]
+    trans = np.full((3, 3), 0.05)
+    np.fill_diagonal(trans, 0.0)
+    for L, budget in [(4, 8), (6, 10), (3, 3)]:
+        c_choice, c_t = dynamic_programming_core(time, mem, trans, L, budget)
+        p_choice, p_t = _dp_python(np.asarray(time), np.asarray(mem),
+                                   trans, L, budget)
+        assert abs(c_t - p_t) < 1e-9
+        assert sum(mem[s] for s in c_choice) <= budget
+
+
+def test_dp_infeasible_raises():
+    with pytest.raises(ValueError):
+        dynamic_programming_core([1.0], [5], np.zeros((1, 1)), 3, 4)
+
+
+def test_dp_prefers_fast_under_loose_budget():
+    time = [1.0, 0.2]
+    mem = [1, 3]
+    choice, t = dynamic_programming_core(time, mem, np.zeros((2, 2)), 4, 12)
+    assert choice == [1, 1, 1, 1]
+    choice, t = dynamic_programming_core(time, mem, np.zeros((2, 2)), 4, 6)
+    # budget 6 only fits one expensive layer: 3+1+1+1
+    assert sorted(choice) == [0, 0, 0, 1]
+
+
+def test_balance_stages():
+    assert balance_stages(8, [1.0, 1.0]) == [4, 4]
+    assert balance_stages(9, [2.0, 1.0]) == [6, 3]
+    layers = balance_stages(32, [1.0, 1.0, 0.5, 0.5])
+    assert sum(layers) == 32 and layers[0] > layers[2]
+
+
+def test_search_7b_prefers_model_parallel_on_small_hbm():
+    hw = HardwareProfile.preset("v5e")  # 16G: 7B fp32 Adam cannot fit 1 chip
+    cost = CostModel(hw=hw, num_layers=32, hidden=4096, intermediate=11008,
+                     vocab=32000, num_params=6_738_000_000,
+                     global_batch=64, seq_len=4096)
+    results = search_strategy(cost, num_devices=64)
+    assert results, "no feasible strategy found"
+    best, t, m = results[0]
+    assert best.num_devices == 64
+    assert best.tp * best.pp > 1  # must use model parallelism
+    assert m <= hw.hbm_gbytes * 1e9
+    cfg = emit_ds_config(cost, best)
+    assert cfg["strategy"]["tp"] == best.tp
+
+
+def test_recompute_layer_choice():
+    hw = HardwareProfile.preset("v5p")
+    cost = CostModel(hw=hw, num_layers=8, hidden=1024, intermediate=2816,
+                     vocab=32000, num_params=300_000_000,
+                     global_batch=8, seq_len=1024)
+    c = StrategyCandidate(dp=1, tp=1, pp=1)
+    act_unit = 8 * 1024 * 1024 * 2  # b*s*h*2 bytes, one boundary
+    # tight budget (exactly one boundary per layer) -> all remat
+    tight = choose_recompute_layers(cost, c, act_budget_bytes=8 * act_unit)
+    assert all(tight)
+    loose = choose_recompute_layers(cost, c, act_budget_bytes=1e12)
+    assert not any(loose)

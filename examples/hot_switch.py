@@ -1,0 +1,41 @@
+"""Parallelism hot-switching by sequence-length bucket
+(reference: examples/hotspa/llama_hot_switch_trainer.py)."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import numpy as np
+
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.data import pad_batch
+from hetu_tpu.engine import HotSwitchTrainer, TrainingConfig
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel import ParallelStrategy
+
+
+def main():
+    cfg = LlamaConfig.tiny()
+    # short sequences -> DP-heavy; long sequences -> TP(+SP)
+    strategies = [
+        ParallelStrategy(mesh=MeshConfig(dp=8)),                        # bucket 0
+        ParallelStrategy(mesh=MeshConfig(dp=4, tp=2),
+                         sequence_parallel=True),                       # bucket 1
+    ]
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=1, seq_len=128,
+                        lr=3e-4, total_steps=100, log_every=10)
+    trainer = HotSwitchTrainer(lambda s: LlamaLMHeadModel(cfg, s), tc,
+                               strategies).build()
+    rng = np.random.default_rng(0)
+    for step in range(40):
+        seq = 64 if step % 4 < 2 else 128           # alternate buckets
+        bucket = 0 if seq <= 64 else 1
+        batch = pad_batch([rng.integers(1, 250, size=seq - 4)
+                           for _ in range(8)], seq)
+        trainer.train_step(batch, strategy_id=bucket)
+    print("done; strategies used:", {i: h.strategy.describe()
+                                     for i, h in trainer._handles.items()})
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,48 @@
+"""Auto-parallel search (reference: tools/Galvatron search flow):
+profile -> cost model -> search -> ds-parallel JSON."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import argparse
+import json
+
+from hetu_tpu.search import CostModel, HardwareProfile, profile_hardware, search_strategy
+from hetu_tpu.search.searcher import emit_ds_config
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--devices", type=int, default=64)
+    ap.add_argument("--chip", default=None)
+    ap.add_argument("--model", default="llama2_7b")
+    ap.add_argument("--global-batch", type=int, default=512)
+    ap.add_argument("--seq-len", type=int, default=4096)
+    ap.add_argument("--out", default="ds_config.json")
+    ap.add_argument("--no-measure", action="store_true",
+                    help="skip device benchmarks; use chip presets")
+    args = ap.parse_args()
+
+    from hetu_tpu.models.llama import LlamaConfig
+    cfg = getattr(LlamaConfig, args.model)()
+    hw = profile_hardware(chip=args.chip, measure=not args.no_measure)
+    print("hardware:", hw.chip, hw.measured)
+    cost = CostModel(hw=hw, num_layers=cfg.num_hidden_layers,
+                     hidden=cfg.hidden_size,
+                     intermediate=cfg.intermediate_size,
+                     vocab=cfg.vocab_size, num_params=cfg.num_params(),
+                     global_batch=args.global_batch, seq_len=args.seq_len)
+    results = search_strategy(cost, args.devices)
+    for c, t, m in results:
+        toks = args.global_batch * args.seq_len / t
+        print(f"  {c.describe():28s} step {t:7.2f}s  mem {m/1e9:5.1f}GB  "
+              f"tokens/s {toks:,.0f}")
+    best = results[0][0]
+    with open(args.out, "w") as f:
+        json.dump(emit_ds_config(cost, best), f, indent=2)
+    print("wrote", args.out)
+
+
+if __name__ == "__main__":
+    main()

@@ -45,17 +45,25 @@ class DataLoader:
         if not self.drop_last and len(idx) % self.batch_size:
             yield idx[n_full * self.batch_size:]
 
+    def _collate(self, seqs):
+        # packing produces a data-dependent row count; pin it to batch_size so
+        # the compiled train step sees ONE static shape (underfilled rows are
+        # all-pad and contribute no loss)
+        if getattr(self.collator, "packing", False):
+            return self.collator(seqs, num_rows=self.batch_size)
+        return self.collator(seqs)
+
     def epoch(self, epoch: int = 0) -> Iterator[Dict[str, np.ndarray]]:
         def produce(q):
             for batch_idx in self._index_iter(epoch):
                 seqs = [self.dataset[int(i)] for i in batch_idx]
-                q.put(self.collator(seqs))
+                q.put(self._collate(seqs))
             q.put(None)
 
         if self.prefetch <= 0:
             for batch_idx in self._index_iter(epoch):
                 seqs = [self.dataset[int(i)] for i in batch_idx]
-                yield self.collator(seqs)
+                yield self._collate(seqs)
             return
 
         q: queue_mod.Queue = queue_mod.Queue(maxsize=self.prefetch)

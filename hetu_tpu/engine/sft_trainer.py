@@ -20,13 +20,29 @@ from hetu_tpu.peft.lora import LoRAConfig, LoRAWrappedModel
 
 
 def mask_prompt_labels(input_ids: np.ndarray, prompt_lens: Sequence[int],
-                       pad_id: int = 0) -> np.ndarray:
-    """labels with prompt positions (and pads) set to -100 — only response
-    tokens contribute loss (the SFT objective)."""
-    labels = np.asarray(input_ids, np.int32).copy()
+                       seq_lens: Optional[Sequence[int]] = None,
+                       pad_id: Optional[int] = 0) -> np.ndarray:
+    """labels with prompt positions and padding set to -100 — only response
+    tokens contribute loss (the SFT objective).
+
+    Padding is masked BY POSITION: via `seq_lens` when given, else by the
+    trailing run of `pad_id` — a genuine pad_id token inside the response
+    (e.g. eos == pad, the common GPT-2/LLaMA setup) keeps its loss so the
+    model learns to stop."""
+    ids = np.asarray(input_ids)
+    labels = ids.astype(np.int32).copy()
+    n, L = labels.shape
     for i, plen in enumerate(prompt_lens):
         labels[i, :plen] = -100
-    labels[np.asarray(input_ids) == pad_id] = -100
+    if seq_lens is not None:
+        for i, slen in enumerate(seq_lens):
+            labels[i, slen:] = -100
+    elif pad_id is not None:
+        for i in range(n):
+            j = L
+            while j > 0 and ids[i, j - 1] == pad_id:
+                j -= 1
+            labels[i, j:] = -100
     return labels
 
 
@@ -44,26 +60,15 @@ class SFTTrainer(Trainer):
             model = LoRAWrappedModel(model, base_params, lora)
         super().__init__(model, config, strategy, **kw)
 
-    def build(self, rng=None):
+    def _make_shardings(self):
         if self.lora_cfg is None:
-            return super().build(rng)
-        # LoRA: params = adapter tree (replicated — it is tiny); base stays
-        # in the wrapper closure with its own shardings
-        rng = rng if rng is not None else jax.random.key(self.config.seed)
-        with use_mesh(self.mesh):
-            self.params = self.model.init(rng, mesh=self.mesh)
-            from jax.sharding import NamedSharding, PartitionSpec as P
-            rep = NamedSharding(self.mesh, P())
-            self._pshard = jax.tree.map(lambda _: rep, self.params)
-            self._sshard = {
-                "step": rep,
-                "m": jax.tree.map(lambda _: rep, self.params),
-                "v": jax.tree.map(lambda _: rep, self.params),
-            }
-            self.opt_state = jax.jit(
-                self.optimizer.init, out_shardings=self._sshard)(self.params)
-            self._step_fn = jax.jit(
-                self._train_step,
-                out_shardings=(self._pshard, self._sshard, None),
-                donate_argnums=(0, 1))
-        return self
+            return super()._make_shardings()
+        # LoRA: the adapter tree is tiny — replicate it (and its opt state);
+        # the frozen base keeps its own shardings inside the wrapper closure
+        from jax.sharding import NamedSharding, PartitionSpec as P
+        rep = NamedSharding(self.mesh, P())
+        pshard = jax.tree.map(lambda _: rep, self.params)
+        sshard = {"step": rep,
+                  "m": jax.tree.map(lambda _: rep, self.params),
+                  "v": jax.tree.map(lambda _: rep, self.params)}
+        return pshard, sshard

@@ -59,32 +59,36 @@ class Trainer:
             b1=c.beta1, b2=c.beta2, eps=c.eps, weight_decay=c.weight_decay)
 
     # ------------------------------------------------------------------
+    def _make_shardings(self):
+        """(param_shardings, opt_state_shardings) — overridable (e.g. the
+        LoRA SFT trainer replicates its tiny adapter tree)."""
+        mesh, st = self.mesh, self.strategy
+        pshard = self.model.shardings(mesh)
+        abstract = self.model.abstract_params()
+        if st.zero:
+            sshard = {
+                "step": NamedSharding(mesh, P()),
+                "m": zero_shardings(pshard, abstract, mesh, "dp"),
+                "v": zero_shardings(pshard, abstract, mesh, "dp"),
+            }
+        else:
+            sshard = {"step": NamedSharding(mesh, P()),
+                      "m": pshard, "v": pshard}
+        return pshard, sshard
+
     def build(self, rng: Optional[jax.Array] = None):
         """Materialize sharded params/opt state and compile the step."""
-        c, st, mesh = self.config, self.strategy, self.mesh
+        c, mesh = self.config, self.mesh
         rng = rng if rng is not None else jax.random.key(c.seed)
 
         with use_mesh(mesh):
             self.params = self.model.init(rng, mesh=mesh)
-            pshard = self.model.shardings(mesh)
-            abstract = self.model.abstract_params()
-            if st.zero:
-                state_shard = {
-                    "step": NamedSharding(mesh, P()),
-                    "m": zero_shardings(pshard, abstract, mesh, "dp"),
-                    "v": zero_shardings(pshard, abstract, mesh, "dp"),
-                }
-            else:
-                state_shard = {
-                    "step": NamedSharding(mesh, P()),
-                    "m": pshard, "v": pshard,
-                }
+            self._pshard, self._sshard = self._make_shardings()
             self.opt_state = jax.jit(
-                self.optimizer.init, out_shardings=state_shard)(self.params)
-            self._pshard, self._sshard = pshard, state_shard
+                self.optimizer.init, out_shardings=self._sshard)(self.params)
             self._step_fn = jax.jit(
                 self._train_step,
-                out_shardings=(pshard, state_shard, None),
+                out_shardings=(self._pshard, self._sshard, None),
                 donate_argnums=(0, 1))
         return self
 

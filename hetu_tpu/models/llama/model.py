@@ -349,6 +349,10 @@ class LlamaLMHeadModel(Module):
         c = config
         self.model = LlamaModel(c, strategy)
         if not c.tie_word_embeddings:
+            if strategy.tp > 1 and c.vocab_size % strategy.tp:
+                raise ValueError(
+                    f"vocab size {c.vocab_size} must divide by tp="
+                    f"{strategy.tp}; pad the vocab (e.g. 50257 -> 50304)")
             lm_ds = DS.make(2, {1: "tp"}) if strategy.tp > 1 else None
             self.param("lm_head", (c.hidden_size, c.vocab_size),
                        init.normal(c.initializer_range), dtype=c.param_dtype,

@@ -33,6 +33,9 @@ class ColumnParallelLinear(Module):
         super().__init__()
         self.strategy = strategy
         self.gather_output = gather_output
+        if strategy.tp > 1 and out_features % strategy.tp:
+            raise ValueError(f"out_features {out_features} must divide by "
+                             f"tp={strategy.tp}")
         self.param("weight", (in_features, out_features),
                    weight_init or init.xavier_uniform(), dtype=param_dtype,
                    ds=strategy.col_weight())
@@ -61,6 +64,9 @@ class RowParallelLinear(Module):
                  param_dtype=jnp.float32, weight_init=None):
         super().__init__()
         self.strategy = strategy
+        if strategy.tp > 1 and in_features % strategy.tp:
+            raise ValueError(f"in_features {in_features} must divide by "
+                             f"tp={strategy.tp}")
         self.param("weight", (in_features, out_features),
                    weight_init or init.xavier_uniform(), dtype=param_dtype,
                    ds=strategy.row_weight())
@@ -91,6 +97,10 @@ class VocabParallelEmbedding(Module):
         super().__init__()
         self.strategy = strategy
         self.num_embeddings = num_embeddings
+        if strategy.tp > 1 and num_embeddings % strategy.tp:
+            raise ValueError(
+                f"vocab size {num_embeddings} must divide by tp="
+                f"{strategy.tp}; pad the vocab (e.g. 50257 -> 50304)")
         self.param("weight", (num_embeddings, embedding_dim),
                    weight_init or init.normal(0.02), dtype=param_dtype,
                    ds=strategy.vocab_weight())

@@ -20,3 +20,8 @@ from hetu_tpu.ops.losses import (
     binary_cross_entropy,
 )
 from hetu_tpu.ops.attention import attention, flash_attention
+from hetu_tpu.ops import tensor
+from hetu_tpu.ops.quantization import (
+    quantize_int8, dequantize_int8, quantize_int4, dequantize_int4,
+    quantized_matmul_int8,
+)

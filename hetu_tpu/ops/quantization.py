@@ -1,0 +1,62 @@
+"""Quantize / dequantize ops.
+
+Rebuild of the reference quantization ops (reference: hetu/graph/ops/
+Quantization.h:15 Quantization/DeQuantization backed by bitsandbytes kernels
+in third_party/bitsandbytes — int8 absmax and 4-bit block quantization).
+
+TPU version: block-wise absmax int8 and packed int4, written in jnp (XLA
+vectorizes these well on the VPU; a Pallas variant is only worth it fused
+into a matmul, which is the weight-only-quantized matmul below).
+"""
+from __future__ import annotations
+
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def quantize_int8(x, block_size: int = 256) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Block-wise absmax int8: returns (q [.../bs, bs] int8-valued, scales)."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    assert n % block_size == 0, (n, block_size)
+    blocks = flat.reshape(-1, block_size).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(blocks / scale), -127, 127).astype(jnp.int8)
+    return q, scale[:, 0]
+
+
+def dequantize_int8(q, scale, shape) -> jnp.ndarray:
+    blocks = q.astype(jnp.float32) * scale[:, None]
+    return blocks.reshape(shape)
+
+
+def quantize_int4(x, block_size: int = 64) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Block-wise absmax int4, two nibbles packed per int8."""
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    assert n % block_size == 0 and block_size % 2 == 0
+    blocks = flat.reshape(-1, block_size).astype(jnp.float32)
+    scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 7.0
+    scale = jnp.maximum(scale, 1e-12)
+    q = jnp.clip(jnp.round(blocks / scale), -7, 7).astype(jnp.int8) + 8
+    lo = q[:, 0::2]
+    hi = q[:, 1::2]
+    packed = (lo | (hi << 4)).astype(jnp.uint8)
+    return packed, scale[:, 0]
+
+
+def dequantize_int4(packed, scale, shape) -> jnp.ndarray:
+    lo = (packed & 0xF).astype(jnp.int32) - 8
+    hi = ((packed >> 4) & 0xF).astype(jnp.int32) - 8
+    blocks = jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+    return (blocks.astype(jnp.float32) * scale[:, None]).reshape(shape)
+
+
+def quantized_matmul_int8(x, wq, wscale, w_shape) -> jnp.ndarray:
+    """Weight-only int8 matmul: dequantize-on-the-fly (XLA fuses the
+    dequant into the matmul epilogue's operand feed)."""
+    w = dequantize_int8(wq, wscale, w_shape).astype(x.dtype)
+    return x @ w

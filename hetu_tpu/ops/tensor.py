@@ -1,0 +1,147 @@
+"""Tensor/elementwise/reduction/view op surface.
+
+The reference implements each of these as a C++/CUDA op pair
+(reference: hetu/graph/ops/ — inventory in SURVEY.md §2.3: elementwise/unary,
+arithmetics/linalg, shape/view, reductions).  On TPU they are jax.numpy
+compositions that XLA fuses; this module provides the reference-named
+functional surface so code written against the reference's op list ports
+directly, and documents the 1:1 coverage for each inventory row.
+
+All functions are jit-compatible and differentiate via jax autodiff — the
+reference's per-op DoGradient is subsumed.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# -- elementwise / unary (reference: Abs.cc, Ceil.cc, Exp.cc, ...) ----------
+abs = jnp.abs  # noqa: A001
+ceil = jnp.ceil
+exp = jnp.exp
+floor = jnp.floor
+log = jnp.log
+opposite = jnp.negative
+pow = jnp.power  # noqa: A001
+reciprocal = jnp.reciprocal
+round = jnp.round  # noqa: A001
+sqrt = jnp.sqrt
+rsqrt = lax.rsqrt
+sin = jnp.sin
+cos = jnp.cos
+tanh = jnp.tanh
+sigmoid = jax.nn.sigmoid
+
+
+def bool_(x):
+    return x.astype(jnp.bool_)
+
+
+where = jnp.where
+
+
+def masked_fill(x, mask, value):
+    """reference: Maskedfill.cc"""
+    return jnp.where(mask, jnp.asarray(value, x.dtype), x)
+
+
+clamp = jnp.clip
+
+
+def range_mask(x, lo, hi):
+    """reference: RangeMask kernel — 1 where lo <= x <= hi."""
+    return ((x >= lo) & (x <= hi)).astype(x.dtype)
+
+
+# -- arithmetics / linalg (reference: Arithmetics.cc, matmul.cc, ...) -------
+add = jnp.add
+sub = jnp.subtract
+mul = jnp.multiply
+div = jnp.divide
+matmul = jnp.matmul
+bmm = jnp.matmul          # BatchMatMul.cc — jnp.matmul batches leading dims
+
+
+def linear(x, w, b=None):
+    """reference: Linear.cc (x@w + b)."""
+    y = x @ w
+    return y + b if b is not None else y
+
+
+dot = jnp.dot
+outer = jnp.outer
+einsum = jnp.einsum       # reference: Einsum.cc (~1.9k LoC) -> one call
+norm = jnp.linalg.norm
+
+# reductions (reference: Reduce.cc/ReduceX.cu: sum/mean/max/min/prod)
+reduce_sum = jnp.sum
+reduce_mean = jnp.mean
+reduce_max = jnp.max
+reduce_min = jnp.min
+reduce_prod = jnp.prod
+
+# -- shape / view (reference: Views.h, Reshape.cc, ...) ---------------------
+reshape = jnp.reshape
+transpose = jnp.transpose
+
+
+def slice(x, begin, size):  # noqa: A001
+    """reference: Slice.cc (begin/size semantics)."""
+    return lax.dynamic_slice(x, begin, size)
+
+
+split = jnp.split
+concat = jnp.concatenate
+pad = jnp.pad
+repeat = jnp.repeat
+roll = jnp.roll
+gather = jnp.take_along_axis
+
+
+def index_add(x, dim, index, src):
+    """reference: IndexAdd.cc — x[..., index_i, ...] += src[..., i, ...]."""
+    moved = jnp.moveaxis(x, dim, 0)
+    moved_src = jnp.moveaxis(src, dim, 0)
+    out = moved.at[index].add(moved_src)
+    return jnp.moveaxis(out, 0, dim)
+
+
+diagonal = jnp.diagonal
+triu = jnp.triu
+tril = jnp.tril
+arange = jnp.arange
+
+
+def onehot(x, num_classes):
+    return jax.nn.one_hot(x, num_classes)
+
+
+eye = jnp.eye
+
+
+def interpolate(x, scale: int):
+    """reference: Interpolate.cc — nearest-neighbor upsample (NHWC)."""
+    return jnp.repeat(jnp.repeat(x, scale, axis=1), scale, axis=2)
+
+
+broadcast_to = jnp.broadcast_to
+
+
+def contiguous(x):
+    """reference: Contiguous.cc — a no-op under XLA (layouts are compiler-
+    managed); kept for API parity."""
+    return x
+
+
+def embedding_lookup(table, ids):
+    """reference: EmbeddingLookup.cc"""
+    return jnp.take(table, ids, axis=0)
+
+
+def softmax(x, axis=-1):
+    return jax.nn.softmax(x, axis=axis)
+
+
+def cumsum(x, axis=0):
+    return jnp.cumsum(x, axis=axis)

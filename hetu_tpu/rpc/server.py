@@ -107,8 +107,11 @@ class CoordinationServer:
                                        f"timeout); signaling stop to "
                                        f"survivors")
                         self._kv["__membership_change__"] = now
-                        # stop surviving workers so they can re-mesh
+                        # stop BOTH the dead worker (if it resurrects, it must
+                        # not rejoin the old mesh — split-brain guard) and the
+                        # survivors so they can re-mesh
                         # (reference: WorkerStop broadcast on worker loss)
+                        self._stop_flags.add(rank)
                         for r, w in self._workers.items():
                             if w.get("alive"):
                                 self._stop_flags.add(r)
@@ -148,10 +151,13 @@ class CoordinationServer:
                         "world_size": self.world_size}
             if op == "heartbeat":      # HeartBeat
                 rank = req["rank"]
+                stop = rank in self._stop_flags
                 if rank in self._workers:
                     self._workers[rank]["last_beat"] = time.time()
-                    self._workers[rank]["alive"] = True
-                stop = rank in self._stop_flags
+                    # a stop-flagged worker is NOT resurrected by a late
+                    # heartbeat — it must re-connect for a fresh rank
+                    if not stop:
+                        self._workers[rank]["alive"] = True
                 return {"ok": True, "stop": stop}
             if op == "put":            # PutJson/PutBytes...
                 self._kv[req["key"]] = req["value"]
@@ -179,7 +185,16 @@ class CoordinationServer:
                 name, rank, value, count = (req["name"], req["rank"],
                                             req["value"], req["count"])
                 st = self._votes.setdefault(
-                    name, {"votes": {}, "result": None, "collected": set()})
+                    name, {"votes": {}, "result": None, "collected": set(),
+                           "done_at": None})
+                if st["result"] is not None and st["done_at"] is not None \
+                        and time.time() - st["done_at"] > 10.0:
+                    # stale round (a participant died before collecting):
+                    # garbage-collect so the name is reusable
+                    del self._votes[name]
+                    st = self._votes.setdefault(
+                        name, {"votes": {}, "result": None,
+                               "collected": set(), "done_at": None})
                 if st["result"] is not None:
                     # a completed round: hand out the result; clear the round
                     # once every participant has collected it, so the name is
@@ -196,6 +211,7 @@ class CoordinationServer:
                     agreed = all(v == vals[0] for v in vals)
                     st["result"] = (agreed, vals[0] if agreed else None)
                     st["collected"] = {rank}
+                    st["done_at"] = time.time()
                     return {"ok": True, "done": True, "agreed": agreed,
                             "value": vals[0] if agreed else None}
                 return {"ok": True, "done": False}

@@ -107,15 +107,22 @@ def measure_collective_gbps(mesh, axis: str = "tp",
     return bus / min(times) / 1e9
 
 
-def profile_hardware(mesh=None, chip: Optional[str] = None) -> HardwareProfile:
+def profile_hardware(mesh=None, chip: Optional[str] = None,
+                     measure: bool = True) -> HardwareProfile:
     """Measure what is measurable on the current devices, fill the rest from
-    the chip preset (reference: galvatron profile_hardware scripts)."""
+    the chip preset (reference: galvatron profile_hardware scripts).
+    measure=False skips device benchmarks (preset-only — e.g. when planning
+    for a different pod than the one running the search)."""
+    if not measure and chip is not None:
+        return HardwareProfile.preset(chip)
     kind = jax.devices()[0].device_kind.lower()
     if chip is None:
         chip = ("v5p" if "v5p" in kind or "v5 p" in kind else
                 "v5e" if "v5" in kind else
                 "v4" if "v4" in kind else "v5e")
     prof = HardwareProfile.preset(chip)
+    if not measure:
+        return prof
     try:
         prof.measured["matmul_tflops"] = round(measure_matmul_tflops(), 1)
     except Exception:

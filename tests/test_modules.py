@@ -90,3 +90,26 @@ def test_dropout_deterministic_and_random():
     y = d({}, x, rng=jax.random.key(0), deterministic=False)
     vals = np.unique(np.asarray(y))
     assert set(vals.tolist()) <= {0.0, 2.0}
+
+
+def test_cifar_style_cnn_smoke():
+    """BASELINE config 1 mirror (reference tests/test_cifar10.py): MLP/CNN
+    graph-executor smoke — trains to high accuracy on separable data."""
+    import os
+    import runpy
+    import sys
+    old = sys.argv
+    sys.argv = ["cifar10.py", "--steps", "30", "--batch", "64"]
+    try:
+        import io, contextlib
+        buf = io.StringIO()
+        with contextlib.redirect_stdout(buf):
+            runpy.run_path(
+                os.path.join(os.path.dirname(__file__), "..", "examples",
+                             "cifar10.py"), run_name="__main__")
+        out = buf.getvalue()
+    finally:
+        sys.argv = old
+    last = [l for l in out.strip().splitlines() if l.startswith("step")][-1]
+    acc = float(last.split("acc")[1])
+    assert acc > 0.85, out

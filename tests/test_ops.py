@@ -1,0 +1,73 @@
+"""Op-surface tests (reference: tests/test_ops.py golden-value comparison vs
+torch/numpy — here vs numpy; the inventory mirrors SURVEY.md §2.3)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu import ops
+from hetu_tpu.ops import tensor as T
+from hetu_tpu.ops.quantization import (dequantize_int4, dequantize_int8,
+                                       quantize_int4, quantize_int8,
+                                       quantized_matmul_int8)
+
+
+def test_elementwise_and_views_golden():
+    rng = np.random.default_rng(0)
+    x = rng.normal(size=(4, 6)).astype(np.float32)
+    xt = jnp.asarray(x)
+    np.testing.assert_allclose(np.asarray(T.abs(xt)), np.abs(x), rtol=1e-6)
+    np.testing.assert_allclose(np.asarray(T.reciprocal(xt)), 1 / x, rtol=1e-5)
+    np.testing.assert_allclose(np.asarray(T.masked_fill(xt, xt > 0, -1.0)),
+                               np.where(x > 0, -1.0, x))
+    np.testing.assert_allclose(np.asarray(T.triu(xt)), np.triu(x))
+    np.testing.assert_allclose(np.asarray(T.reduce_mean(xt, axis=1)),
+                               x.mean(1), rtol=1e-6)
+    np.testing.assert_allclose(
+        np.asarray(T.interpolate(jnp.asarray(x)[None, :, :, None], 2)).shape,
+        (1, 8, 12, 1))
+
+
+def test_index_add_golden():
+    x = jnp.zeros((5, 3))
+    src = jnp.ones((2, 3))
+    out = T.index_add(x, 0, jnp.asarray([1, 3]), src)
+    expect = np.zeros((5, 3)); expect[1] = 1; expect[3] = 1
+    np.testing.assert_array_equal(np.asarray(out), expect)
+
+
+def test_einsum_and_linear():
+    rng = np.random.default_rng(1)
+    a = rng.normal(size=(3, 4)).astype(np.float32)
+    b = rng.normal(size=(4, 5)).astype(np.float32)
+    np.testing.assert_allclose(np.asarray(T.einsum("ij,jk->ik",
+                                                   jnp.asarray(a),
+                                                   jnp.asarray(b))),
+                               a @ b, rtol=1e-5)
+    bias = rng.normal(size=(5,)).astype(np.float32)
+    np.testing.assert_allclose(
+        np.asarray(T.linear(jnp.asarray(a), jnp.asarray(b), jnp.asarray(bias))),
+        a @ b + bias, rtol=1e-5)
+
+
+def test_int8_quantization_roundtrip():
+    rng = np.random.default_rng(2)
+    w = rng.normal(size=(512, 128)).astype(np.float32)
+    q, s = quantize_int8(jnp.asarray(w))
+    back = np.asarray(dequantize_int8(q, s, w.shape))
+    rel = np.abs(back - w).max() / np.abs(w).max()
+    assert rel < 0.02  # int8 absmax error bound
+
+    x = jnp.asarray(rng.normal(size=(8, 512)), jnp.float32)
+    y = quantized_matmul_int8(x, q, s, w.shape)
+    np.testing.assert_allclose(np.asarray(y), np.asarray(x) @ back, rtol=1e-4,
+                               atol=1e-4)
+
+
+def test_int4_quantization_roundtrip():
+    rng = np.random.default_rng(3)
+    w = rng.normal(size=(256, 64)).astype(np.float32)
+    packed, s = quantize_int4(jnp.asarray(w))
+    assert packed.dtype == jnp.uint8 and packed.size == w.size // 2
+    back = np.asarray(dequantize_int4(packed, s, w.shape))
+    rel = np.abs(back - w).max() / np.abs(w).max()
+    assert rel < 0.15  # int4 error bound

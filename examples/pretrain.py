@@ -16,6 +16,8 @@ import numpy as np
 
 def main():
     ap = argparse.ArgumentParser()
+    ap.add_argument("--config", help="experiment YAML (parallel/model/trainer "
+                    "sections; see examples/config/)")
     ap.add_argument("--ds-config", help="ds-parallel JSON (planner output)")
     ap.add_argument("--dp", type=int, default=1)
     ap.add_argument("--tp", type=int, default=1)
@@ -27,7 +29,8 @@ def main():
     ap.add_argument("--data", help=".jsonl with a 'text' field (synthetic "
                     "data when omitted)")
     ap.add_argument("--tokenizer", default="gpt2")
-    ap.add_argument("--steps", type=int, default=50)
+    ap.add_argument("--steps", type=int, default=None,
+                help="override total steps (YAML/default 50)")
     ap.add_argument("--global-batch", type=int, default=8)
     ap.add_argument("--micro-batch", type=int, default=2)
     ap.add_argument("--seq-len", type=int, default=512)
@@ -44,7 +47,17 @@ def main():
     from hetu_tpu.parallel import ParallelStrategy
     from hetu_tpu.utils.parallel_config import read_ds_parallel_config
 
-    if args.ds_config:
+    if args.config:
+        from hetu_tpu.utils.yaml_config import load_experiment
+        model, tc, strategy, _raw = load_experiment(args.config)
+        if args.steps is not None:
+            tc.total_steps = args.steps
+        cfg = model.config
+        if args.packing:
+            tc.packing = True
+        if args.ckpt_dir:
+            tc.ckpt_dir = args.ckpt_dir
+    elif args.ds_config:
         strategy, _ = read_ds_parallel_config(args.ds_config)
     else:
         strategy = ParallelStrategy(

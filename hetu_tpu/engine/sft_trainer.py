@@ -25,10 +25,11 @@ def mask_prompt_labels(input_ids: np.ndarray, prompt_lens: Sequence[int],
     """labels with prompt positions and padding set to -100 — only response
     tokens contribute loss (the SFT objective).
 
-    Padding is masked BY POSITION: via `seq_lens` when given, else by the
-    trailing run of `pad_id` — a genuine pad_id token inside the response
-    (e.g. eos == pad, the common GPT-2/LLaMA setup) keeps its loss so the
-    model learns to stop."""
+    Padding is masked BY POSITION: via `seq_lens` when given (exact), else
+    by the trailing run of `pad_id` with its FIRST element kept — when
+    eos == pad (the common GPT-2/LLaMA setup) that first trailing token is
+    the response's terminating eos, which must keep its loss so the model
+    learns to stop."""
     ids = np.asarray(input_ids)
     labels = ids.astype(np.int32).copy()
     n, L = labels.shape
@@ -42,7 +43,9 @@ def mask_prompt_labels(input_ids: np.ndarray, prompt_lens: Sequence[int],
             j = L
             while j > 0 and ids[i, j - 1] == pad_id:
                 j -= 1
-            labels[i, j:] = -100
+            # keep position j (the presumed eos terminator) when a run exists
+            keep_eos = j < L and j > int(prompt_lens[i])
+            labels[i, (j + 1 if keep_eos else j):] = -100
     return labels
 
 

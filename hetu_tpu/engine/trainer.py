@@ -146,6 +146,12 @@ class Trainer:
 
         denom = jnp.maximum(csum, 1.0)
         grads = jax.tree.map(lambda g: g / denom, grads)
+        if getattr(self.strategy, "zero_stage", 1) >= 2 and self.strategy.dp > 1:
+            # ZeRO-2: keep grads dp-sharded through clip+update (GSPMD turns
+            # the grad sync into reduce-scatter; params re-gather after)
+            grads = jax.tree.map(
+                lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
+                grads, self._sshard["m"])
         grads, gnorm = optim.clip_by_global_norm(grads, c.grad_clip)
         params, opt_state = self.optimizer.update(grads, opt_state, params)
         metrics = {"loss": lsum / denom, "grad_norm": gnorm,

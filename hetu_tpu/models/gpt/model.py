@@ -87,7 +87,8 @@ class GPTAttention(Module):
         if self.n_heads % max(strategy.tp, 1):
             raise ValueError(f"heads={self.n_heads} vs tp={strategy.tp}")
         # [h, heads, 3, hd]: per head [q|k|v] — TP splits the heads dim
-        qkv_ds = DS.make(4, {1: "tp"}) if strategy.tp > 1 else None
+        qkv_ds = strategy.fsdp(
+            DS.make(4, {1: "tp"}) if strategy.tp > 1 else None, 4, 0)
         self.param("wqkv", (c.hidden_size, self.n_heads, 3, hd),
                    init.normal(c.initializer_range), dtype=c.param_dtype,
                    ds=qkv_ds)

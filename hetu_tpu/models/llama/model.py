@@ -49,6 +49,7 @@ class LlamaAttention(Module):
         # qkv weight [h, n_kv, group+2, hd]: per kv group [q...q | k | v].
         # TP shards the n_kv dim -> the fused matmul splits cleanly.
         qkv_ds = DS.make(4, {1: "tp"}) if strategy.tp > 1 else None
+        qkv_ds = strategy.fsdp(qkv_ds, 4, 0)
         self.param("wqkv", (c.hidden_size, self.n_kv, self.group + 2, hd),
                    init.normal(c.initializer_range), dtype=c.param_dtype,
                    ds=qkv_ds)
@@ -76,7 +77,15 @@ class LlamaAttention(Module):
 
         use_attn_dropout = (c.attention_dropout > 0.0 and not deterministic
                             and rng is not None)
-        if st.cp > 1:
+        if st.cp > 1 and st.pp > 1:
+            # pp x cp: the ring's shard_map cannot nest inside the pipeline's
+            # spmd vmap; use the global-view fallback (GSPMD all-gathers KV
+            # over cp) — correct, ring-optimized variant is a next-round item
+            from hetu_tpu.parallel.ring_attention import ring_attention_fallback
+            attn = ring_attention_fallback(q, k, v, strategy=st,
+                                           segment_ids=segment_ids,
+                                           position_ids=position_ids)
+        elif st.cp > 1:
             from hetu_tpu.parallel.ring_attention import ring_attention_gspmd
             attn = ring_attention_gspmd(q, k, v, strategy=st,
                                         segment_ids=segment_ids,
@@ -104,6 +113,7 @@ class LlamaMLP(Module):
         self.config, self.strategy = config, strategy
         c = config
         gu_ds = DS.make(3, {2: "tp"}) if strategy.tp > 1 else None
+        gu_ds = strategy.fsdp(gu_ds, 3, 0)
         self.param("w_gate_up", (c.hidden_size, 2, c.intermediate_size),
                    init.normal(c.initializer_range), dtype=c.param_dtype,
                    ds=gu_ds)
@@ -202,16 +212,12 @@ class LlamaDecoderStack(Module):
         if st.pp > 1:
             if use_drop:
                 raise NotImplementedError("dropout inside the pipeline")
-            if st.cp > 1:
-                raise NotImplementedError("pp x cp composition (nested "
-                                          "manual collectives) — planned")
             if not c.use_scan:
                 raise ValueError("pipeline parallelism requires use_scan")
-            return (self._pipeline_forward(params, x, cos=cos, sin=sin,
-                                           position_ids=position_ids,
-                                           segment_ids=segment_ids,
-                                           n_micro=n_micro),
-                    jnp.zeros((), jnp.float32))
+            return self._pipeline_forward(params, x, cos=cos, sin=sin,
+                                          position_ids=position_ids,
+                                          segment_ids=segment_ids,
+                                          n_micro=n_micro)
         layer_rngs = (jax.random.split(rng, self.num_layers)
                       if use_drop else None)
 
@@ -277,18 +283,17 @@ class LlamaDecoderStack(Module):
         use_pos = position_ids is not None
         use_seg = segment_ids is not None
 
-        if self.config.num_experts > 0:
-            raise NotImplementedError("MoE inside the pipeline — planned")
-
         def stage_body(local_params, x_mb, tok):
             def body(carry, layer_params):
-                out, _aux = self.block(
-                    layer_params, carry, cos=cos, sin=sin,
+                x_c, aux_c = carry
+                out, aux = self.block(
+                    layer_params, x_c, cos=cos, sin=sin,
                     position_ids=tok["position_ids"] if use_pos else None,
                     segment_ids=tok["segment_ids"] if use_seg else None)
-                return out, None
-            out, _ = lax.scan(body, x_mb, local_params)
-            return out
+                return (out, aux_c + aux), None
+            (out, aux), _ = lax.scan(
+                body, (x_mb, jnp.zeros((), jnp.float32)), local_params)
+            return out, aux
 
         token_data = {}
         if use_pos:
@@ -353,7 +358,8 @@ class LlamaLMHeadModel(Module):
                 raise ValueError(
                     f"vocab size {c.vocab_size} must divide by tp="
                     f"{strategy.tp}; pad the vocab (e.g. 50257 -> 50304)")
-            lm_ds = DS.make(2, {1: "tp"}) if strategy.tp > 1 else None
+            lm_ds = strategy.fsdp(
+                DS.make(2, {1: "tp"}) if strategy.tp > 1 else None, 2, 0)
             self.param("lm_head", (c.hidden_size, c.vocab_size),
                        init.normal(c.initializer_range), dtype=c.param_dtype,
                        ds=lm_ds)

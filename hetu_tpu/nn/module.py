@@ -89,13 +89,25 @@ class Module:
             is_leaf=lambda s: isinstance(s, ParamSpec))
 
     def shardings(self, mesh):
-        """NamedSharding pytree for all params (replicated when no ds)."""
+        """NamedSharding pytree for all params (replicated when no ds).
+        Axes that do not divide a dim are dropped (e.g. FSDP on an odd-sized
+        norm weight) — sharding is an optimization, never a correctness
+        requirement here."""
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         def one(spec: ParamSpec):
             if spec.ds is None:
                 return NamedSharding(mesh, P())
-            return spec.ds.named_sharding(mesh)
+            ds = spec.ds
+            for d, axes in enumerate(ds.spec):
+                if not axes:
+                    continue
+                size = 1
+                for a in axes:
+                    size *= int(mesh.shape.get(a, 1))
+                if spec.shape[d] % size:
+                    ds = ds.without_split(d)
+            return ds.named_sharding(mesh)
 
         return jax.tree.map(one, self.param_specs(),
                             is_leaf=lambda s: isinstance(s, ParamSpec))

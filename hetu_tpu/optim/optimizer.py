@@ -148,6 +148,10 @@ def zero_shardings(param_shardings, abstract_params, mesh, axis: str = "dp"):
     def shard_one(ns, ref):
         shape = ref.shape
         spec = list(ns.spec) + [None] * (len(shape) - len(ns.spec))
+        flat = [a for s in spec if s is not None
+                for a in ((s,) if isinstance(s, str) else s)]
+        if axis in flat:
+            return ns  # already sharded over this axis (e.g. FSDP weights)
         for i in range(len(shape)):
             if spec[i] is None and shape[i] % size == 0 and shape[i] >= size:
                 spec[i] = axis

@@ -84,16 +84,30 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     init_x = lax.with_sharding_constraint(init_x, P(pp_axis))
     init_tok = {k: jnp.zeros((pp, mb, s), v.dtype) for k, v in tok.items()}
 
+    # stage s processes micro t-s at tick t; anything else is fill/drain
+    # garbage whose aux (e.g. MoE router loss on zero activations) must NOT
+    # reach the training loss
+    ticks = jnp.arange(T)
+    stages = jnp.arange(pp)
+    micro_idx = ticks[:, None] - stages[None, :]          # [T, pp]
+    aux_mask = ((micro_idx >= 0) & (micro_idx < n_micro)).astype(jnp.float32)
+
     def step(carry, xs_t):
         state_x, state_tok = carry
-        in_x, in_tok = xs_t
+        in_x, in_tok, mask_t = xs_t
         cur_x = shift_in(in_x, state_x)
         cur_tok = {k: shift_in(in_tok[k], state_tok[k]) for k in state_tok}
-        out_x = vbody(stage_params, cur_x, cur_tok)
+        out = vbody(stage_params, cur_x, cur_tok)
+        if isinstance(out, tuple):
+            out_x, aux = out                 # [pp, mb, s, h], [pp]
+            aux = jnp.sum(aux * mask_t)
+        else:
+            out_x, aux = out, jnp.zeros((), jnp.float32)
         out_x = lax.with_sharding_constraint(out_x, P(pp_axis))
         # collect the LAST stage's output (micro t-(pp-1) finishes at tick t)
-        return (out_x, cur_tok), out_x[-1]
+        return (out_x, cur_tok), (out_x[-1], aux)
 
-    _, ys = lax.scan(step, (init_x, init_tok), (xs_x, xs_tok))
+    _, (ys, auxs) = lax.scan(step, (init_x, init_tok),
+                             (xs_x, xs_tok, aux_mask))
     outs = ys[pad:] if pad else ys          # [n_micro, mb, s, h]
-    return outs.reshape(B, s, h)
+    return outs.reshape(B, s, h), jnp.sum(auxs)

@@ -220,3 +220,26 @@ def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, tok_spec, tok_spec),
         out_specs=qkv_spec, check_vma=False)
     return fn(q, k, v, segment_ids, position_ids)
+
+
+def ring_attention_fallback(q, k, v, *, strategy: ParallelStrategy,
+                            segment_ids=None, position_ids=None,
+                            causal: bool = True):
+    """Global-view CP attention: GSPMD materializes KV via all-gather over
+    cp. O(seq) KV memory per shard — the correctness fallback used where the
+    ring's shard_map cannot run (inside the pipeline's spmd vmap).
+
+    position_ids (per-segment positions, e.g. from cp_split_batch's
+    reordered layout) drive the causal mask exactly like the ring path —
+    masking by array index would let reordered tokens see their future."""
+    from hetu_tpu import ops
+    import jax.numpy as jnp
+    if position_ids is not None and causal:
+        neg = jnp.finfo(jnp.float32).min
+        bias = jnp.where(
+            position_ids[:, :, None] >= position_ids[:, None, :], 0.0, neg)
+        out = ops.attention(q, k, v, causal=False, bias=bias[:, None],
+                            segment_ids=segment_ids)
+    else:
+        out = ops.attention(q, k, v, causal=causal, segment_ids=segment_ids)
+    return strategy.constrain(out, strategy.act_attn())

@@ -29,7 +29,11 @@ class ParallelStrategy:
 
     mesh: MeshConfig = MeshConfig()
     sequence_parallel: bool = False
-    zero: bool = True
+    zero: bool = True          # ZeRO-1 (optimizer-state sharding over dp)
+    zero_stage: int = 1        # 1 = opt state; 2 = +grads; 3 = +params (FSDP)
+                               # (reference: distributed_states.h zero flag +
+                               # bridge subgraphs; stage 3 = fully sharded
+                               # weights gathered per-layer by the scan)
 
     # -- mesh ---------------------------------------------------------------
     def build_mesh(self, devices=None):
@@ -56,14 +60,28 @@ class ParallelStrategy:
         return self.mesh.ep
 
     # -- parameter layouts (Megatron-style TP over the tp axis) -------------
+    def fsdp(self, ds: Optional[DS], ndim: int, dim: int) -> Optional[DS]:
+        """ZeRO-3/FSDP: additionally shard a weight dim over dp; the
+        scan-over-layers gathers one layer's weights at a time (streaming
+        all-gather), giving the reference's ZeRO-3 memory shape."""
+        if self.zero_stage < 3 or self.dp <= 1:
+            return ds
+        if ds is None:
+            ds = DS.dup(ndim)
+        if "dp" in ds.used_axes() or ds.spec[dim]:
+            return ds
+        return ds.with_split(dim, "dp")
+
     def col_weight(self, ndim: int = 2) -> Optional[DS]:
         """Column-parallel weight [in, out]: out dim sharded.
         (reference: HtMultiColumnParallelLinear, parallel_multi_ds.py:328)"""
-        return DS.make(ndim, {ndim - 1: "tp"}) if self.tp > 1 else None
+        ds = DS.make(ndim, {ndim - 1: "tp"}) if self.tp > 1 else None
+        return self.fsdp(ds, ndim, ndim - 2)
 
     def row_weight(self, ndim: int = 2) -> Optional[DS]:
         """Row-parallel weight [in, out]: in dim sharded."""
-        return DS.make(ndim, {ndim - 2: "tp"}) if self.tp > 1 else None
+        ds = DS.make(ndim, {ndim - 2: "tp"}) if self.tp > 1 else None
+        return self.fsdp(ds, ndim, ndim - 1)
 
     def col_bias(self) -> Optional[DS]:
         return DS.make(1, {0: "tp"}) if self.tp > 1 else None
@@ -71,7 +89,8 @@ class ParallelStrategy:
     def vocab_weight(self) -> Optional[DS]:
         """Vocab-parallel embedding [vocab, hidden]
         (reference: HtMultiVocabParallelEmbedding, parallel_multi_ds.py:268)."""
-        return DS.make(2, {0: "tp"}) if self.tp > 1 else None
+        ds = DS.make(2, {0: "tp"}) if self.tp > 1 else None
+        return self.fsdp(ds, 2, 1)
 
     def replicated(self, ndim: int) -> Optional[DS]:
         return None
@@ -137,7 +156,7 @@ class ParallelStrategy:
         if self.sequence_parallel:
             bits.append("sp")
         if self.zero:
-            bits.append("zero1")
+            bits.append(f"zero{max(self.zero_stage, 1)}")
         return "+".join(bits)
 
 

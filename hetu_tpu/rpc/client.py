@@ -26,6 +26,7 @@ class CoordinationClient:
         self.rank = resp["rank"]
         self.world_size = resp.get("world_size")
         self.should_stop = False
+        self._vote_round: Dict[str, int] = {}
         self._hb_interval = heartbeat_interval
         self._shutdown = False
         if auto_heartbeat:
@@ -89,7 +90,13 @@ class CoordinationClient:
     def consistent(self, name: str, value: Any, count: int,
                    timeout: float = 60.0) -> Any:
         """All `count` participants must agree on `value`
-        (reference: elastic server Consistent :389)."""
+        (reference: elastic server Consistent :389).  Each call advances a
+        per-name round counter so reusing a name never mixes rounds (all
+        participants must call the same number of times — the natural
+        once-per-decision usage)."""
+        rnd = self._vote_round.get(name, 0)
+        self._vote_round[name] = rnd + 1
+        name = f"{name}#{rnd}"
         deadline = time.time() + timeout
         while True:
             resp = self._call({"op": "consistent", "name": name,

@@ -99,6 +99,13 @@ class CoordinationServer:
             time.sleep(self.heartbeat_timeout / 4)
             now = time.time()
             with self._lock:
+                # sweep completed vote rounds whose collectors never returned
+                # (rounds are client-versioned name#N keys, so deleting an
+                # orphan cannot poison a later round)
+                for vname in list(self._votes):
+                    st = self._votes[vname]
+                    if st.get("done_at") and now - st["done_at"] > 60.0:
+                        del self._votes[vname]
                 for rank, info in list(self._workers.items()):
                     if info.get("alive") and \
                             now - info["last_beat"] > self.heartbeat_timeout:
@@ -187,14 +194,6 @@ class CoordinationServer:
                 st = self._votes.setdefault(
                     name, {"votes": {}, "result": None, "collected": set(),
                            "done_at": None})
-                if st["result"] is not None and st["done_at"] is not None \
-                        and time.time() - st["done_at"] > 10.0:
-                    # stale round (a participant died before collecting):
-                    # garbage-collect so the name is reusable
-                    del self._votes[name]
-                    st = self._votes.setdefault(
-                        name, {"votes": {}, "result": None,
-                               "collected": set(), "done_at": None})
                 if st["result"] is not None:
                     # a completed round: hand out the result; clear the round
                     # once every participant has collected it, so the name is

@@ -1,0 +1,91 @@
+"""YAML config front-end.
+
+Rebuild of the reference's Hydra/OmegaConf config layer (reference:
+examples/pretrain/config/*.yaml with rpc / ds_parallel / trainer / model
+sections merged into TrainingConfig, SURVEY §5.6 layer 1).  Plain PyYAML
+(hydra is not in the image): the same section layout, merged into the typed
+configs.
+
+```yaml
+parallel:            # == the reference's ds_parallel section
+  dp: 2
+  tp: 4
+  sequence_parallel: true
+  zero_stage: 1
+model:
+  family: llama      # llama | gpt
+  preset: llama2_7b  # or explicit fields
+  overrides: {remat: true}
+trainer:             # == TrainingConfig fields
+  global_batch_size: 512
+  seq_len: 4096
+  lr: 3.0e-4
+rpc:                 # coordination service (elastic runs)
+  server: "10.0.0.1:7777"
+```
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+import yaml
+
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.engine.trainer_config import TrainingConfig
+from hetu_tpu.parallel.strategy import ParallelStrategy
+
+
+def load_yaml_config(path_or_dict) -> Dict[str, Any]:
+    if isinstance(path_or_dict, dict):
+        return path_or_dict
+    with open(path_or_dict) as f:
+        return yaml.safe_load(f)
+
+
+def parse_parallel(cfg: Dict[str, Any]) -> ParallelStrategy:
+    p = dict(cfg.get("parallel", {}))
+    mesh_keys = {k: int(p.pop(k)) for k in ("dp", "cp", "tp", "pp", "ep")
+                 if k in p}
+    known = {f.name for f in dataclasses.fields(ParallelStrategy)} - {"mesh"}
+    unknown = set(p) - known
+    if unknown:
+        raise ValueError(f"unknown parallel config keys: {sorted(unknown)}")
+    return ParallelStrategy(mesh=MeshConfig(**mesh_keys), **p)
+
+
+def parse_trainer(cfg: Dict[str, Any]) -> TrainingConfig:
+    t = dict(cfg.get("trainer", {}))
+    known = {f.name for f in dataclasses.fields(TrainingConfig)}
+    unknown = set(t) - known
+    if unknown:
+        raise ValueError(f"unknown trainer config keys: {sorted(unknown)}")
+    return TrainingConfig(**t)
+
+
+def parse_model(cfg: Dict[str, Any], strategy: ParallelStrategy):
+    """Build the model from the `model:` section."""
+    m = dict(cfg.get("model", {}))
+    family = m.get("family", "llama")
+    preset = m.get("preset", "tiny")
+    overrides = m.get("overrides", {}) or {}
+    if family == "llama":
+        from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+        mk = getattr(LlamaConfig, preset)
+        return LlamaLMHeadModel(mk(**overrides), strategy)
+    if family == "gpt":
+        from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+        mk = getattr(GPTConfig, preset, None)
+        cfg_obj = mk(**overrides) if mk else GPTConfig(**overrides)
+        return GPTLMHeadModel(cfg_obj, strategy)
+    raise ValueError(f"unknown model family {family!r}")
+
+
+def load_experiment(path_or_dict) -> Tuple[Any, TrainingConfig, ParallelStrategy, Dict]:
+    """(model, training_config, strategy, raw) from one YAML
+    (the reference's train_hetu.py:12-14 structured merge)."""
+    raw = load_yaml_config(path_or_dict)
+    strategy = parse_parallel(raw)
+    trainer_cfg = parse_trainer(raw)
+    model = parse_model(raw, strategy)
+    return model, trainer_cfg, strategy, raw

@@ -78,10 +78,45 @@ def test_pp_requires_divisible_layers():
     mesh = st.build_mesh()
     with ht.use_mesh(mesh):
         params = model.init(jax.random.key(0), mesh=mesh)
-    # 2 layers / pp2 ok; 3-layer config fails at sharded init (layer dim
-    # not divisible over pp)
+    # 2 layers / pp2 ok; a 3-layer config inits (the indivisible layer-dim
+    # sharding is dropped gracefully) but the pipeline forward rejects it
     cfg3 = LlamaConfig.tiny(num_hidden_layers=3)
     m3 = LlamaLMHeadModel(cfg3, st)
     with ht.use_mesh(mesh):
-        with pytest.raises(Exception):
-            m3.init(jax.random.key(0), mesh=mesh)
+        p3 = m3.init(jax.random.key(0), mesh=mesh)
+        with pytest.raises(ValueError):
+            m3(p3, _ids())
+
+
+def test_pp_cp_composition():
+    # pp x cp via the global-view CP fallback inside the pipeline
+    ids = _ids(b=4, s=64)
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    gm = LlamaLMHeadModel(cfg, ParallelStrategy())
+    gp = gm.init(jax.random.key(7))
+    golden = gm(gp, ids)
+
+    st = ParallelStrategy(mesh=MeshConfig(cp=2, tp=2, pp=2))
+    mesh = st.build_mesh()
+    m = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        p = m.init(jax.random.key(7), mesh=mesh)
+        out = jax.jit(lambda p, x: m(p, x, n_micro=2))(p, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_moe_in_pipeline_trains():
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.data import pad_batch
+    cfg = LlamaConfig.tiny(remat=False, num_experts=4, moe_top_k=2)
+    st = ParallelStrategy(mesh=MeshConfig(dp=2, ep=2, pp=2))
+    model = LlamaLMHeadModel(cfg, st)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=64,
+                        lr=3e-3, warmup_steps=2, total_steps=20, log_every=100)
+    tr = Trainer(model, tc, st).build()
+    rng = np.random.default_rng(0)
+    batch = pad_batch([rng.integers(1, 250, size=60) for _ in range(8)], 64)
+    losses = [float(tr.train_step(batch)["loss"]) for _ in range(6)]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] - 0.2, losses

@@ -1,0 +1,38 @@
+"""YAML experiment config tests (reference: Hydra config layer, SURVEY §5.6)."""
+import pytest
+
+from hetu_tpu.utils.yaml_config import load_experiment, parse_parallel
+
+
+def test_load_experiment_yaml(tmp_path):
+    p = tmp_path / "exp.yaml"
+    p.write_text("""
+parallel: {dp: 2, tp: 2, sequence_parallel: true, zero_stage: 2}
+model: {family: llama, preset: tiny, overrides: {vocab_size: 512}}
+trainer: {global_batch_size: 16, seq_len: 128, lr: 1.0e-3}
+""")
+    model, tc, st, raw = load_experiment(str(p))
+    assert st.dp == 2 and st.tp == 2 and st.sequence_parallel
+    assert st.zero_stage == 2
+    assert tc.global_batch_size == 16 and tc.lr == 1e-3
+    assert model.config.vocab_size == 512
+
+
+def test_unknown_keys_rejected():
+    with pytest.raises(ValueError):
+        parse_parallel({"parallel": {"dp": 2, "bogus": 1}})
+    from hetu_tpu.utils.yaml_config import parse_trainer
+    with pytest.raises(ValueError):
+        parse_trainer({"trainer": {"learning_rate": 1e-3}})  # wrong name
+
+
+def test_gpt_family(tmp_path):
+    p = tmp_path / "exp.yaml"
+    p.write_text("""
+parallel: {dp: 1}
+model: {family: gpt, preset: tiny}
+trainer: {global_batch_size: 4}
+""")
+    model, tc, st, _ = load_experiment(str(p))
+    from hetu_tpu.models.gpt import GPTLMHeadModel
+    assert isinstance(model, GPTLMHeadModel)

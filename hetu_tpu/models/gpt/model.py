@@ -41,7 +41,12 @@ class GPTConfig:
     compute_dtype: object = jnp.bfloat16
     use_scan: bool = True
     remat: bool = True
+    remat_policy: str = "nothing"
     use_flash_attention: bool = True
+
+    def __post_init__(self):
+        from hetu_tpu.nn.remat import validate_remat_policy
+        validate_remat_policy(self.remat_policy)
 
     @property
     def head_dim(self) -> int:
@@ -243,8 +248,8 @@ class GPTModel(Module):
                                   deterministic=deterministic), None
             fn = body
             if c.remat:
-                fn = jax.checkpoint(
-                    body, policy=jax.checkpoint_policies.nothing_saveable)
+                from hetu_tpu.nn.remat import remat_policy
+                fn = jax.checkpoint(body, policy=remat_policy(c.remat_policy))
             xs = (params["blocks"],
                   layer_rngs if use_drop else
                   jnp.zeros((c.num_hidden_layers,), jnp.uint32))

@@ -36,11 +36,17 @@ class LlamaConfig:
     use_scan: bool = True          # lax.scan over layers (compile-time + pipeline friendly)
     remat: bool = True             # gradient checkpointing per block
                                    # (reference: recompute/recompute.cc pass)
+    remat_policy: str = "nothing"  # nothing | dots | offload — what each
+                                   # block saves (jax.checkpoint_policies;
+                                   # 'offload' stages dot outputs to host,
+                                   # the reference's activation_cpu_offload)
     use_flash_attention: bool = True
 
     def __post_init__(self):
         if self.num_key_value_heads is None:
             self.num_key_value_heads = self.num_attention_heads
+        from hetu_tpu.nn.remat import validate_remat_policy
+        validate_remat_policy(self.remat_policy)
 
     @property
     def head_dim(self) -> int:

@@ -1,0 +1,118 @@
+"""HuggingFace <-> hetu_tpu weight conversion for the LLaMA family.
+
+Rebuild of the reference's model hub/converter
+(reference: python/hetu/models/utils/model_utils.py + config_utils.py:9 —
+HF-compatible PreTrainedModel loading).  Maps an HF `LlamaForCausalLM` state
+dict onto our parameter tree, regrouping per-head projections into the fused,
+kv-group-aligned QKV layout and the fused gate+up layout (see
+models/llama/model.py header for why those layouts exist).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+import numpy as np
+import jax.numpy as jnp
+
+from hetu_tpu.models.llama.config import LlamaConfig
+
+
+def _t(x) -> np.ndarray:
+    """torch tensor / array -> numpy float32."""
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x, np.float32)
+
+
+def convert_hf_llama(state_dict: Dict[str, Any], config: LlamaConfig,
+                     dtype=None) -> Dict[str, Any]:
+    """HF LlamaForCausalLM state dict -> hetu_tpu params pytree
+    (use_scan layout: per-layer weights stacked on a leading dim)."""
+    c = config
+    h, hd = c.hidden_size, c.head_dim
+    nq, nkv = c.num_attention_heads, c.num_key_value_heads
+    g = nq // nkv
+    L = c.num_hidden_layers
+    dtype = dtype or c.param_dtype
+
+    def get(name):
+        return _t(state_dict[name])
+
+    wqkv, o_proj, gate_up, down, in_norm, post_norm = [], [], [], [], [], []
+    for i in range(L):
+        pre = f"model.layers.{i}."
+        # HF stores [out, in]; ours is [in, out]
+        q = get(pre + "self_attn.q_proj.weight").T.reshape(h, nkv, g, hd)
+        k = get(pre + "self_attn.k_proj.weight").T.reshape(h, nkv, 1, hd)
+        v = get(pre + "self_attn.v_proj.weight").T.reshape(h, nkv, 1, hd)
+        wqkv.append(np.concatenate([q, k, v], axis=2))  # [h, nkv, g+2, hd]
+        o_proj.append(get(pre + "self_attn.o_proj.weight").T)
+        gate = get(pre + "mlp.gate_proj.weight").T      # [h, I]
+        up = get(pre + "mlp.up_proj.weight").T
+        gate_up.append(np.stack([gate, up], axis=1))    # [h, 2, I]
+        down.append(get(pre + "mlp.down_proj.weight").T)
+        in_norm.append(get(pre + "input_layernorm.weight"))
+        post_norm.append(get(pre + "post_attention_layernorm.weight"))
+
+    def stack(xs):
+        return jnp.asarray(np.stack(xs), dtype)
+
+    layers = {
+        "attn": {"wqkv": stack(wqkv), "o_proj": {"weight": stack(o_proj)}},
+        "mlp": {"w_gate_up": stack(gate_up),
+                "down_proj": {"weight": stack(down)}},
+        "input_norm": {"weight": stack(in_norm)},
+        "post_norm": {"weight": stack(post_norm)},
+    }
+    params: Dict[str, Any] = {
+        "model": {
+            "embed": {"weight": jnp.asarray(
+                get("model.embed_tokens.weight"), dtype)},
+            "layers": {"layers": layers},
+            "final_norm": {"weight": jnp.asarray(
+                get("model.norm.weight"), dtype)},
+        }
+    }
+    if not c.tie_word_embeddings:
+        lm = state_dict.get("lm_head.weight",
+                            state_dict["model.embed_tokens.weight"])
+        params["lm_head"] = jnp.asarray(_t(lm).T, dtype)
+    return params
+
+
+def export_hf_llama(params: Dict[str, Any], config: LlamaConfig) -> Dict[str, np.ndarray]:
+    """Inverse mapping: hetu_tpu params -> HF state dict (numpy)."""
+    c = config
+    h, hd = c.hidden_size, c.head_dim
+    nq, nkv = c.num_attention_heads, c.num_key_value_heads
+    g = nq // nkv
+    layers = params["model"]["layers"]["layers"]
+    out: Dict[str, np.ndarray] = {
+        "model.embed_tokens.weight": np.asarray(
+            params["model"]["embed"]["weight"], np.float32),
+        "model.norm.weight": np.asarray(
+            params["model"]["final_norm"]["weight"], np.float32),
+    }
+    wqkv = np.asarray(layers["attn"]["wqkv"], np.float32)
+    o = np.asarray(layers["attn"]["o_proj"]["weight"], np.float32)
+    gu = np.asarray(layers["mlp"]["w_gate_up"], np.float32)
+    dn = np.asarray(layers["mlp"]["down_proj"]["weight"], np.float32)
+    inn = np.asarray(layers["input_norm"]["weight"], np.float32)
+    pon = np.asarray(layers["post_norm"]["weight"], np.float32)
+    for i in range(c.num_hidden_layers):
+        pre = f"model.layers.{i}."
+        out[pre + "self_attn.q_proj.weight"] = \
+            wqkv[i][:, :, :g, :].reshape(h, nq * hd).T
+        out[pre + "self_attn.k_proj.weight"] = \
+            wqkv[i][:, :, g, :].reshape(h, nkv * hd).T
+        out[pre + "self_attn.v_proj.weight"] = \
+            wqkv[i][:, :, g + 1, :].reshape(h, nkv * hd).T
+        out[pre + "self_attn.o_proj.weight"] = o[i].T
+        out[pre + "mlp.gate_proj.weight"] = gu[i][:, 0, :].T
+        out[pre + "mlp.up_proj.weight"] = gu[i][:, 1, :].T
+        out[pre + "mlp.down_proj.weight"] = dn[i].T
+        out[pre + "input_layernorm.weight"] = inn[i]
+        out[pre + "post_attention_layernorm.weight"] = pon[i]
+    if "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"], np.float32).T
+    return out

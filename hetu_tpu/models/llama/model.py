@@ -25,6 +25,7 @@ from jax import lax
 from hetu_tpu import ops
 from hetu_tpu.nn import initializers as init
 from hetu_tpu.nn.module import Module, ParamSpec, stack_param_specs
+from hetu_tpu.nn.remat import remat_policy as _remat_policy
 from hetu_tpu.nn.parallel import (
     ColumnParallelLinear, ParallelRMSNorm, RowParallelLinear,
     VocabParallelEmbedding,
@@ -235,8 +236,7 @@ class LlamaDecoderStack(Module):
         if c.use_scan:
             fn = body
             if c.remat:
-                fn = jax.checkpoint(
-                    body, policy=jax.checkpoint_policies.nothing_saveable)
+                fn = jax.checkpoint(body, policy=_remat_policy(c.remat_policy))
             xs = (params["layers"],
                   layer_rngs if use_drop else
                   jnp.zeros((self.num_layers,), jnp.uint32))
@@ -253,7 +253,7 @@ class LlamaDecoderStack(Module):
                                   deterministic=deterministic,
                                   token_ids=token_ids)
             if c.remat:
-                blk = jax.checkpoint(blk)
+                blk = jax.checkpoint(blk, policy=_remat_policy(c.remat_policy))
             x, aux = blk(params[f"layer_{i}"], x)
             aux_total = aux_total + aux
         return x, aux_total
@@ -301,8 +301,8 @@ class LlamaDecoderStack(Module):
         if use_seg:
             token_data["segment_ids"] = segment_ids
         return pipeline_apply(stage_body, stage_params, x, token_data,
-                              n_micro=n_micro, mesh=mesh,
-                              remat=c.remat)
+                              n_micro=n_micro, mesh=mesh, remat=c.remat,
+                              remat_policy=c.remat_policy)
 
 
 class LlamaModel(Module):

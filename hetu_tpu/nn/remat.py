@@ -1,0 +1,26 @@
+"""Recompute (activation checkpoint) policies shared by all model families
+(reference: hetu/graph/recompute/recompute.cc pass + the activation
+CPU-offload pass offload/activation_cpu_offload.h — 'offload' keeps dot
+outputs staged in pinned host memory)."""
+from __future__ import annotations
+
+import jax
+
+REMAT_POLICIES = ("nothing", "dots", "offload")
+
+
+def remat_policy(name: str):
+    cp = jax.checkpoint_policies
+    if name == "nothing":
+        return cp.nothing_saveable
+    if name == "dots":
+        return cp.dots_with_no_batch_dims_saveable
+    if name == "offload":
+        return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
+    raise ValueError(f"unknown remat_policy {name!r}; one of {REMAT_POLICIES}")
+
+
+def validate_remat_policy(name: str):
+    if name not in REMAT_POLICIES:
+        raise ValueError(
+            f"unknown remat_policy {name!r}; one of {REMAT_POLICIES}")

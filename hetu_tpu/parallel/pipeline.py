@@ -40,7 +40,7 @@ from jax.sharding import PartitionSpec as P
 
 def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                    *, n_micro: int, mesh, pp_axis: str = "pp",
-                   remat: bool = True):
+                   remat: bool = True, remat_policy: str = "nothing"):
     """Run the circular pipeline.
 
     stage_body(stage_params_slice, x_mb, token_data_mb) -> x_mb — applies one
@@ -61,8 +61,8 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
 
     body = stage_body
     if remat:
-        body = jax.checkpoint(
-            stage_body, policy=jax.checkpoint_policies.nothing_saveable)
+        from hetu_tpu.nn.remat import remat_policy as _policy
+        body = jax.checkpoint(stage_body, policy=_policy(remat_policy))
     vbody = jax.vmap(body, in_axes=(0, 0, 0), spmd_axis_name=pp_axis)
 
     def shift_in(new, state):

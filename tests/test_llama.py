@@ -141,3 +141,20 @@ def test_multi_axis_dim_order_reshard():
                    out_specs=dst.partition_spec(), check_vma=False)
     out = jax.jit(fn)(x)
     np.testing.assert_allclose(np.asarray(out), np.asarray(x))
+
+
+def test_remat_policies_compile_and_match():
+    ids = _data()
+    outs = {}
+    for pol in ("nothing", "dots"):
+        cfg = LlamaConfig.tiny(remat=True, compute_dtype=jnp.float32,
+                               remat_policy=pol)
+        m = LlamaLMHeadModel(cfg)
+        p = m.init(jax.random.key(4))
+        g = jax.grad(lambda p: m(p, ids, labels=ids))(p)
+        outs[pol] = jax.tree.leaves(g)[0]
+    np.testing.assert_allclose(np.asarray(outs["nothing"]),
+                               np.asarray(outs["dots"]), rtol=1e-5)
+    with pytest.raises(ValueError):
+        LlamaLMHeadModel(LlamaConfig.tiny(remat_policy="bogus"))(
+            LlamaLMHeadModel(LlamaConfig.tiny()).init(jax.random.key(0)), ids)

@@ -1,0 +1,57 @@
+"""Straggler injection tool.
+
+Rebuild of the reference's straggler workloads (reference: workloads/cuda/
+workload_{heavy_compute,heavy_communicate,stall_communicate}.cu — standalone
+binaries that occupy/stall GPUs to simulate stragglers for the Malleus
+experiments, examples/malleus/test_straggler_workload.py).
+
+TPU version: a competing process that burns MXU cycles (heavy_compute) or
+sleeps in bursts (stall) on the local chip, degrading a co-located trainer
+so Malleus planning / elastic behavior can be exercised.
+
+    python tools_straggler.py --mode compute --duty 0.5 --seconds 60
+"""
+import argparse
+import time
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", choices=["compute", "stall"], default="compute")
+    ap.add_argument("--duty", type=float, default=0.5,
+                    help="fraction of each second spent burning")
+    ap.add_argument("--seconds", type=float, default=60.0)
+    ap.add_argument("--size", type=int, default=4096)
+    args = ap.parse_args()
+
+    import jax
+    import jax.numpy as jnp
+
+    x = jnp.ones((args.size, args.size), jnp.bfloat16)
+
+    @jax.jit
+    def burn(x):
+        for _ in range(8):
+            x = (x @ x) * (1.0 / args.size)
+        return jnp.sum(x.astype(jnp.float32))
+
+    t_end = time.time() + args.seconds
+    print(f"straggler[{args.mode}] duty={args.duty} for {args.seconds}s")
+    while time.time() < t_end:
+        t0 = time.time()
+        if args.mode == "compute":
+            # occupy the device for `duty` of each second
+            while time.time() - t0 < args.duty:
+                float(burn(x))
+            time.sleep(max(0.0, 1.0 - args.duty))
+        else:
+            # stall: short device bursts separated by long holds — keeps the
+            # device claimed (queue pressure) while mostly idle, the shape of
+            # the reference's stall_communicate workload
+            float(burn(x))
+            time.sleep(max(args.duty, 0.05))
+    print("straggler done")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,175 @@
+"""Autoregressive generation with a KV cache.
+
+Rebuild of the reference's model-generation surface (reference:
+python/hetu/models/utils/model_utils.py PreTrainedModel generate path; the
+reference is training-first and so are we — this is the functional decode
+loop for eval/demo, TPU-shaped: static max length, lax.scan decode, cache as
+a pytree carried through the scan).
+
+Works with the LLaMA family's stacked-scan parameter layout: the per-layer
+KV caches are stacked [L, b, max_len, n_kv, hd] and the decode step scans
+layers with the cache rows as per-layer xs/ys.
+"""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from hetu_tpu import ops
+
+
+def _attend_cached(q, ck, cv, pos, scale):
+    """q: [b, 1, nq, hd]; ck/cv: [b, M, n_kv, hd]; attend over cache[:pos+1]."""
+    b, M, n_kv, hd = ck.shape
+    nq = q.shape[2]
+    group = nq // n_kv
+    if group > 1:
+        ck = jnp.repeat(ck, group, axis=2)
+        cv = jnp.repeat(cv, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    mask = jnp.arange(M)[None, None, None, :] <= pos
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def init_cache(model, batch: int, max_len: int):
+    """Empty KV cache [L, b, max_len, n_kv, hd] for the llama family."""
+    c = model.config
+    shape = (c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
+             c.head_dim)
+    return (jnp.zeros(shape, c.compute_dtype), jnp.zeros(shape, c.compute_dtype))
+
+
+def prefill(model, params, input_ids, max_len: int):
+    """Run the full forward over the prompt, returning (last_logits, cache).
+    Uses the model's training forward (flash path) plus a kv-extraction pass.
+    """
+    c = model.config
+    if not c.use_scan:
+        raise ValueError("generation requires use_scan=True (stacked layer "
+                         "params); rebuild the model with use_scan=True")
+    b, plen = input_ids.shape
+    # extract per-layer k/v by re-running the projections layer by layer —
+    # one pass via the scan collecting (k, v) as ys
+    mp = params["model"]
+    x = model.model.embed(mp["embed"], input_ids).astype(c.compute_dtype)
+    cos, sin = ops.build_rope_cache(c.max_position_embeddings, c.head_dim,
+                                    c.rope_theta)
+    block = model.model.layers.block
+
+    att = block.attn
+
+    def body(carry, layer_params):
+        h = carry
+        out, _aux = block(layer_params, h, cos=cos, sin=sin)
+        # recompute only the K/V planes of the fused projection for the cache
+        # (the q-head planes are sliced out of the weight before the einsum)
+        w_kv = layer_params["attn"]["wqkv"][:, :, att.group: att.group + 2, :]
+        kv = jnp.einsum("bsh,hkgd->bskgd",
+                        block.input_norm(layer_params["input_norm"], h),
+                        w_kv.astype(h.dtype))
+        k = ops.apply_rotary(kv[..., 0, :], cos, sin, None)
+        v = kv[..., 1, :]
+        return out, (k, v)
+
+    x, (ks, vs) = lax.scan(body, x, mp["layers"]["layers"])
+    hidden = model.model.final_norm(mp["final_norm"], x)
+    logits = model.logits(params, hidden)[:, -1, :]
+    pad = max_len - plen
+    cache_k = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    cache_v = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    return logits, (cache_k, cache_v)
+
+
+def decode_step(model, params, token, cache, pos):
+    """One token step. token: [b] int32; pos: scalar current position.
+    Returns (logits [b, vocab], new_cache)."""
+    c = model.config
+    if not c.use_scan:
+        raise ValueError("generation requires use_scan=True (stacked layer "
+                         "params)")
+    mp = params["model"]
+    b = token.shape[0]
+    x = model.model.embed(mp["embed"], token[:, None]).astype(c.compute_dtype)
+    cos, sin = ops.build_rope_cache(c.max_position_embeddings, c.head_dim,
+                                    c.rope_theta)
+    block = model.model.layers.block
+    att = block.attn
+    scale = c.head_dim ** -0.5
+    pos_ids = jnp.full((b, 1), pos, jnp.int32)
+    cache_k, cache_v = cache
+
+    def body(carry, xs):
+        h = carry
+        layer_params, ck, cv = xs
+        hn = block.input_norm(layer_params["input_norm"], h)
+        qkv = jnp.einsum("bsh,hkgd->bskgd", hn,
+                         layer_params["attn"]["wqkv"].astype(h.dtype))
+        q = qkv[..., : att.group, :].reshape(b, 1, att.n_q, c.head_dim)
+        k = qkv[..., att.group, :]
+        v = qkv[..., att.group + 1, :]
+        q = ops.apply_rotary(q, cos, sin, pos_ids)
+        k = ops.apply_rotary(k, cos, sin, pos_ids)
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        attn = _attend_cached(q, ck, cv, pos, scale)
+        h = h + att.o_proj(layer_params["attn"]["o_proj"],
+                           attn.reshape(b, 1, att.n_q * c.head_dim))
+        mlp_out = block.mlp(layer_params["mlp"],
+                            block.post_norm(layer_params["post_norm"], h))
+        if isinstance(mlp_out, tuple):  # MoE
+            mlp_out = mlp_out[0]
+        h = h + mlp_out
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (mp["layers"]["layers"], cache_k, cache_v))
+    hidden = model.model.final_norm(mp["final_norm"], x)
+    logits = model.logits(params, hidden)[:, 0, :]
+    return logits, (new_k, new_v)
+
+
+def generate(model, params, input_ids, *, max_new_tokens: int,
+             temperature: float = 0.0, top_k: Optional[int] = None,
+             rng: Optional[jax.Array] = None,
+             eos_id: Optional[int] = None):
+    """Autoregressive generation (greedy when temperature == 0).
+    input_ids: [b, plen] int32 -> [b, plen + max_new_tokens]."""
+    b, plen = input_ids.shape
+    max_len = plen + max_new_tokens
+    if max_len > model.config.max_position_embeddings:
+        raise ValueError(f"total length {max_len} exceeds "
+                         f"max_position_embeddings")
+    logits, cache = prefill(model, params, input_ids, max_len)
+    rng = rng if rng is not None else jax.random.key(0)
+
+    def sample(logits, key):
+        if temperature == 0.0:
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        logits = logits / temperature
+        if top_k is not None:
+            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            logits = jnp.where(logits < kth, -1e30, logits)
+        return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
+
+    def step(carry, i):
+        logits, cache, key, done = carry
+        key, sub = jax.random.split(key)
+        tok = sample(logits, sub)
+        if eos_id is not None:
+            tok = jnp.where(done, eos_id, tok)
+            done = done | (tok == eos_id)
+        logits, cache = decode_step(model, params, tok, cache, plen + i)
+        return (logits, cache, key, done), tok
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _, _), toks = lax.scan(
+        step, (logits, cache, rng, done0), jnp.arange(max_new_tokens))
+    return jnp.concatenate([input_ids, toks.T], axis=1)

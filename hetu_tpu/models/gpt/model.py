@@ -255,12 +255,17 @@ class GPTModel(Module):
                   jnp.zeros((c.num_hidden_layers,), jnp.uint32))
             x, _ = lax.scan(fn, x, xs)
         else:
+            from hetu_tpu.nn.remat import remat_policy
             for i in range(c.num_hidden_layers):
-                x = self.block(params[f"block_{i}"], x,
-                               position_ids=position_ids,
-                               segment_ids=segment_ids,
-                               rng=layer_rngs[i] if use_drop else None,
-                               deterministic=deterministic)
+                def blk(p, y, i=i):
+                    return self.block(p, y, position_ids=position_ids,
+                                      segment_ids=segment_ids,
+                                      rng=layer_rngs[i] if use_drop else None,
+                                      deterministic=deterministic)
+                if c.remat:
+                    blk = jax.checkpoint(blk,
+                                         policy=remat_policy(c.remat_policy))
+                x = blk(params[f"block_{i}"], x)
         return self.final_ln(params["final_ln"], x)
 
 

@@ -30,6 +30,12 @@ class LlamaConfig:
     moe_capacity_factor: float = 1.25
     moe_gate: str = "topk"
 
+    # heterogeneous pipeline: per-stage layer counts (sum = num_hidden_layers,
+    # len = pp). None = equal split. The Malleus planner emits this
+    # (reference: hetero pipelines with per-stage layer counts,
+    # generate_llama_hetero_4d_config.py; engine/strategy.py planner)
+    pipeline_stage_layers: object = None
+
     # TPU-build knobs
     param_dtype: object = jnp.float32
     compute_dtype: object = jnp.bfloat16

@@ -40,7 +40,8 @@ from jax.sharding import PartitionSpec as P
 
 def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                    *, n_micro: int, mesh, pp_axis: str = "pp",
-                   remat: bool = True, remat_policy: str = "nothing"):
+                   remat: bool = True, remat_policy: str = "nothing",
+                   stage_mask=None):
     """Run the circular pipeline.
 
     stage_body(stage_params_slice, x_mb, token_data_mb) -> x_mb — applies one
@@ -63,7 +64,9 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     if remat:
         from hetu_tpu.nn.remat import remat_policy as _policy
         body = jax.checkpoint(stage_body, policy=_policy(remat_policy))
-    vbody = jax.vmap(body, in_axes=(0, 0, 0), spmd_axis_name=pp_axis)
+    extra_axes = (0,) if stage_mask is not None else ()
+    vbody = jax.vmap(body, in_axes=(0, 0, 0) + extra_axes,
+                     spmd_axis_name=pp_axis)
 
     def shift_in(new, state):
         """Stage hand-off: stage 0 gets the fresh micro, stage i gets stage
@@ -97,7 +100,10 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         in_x, in_tok, mask_t = xs_t
         cur_x = shift_in(in_x, state_x)
         cur_tok = {k: shift_in(in_tok[k], state_tok[k]) for k in state_tok}
-        out = vbody(stage_params, cur_x, cur_tok)
+        args = (stage_params, cur_x, cur_tok)
+        if stage_mask is not None:
+            args = args + (stage_mask,)
+        out = vbody(*args)
         if isinstance(out, tuple):
             out_x, aux = out                 # [pp, mb, s, h], [pp]
             aux = jnp.sum(aux * mask_t)

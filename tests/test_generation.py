@@ -1,0 +1,71 @@
+"""KV-cache generation tests: greedy decode must match the naive
+full-recompute argmax loop exactly."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.models.generation import generate, prefill, decode_step
+
+
+def _model():
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False)
+    m = LlamaLMHeadModel(cfg)
+    return m, m.init(jax.random.key(0))
+
+
+def test_greedy_matches_full_recompute():
+    model, params = _model()
+    rng = np.random.default_rng(0)
+    prompt = jnp.asarray(rng.integers(0, 256, (2, 8)), jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6)
+    assert out.shape == (2, 14)
+
+    # naive loop: full forward each step, take argmax
+    seq = prompt
+    for _ in range(6):
+        logits = model(params, seq)
+        nxt = jnp.argmax(logits[:, -1, :], axis=-1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_prefill_logits_match_forward():
+    model, params = _model()
+    prompt = jnp.asarray(np.random.default_rng(1).integers(0, 256, (2, 12)),
+                         jnp.int32)
+    logits, cache = prefill(model, params, prompt, max_len=16)
+    full = model(params, prompt)
+    np.testing.assert_allclose(np.asarray(logits), np.asarray(full[:, -1, :]),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_sampled_generation_runs_and_eos_stops():
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=8, temperature=0.8,
+                   top_k=20, rng=jax.random.key(5))
+    assert out.shape == (1, 12)
+    # eos propagation: once produced (forced here by eos_id == every token)
+    logits, cache = prefill(model, params, prompt, max_len=12)
+    tok = int(jnp.argmax(logits[0]))
+    out2 = generate(model, params, prompt, max_new_tokens=8, eos_id=tok)
+    tail = np.asarray(out2)[0, 4:]
+    first = np.flatnonzero(tail == tok)
+    if len(first):
+        assert (tail[first[0]:] == tok).all()
+
+
+def test_gqa_generation():
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           num_key_value_heads=2, use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(2))
+    prompt = jnp.asarray([[5, 6, 7]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=5)
+    seq = prompt
+    for _ in range(5):
+        nxt = jnp.argmax(model(params, seq)[:, -1, :], -1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))

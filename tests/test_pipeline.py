@@ -120,3 +120,63 @@ def test_moe_in_pipeline_trains():
     losses = [float(tr.train_step(batch)["loss"]) for _ in range(6)]
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] - 0.2, losses
+
+
+def test_hetero_stage_layers_match_equal_split():
+    # Malleus-style uneven stages: [3, 1] layers over pp=2 must equal the
+    # single-device model exactly
+    ids = _ids(b=4, s=32)
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, remat=False,
+                           compute_dtype=jnp.float32)
+    gm = LlamaLMHeadModel(cfg, ParallelStrategy())
+    gp = gm.init(jax.random.key(9))
+    golden = gm(gp, ids)
+
+    cfg_h = LlamaConfig.tiny(num_hidden_layers=4, remat=False,
+                             compute_dtype=jnp.float32,
+                             pipeline_stage_layers=(3, 1))
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    mesh = st.build_mesh()
+    m = LlamaLMHeadModel(cfg_h, st)
+    with ht.use_mesh(mesh):
+        p = m.init(jax.random.key(9), mesh=mesh)
+        out = jax.jit(lambda p, x: m(p, x, n_micro=2))(p, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_hetero_stage_layers_from_malleus_plan_trains():
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.engine.malleus import MalleusPlanner, StragglerProfile
+    from hetu_tpu.data import pad_batch
+    from hetu_tpu.utils.parallel_config import (read_ds_parallel_config,
+                                                stage_layer_ranges)
+    # plan for 2 stages (tp=2 within each) with a slow pair
+    plan = MalleusPlanner(num_layers=4, tp=2, dp=1).plan(
+        StragglerProfile(speeds=[1.0, 1.0, 0.5, 0.5]))
+    strategy, raw = read_ds_parallel_config(plan)
+    layers = [b - a for a, b in stage_layer_ranges(raw)]
+    assert sum(layers) == 4 and len(layers) == 2 and layers[0] != layers[1]
+
+    cfg = LlamaConfig.tiny(num_hidden_layers=4, remat=False,
+                           pipeline_stage_layers=tuple(layers))
+    model = LlamaLMHeadModel(cfg, strategy)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=64,
+                        lr=3e-3, warmup_steps=2, total_steps=20, log_every=100)
+    tr = Trainer(model, tc, strategy).build()
+    rng = np.random.default_rng(0)
+    batch = pad_batch([rng.integers(1, 250, size=60) for _ in range(8)], 64)
+    losses = [float(tr.train_step(batch)["loss"]) for _ in range(6)]
+    assert np.isfinite(losses).all() and losses[-1] < losses[0] - 0.3, losses
+
+
+def test_bad_stage_layers_rejected():
+    cfg = LlamaConfig.tiny(num_hidden_layers=4,
+                           pipeline_stage_layers=(3, 2))  # sums to 5
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    mesh = st.build_mesh()
+    m = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        p = m.init(jax.random.key(0), mesh=mesh)
+        with pytest.raises(ValueError):
+            m(p, _ids())

@@ -45,11 +45,15 @@ def main():
                 float(burn(x))
             time.sleep(max(0.0, 1.0 - args.duty))
         else:
-            # stall: short device bursts separated by long holds — keeps the
-            # device claimed (queue pressure) while mostly idle, the shape of
+            # stall: one short device burst per cycle, then idle for the rest
+            # — duty stays 'fraction of the cycle busy' in BOTH modes; the
+            # burst keeps the device claimed (queue pressure), the shape of
             # the reference's stall_communicate workload
+            burst_t = time.time()
             float(burn(x))
-            time.sleep(max(args.duty, 0.05))
+            busy = time.time() - burst_t
+            time.sleep(max(busy * (1.0 - args.duty) / max(args.duty, 0.05),
+                           0.01))
     print("straggler done")
 
 

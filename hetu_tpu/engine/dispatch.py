@@ -1,0 +1,57 @@
+"""Dynamic per-batch strategy dispatch.
+
+Rebuild of the Hydraulis flow (reference: examples/hydraulis/strategy/
+dynamic_pulp.py:179 `dynamic_strategy` ILP + cost_model.py +
+train_hetu_with_kv_store.py — per-batch strategy chosen from the batch's
+sequence-length distribution, strategies hot-switched via the KV store).
+
+Here: the cost model scores each candidate strategy for the incoming batch's
+(padded) shape and the dispatcher returns the fastest feasible one; pair it
+with HotSwitchTrainer.train_step(batch, strategy_id=...) for the full loop.
+The ILP of the reference is replaced by exact enumeration — strategy pools
+are small (a handful of seq-len buckets).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.search.cost_model import CostModel, StrategyCandidate
+from hetu_tpu.parallel.strategy import ParallelStrategy
+
+
+@dataclasses.dataclass
+class BatchStrategyDispatcher:
+    """Choose a strategy id per batch by predicted step time under the
+    hardware cost model."""
+
+    cost: CostModel
+    strategies: Sequence[ParallelStrategy]
+
+    def _candidate(self, st: ParallelStrategy) -> StrategyCandidate:
+        return StrategyCandidate(
+            dp=st.dp, tp=st.tp, pp=st.pp, cp=st.cp,
+            sequence_parallel=st.sequence_parallel, zero=st.zero,
+            remat=True, n_micro=max(2 * st.pp, 1) if st.pp > 1 else 1)
+
+    def choose(self, seq_lens: Sequence[int],
+               global_batch: Optional[int] = None) -> int:
+        """Strategy id minimizing predicted time for this batch shape.
+        seq_lens: the batch's sequence lengths (max -> padded seq)."""
+        seq = int(max(seq_lens))
+        cost = dataclasses.replace(
+            self.cost, seq_len=seq,
+            global_batch=global_batch or len(seq_lens))
+        hbm = cost.hw.hbm_gbytes * 1e9 * 0.9
+        best, best_t = None, float("inf")
+        for i, st in enumerate(self.strategies):
+            c = self._candidate(st)
+            t, m = cost.evaluate(c)
+            if m <= hbm and t < best_t:
+                best, best_t = i, t
+        if best is None:
+            raise ValueError(
+                f"no strategy in the pool fits memory for seq={seq}")
+        return best

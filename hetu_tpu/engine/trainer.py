@@ -220,6 +220,45 @@ class Trainer:
         return metrics
 
     # ------------------------------------------------------------------
+    def evaluate(self, batches: Iterable[Dict[str, np.ndarray]],
+                 max_batches: Optional[int] = None) -> Dict[str, float]:
+        """Evaluation loop: token-weighted CE (router aux excluded) and
+        perplexity (reference: trainer eval path)."""
+        if self.params is None:
+            self.build()
+        if not hasattr(self, "_eval_fn"):
+            def eval_step(params, batch):
+                return self.model(
+                    params, batch["input_ids"], labels=batch["labels"],
+                    position_ids=batch.get("position_ids"),
+                    segment_ids=batch.get("segment_ids"),
+                    deterministic=True, loss_reduction="sum",
+                    include_aux_loss=False)
+            with use_mesh(self.mesh):
+                self._eval_fn = jax.jit(eval_step)
+        total, count = 0.0, 0.0
+        for i, host_batch in enumerate(batches):
+            if max_batches is not None and i >= max_batches:
+                break
+            # same dp/cp input sharding as training (batches here have no
+            # micro dim: [gbs, seq])
+            st = self.strategy
+            spec = [None, None]
+            if st.dp > 1:
+                spec[0] = "dp"
+            if st.cp > 1:
+                spec[1] = "cp"
+            sh = NamedSharding(self.mesh, P(*spec))
+            batch = {k: jax.device_put(v, sh) for k, v in host_batch.items()}
+            with use_mesh(self.mesh):
+                lsum, csum = self._eval_fn(self.params, batch)
+            total += float(lsum)
+            count += float(csum)
+        loss = total / max(count, 1.0)
+        return {"loss": loss, "perplexity": float(np.exp(min(loss, 30.0))),
+                "tokens": count}
+
+    # ------------------------------------------------------------------
     def state(self):
         return {"params": self.params, "opt_state": self.opt_state,
                 "step": self.global_step}

@@ -221,12 +221,9 @@ class GPTModel(Module):
         return out
 
     def forward(self, params, input_ids, *, position_ids=None,
-                segment_ids=None, rng=None, deterministic=True):
+                segment_ids=None, rng=None, deterministic=True,
+                n_micro=None):
         c, st = self.config, self.strategy
-        if st.pp > 1:
-            raise NotImplementedError(
-                "GPT pipeline parallelism: use the LLaMA family or pp=1 "
-                "(planned)")
         b, s = input_ids.shape
         pos = position_ids if position_ids is not None else \
             jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
@@ -236,6 +233,28 @@ class GPTModel(Module):
         x = st.constrain(x, st.act_hidden())
 
         use_drop = not deterministic and rng is not None
+        if st.pp > 1:
+            if use_drop:
+                raise NotImplementedError("dropout inside the pipeline")
+            if not c.use_scan:
+                raise ValueError("pipeline parallelism requires use_scan")
+            from hetu_tpu.core.mesh import current_mesh
+            from hetu_tpu.parallel.pipeline import staged_stack_forward
+            mesh = current_mesh()
+            if mesh is None:
+                raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
+
+            def block_fn(layer_params, x_mb, pos_mb, seg_mb):
+                out = self.block(layer_params, x_mb, position_ids=pos_mb,
+                                 segment_ids=seg_mb)
+                return out, jnp.zeros((), jnp.float32)
+
+            x, _aux = staged_stack_forward(
+                block_fn, params["blocks"], x,
+                num_layers=c.num_hidden_layers, pp=st.pp, mesh=mesh,
+                position_ids=position_ids, segment_ids=segment_ids,
+                n_micro=n_micro, remat=c.remat, remat_policy=c.remat_policy)
+            return self.final_ln(params["final_ln"], x)
         layer_rngs = (jax.random.split(rng, c.num_hidden_layers)
                       if use_drop else None)
         if c.use_scan:
@@ -286,11 +305,14 @@ class GPTLMHeadModel(Module):
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, loss_reduction: str = "mean", rng=None,
-                deterministic=True, n_micro=None):
+                deterministic=True, n_micro=None,
+                include_aux_loss: bool = True):
+        # include_aux_loss: accepted for API uniformity with the MoE-capable
+        # LLaMA family; GPT has no router losses so it is a no-op
         hidden = self.model(params["model"], input_ids,
                             position_ids=position_ids,
                             segment_ids=segment_ids, rng=rng,
-                            deterministic=deterministic)
+                            deterministic=deterministic, n_micro=n_micro)
         if self.config.tie_word_embeddings:
             w = params["model"]["wte"]["weight"].astype(hidden.dtype).T
         else:
@@ -300,6 +322,9 @@ class GPTLMHeadModel(Module):
         if labels is None:
             return logits
         tgt = labels[:, 1:]
+        if loss_reduction not in ("mean", "sum"):
+            raise ValueError(f"loss_reduction must be 'mean' or 'sum', got "
+                             f"{loss_reduction!r}")
         if loss_reduction == "sum":
             loss = ops.softmax_cross_entropy_sparse(
                 logits[:, :-1, :], tgt, ignore_index=-100, reduction="sum")

@@ -263,89 +263,26 @@ class LlamaDecoderStack(Module):
                           segment_ids, n_micro: Optional[int]):
         """pp > 1: run the decoder stack through the circular SPMD pipeline
         (hetu_tpu.parallel.pipeline; reference: executable_graph.cc:803/:836
-        pipeline schedules)."""
+        pipeline schedules).  Uneven stage_layers (the Malleus layout) run as
+        padded + masked stage stacks."""
         from hetu_tpu.core.mesh import current_mesh
-        from hetu_tpu.parallel.pipeline import pipeline_apply
+        from hetu_tpu.parallel.pipeline import staged_stack_forward
 
         st, c = self.strategy, self.config
         mesh = current_mesh()
         if mesh is None:
             raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
-        pp = st.pp
-        if n_micro is None:
-            n_micro = pp
-        L = self.num_layers
-        stage_layers = c.pipeline_stage_layers
-        if stage_layers is None:
-            if L % pp:
-                raise ValueError(f"num_layers={L} must divide by pp={pp} "
-                                 "(or set pipeline_stage_layers)")
-            stage_layers = [L // pp] * pp
-        stage_layers = list(stage_layers)
-        if len(stage_layers) != pp or sum(stage_layers) != L:
-            raise ValueError(
-                f"pipeline_stage_layers={stage_layers} must have len pp={pp} "
-                f"and sum num_layers={L}")
-        max_k = max(stage_layers)
 
-        if all(k == max_k for k in stage_layers):
-            stage_params = jax.tree.map(
-                lambda a: a.reshape((pp, max_k) + a.shape[1:]),
-                params["layers"])
-            layer_mask = None
-        else:
-            # heterogeneous stages (the Malleus layout): gather each stage's
-            # layer slice into a padded [pp, max_k, ...] stack and mask the
-            # padding — one SPMD program; wasted compute bounded by
-            # pp*max_k - L layer applications
-            starts = np.cumsum([0] + stage_layers[:-1])
-            idx = np.zeros((pp, max_k), np.int32)
-            mask = np.zeros((pp, max_k), np.float32)
-            for s_i, (st0, k) in enumerate(zip(starts, stage_layers)):
-                idx[s_i, :k] = np.arange(st0, st0 + k)
-                mask[s_i, :k] = 1.0
-            idx_j = jnp.asarray(idx).reshape(-1)
-            stage_params = jax.tree.map(
-                lambda a: jnp.take(a, idx_j, axis=0).reshape(
-                    (pp, max_k) + a.shape[1:]),
-                params["layers"])
-            layer_mask = jnp.asarray(mask)
+        def block_fn(layer_params, x_mb, pos_mb, seg_mb):
+            return self.block(layer_params, x_mb, cos=cos, sin=sin,
+                              position_ids=pos_mb, segment_ids=seg_mb)
 
-        use_pos = position_ids is not None
-        use_seg = segment_ids is not None
-
-        def stage_body(local_params, x_mb, tok, *mask_args):
-            m = mask_args[0] if mask_args else None
-
-            def body(carry, xs):
-                if m is None:
-                    layer_params = xs
-                else:
-                    layer_params, mj = xs
-                x_c, aux_c = carry
-                out, aux = self.block(
-                    layer_params, x_c, cos=cos, sin=sin,
-                    position_ids=tok["position_ids"] if use_pos else None,
-                    segment_ids=tok["segment_ids"] if use_seg else None)
-                if m is not None:
-                    out = jnp.where(mj > 0, out, x_c)  # padded layer = identity
-                    aux = aux * mj
-                return (out, aux_c + aux), None
-
-            xs = local_params if m is None else (local_params, m)
-            (out, aux), _ = lax.scan(
-                body, (x_mb, jnp.zeros((), jnp.float32)), xs)
-            return out, aux
-
-        token_data = {}
-        if use_pos:
-            token_data["position_ids"] = position_ids
-        if use_seg:
-            token_data["segment_ids"] = segment_ids
-        return pipeline_apply(stage_body, stage_params, x, token_data,
-                              n_micro=n_micro, mesh=mesh, remat=c.remat,
-                              remat_policy=c.remat_policy,
-                              stage_mask=layer_mask)
+        return staged_stack_forward(
+            block_fn, params["layers"], x,
+            num_layers=self.num_layers, pp=st.pp, mesh=mesh,
+            position_ids=position_ids, segment_ids=segment_ids,
+            stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
+            remat=c.remat, remat_policy=c.remat_policy)
 
 
 class LlamaModel(Module):

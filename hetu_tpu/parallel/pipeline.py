@@ -117,3 +117,82 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                              (xs_x, xs_tok, aux_mask))
     outs = ys[pad:] if pad else ys          # [n_micro, mb, s, h]
     return outs.reshape(B, s, h), jnp.sum(auxs)
+
+
+def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
+                         pp: int, mesh, position_ids=None, segment_ids=None,
+                         stage_layers=None, n_micro=None,
+                         remat: bool = True, remat_policy: str = "nothing"):
+    """Model-family-agnostic pipelined decoder stack.
+
+    block_fn(layer_params, x_mb, position_ids_mb, segment_ids_mb) ->
+    (x_mb, aux_scalar) applies ONE layer; the per-micro token riders are
+    threaded by the pipeline (None stays None).
+    stack_params: pytree with leading [num_layers, ...] dims.
+    Handles equal and heterogeneous (Malleus) stage layer counts — uneven
+    stages run as padded + masked stacks (see the llama model tests for the
+    bit-equality guarantee).  Returns (x, aux_total).
+    """
+    import numpy as np
+
+    token_data = {}
+    if position_ids is not None:
+        token_data["position_ids"] = position_ids
+    if segment_ids is not None:
+        token_data["segment_ids"] = segment_ids
+
+    L = num_layers
+    if n_micro is None:
+        n_micro = pp
+    if stage_layers is None:
+        if L % pp:
+            raise ValueError(f"num_layers={L} must divide by pp={pp} "
+                             "(or pass stage_layers)")
+        stage_layers = [L // pp] * pp
+    stage_layers = list(stage_layers)
+    if len(stage_layers) != pp or sum(stage_layers) != L:
+        raise ValueError(f"stage_layers={stage_layers} must have len pp={pp} "
+                         f"and sum num_layers={L}")
+    max_k = max(stage_layers)
+
+    if all(k == max_k for k in stage_layers):
+        stage_params = jax.tree.map(
+            lambda a: a.reshape((pp, max_k) + a.shape[1:]), stack_params)
+        layer_mask = None
+    else:
+        starts = np.cumsum([0] + stage_layers[:-1])
+        idx = np.zeros((pp, max_k), np.int32)
+        mask = np.zeros((pp, max_k), np.float32)
+        for s_i, (st0, k) in enumerate(zip(starts, stage_layers)):
+            idx[s_i, :k] = np.arange(st0, st0 + k)
+            mask[s_i, :k] = 1.0
+        idx_j = jnp.asarray(idx).reshape(-1)
+        stage_params = jax.tree.map(
+            lambda a: jnp.take(a, idx_j, axis=0).reshape(
+                (pp, max_k) + a.shape[1:]), stack_params)
+        layer_mask = jnp.asarray(mask)
+
+    def stage_body(local_params, x_mb, tok, *mask_args):
+        m = mask_args[0] if mask_args else None
+
+        def body(carry, xs):
+            if m is None:
+                layer_params = xs
+            else:
+                layer_params, mj = xs
+            x_c, aux_c = carry
+            out, aux = block_fn(layer_params, x_c,
+                                tok.get("position_ids"),
+                                tok.get("segment_ids"))
+            if m is not None:
+                out = jnp.where(mj > 0, out, x_c)   # padded layer = identity
+                aux = aux * mj
+            return (out, aux_c + aux), None
+
+        xs = local_params if m is None else (local_params, m)
+        (out, aux), _ = lax.scan(body, (x_mb, jnp.zeros((), jnp.float32)), xs)
+        return out, aux
+
+    return pipeline_apply(stage_body, stage_params, x, token_data,
+                          n_micro=n_micro, mesh=mesh, remat=remat,
+                          remat_policy=remat_policy, stage_mask=layer_mask)

@@ -131,3 +131,41 @@ def test_ds_parallel_config_roundtrip(tmp_path):
     st, raw = read_ds_parallel_config(p)
     assert st.tp == 2 and st.pp == 2 and st.sequence_parallel
     assert raw["model"]["num_layers"] == 7
+
+
+def test_evaluate_perplexity():
+    trainer, cfg = _make_trainer(dp=2, tp=1)
+    trainer.build()
+    (batch,) = _batches(cfg, trainer.config, 1)
+    for _ in range(5):
+        trainer.train_step(batch)
+    m = trainer.evaluate([batch])
+    assert m["tokens"] > 0 and np.isfinite(m["loss"])
+    assert m["perplexity"] == pytest.approx(np.exp(m["loss"]), rel=1e-6)
+    # training on the batch should beat the untrained model
+    t2, _ = _make_trainer(dp=2, tp=1)
+    t2.build()
+    m0 = t2.evaluate([batch])
+    assert m["loss"] < m0["loss"]
+
+
+def test_batch_strategy_dispatcher():
+    from hetu_tpu.engine import BatchStrategyDispatcher
+    from hetu_tpu.search import CostModel, HardwareProfile
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.parallel import ParallelStrategy
+
+    cost = CostModel(hw=HardwareProfile.preset("v5e"), num_layers=32,
+                     hidden=4096, intermediate=11008, vocab=32000,
+                     num_params=6_738_000_000, global_batch=64, seq_len=1024)
+    pool = [ParallelStrategy(mesh=MeshConfig(dp=8, tp=8)),          # short
+            ParallelStrategy(mesh=MeshConfig(dp=2, tp=8, cp=4),
+                             sequence_parallel=True)]               # long
+    disp = BatchStrategyDispatcher(cost, pool)
+    short = disp.choose([256] * 64)
+    # at full batch x 16k seq the no-CP strategy blows HBM -> CP chosen
+    long = disp.choose([16384] * 64)
+    assert long == 1
+    assert short in (0, 1)
+    with pytest.raises(ValueError):
+        disp.choose([131072] * 64)  # nothing in the pool fits

@@ -1,0 +1,99 @@
+// Host-side LRU embedding cache.
+//
+// Rebuild of the reference's client-side embedding caches (reference:
+// hetu/v1/src/hetu_cache/include/{lru_cache.h,lfu_cache.h} — the HET-paper
+// caches that keep hot embedding rows near the worker, with pulls for
+// misses).  C ABI for ctypes (no pybind11 in the image).
+//
+// The cache maps int64 embedding ids -> fixed slots in a caller-owned host
+// buffer; lookup assigns slots for misses by evicting the least-recently-used
+// id and reports which rows must be fetched from the parameter server /
+// KV store (hetu_tpu.rpc) by the caller.
+//
+// Build: make -C csrc
+
+#include <cstdint>
+#include <list>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct LruCache {
+  int64_t capacity;
+  // recency list: front = most recent; entries are ids
+  std::list<int64_t> order;
+  struct Entry {
+    int64_t slot;
+    std::list<int64_t>::iterator pos;
+  };
+  std::unordered_map<int64_t, Entry> map;
+  std::vector<int64_t> free_slots;
+  int64_t hits = 0, misses = 0, evictions = 0;
+
+  explicit LruCache(int64_t cap) : capacity(cap) {
+    free_slots.reserve(cap);
+    for (int64_t i = cap - 1; i >= 0; --i) free_slots.push_back(i);
+    map.reserve(cap * 2);
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lru_create(int64_t capacity) { return new LruCache(capacity); }
+
+void lru_destroy(void* h) { delete static_cast<LruCache*>(h); }
+
+// For each key: out_slots[i] = buffer slot; out_hit[i] = 1 if resident.
+// On miss, a slot is assigned (evicting the LRU id if full) and
+// out_evicted[i] = the evicted id (or -1).  The caller must fill the slot
+// for every miss before using it.
+void lru_lookup(void* h, const int64_t* keys, int64_t n, int64_t* out_slots,
+                int8_t* out_hit, int64_t* out_evicted) {
+  auto* c = static_cast<LruCache*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    out_evicted[i] = -1;
+    auto it = c->map.find(key);
+    if (it != c->map.end()) {
+      // hit: refresh recency
+      c->order.erase(it->second.pos);
+      c->order.push_front(key);
+      it->second.pos = c->order.begin();
+      out_slots[i] = it->second.slot;
+      out_hit[i] = 1;
+      ++c->hits;
+      continue;
+    }
+    ++c->misses;
+    out_hit[i] = 0;
+    int64_t slot;
+    if (!c->free_slots.empty()) {
+      slot = c->free_slots.back();
+      c->free_slots.pop_back();
+    } else {
+      int64_t victim = c->order.back();
+      c->order.pop_back();
+      auto vit = c->map.find(victim);
+      slot = vit->second.slot;
+      c->map.erase(vit);
+      out_evicted[i] = victim;
+      ++c->evictions;
+    }
+    c->order.push_front(key);
+    c->map[key] = {slot, c->order.begin()};
+    out_slots[i] = slot;
+  }
+}
+
+void lru_stats(void* h, int64_t* out) {  // [hits, misses, evictions, size]
+  auto* c = static_cast<LruCache*>(h);
+  out[0] = c->hits;
+  out[1] = c->misses;
+  out[2] = c->evictions;
+  out[3] = static_cast<int64_t>(c->map.size());
+}
+
+}  // extern "C"

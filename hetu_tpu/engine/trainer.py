@@ -52,6 +52,8 @@ class Trainer:
                       if config.ckpt_dir else None)
         self.global_step = 0
 
+        from hetu_tpu.utils.profiling import StepProfiler
+        self.profiler = StepProfiler()
         c = config
         self.optimizer = optim.AdamW(
             lr=optim.cosine_schedule(c.lr, c.warmup_steps, c.total_steps,
@@ -204,7 +206,8 @@ class Trainer:
         for i, host_batch in enumerate(batches):
             if num_steps is not None and i >= num_steps:
                 break
-            metrics = self.train_step(host_batch)
+            with self.profiler.step(self.global_step):
+                metrics = self.train_step(host_batch)
             tokens += int(np.prod(host_batch["input_ids"].shape))
             if (self.global_step % c.log_every) == 0:
                 loss = float(metrics["loss"])  # forces device sync
@@ -217,6 +220,7 @@ class Trainer:
                 t0, tokens = time.perf_counter(), 0
             if self._ckpt and (self.global_step % c.ckpt_every) == 0:
                 self.save()
+        self.profiler.close()
         return metrics
 
     # ------------------------------------------------------------------

@@ -1,1 +1,3 @@
-from hetu_tpu.peft.lora import LoRAConfig, init_lora_params, merge_lora_params, LoRAWrappedModel
+from hetu_tpu.peft.lora import (LoRAConfig, init_lora_params,
+                                merge_lora_params, LoRAWrappedModel,
+                                MultiLoRAManager)

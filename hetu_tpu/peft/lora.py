@@ -149,3 +149,47 @@ class LoRAWrappedModel(Module):
 
     def num_trainable_params(self, lora_params) -> int:
         return sum(int(v.size) for v in jax.tree.leaves(lora_params))
+
+
+class MultiLoRAManager:
+    """Multi-task LoRA over one frozen base
+    (reference: peft/lora/layer.py:71 MultiLoraLayers + examples/lobra —
+    multi-task adapters with a per-batch task scheduler).
+
+    One adapter tree per task: `forward(task, ...)` runs the model with that
+    task's adapters, `loss_and_grads(task, loss_fn)` differentiates only that
+    adapter tree, and `schedule(stream)` groups a mixed (task, sample) stream
+    into per-task sub-batches the way lobra's batch scheduler does."""
+
+    def __init__(self, base_model, base_params, cfg: LoRAConfig,
+                 tasks: Sequence[str], key=None):
+        self.base_model = base_model
+        self.cfg = cfg
+        self.wrapped_model = LoRAWrappedModel(base_model, base_params, cfg)
+        key = key if key is not None else jax.random.key(0)
+        self.adapters: Dict[str, Any] = {
+            t: init_lora_params(base_params, cfg, jax.random.fold_in(key, i))
+            for i, t in enumerate(tasks)}
+
+    def tasks(self) -> List[str]:
+        return list(self.adapters)
+
+    def forward(self, task: str, *args, **kwargs):
+        return self.wrapped_model(self.adapters[task], *args, **kwargs)
+
+    def loss_and_grads(self, task: str, loss_fn):
+        """grad wrt ONE task's adapters (others untouched)."""
+        return jax.value_and_grad(loss_fn)(self.adapters[task])
+
+    def update(self, task: str, new_adapter):
+        self.adapters[task] = new_adapter
+
+    @staticmethod
+    def schedule(batch_stream):
+        """Group a mixed (task, sample) stream into per-task batches
+        (reference: lobra/trainer/batch_scheduler.py — minimize task
+        switches by grouping)."""
+        by_task: Dict[str, List[Any]] = {}
+        for task, sample in batch_stream:
+            by_task.setdefault(task, []).append(sample)
+        return by_task

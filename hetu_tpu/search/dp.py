@@ -4,11 +4,11 @@ pybind11; ctypes here — no pybind11 in the TPU image)."""
 from __future__ import annotations
 
 import ctypes
-import os
-import subprocess
 from typing import List, Optional, Sequence, Tuple
 
 import numpy as np
+
+from hetu_tpu.utils.native import load_native_lib
 
 _LIB = None
 
@@ -17,24 +17,14 @@ def _lib() -> Optional[ctypes.CDLL]:
     global _LIB
     if _LIB is not None:
         return _LIB or None
-    root = os.path.join(os.path.dirname(__file__), "..", "..", "csrc")
-    so = os.path.abspath(os.path.join(root, "libdp_core.so"))
-    if not os.path.exists(so):
-        try:  # build on demand
-            subprocess.run(["make", "-C", os.path.abspath(root)], check=True,
-                           capture_output=True)
-        except Exception:
-            _LIB = False
-            return None
-    try:
-        lib = ctypes.CDLL(so)
-        lib.dynamic_programming_core.restype = ctypes.c_int
-        lib.balance_stages.restype = ctypes.c_int
-        _LIB = lib
-        return lib
-    except OSError:
+    lib = load_native_lib("libdp_core.so", "libdp_core.so", required=False)
+    if lib is None:
         _LIB = False
         return None
+    lib.dynamic_programming_core.restype = ctypes.c_int
+    lib.balance_stages.restype = ctypes.c_int
+    _LIB = lib
+    return lib
 
 
 def dynamic_programming_core(time: Sequence[float], mem: Sequence[int],

@@ -1,0 +1,46 @@
+"""Shared loader for the native C++ components in csrc/ (build-on-demand +
+ctypes; the reference builds its native code via CMake up front)."""
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_CACHE: dict = {}
+
+
+def csrc_dir() -> str:
+    return os.path.abspath(
+        os.path.join(os.path.dirname(__file__), "..", "..", "csrc"))
+
+
+def load_native_lib(so_name: str, make_target: Optional[str] = None,
+                    required: bool = True) -> Optional[ctypes.CDLL]:
+    """Load csrc/<so_name>, building it with make if absent.  Build/compile
+    errors surface the compiler's stderr.  required=False returns None on
+    failure (callers with a python fallback)."""
+    if so_name in _CACHE:
+        return _CACHE[so_name] or None
+    root = csrc_dir()
+    so = os.path.join(root, so_name)
+    if not os.path.exists(so):
+        try:
+            subprocess.run(
+                ["make", "-C", root] + ([make_target] if make_target else []),
+                check=True, capture_output=True, text=True)
+        except subprocess.CalledProcessError as e:
+            _CACHE[so_name] = False
+            if required:
+                raise RuntimeError(
+                    f"building {so_name} failed:\n{e.stderr}") from e
+            return None
+    try:
+        lib = ctypes.CDLL(so)
+    except OSError as e:
+        _CACHE[so_name] = False
+        if required:
+            raise
+        return None
+    _CACHE[so_name] = lib
+    return lib

@@ -1,0 +1,98 @@
+"""Profiling / observability surface.
+
+Rebuild of the reference's env-flag-driven profiling (reference: SURVEY §5.1,
+§5.6 layer 3 — HETU_EVENT_TIMING records per-op events,
+HETU_MEMORY_PROFILE per-micro-batch memory, HETU_PARALLEL_ATTN attn timing,
+executable_graph.cc:1163-1313 GetExecEnvs).
+
+TPU mapping: XLA owns op scheduling, so per-op timing comes from
+jax.profiler traces; this module keeps the reference's ENV-FLAG CONTRACT and
+provides step-level timing + trace capture:
+
+    HETU_TPU_EVENT_TIMING=1        step timing logged per step
+    HETU_TPU_TRACE_DIR=/tmp/trace  capture a jax.profiler trace (step window)
+    HETU_TPU_MEMORY_PROFILE=1      per-step device memory stats (if exposed)
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+import time
+from typing import Dict, Optional
+
+import jax
+
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("profiling")
+
+
+def env_flags() -> Dict[str, str]:
+    """The runtime-behavior env surface (reference: GetExecEnvs)."""
+    return {k: v for k, v in os.environ.items()
+            if k.startswith("HETU_TPU_")}
+
+
+class StepProfiler:
+    """Step-level timing/trace hooks for the trainer loop."""
+
+    def __init__(self):
+        self.event_timing = os.environ.get("HETU_TPU_EVENT_TIMING") == "1"
+        self.trace_dir = os.environ.get("HETU_TPU_TRACE_DIR")
+        self.mem_profile = os.environ.get("HETU_TPU_MEMORY_PROFILE") == "1"
+        self._trace_active = False
+        self._trace_done = False
+        self._first_step: Optional[int] = None
+        self._times = []
+
+    def _stop_trace(self):
+        if self._trace_active:
+            try:
+                jax.profiler.stop_trace()
+                logger.info(f"trace written to {self.trace_dir}")
+            finally:
+                self._trace_active = False
+                self._trace_done = True
+
+    @contextlib.contextmanager
+    def step(self, step_idx: int, trace_steps=(2, 4)):
+        """trace_steps are RELATIVE to the first profiled step, so traces
+        fire on checkpoint-resumed runs too."""
+        if self._first_step is None:
+            self._first_step = step_idx
+        rel = step_idx - self._first_step
+        if (self.trace_dir and not self._trace_active and not self._trace_done
+                and rel >= trace_steps[0]):
+            jax.profiler.start_trace(self.trace_dir)
+            self._trace_active = True
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            dt = time.perf_counter() - t0
+            self._times.append(dt)
+            if self.event_timing:
+                logger.info(f"step {step_idx}: {dt * 1000:.1f} ms")
+            if self.mem_profile:
+                try:
+                    stats = jax.local_devices()[0].memory_stats() or {}
+                    used = stats.get("bytes_in_use")
+                    if used is not None:
+                        logger.info(
+                            f"step {step_idx}: {used / 1e9:.2f} GB in use")
+                except Exception:
+                    pass
+            if self._trace_active and rel >= trace_steps[1]:
+                self._stop_trace()
+
+    def close(self):
+        """Flush an in-flight trace (called by the trainer when the loop
+        ends before the trace window closes)."""
+        self._stop_trace()
+
+    def summary(self) -> Dict[str, float]:
+        if not self._times:
+            return {}
+        ts = sorted(self._times)
+        return {"steps": len(ts), "min_s": ts[0],
+                "median_s": ts[len(ts) // 2], "max_s": ts[-1]}

@@ -1,0 +1,121 @@
+"""C++ LRU embedding cache tests (reference: v1 hetu_cache LRU semantics)."""
+import numpy as np
+import pytest
+
+from hetu_tpu.data.embedding_cache import EmbeddingCache
+
+
+def _table(vocab=100, dim=8):
+    rng = np.random.default_rng(0)
+    table = rng.normal(size=(vocab, dim)).astype(np.float32)
+    fetches = []
+
+    def fetch(ids):
+        fetches.append(list(ids))
+        return table[ids]
+
+    return table, fetch, fetches
+
+
+def test_lookup_returns_correct_rows_and_caches():
+    table, fetch, fetches = _table()
+    cache = EmbeddingCache(capacity=16, dim=8, fetch_fn=fetch)
+    ids = np.array([3, 7, 3, 11])
+    rows = cache.lookup(ids)
+    np.testing.assert_allclose(rows, table[ids])
+    assert fetches == [[3, 7, 11]]  # unique misses fetched once
+    # second lookup: all hits, no fetch
+    rows2 = cache.lookup(np.array([7, 11]))
+    np.testing.assert_allclose(rows2, table[[7, 11]])
+    assert len(fetches) == 1
+    st = cache.stats()
+    assert st["hits"] >= 3 and st["misses"] == 3 and st["resident"] == 3
+
+
+def test_lru_eviction_order():
+    table, fetch, fetches = _table()
+    cache = EmbeddingCache(capacity=3, dim=8, fetch_fn=fetch)
+    cache.lookup(np.array([1, 2, 3]))      # fill
+    cache.lookup(np.array([1]))            # 1 most recent; LRU = 2
+    cache.lookup(np.array([4]))            # evicts 2
+    st = cache.stats()
+    assert st["evictions"] == 1
+    fetches.clear()
+    cache.lookup(np.array([1, 3, 4]))      # all resident
+    assert fetches == []
+    cache.lookup(np.array([2]))            # 2 was evicted -> fetch
+    assert fetches == [[2]]
+
+
+def test_write_back_roundtrip():
+    table, fetch, _ = _table()
+    cache = EmbeddingCache(capacity=8, dim=8, fetch_fn=fetch)
+    ids = np.array([5, 9])
+    new_rows = np.ones((2, 8), np.float32)
+    cache.write_back(ids, new_rows)
+    np.testing.assert_allclose(cache.lookup(ids), new_rows)
+    # an untouched row still comes from the table
+    np.testing.assert_allclose(cache.lookup(np.array([5, 1]))[1], table[1])
+
+
+def test_correctness_under_heavy_eviction():
+    table, fetch, _ = _table(vocab=1000)
+    cache = EmbeddingCache(capacity=32, dim=8, fetch_fn=fetch)
+    rng = np.random.default_rng(1)
+    for _ in range(50):
+        ids = rng.integers(0, 1000, size=20)
+        np.testing.assert_allclose(cache.lookup(ids), table[ids])
+
+
+def test_capacity_guard():
+    with pytest.raises(ValueError):
+        EmbeddingCache(capacity=0, dim=4,
+                       fetch_fn=lambda i: np.zeros((len(i), 4)))
+
+
+def test_intra_batch_eviction_correctness():
+    # regression: capacity 1 with duplicate/alternating ids in ONE batch —
+    # slot reuse inside the batch must not corrupt returned rows
+    table, fetch, _ = _table()
+    cache = EmbeddingCache(capacity=1, dim=8, fetch_fn=fetch)
+    ids = np.array([7, 9, 7, 9, 9, 7])
+    np.testing.assert_allclose(cache.lookup(ids), table[ids])
+    # capacity 2 thrash
+    cache2 = EmbeddingCache(capacity=2, dim=8, fetch_fn=fetch)
+    ids2 = np.array([1, 2, 3, 1, 4, 2, 5])
+    np.testing.assert_allclose(cache2.lookup(ids2), table[ids2])
+
+
+def test_dirty_eviction_flushes_to_store():
+    # regression: write_back updates must survive eviction via flush_fn
+    store = {i: np.full(8, float(i), np.float32) for i in range(10)}
+
+    def fetch(ids):
+        return np.stack([store[int(i)] for i in ids])
+
+    def flush(ids, rows):
+        for i, r in zip(ids, rows):
+            store[int(i)] = r.copy()
+
+    cache = EmbeddingCache(capacity=2, dim=8, fetch_fn=fetch, flush_fn=flush)
+    cache.lookup(np.array([5]))
+    cache.write_back(np.array([5]), np.full((1, 8), 99.0, np.float32))
+    cache.lookup(np.array([1, 2]))          # evicts 5 -> flush
+    np.testing.assert_allclose(store[5], 99.0)
+    # refetch returns the flushed (updated) value
+    np.testing.assert_allclose(cache.lookup(np.array([5]))[0], 99.0)
+
+
+def test_write_back_does_not_prefetch():
+    calls = []
+
+    def fetch(ids):
+        calls.append(list(ids))
+        return np.zeros((len(ids), 8), np.float32)
+
+    cache = EmbeddingCache(capacity=4, dim=8, fetch_fn=fetch)
+    # fresh id written directly: must NOT hit the store
+    cache.write_back(np.array([42]), np.ones((1, 8), np.float32))
+    assert calls == []
+    np.testing.assert_allclose(cache.lookup(np.array([42]))[0], 1.0)
+    assert calls == []  # still resident, no fetch

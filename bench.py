@@ -10,35 +10,10 @@ absolute numbers, BASELINE.md "Published numbers: None").
 from __future__ import annotations
 
 import json
-import os
-import subprocess
 import sys
-import threading
 import time
 
 import numpy as np
-
-
-def _probe_backend(timeout_s: float = 120.0):
-    """The axon remote-TPU tunnel can hang indefinitely — even
-    jax.default_backend() blocks during client init — so ALL first contact
-    happens on a watchdog thread.  Returns the backend name or None."""
-    ok = []
-
-    def probe():
-        try:
-            import jax
-            import jax.numpy as jnp
-            backend = jax.default_backend()
-            float(jnp.ones((64, 64)).sum())
-            ok.append(backend)
-        except Exception:
-            pass
-
-    t = threading.Thread(target=probe, daemon=True)
-    t.start()
-    t.join(timeout_s)
-    return ok[0] if ok else None
 
 
 def main():
@@ -48,15 +23,21 @@ def main():
     force_cpu = "--force-cpu" in sys.argv
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
-    elif _probe_backend() is None:
-        # tunnel down: emit a valid JSON line instead of hanging the driver
-        print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
-                          "unit": "fraction_of_peak", "vs_baseline": 0.0,
-                          "detail": {"error": "tpu tunnel unresponsive; "
-                                     "last measured value 0.482 (see README)",
-                                     "backend": "tpu-unreachable"}}),
-              flush=True)
-        return 0
+    else:
+        from hetu_tpu.utils.device import probe_backend
+        backend, err = probe_backend()
+        if backend is None:
+            # distinguish a genuine init error from a tunnel hang, and emit
+            # a valid JSON line either way instead of hanging the driver
+            reason = (f"device init failed: {err!r}" if err is not None
+                      else "tpu tunnel unresponsive (probe timed out); "
+                           "last measured value in README.md Benchmarks")
+            print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
+                              "unit": "fraction_of_peak", "vs_baseline": 0.0,
+                              "detail": {"error": reason,
+                                         "backend": "unreachable"}}),
+                  flush=True)
+            return 0
 
     import hetu_tpu as ht
     from hetu_tpu import optim

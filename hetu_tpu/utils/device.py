@@ -1,0 +1,51 @@
+"""Device-backend probing for entry points.
+
+The axon remote-TPU plugin (a) overrides JAX_PLATFORMS=cpu from the
+environment and (b) can hang indefinitely on first contact when its tunnel
+is down — even jax.default_backend() blocks.  These helpers give entry
+points (bench.py, __graft_entry__) a safe first touch.
+"""
+from __future__ import annotations
+
+import os
+import threading
+from typing import Optional, Tuple
+
+
+def force_cpu_if_requested():
+    """Honor a caller's CPU request in-process (the plugin ignores the env):
+    triggers on JAX_PLATFORMS=cpu or a host-platform device-count flag."""
+    import jax
+    want_cpu = (os.environ.get("JAX_PLATFORMS") == "cpu"
+                or "xla_force_host_platform_device_count"
+                in os.environ.get("XLA_FLAGS", ""))
+    if want_cpu:
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except RuntimeError:
+            pass  # backend already initialized
+
+
+def probe_backend(timeout_s: float = 120.0) -> Tuple[Optional[str], Optional[BaseException]]:
+    """First device contact on a watchdog thread.
+    Returns (backend_name, None) on success, (None, exception) when the
+    probe raised, (None, None) on timeout (tunnel hang)."""
+    ok: list = []
+    err: list = []
+
+    def probe():
+        try:
+            import jax
+            import jax.numpy as jnp
+            backend = jax.default_backend()
+            float(jnp.ones((8, 8)).sum())
+            ok.append(backend)
+        except BaseException as e:  # noqa: BLE001 — reported, not swallowed
+            err.append(e)
+
+    t = threading.Thread(target=probe, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if ok:
+        return ok[0], None
+    return None, (err[0] if err else None)

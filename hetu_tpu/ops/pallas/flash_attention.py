@@ -45,6 +45,22 @@ DEFAULT_BLOCK_Q = 512
 DEFAULT_BLOCK_K = 512
 
 
+def _diag_clamp_k(block_q: int, block_k: int, skip: bool):
+    """Index map clamp: skipped above-diagonal iterations re-fetch the
+    diagonal k block so Mosaic elides the duplicate DMA."""
+    if not skip:
+        return lambda qi, ki: ki
+    return lambda qi, ki: jnp.minimum(ki, (qi * block_q + block_q - 1)
+                                      // block_k)
+
+
+def _diag_clamp_q(block_q: int, block_k: int, skip: bool):
+    """Transpose clamp for the dkv kernel's (ki, qi) grid."""
+    if not skip:
+        return lambda ki, qi: qi
+    return lambda ki, qi: jnp.maximum(qi, ki * block_k // block_q)
+
+
 def _mask(s, q_pos, k_pos, q_seg, k_seg, causal):
     """Combined causal+segment mask for one (Bq, Bk) score tile."""
     m = None
@@ -142,14 +158,7 @@ def _fwd(q, k, v, q_pos, k_pos, q_seg, k_seg, *, scale, causal,
     q_seg = q_seg.reshape(b, 1, sq)
     k_seg = k_seg.reshape(b, 1, sk)
 
-    if skip_blocks and causal:
-        # clamp the k index so skipped (above-diagonal) iterations re-fetch
-        # the diagonal block — Mosaic elides the duplicate DMA
-        def kidx(qi, ki):
-            return jnp.minimum(ki, (qi * block_q + block_q - 1) // block_k)
-    else:
-        def kidx(qi, ki):
-            return ki
+    kidx = _diag_clamp_k(block_q, block_k, skip_blocks and causal)
 
     o, lse = pl.pallas_call(
         kernel,
@@ -308,6 +317,8 @@ def _bwd(q, k, v, o, lse, do, q_pos, k_pos, q_seg, k_seg, *, scale, causal,
     lse4 = lse.reshape(b, hq, 1, sq)
     delta4 = delta.reshape(b, hq, 1, sq)
 
+    kidx_b = _diag_clamp_k(block_q, block_k, skip_blocks and causal)
+
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, scale=scale, causal=causal,
                           use_seg=use_seg, nk=nk, block_q=block_q,
@@ -315,15 +326,19 @@ def _bwd(q, k, v, o, lse, do, q_pos, k_pos, q_seg, k_seg, *, scale, causal,
         grid=(b, hq, nq, nk),
         in_specs=[
             pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
-            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, 0, kidx_b(qi, ki))),
             pl.BlockSpec((1, 1, block_q), lambda bi, hi, qi, ki: (bi, 0, qi)),
-            pl.BlockSpec((1, 1, block_k), lambda bi, hi, qi, ki: (bi, 0, ki)),
+            pl.BlockSpec((1, 1, block_k),
+                         lambda bi, hi, qi, ki: (bi, 0, kidx_b(qi, ki))),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi // group,
+                                                 kidx_b(qi, ki), 0)),
             pl.BlockSpec((1, 1, block_k, d),
-                         lambda bi, hi, qi, ki: (bi, hi // group, ki, 0)),
+                         lambda bi, hi, qi, ki: (bi, hi // group,
+                                                 kidx_b(qi, ki), 0)),
             pl.BlockSpec((1, 1, block_q, d),
                          lambda bi, hi, qi, ki: (bi, hi, qi, 0)),
             pl.BlockSpec((1, 1, 1, block_q),
@@ -343,28 +358,32 @@ def _bwd(q, k, v, o, lse, do, q_pos, k_pos, q_seg, k_seg, *, scale, causal,
 
     # dk/dv per Q HEAD (grid over k blocks, inner loop over q blocks), then
     # group-summed to kv heads outside.
+    qidx_b = _diag_clamp_q(block_q, block_k, skip_blocks and causal)
+
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, scale=scale, causal=causal,
                           use_seg=use_seg, nq=nq, block_q=block_q,
                           block_k=block_k, skip_blocks=skip_blocks and causal),
         grid=(b, hq, nk, nq),
         in_specs=[
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, ki, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, 0, qidx_b(ki, qi))),
             pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)),
-            pl.BlockSpec((1, 1, block_q), lambda bi, hi, ki, qi: (bi, 0, qi)),
+            pl.BlockSpec((1, 1, block_q),
+                         lambda bi, hi, ki, qi: (bi, 0, qidx_b(ki, qi))),
             pl.BlockSpec((1, 1, block_k), lambda bi, hi, ki, qi: (bi, 0, ki)),
             pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, ki, qi: (bi, hi, qidx_b(ki, qi), 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
             pl.BlockSpec((1, 1, block_k, d),
                          lambda bi, hi, ki, qi: (bi, hi // group, ki, 0)),
             pl.BlockSpec((1, 1, block_q, d),
-                         lambda bi, hi, ki, qi: (bi, hi, qi, 0)),
+                         lambda bi, hi, ki, qi: (bi, hi, qidx_b(ki, qi), 0)),
             pl.BlockSpec((1, 1, 1, block_q),
-                         lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qidx_b(ki, qi))),
             pl.BlockSpec((1, 1, 1, block_q),
-                         lambda bi, hi, ki, qi: (bi, hi, 0, qi)),
+                         lambda bi, hi, ki, qi: (bi, hi, 0, qidx_b(ki, qi))),
         ],
         out_specs=[
             pl.BlockSpec((1, 1, block_k, d),
@@ -436,8 +455,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
                     block_k: int = DEFAULT_BLOCK_K):
     """Flash attention. q/k/v: [batch, seq, heads, head_dim] (kv heads may
     divide q heads — GQA). segment_ids: [batch, seq] packed-batch ids
-    (0 = pad); positions: [batch, seq] global positions for causal masking
-    (default arange — pass explicit ones under CP).  Returns [b, s, hq, d]."""
+    (0 = pad); positions: [batch, seq] global positions for causal masking.
+    Defaults: kv = arange(sk); q = arange(sq) + (sk - sq), i.e. BOTTOM-RIGHT
+    causal alignment for sq != sk (the HF convention) — pass explicit
+    positions under CP or for other alignments.  Returns [b, s, hq, d]."""
     b, sq, hq, d = q.shape
     sk = k.shape[1]
     block_q = min(block_q, sq)
@@ -455,7 +476,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     if q_positions is None:
-        q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+        # bottom-right causal alignment for sq != sk (queries are the LAST
+        # sq positions — the HF / reference-attention convention)
+        q_positions = jnp.broadcast_to(
+            jnp.arange(sq, dtype=jnp.int32) + (sk - sq), (b, sq))
     if kv_positions is None:
         kv_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
     if segment_ids is not None and kv_segment_ids is None:
@@ -485,7 +509,8 @@ def flash_attention_with_lse(q, k, v, *, causal: bool = True,
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
     if q_positions is None:
-        q_positions = jnp.broadcast_to(jnp.arange(sq, dtype=jnp.int32), (b, sq))
+        q_positions = jnp.broadcast_to(
+            jnp.arange(sq, dtype=jnp.int32) + (sk - sq), (b, sq))
     if kv_positions is None:
         kv_positions = jnp.broadcast_to(jnp.arange(sk, dtype=jnp.int32), (b, sk))
     if segment_ids is not None and kv_segment_ids is None:

@@ -100,3 +100,28 @@ def test_future_block_gives_zero_and_neginf_lse():
                                       block_k=128)
     assert float(jnp.abs(o).max()) == 0.0
     assert float(lse.max()) <= -1e29
+
+
+def test_cross_length_causal_alignment():
+    # regression: sq != sk defaults to BOTTOM-RIGHT causal alignment (the
+    # HF / reference-attention convention), found by a verify probe
+    q, k, v = _qkv(s=256, seed=7)
+    q = q[:, :128]
+    out = flash_attention(q, k, v, causal=True, block_q=64, block_k=64)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+
+
+def test_multiblock_asymmetric_gradients():
+    # regression coverage: the bwd DMA clamps under multi-block asymmetric
+    # block shapes (block_q != block_k) with skip active
+    q, k, v = _qkv(s=128, seed=8)
+    for bq, bk in ((32, 64), (64, 32), (32, 32)):
+        g1 = jax.grad(lambda q, k, v: (flash_attention(
+            q, k, v, causal=True, block_q=bq, block_k=bk) ** 2).sum(),
+            (0, 1, 2))(q, k, v)
+        g2 = jax.grad(lambda q, k, v: (attention(q, k, v, causal=True) ** 2
+                                       ).sum(), (0, 1, 2))(q, k, v)
+        for a, b in zip(g1, g2):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=5e-3, atol=5e-3)

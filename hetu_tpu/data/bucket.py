@@ -140,38 +140,72 @@ def pack_sequences(seqs: Sequence[np.ndarray], max_len: int, pad_id: int = 0,
             "position_ids": position_ids, "segment_ids": segment_ids}
 
 
-def cp_split_batch(batch: Dict[str, np.ndarray], cp: int) -> List[Dict[str, np.ndarray]]:
-    """Split a packed/padded batch along seq into per-CP-rank slices with the
-    head+tail symmetric assignment (reference: bucket.py:193
-    generate_cp_pack_data): of 2*cp equal chunks, rank r owns chunks r and
-    2*cp-1-r, so every rank sees a balanced share of causal work.
+def cp_split_batch(batch: Dict[str, np.ndarray], cp: int,
+                   split: str = "sym") -> List[Dict[str, np.ndarray]]:
+    """Split a packed/padded batch along seq into per-CP-rank slices
+    (reference: bucket.py:193 generate_cp_pack_data + the ring's
+    HETU_PARALLEL_ATTN_SPLIT=NORMAL|STRIPE|SYM modes,
+    ParallelAttention.cc:196-204):
 
-    Returns a list of cp dicts, each with seq_len = total/cp; the `cp_index`
-    arrays give each token's global position (used as position_ids)."""
-    out = []
+      sym    — of 2*cp equal chunks, rank r owns chunks r and 2*cp-1-r
+               (head+tail symmetric; balanced causal work)
+      stripe — round-robin token-block striping (chunk i -> rank i % cp)
+      normal — contiguous chunks (rank r owns chunk r; causal-imbalanced)
+
+    Returns a list of cp dicts, each with seq_len = total/cp.  Causality
+    under any split is preserved by the ring kernel's position-based masks
+    (feed the original position_ids through)."""
     seq = batch["input_ids"].shape[1]
-    assert seq % (2 * cp) == 0, f"seq {seq} must divide by 2*cp={2*cp}"
-    chunk = seq // (2 * cp)
+    if split == "sym":
+        assert seq % (2 * cp) == 0, f"seq {seq} must divide by 2*cp={2*cp}"
+        chunk = seq // (2 * cp)
+        owner = [(r * chunk, (2 * cp - 1 - r) * chunk) for r in range(cp)]
+        idx = [np.concatenate([np.arange(lo, lo + chunk),
+                               np.arange(hi, hi + chunk)])
+               for lo, hi in owner]
+    elif split == "stripe":
+        assert seq % cp == 0, f"seq {seq} must divide by cp={cp}"
+        # finest stripe granularity giving every rank >= 2 blocks (one block
+        # per rank would degenerate into the contiguous 'normal' split)
+        g = None
+        for m in range(cp, 1, -1):
+            if seq % (cp * m) == 0:
+                g = seq // (cp * m)
+                break
+        if g is None:
+            raise ValueError(
+                f"stripe split needs seq ({seq}) divisible by cp*m for some "
+                f"m >= 2 (cp={cp}); use split='sym' or 'normal'")
+        blocks = [np.arange(i * g, (i + 1) * g) for i in range(seq // g)]
+        idx = [np.concatenate(blocks[r::cp]) for r in range(cp)]
+    elif split == "normal":
+        assert seq % cp == 0, f"seq {seq} must divide by cp={cp}"
+        chunk = seq // cp
+        idx = [np.arange(r * chunk, (r + 1) * chunk) for r in range(cp)]
+    else:
+        raise ValueError(f"split must be sym|stripe|normal, got {split!r}")
+    out = []
     for r in range(cp):
-        lo = slice(r * chunk, (r + 1) * chunk)
-        hi_start = (2 * cp - 1 - r) * chunk
-        hi = slice(hi_start, hi_start + chunk)
-        shard = {}
-        for k, v in batch.items():
-            shard[k] = np.concatenate([v[:, lo], v[:, hi]], axis=1)
-        out.append(shard)
+        out.append({k: v[:, idx[r]] for k, v in batch.items()})
     return out
 
 
-def merge_cp_batch(shards: List[Dict[str, np.ndarray]]) -> Dict[str, np.ndarray]:
+def cp_split_indices(seq: int, cp: int, split: str = "sym") -> List[np.ndarray]:
+    """The global token indices each cp rank owns (for reassembly/tests)."""
+    dummy = {"input_ids": np.arange(seq)[None, :]}
+    return [s["input_ids"][0] for s in cp_split_batch(dummy, cp, split)]
+
+
+def merge_cp_batch(shards: List[Dict[str, np.ndarray]],
+                   split: str = "sym") -> Dict[str, np.ndarray]:
     """Inverse of cp_split_batch (for tests / unsharded eval)."""
     cp = len(shards)
-    chunk = shards[0]["input_ids"].shape[1] // 2
-    parts = [None] * (2 * cp)
+    seq = sum(s["input_ids"].shape[1] for s in shards)
+    idx = cp_split_indices(seq, cp, split)
     merged = {}
     for k in shards[0]:
+        total = np.zeros((shards[0][k].shape[0], seq), shards[0][k].dtype)
         for r, sh in enumerate(shards):
-            parts[r] = sh[k][:, :chunk]
-            parts[2 * cp - 1 - r] = sh[k][:, chunk:]
-        merged[k] = np.concatenate(parts, axis=1)
+            total[:, idx[r]] = sh[k]
+        merged[k] = total
     return merged

@@ -1,0 +1,74 @@
+"""Ampelos-style joint hetero planner.
+
+Rebuild of the reference's ILP planner (reference: python/hetu/engine/
+strategy_ampelos.py, 1,679 LoC PuLP ILP — jointly chooses TP arrangement,
+pipeline grouping, and per-stage layer counts from per-device straggler
+ratios; the Malleus `StrategyModel` solves a related DFS form).
+
+TPU version: the decision space per pod slice is small (tp ∈ powers of two,
+stage groupings of speed-sorted devices), so the ILP is replaced by exact
+enumeration with the same objective — minimize the pipeline-limited step
+time, where a stage runs at the speed of its SLOWEST member and contributes
+layers[s] / stage_speed[s] work per micro-batch:
+
+    T(cfg) ∝ (max_s layers[s] / speed[s]) * (n_micro + pp - 1) / n_micro
+
+balance_stages (C++ core) provides the optimal layer split for a fixed
+grouping, so enumeration only ranges over (tp, grouping).
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+
+from hetu_tpu.engine.malleus import MalleusPlanner, StragglerProfile
+
+
+@dataclasses.dataclass
+class AmpelosPlanner:
+    num_layers: int
+    tp_candidates: Sequence[int] = (1, 2, 4, 8)
+    n_micro: Optional[int] = None
+    tp_efficiency: float = 0.85   # per-doubling scaling efficiency of TP
+                                  # (collective overhead; cost-model knob)
+
+    def _score(self, cfg: Dict, tp: int) -> float:
+        """Pipeline-limited relative step time: a layer's compute is split
+        across tp devices (at tp_efficiency scaling), a stage runs at its
+        slowest member's speed, and GPipe's fill/drain bubble applies."""
+        stages = cfg["stages"]
+        pp = len(stages)
+        n_micro = self.n_micro or max(2 * pp, 1)
+        eff_tp = tp * (self.tp_efficiency ** max(
+            int(np.log2(tp)) if tp > 1 else 0, 0))
+        bottleneck = max((st["layers"][1] - st["layers"][0]) /
+                         (st["speed"] * eff_tp) for st in stages)
+        bubble = (n_micro + pp - 1) / n_micro
+        return bottleneck * bubble
+
+    def plan(self, speeds: Sequence[float]) -> Dict:
+        """speeds: per-device relative speeds (1.0 = healthy).
+        Enumerates tp via the Malleus stage planner (one grouping per tp)
+        and scores each plan; returns the best hetero ds-parallel config
+        with the predicted relative step time in config["score"]."""
+        n = len(speeds)
+        profile = StragglerProfile(speeds=list(speeds))
+        best = None
+        for tp in self.tp_candidates:
+            if n % tp or n // tp < 1 or self.num_layers < n // tp:
+                continue
+            try:
+                cfg = MalleusPlanner(self.num_layers, tp=tp, dp=1).plan(profile)
+            except ValueError:
+                continue
+            score = self._score(cfg, tp)
+            if best is None or score < best[0]:
+                best = (score, cfg)
+        if best is None:
+            raise ValueError(f"no feasible plan for {n} devices, "
+                             f"{self.num_layers} layers")
+        score, cfg = best
+        cfg["score"] = round(float(score), 4)
+        return cfg

@@ -70,3 +70,42 @@ def test_choose_bucket():
     assert choose_bucket(100) == 256
     assert choose_bucket(257) == 512
     assert choose_bucket(10 ** 9) == 32768
+
+
+def test_cp_split_modes_roundtrip():
+    from hetu_tpu.data.bucket import cp_split_indices
+    batch = pad_batch([np.arange(64), np.arange(64)], 64)
+    for mode in ("sym", "stripe", "normal"):
+        shards = cp_split_batch(batch, cp=4, split=mode)
+        merged = merge_cp_batch(shards, split=mode)
+        for k in batch:
+            np.testing.assert_array_equal(merged[k], batch[k])
+        # each rank owns exactly seq/cp distinct tokens
+        idx = cp_split_indices(64, 4, mode)
+        all_idx = np.concatenate(idx)
+        assert len(np.unique(all_idx)) == 64
+    # stripe: rank 0 owns fine-grained blocks spread across the sequence
+    idx = cp_split_indices(64, 4, "stripe")
+    assert idx[0][0] == 0 and idx[0][-1] > 32
+    # normal: contiguous
+    idx = cp_split_indices(64, 4, "normal")
+    np.testing.assert_array_equal(idx[0], np.arange(16))
+
+
+def test_bad_cp_split_mode():
+    batch = pad_batch([np.arange(16)], 16)
+    import pytest
+    with pytest.raises(ValueError):
+        cp_split_batch(batch, 2, split="zigzag")
+
+
+def test_stripe_never_degenerates_to_normal():
+    # regression: seq divisible by cp but not cp*cp must still stripe (or
+    # raise) — never silently fall back to the contiguous split
+    from hetu_tpu.data.bucket import cp_split_indices
+    idx = cp_split_indices(40, 4, "stripe")  # 40 % 16 != 0 but 40 % 8 == 0
+    # rank 0 must own non-contiguous blocks
+    assert (np.diff(idx[0]) > 1).any()
+    import pytest
+    with pytest.raises(ValueError):
+        cp_split_indices(4, 4, "stripe")  # no m >= 2 possible

@@ -67,3 +67,30 @@ def test_malleus_planner_groups_stragglers():
     for s in cfg["stages"]:
         member_speeds = [prof.speeds[d] for d in s["devices"]]
         assert max(member_speeds) - min(member_speeds) < 1e-9
+
+
+def test_ampelos_planner_joint_choice():
+    from hetu_tpu.engine import AmpelosPlanner
+    # 8 devices, two stragglers: the planner picks tp/pp and groups the
+    # slow pair into one stage with fewer layers
+    plan = AmpelosPlanner(num_layers=16, tp_candidates=(1, 2, 4)).plan(
+        [1.0, 1.0, 1.0, 1.0, 1.0, 1.0, 0.5, 0.5])
+    layers = [s["layers"][1] - s["layers"][0] for s in plan["stages"]]
+    assert sum(layers) == 16
+    slow_stage = min(range(len(plan["stages"])),
+                     key=lambda i: plan["stages"][i]["speed"])
+    assert layers[slow_stage] <= min(layers[i] for i in range(len(layers))
+                                     if i != slow_stage)
+    # homogeneous cluster: plan must be balanced and at least as good
+    plan_h = AmpelosPlanner(num_layers=16, tp_candidates=(1, 2, 4)).plan(
+        [1.0] * 8)
+    layers_h = [s["layers"][1] - s["layers"][0] for s in plan_h["stages"]]
+    assert len(set(layers_h)) == 1
+    assert plan_h["score"] <= plan["score"]
+
+
+def test_ampelos_infeasible():
+    from hetu_tpu.engine import AmpelosPlanner
+    import pytest
+    with pytest.raises(ValueError):
+        AmpelosPlanner(num_layers=1, tp_candidates=(1,)).plan([1.0] * 8)

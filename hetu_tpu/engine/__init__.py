@@ -5,4 +5,5 @@ from hetu_tpu.engine.hot_switch import HotSwitchTrainer
 from hetu_tpu.engine.sft_trainer import SFTTrainer, mask_prompt_labels
 from hetu_tpu.engine.malleus import MalleusPlanner, StragglerProfile
 from hetu_tpu.engine.ampelos import AmpelosPlanner
+from hetu_tpu.engine.elastic import ElasticController
 from hetu_tpu.engine.dispatch import BatchStrategyDispatcher

@@ -1,0 +1,108 @@
+"""Elastic training loop.
+
+Rebuild of the reference's elastic recovery flow (reference: SURVEY §5.3 —
+elastic gRPC server heartbeat monitor :463 + WorkerStop broadcast,
+pssh relaunch with rewritten strategy args elastic_arg_parser.py, workers
+re-entering the Trainer with the new ds config; trainer kills the process
+group on RuntimeError trainer.py:317-322).
+
+TPU flow here:
+  1. every worker heartbeats the coordination server;
+  2. on worker loss the server stop-flags everyone (split-brain-guarded);
+  3. workers hit a named barrier, read the surviving membership, agree on a
+     new plan via a consistency vote (planner runs on rank 0, broadcast via
+     the KV store), rebuild the trainer under the new strategy, and resume
+     from the latest checkpoint (reshard-on-load does the layout move).
+"""
+from __future__ import annotations
+
+import time
+from typing import Callable, Dict, Optional
+
+from hetu_tpu.rpc.client import CoordinationClient
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("elastic")
+
+
+class ElasticController:
+    """Drives train -> detect-loss -> re-plan -> rebuild -> resume.
+
+    trainer_factory(ds_config: dict) -> built Trainer (checkpoint-configured);
+    planner_fn(alive: list[int]) -> ds-parallel config dict for the
+    surviving membership (e.g. AmpelosPlanner with measured speeds).
+    """
+
+    def __init__(self, client: CoordinationClient,
+                 trainer_factory: Callable[[Dict], object],
+                 planner_fn: Callable[[list], Dict]):
+        # checkpoint cadence belongs to TrainingConfig.ckpt_every; the
+        # controller only saves at stop/exit boundaries
+        self.client = client
+        self.trainer_factory = trainer_factory
+        self.planner_fn = planner_fn
+        self.generation = 0
+        self.trainer = None
+
+    # ------------------------------------------------------------------
+    def _replan(self) -> Dict:
+        """Agree on a new plan for the survivors (rank order decides the
+        proposer; everyone votes on the result's fingerprint)."""
+        alive = self.client.membership()
+        leader = min(alive)
+        key = f"__elastic_plan_gen{self.generation}__"
+        if self.client.rank == leader:
+            plan = self.planner_fn(alive)
+            self.client.put(key, plan)
+        plan = self.client.get(key, block=True, timeout=120)
+        # consistency vote on the plan fingerprint (reference: Consistent)
+        fingerprint = str(sorted(plan.get("strategy", {}).items()))
+        self.client.consistent(f"plan_gen{self.generation}", fingerprint,
+                               count=len(alive))
+        return plan
+
+    def _rebuild(self):
+        plan = self._replan()
+        logger.info(f"[gen {self.generation}] rebuilding with strategy "
+                    f"{plan.get('strategy')}")
+        self.trainer = self.trainer_factory(plan)
+        if getattr(self.trainer, "_ckpt", None) is not None:
+            try:
+                self.trainer.restore()
+                logger.info(f"[gen {self.generation}] resumed at step "
+                            f"{self.trainer.global_step}")
+            except FileNotFoundError:
+                logger.info(f"[gen {self.generation}] fresh start "
+                            "(no checkpoint yet)")
+        else:
+            logger.info(f"[gen {self.generation}] no ckpt_dir configured — "
+                        "state will NOT survive re-meshing")
+        self.client.resume()   # clear the server-side stop flag too
+        self.generation += 1
+
+    # ------------------------------------------------------------------
+    def run(self, batches, num_steps: int) -> object:
+        """The elastic loop (reference: workers re-entering Trainer after
+        WorkerStop).  Returns the final trainer."""
+        self._rebuild()
+        it = iter(batches)
+        steps_done = self.trainer.global_step
+        while steps_done < num_steps:
+            # confirm via a fresh heartbeat — the cached flag can be stale
+            # for one beat around resume()
+            if self.client.should_stop and self.client.check_stop():
+                logger.warning("membership change signaled; checkpointing "
+                               "and re-meshing")
+                if getattr(self.trainer, "_ckpt", None) is not None:
+                    self.trainer.save(wait=True)
+                self._rebuild()
+                continue
+            try:
+                batch = next(it)
+            except StopIteration:
+                break
+            self.trainer.train_step(batch)
+            steps_done = self.trainer.global_step
+        if getattr(self.trainer, "_ckpt", None) is not None:
+            self.trainer.save(wait=True)
+        return self.trainer

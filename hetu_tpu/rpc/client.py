@@ -117,6 +117,25 @@ class CoordinationClient:
     def worker_stop(self, ranks=None):
         self._call({"op": "worker_stop", "ranks": ranks})
 
+    def resume(self):
+        """Acknowledge a stop signal after re-meshing (clears the server's
+        stop flag for this rank).  Raises if this rank was declared dead —
+        a zombie must reconnect for a fresh rank (split-brain guard)."""
+        resp = self._call({"op": "resume", "rank": self.rank})
+        if not resp.get("accepted", True):
+            raise RuntimeError(
+                "resume rejected: this rank was declared dead — reconnect "
+                "with a new CoordinationClient for a fresh rank")
+        self.should_stop = False
+
+    def check_stop(self) -> bool:
+        """Synchronous, race-free stop check (a fresh heartbeat op) — the
+        cached should_stop can be momentarily stale around resume()."""
+        resp = self._call({"op": "heartbeat", "rank": self.rank})
+        stop = bool(resp.get("stop"))
+        self.should_stop = stop
+        return stop
+
     def exit(self):
         try:
             self._call({"op": "exit", "rank": self.rank})

@@ -224,6 +224,16 @@ class CoordinationServer:
                 for r in ranks:
                     self._stop_flags.add(r)
                 return {"ok": True}
+            if op == "resume":        # worker acknowledges the stop and
+                                       # rejoins under the new plan
+                rank = req["rank"]
+                w = self._workers.get(rank)
+                if w is None or not w.get("alive"):
+                    # a dead-marked worker must reconnect for a fresh rank —
+                    # letting it resume would re-enter the old mesh
+                    return {"ok": True, "accepted": False}
+                self._stop_flags.discard(rank)
+                return {"ok": True, "accepted": True}
             if op == "exit":
                 rank = req["rank"]
                 if rank in self._workers:

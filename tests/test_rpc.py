@@ -131,3 +131,23 @@ def test_dead_worker_stops_survivors(server):
     time.sleep(2.5)
     assert c0.should_stop  # survivor told to stop for re-mesh
     c0.exit()
+
+
+def test_resume_clears_stop_flag(server):
+    c = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.1)
+    c.worker_stop([c.rank])
+    time.sleep(0.4)
+    assert c.should_stop
+    c.resume()
+    time.sleep(0.4)
+    assert not c.should_stop   # heartbeats no longer re-set it
+    c.exit()
+
+
+def test_resume_rejected_for_dead_rank(server):
+    c = CoordinationClient("127.0.0.1", server.port, auto_heartbeat=False)
+    # let the monitor declare it dead (no heartbeats), stop flag set
+    time.sleep(2.0)
+    assert c.rank not in server._handle({"op": "membership"})["alive"]
+    with pytest.raises(RuntimeError):
+        c.resume()

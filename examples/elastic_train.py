@@ -1,0 +1,91 @@
+"""Elastic training demo (reference: examples/hetero + the elastic server
+flow): start the coordination server and N workers in one process tree;
+kill a worker mid-run and watch the survivors re-plan and resume.
+
+    python examples/elastic_train.py --kill-after 10
+"""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import argparse
+import threading
+import time
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--kill-after", type=float, default=8.0)
+    ap.add_argument("--ckpt-dir", default="/tmp/hetu_tpu_elastic_ck")
+    args = ap.parse_args()
+
+    from hetu_tpu.data import pad_batch
+    from hetu_tpu.engine import ElasticController, Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.rpc import CoordinationClient, CoordinationServer
+    from hetu_tpu.utils.parallel_config import (generate_ds_parallel_config,
+                                                read_ds_parallel_config)
+
+    server = CoordinationServer(world_size=2, heartbeat_timeout=1.0)
+    me = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.2)
+
+    cfg = LlamaConfig.tiny(remat=False)
+    rng = np.random.default_rng(0)
+    batch = pad_batch([rng.integers(1, 250, size=60) for _ in range(8)], 64)
+
+    def planner(alive):
+        if len(alive) >= 2:
+            return generate_ds_parallel_config(num_layers=2, dp=4, tp=2)
+        return generate_ds_parallel_config(num_layers=2, dp=8)
+
+    def factory(plan):
+        st, _ = read_ds_parallel_config(plan)
+        print(f"  -> building trainer on {st.describe()}")
+        tc = TrainingConfig(global_batch_size=8, micro_batch_size=1,
+                            seq_len=64, lr=3e-3, warmup_steps=2,
+                            total_steps=1000, log_every=5,
+                            ckpt_dir=args.ckpt_dir, ckpt_every=3)
+        return Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+
+    # a second in-process 'worker' that participates in votes until killed
+    class FakeTrainer:
+        global_step = 0
+        _ckpt = None
+
+        def train_step(self, b):
+            time.sleep(0.05)
+            self.global_step += 1
+            return {"loss": 0.0}
+
+        def save(self, wait=False):
+            pass
+
+    peer_hb = CoordinationClient("127.0.0.1", server.port,
+                                 heartbeat_interval=0.2)
+    peer = ElasticController(peer_hb, lambda p: FakeTrainer(), planner)
+    stop = threading.Event()
+    threading.Thread(target=lambda: (peer._rebuild(), stop.wait()),
+                     daemon=True).start()
+
+    def kill():
+        time.sleep(args.kill_after)
+        print("  !! killing worker 1")
+        stop.set()
+        peer_hb._shutdown = True
+
+    threading.Thread(target=kill, daemon=True).start()
+
+    ctl = ElasticController(me, factory, planner)
+    trainer = ctl.run([batch] * 200, num_steps=args.steps)
+    print(f"done at step {trainer.global_step} after "
+          f"{ctl.generation} generation(s)")
+    me.exit()
+    server.close()
+
+
+if __name__ == "__main__":
+    main()

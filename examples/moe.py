@@ -1,0 +1,43 @@
+"""MoE expert-parallel pretraining (reference: v1 MoE examples; BASELINE
+config 3 'GPT-MoE expert parallel')."""
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), ".."))
+
+import argparse
+
+import numpy as np
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--experts", type=int, default=8)
+    ap.add_argument("--top-k", type=int, default=2)
+    ap.add_argument("--dp", type=int, default=1)
+    ap.add_argument("--ep", type=int, default=1)
+    ap.add_argument("--tp", type=int, default=1)
+    ap.add_argument("--steps", type=int, default=30)
+    args = ap.parse_args()
+
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.data import pad_batch
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+
+    cfg = LlamaConfig.tiny(num_experts=args.experts, moe_top_k=args.top_k)
+    st = ParallelStrategy(mesh=MeshConfig(dp=args.dp, ep=args.ep, tp=args.tp))
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=128,
+                        lr=3e-3, warmup_steps=5, total_steps=args.steps,
+                        log_every=5)
+    trainer = Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+    print(f"MoE {args.experts}e top{args.top_k} on {st.describe()} "
+          f"({trainer.model.num_params()/1e6:.0f}M params)")
+    rng = np.random.default_rng(0)
+    batch = pad_batch([rng.integers(1, 250, size=120) for _ in range(8)], 128)
+    trainer.train([batch] * args.steps)
+
+
+if __name__ == "__main__":
+    main()

@@ -109,3 +109,25 @@ def test_stripe_never_degenerates_to_normal():
     import pytest
     with pytest.raises(ValueError):
         cp_split_indices(4, 4, "stripe")  # no m >= 2 possible
+
+
+def test_json_dataset(tmp_path):
+    import json
+
+    class Tok:
+        eos_token_id = 0
+
+        def encode(self, s):
+            return [ord(c) % 250 + 1 for c in s]
+
+    p = tmp_path / "d.jsonl"
+    p.write_text('{"text": "hello"}\n{"text": "world!"}\n')
+    from hetu_tpu.data import JsonDataset
+    ds = JsonDataset(str(p), Tok(), max_seq_len=8)
+    assert len(ds) == 2
+    assert ds[0].tolist() == [ord(c) % 250 + 1 for c in "hello"] + [0]
+    # json-array form
+    p2 = tmp_path / "d.json"
+    p2.write_text(json.dumps([{"text": "ab"}, {"text": "cd"}]))
+    ds2 = JsonDataset(str(p2), Tok())
+    assert len(ds2) == 2 and len(ds2[1]) == 3

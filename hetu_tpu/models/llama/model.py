@@ -79,15 +79,10 @@ class LlamaAttention(Module):
 
         use_attn_dropout = (c.attention_dropout > 0.0 and not deterministic
                             and rng is not None)
-        if st.cp > 1 and st.pp > 1:
-            # pp x cp: the ring's shard_map cannot nest inside the pipeline's
-            # spmd vmap; use the global-view fallback (GSPMD all-gathers KV
-            # over cp) — correct, ring-optimized variant is a next-round item
-            from hetu_tpu.parallel.ring_attention import ring_attention_fallback
-            attn = ring_attention_fallback(q, k, v, strategy=st,
-                                           segment_ids=segment_ids,
-                                           position_ids=position_ids)
-        elif st.cp > 1:
+        if st.cp > 1:
+            # the ring composes with the GSPMD pipeline too (a full
+            # shard_map nests cleanly inside vmap(spmd_axis_name='pp');
+            # only the PARTIAL-manual shard_map mode is partitioner-hostile)
             from hetu_tpu.parallel.ring_attention import ring_attention_gspmd
             attn = ring_attention_gspmd(q, k, v, strategy=st,
                                         segment_ids=segment_ids,

@@ -226,8 +226,9 @@ def ring_attention_fallback(q, k, v, *, strategy: ParallelStrategy,
                             segment_ids=None, position_ids=None,
                             causal: bool = True):
     """Global-view CP attention: GSPMD materializes KV via all-gather over
-    cp. O(seq) KV memory per shard — the correctness fallback used where the
-    ring's shard_map cannot run (inside the pipeline's spmd vmap).
+    cp — O(seq) KV memory per shard.  An explicit alternative to the ring
+    (the ring is the default everywhere, including inside the pipeline);
+    useful when ring latency loses to one big all-gather (short sequences).
 
     position_ids (per-segment positions, e.g. from cp_split_batch's
     reordered layout) drive the causal mask exactly like the ring path —

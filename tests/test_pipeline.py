@@ -89,7 +89,8 @@ def test_pp_requires_divisible_layers():
 
 
 def test_pp_cp_composition():
-    # pp x cp via the global-view CP fallback inside the pipeline
+    # pp x cp with the REAL ring inside the pipeline (full shard_map nests
+    # in vmap(spmd_axis_name); only partial-manual mode crashes)
     ids = _ids(b=4, s=64)
     cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)
     gm = LlamaLMHeadModel(cfg, ParallelStrategy())

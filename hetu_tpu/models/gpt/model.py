@@ -37,6 +37,9 @@ class GPTConfig:
     attention_dropout: float = 0.0
     hidden_dropout: float = 0.0
 
+    # heterogeneous pipeline stage layer counts (see LlamaConfig)
+    pipeline_stage_layers: object = None
+
     param_dtype: object = jnp.float32
     compute_dtype: object = jnp.bfloat16
     use_scan: bool = True
@@ -253,6 +256,7 @@ class GPTModel(Module):
                 block_fn, params["blocks"], x,
                 num_layers=c.num_hidden_layers, pp=st.pp, mesh=mesh,
                 position_ids=position_ids, segment_ids=segment_ids,
+                stage_layers=c.pipeline_stage_layers,
                 n_micro=n_micro, remat=c.remat, remat_policy=c.remat_policy)
             return self.final_ln(params["final_ln"], x)
         layer_rngs = (jax.random.split(rng, c.num_hidden_layers)

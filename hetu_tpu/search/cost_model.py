@@ -61,6 +61,13 @@ class CostModel:
     seq_len: int
     mxu_efficiency: float = 0.5   # fraction of peak the model sustains
 
+    def _allreduce_gbps(self, axis: str, size: int) -> float:
+        """Measured per-axis allreduce bus bandwidth when the profiler
+        recorded one (the reference calibrates from
+        hardware_configs/allreduce_bandwidth_*.json), preset otherwise."""
+        measured = self.hw.measured.get(f"allreduce_gbps_{axis}{size}")
+        return measured if measured else self.hw.ici_allreduce_gbps
+
     # ---------------- compute ----------------
     def _flops_per_token(self) -> float:
         return 6.0 * self.num_params + \
@@ -84,13 +91,13 @@ class CostModel:
             bytes_per = b_local * self.seq_len * self.hidden * 2
             ring = 2 * (c.tp - 1) / c.tp * bytes_per
             t_comm += 4 * self.num_layers * ring / (
-                self.hw.ici_allreduce_gbps * 1e9) / max(c.pp, 1)
+                self._allreduce_gbps("tp", c.tp) * 1e9) / max(c.pp, 1)
 
         # DP/ZeRO grad sync: reduce-scatter + all-gather of the local shard
         if c.dp > 1:
             shard_bytes = 4 * self.num_params / max(c.tp * c.pp, 1)
             ring = 2 * (c.dp - 1) / c.dp * shard_bytes
-            t_comm += ring / (self.hw.ici_allreduce_gbps * 1e9)
+            t_comm += ring / (self._allreduce_gbps("dp", c.dp) * 1e9)
 
         # CP ring: kv blocks circulate cp-1 times
         if c.cp > 1:

@@ -98,3 +98,24 @@ def test_gpt_ci_topology_trains():
     batch = pad_batch([rng.integers(1, 250, size=60) for _ in range(8)], 64)
     losses = [float(tr.train_step(batch)["loss"]) for _ in range(6)]
     assert np.isfinite(losses).all() and losses[-1] < losses[0] - 0.3, losses
+
+
+def test_gpt_hetero_stage_layers():
+    ids = _ids(b=4, s=32)
+    cfg = GPTConfig.tiny(num_hidden_layers=4, remat=False,
+                         compute_dtype=jnp.float32)
+    gm = GPTLMHeadModel(cfg, ParallelStrategy())
+    gp = gm.init(jax.random.key(11))
+    golden = gm(gp, ids)
+
+    cfg_h = GPTConfig.tiny(num_hidden_layers=4, remat=False,
+                           compute_dtype=jnp.float32,
+                           pipeline_stage_layers=(3, 1))
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    mesh = st.build_mesh()
+    m = GPTLMHeadModel(cfg_h, st)
+    with ht.use_mesh(mesh):
+        p = m.init(jax.random.key(11), mesh=mesh)
+        out = jax.jit(lambda p, x: m(p, x, n_micro=2))(p, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-4, atol=2e-4)

@@ -74,3 +74,15 @@ def test_recompute_layer_choice():
     assert all(tight)
     loose = choose_recompute_layers(cost, c, act_budget_bytes=1e12)
     assert not any(loose)
+
+
+def test_cost_model_uses_measured_bandwidth():
+    hw = HardwareProfile.preset("v5e")
+    cost = CostModel(hw=hw, num_layers=8, hidden=1024, intermediate=2816,
+                     vocab=32000, num_params=300_000_000,
+                     global_batch=32, seq_len=1024)
+    c = StrategyCandidate(dp=1, tp=4)
+    t_preset, _ = cost.evaluate(c)
+    hw.measured["allreduce_gbps_tp4"] = hw.ici_allreduce_gbps * 10
+    t_measured, _ = cost.evaluate(c)
+    assert t_measured < t_preset  # faster measured bw -> less comm time

@@ -1,3 +1,4 @@
 from hetu_tpu.core.mesh import MeshConfig, create_mesh, current_mesh, use_mesh
 from hetu_tpu.core import dtypes
 from hetu_tpu.core.symbol import IntSymbol
+from hetu_tpu.core.distributed import distributed_init

@@ -1,0 +1,65 @@
+"""Multi-host bootstrap.
+
+Rebuild of the reference's distributed_init (reference: python/hetu/utils/
+parallel/distributed.py:9 — `ht.init_comm_group(ngpus, server_address)` via
+the gRPC DeviceController: Connect/GetRank + device mapping).
+
+TPU mapping: low-level process bootstrap is jax.distributed.initialize
+(coordination service, NCCL-id-exchange equivalent handled by the runtime);
+the framework-level services (KV, barriers, heartbeats, elastic membership)
+ride our CoordinationServer/Client on top.  One call wires both.
+"""
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("distributed")
+
+
+def distributed_init(coordinator_address: Optional[str] = None,
+                     num_processes: Optional[int] = None,
+                     process_id: Optional[int] = None,
+                     control_address: Optional[str] = None,
+                     heartbeat_interval: float = 2.0):
+    """Initialize multi-host JAX + connect the coordination client.
+
+    coordinator_address: host:port for jax.distributed (every process).
+    control_address: host:port of the hetu_tpu CoordinationServer (optional —
+      enables KV/barrier/heartbeat/elastic services).
+    Env fallbacks: HETU_TPU_COORDINATOR / HETU_TPU_NUM_PROCESSES /
+    HETU_TPU_PROCESS_ID / HETU_TPU_CONTROL.
+
+    Returns (num_devices_total, coordination_client_or_None).
+    """
+    import jax
+
+    coordinator_address = coordinator_address or os.environ.get(
+        "HETU_TPU_COORDINATOR")
+    if num_processes is None and os.environ.get("HETU_TPU_NUM_PROCESSES"):
+        num_processes = int(os.environ["HETU_TPU_NUM_PROCESSES"])
+    if process_id is None and os.environ.get("HETU_TPU_PROCESS_ID"):
+        process_id = int(os.environ["HETU_TPU_PROCESS_ID"])
+    control_address = control_address or os.environ.get("HETU_TPU_CONTROL")
+
+    if coordinator_address and (num_processes or 1) > 1:
+        jax.distributed.initialize(
+            coordinator_address=coordinator_address,
+            num_processes=num_processes, process_id=process_id)
+        logger.info(f"jax.distributed up: process {jax.process_index()} of "
+                    f"{jax.process_count()}")
+
+    client = None
+    if control_address:
+        from hetu_tpu.rpc import CoordinationClient
+        host, port = control_address.rsplit(":", 1)
+        client = CoordinationClient(
+            host, int(port),
+            info={"process_id": jax.process_index(),
+                  "local_devices": len(jax.local_devices())},
+            heartbeat_interval=heartbeat_interval)
+        logger.info(f"coordination client connected as rank {client.rank}")
+
+    return len(jax.devices()), client

@@ -151,3 +151,20 @@ def test_resume_rejected_for_dead_rank(server):
     assert c.rank not in server._handle({"op": "membership"})["alive"]
     with pytest.raises(RuntimeError):
         c.resume()
+
+
+def test_distributed_init_single_process(server):
+    # single process: jax.distributed untouched; control client connects
+    from hetu_tpu.core.distributed import distributed_init
+    n, client = distributed_init(
+        control_address=f"127.0.0.1:{server.port}")
+    assert n >= 1 and client is not None
+    client.put("hello", 1)
+    assert client.get("hello") == 1
+    client.exit()
+
+
+def test_distributed_init_no_args():
+    from hetu_tpu.core.distributed import distributed_init
+    n, client = distributed_init()
+    assert n >= 1 and client is None

@@ -17,6 +17,7 @@ from hetu_tpu.parallel import ParallelStrategy
 from hetu_tpu.rpc import CoordinationClient, CoordinationServer
 
 
+@pytest.mark.slow
 def test_elastic_survives_worker_loss(tmp_path):
     server = CoordinationServer(world_size=2, heartbeat_timeout=1.0)
     me = CoordinationClient("127.0.0.1", server.port, heartbeat_interval=0.2)

@@ -3,6 +3,7 @@ here strategy switching runs on the virtual mesh)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 from hetu_tpu.core.mesh import MeshConfig
 from hetu_tpu.engine import HotSwitchTrainer, TrainingConfig
@@ -16,6 +17,7 @@ def _batch(n=8, seq=64, seed=0):
     return pad_batch([rng.integers(1, 250, size=seq - 4) for _ in range(n)], seq)
 
 
+@pytest.mark.slow
 def test_hot_switch_preserves_state_and_training():
     cfg = LlamaConfig.tiny(remat=False)
     strategies = [

@@ -59,6 +59,7 @@ def test_hash_gate():
     np.testing.assert_array_equal(placed[1], np.arange(16) % 4)
 
 
+@pytest.mark.slow
 def test_moe_llama_trains_with_ep():
     from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
     from hetu_tpu.engine import Trainer, TrainingConfig

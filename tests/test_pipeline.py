@@ -34,6 +34,7 @@ def test_pp_forward_matches_single_device():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_pp_tp_dp_train_step():
     # the reference CI topology: dp2 x tp2 x pp2 on 8 devices
     from hetu_tpu.engine import Trainer, TrainingConfig
@@ -107,6 +108,7 @@ def test_pp_cp_composition():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_moe_in_pipeline_trains():
     from hetu_tpu.engine import Trainer, TrainingConfig
     from hetu_tpu.data import pad_batch
@@ -146,6 +148,7 @@ def test_hetero_stage_layers_match_equal_split():
                                rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.slow
 def test_hetero_stage_layers_from_malleus_plan_trains():
     from hetu_tpu.engine import Trainer, TrainingConfig
     from hetu_tpu.engine.malleus import MalleusPlanner, StragglerProfile

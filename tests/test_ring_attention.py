@@ -73,6 +73,7 @@ def test_ring_with_segments_and_positions():
                                rtol=2e-3, atol=2e-3)
 
 
+@pytest.mark.slow
 def test_llama_with_cp_matches_single_device():
     from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
     ids = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 256)),

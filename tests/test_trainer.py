@@ -57,6 +57,7 @@ def test_trainer_loss_decreases():
     assert trainer.global_step == 12
 
 
+@pytest.mark.slow
 def test_micro_batch_accumulation_matches_full_batch():
     # gbs=8 as 1 micro of 8 vs 4 micros of 2 must give (nearly) the same step
     t1, cfg = _make_trainer(dp=1, tp=1, gbs=8, mbs=8)
@@ -75,6 +76,7 @@ def test_micro_batch_accumulation_matches_full_batch():
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
 
 
+@pytest.mark.slow
 def test_checkpoint_reshard_on_load(tmp_path):
     t1, cfg = _make_trainer(tmp_path=tmp_path / "ck", dp=2, tp=2)
     t1.build()

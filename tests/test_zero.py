@@ -3,6 +3,7 @@ SURVEY §2.4)."""
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 import hetu_tpu as ht
 from hetu_tpu.core.mesh import MeshConfig
@@ -35,6 +36,7 @@ def test_fsdp_params_are_dp_sharded_and_train():
     assert losses[-1] < losses[0] - 0.3, losses
 
 
+@pytest.mark.slow
 def test_zero_stages_match_numerics():
     # zero-1 vs zero-2 vs zero-3 must produce the same training trajectory
     cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32)

@@ -24,8 +24,26 @@ def main():
     if force_cpu:
         jax.config.update("jax_platforms", "cpu")
     else:
+        # The axon tunnel can flap: retry the first device contact with
+        # backoff over a multi-minute budget before declaring it down
+        # (round 1 recorded value=0.0 from a single 120 s probe — see
+        # VERDICT.md Weak #1).
         from hetu_tpu.utils.device import probe_backend
-        backend, err = probe_backend()
+        budget_s = 480.0
+        if "--probe-budget" in sys.argv:
+            try:
+                budget_s = float(sys.argv[sys.argv.index("--probe-budget") + 1])
+            except (IndexError, ValueError):
+                print("# bad --probe-budget, using 480s", file=sys.stderr)
+        deadline = time.monotonic() + budget_s
+        backend, err = probe_backend(timeout_s=120.0)
+        delay = 15.0
+        while backend is None and time.monotonic() < deadline:
+            print(f"# tpu probe failed ({err!r}); retrying in {delay:.0f}s",
+                  file=sys.stderr, flush=True)
+            time.sleep(min(delay, max(0.0, deadline - time.monotonic())))
+            delay = min(delay * 2, 60.0)
+            backend, err = probe_backend(timeout_s=120.0)
         if backend is None:
             # distinguish a genuine init error from a tunnel hang, and emit
             # a valid JSON line either way instead of hanging the driver
@@ -105,7 +123,27 @@ def main():
             "batch": batch, "seq": seq,
             "backend": jax.default_backend(),
         },
-    }))
+    }), flush=True)
+
+    # hardware profile AFTER the metric line is safely out: a tunnel flap
+    # during these probes must not cost the round its MFU record (run on a
+    # daemon thread so a hang can't block process exit either)
+    if on_tpu and "--no-hardware-profile" not in sys.argv:
+        import threading
+
+        def _profile():
+            try:
+                from hetu_tpu.search.profiler import profile_hardware
+                prof = profile_hardware(measure=True)
+                prof.save("hardware_profile_%s.json" % prof.chip)
+                print(f"# hardware profile saved: hardware_profile_"
+                      f"{prof.chip}.json {prof.measured}", file=sys.stderr)
+            except Exception as e:
+                print(f"# hardware profiling failed: {e!r}", file=sys.stderr)
+
+        t = threading.Thread(target=_profile, daemon=True)
+        t.start()
+        t.join(300.0)
 
 
 if __name__ == "__main__":

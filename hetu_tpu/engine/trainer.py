@@ -52,6 +52,16 @@ class Trainer:
                       if config.ckpt_dir else None)
         self.global_step = 0
 
+        if config.pp_schedule not in ("gpipe", "1f1b"):
+            raise ValueError(
+                f"pp_schedule must be 'gpipe' or '1f1b', got "
+                f"{config.pp_schedule!r}")
+        if (config.pp_schedule == "1f1b" and self.strategy.pp > 1
+                and not hasattr(model, "pipeline_train_grads")):
+            raise ValueError(
+                f"pp_schedule='1f1b' needs {type(model).__name__}"
+                ".pipeline_train_grads (use 'gpipe')")
+
         from hetu_tpu.utils.profiling import StepProfiler
         self.profiler = StepProfiler()
         c = config
@@ -121,15 +131,24 @@ class Trainer:
                     "(dropout_deterministic=False with pp > 1)")
             flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in batches.items()}
 
-            def pp_loss(p):
-                return self.model(
-                    p, flat["input_ids"], labels=flat["labels"],
+            if c.pp_schedule == "1f1b":
+                # PipeDream-flush manual-VJP schedule (reference:
+                # executable_graph.cc:836) — grads come back directly
+                (lsum, csum), grads = self.model.pipeline_train_grads(
+                    params, flat["input_ids"], flat["labels"],
                     position_ids=flat.get("position_ids"),
-                    segment_ids=flat.get("segment_ids"),
-                    deterministic=True, loss_reduction="sum",
-                    n_micro=n_micro)
+                    segment_ids=flat.get("segment_ids"), n_micro=n_micro)
+            else:
+                def pp_loss(p):
+                    return self.model(
+                        p, flat["input_ids"], labels=flat["labels"],
+                        position_ids=flat.get("position_ids"),
+                        segment_ids=flat.get("segment_ids"),
+                        deterministic=True, loss_reduction="sum",
+                        n_micro=n_micro)
 
-            (lsum, csum), grads = jax.value_and_grad(pp_loss, has_aux=True)(params)
+                (lsum, csum), grads = jax.value_and_grad(
+                    pp_loss, has_aux=True)(params)
         else:
             def micro(acc, xs):
                 batch, key = xs

@@ -35,6 +35,13 @@ class TrainingConfig:
     seed: int = 0
     dropout_deterministic: bool = True  # pretraining default: no dropout
 
+    # pipeline schedule when strategy.pp > 1 (reference:
+    # executable_graph.cc:836 GeneratePipedreamFlushSchedule vs :803 GPipe):
+    # "gpipe" = scan + autodiff (fastest at small n_micro);
+    # "1f1b"  = PipeDream-flush manual-VJP schedule — O(pp) activation
+    #           memory instead of O(n_micro); use when n_micro >> pp
+    pp_schedule: str = "gpipe"
+
     def num_micro_batches(self, dp: int) -> int:
         denom = self.micro_batch_size * dp
         if self.global_batch_size % denom:

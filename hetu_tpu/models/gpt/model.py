@@ -257,7 +257,8 @@ class GPTModel(Module):
                 num_layers=c.num_hidden_layers, pp=st.pp, mesh=mesh,
                 position_ids=position_ids, segment_ids=segment_ids,
                 stage_layers=c.pipeline_stage_layers,
-                n_micro=n_micro, remat=c.remat, remat_policy=c.remat_policy)
+                n_micro=n_micro, remat=c.remat, remat_policy=c.remat_policy,
+                state_spec=st.pipeline_state_spec())
             return self.final_ln(params["final_ln"], x)
         layer_rngs = (jax.random.split(rng, c.num_hidden_layers)
                       if use_drop else None)

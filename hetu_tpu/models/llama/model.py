@@ -277,7 +277,8 @@ class LlamaDecoderStack(Module):
             num_layers=self.num_layers, pp=st.pp, mesh=mesh,
             position_ids=position_ids, segment_ids=segment_ids,
             stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
-            remat=c.remat, remat_policy=c.remat_policy)
+            remat=c.remat, remat_policy=c.remat_policy,
+            state_spec=st.pipeline_state_spec())
 
 
 class LlamaModel(Module):
@@ -381,3 +382,106 @@ class LlamaLMHeadModel(Module):
         loss = ops.softmax_cross_entropy_sparse(
             logits[:, :-1, :], tgt, ignore_index=-100)
         return loss + aux if include_aux_loss else loss
+
+    # ------------------------------------------------------------------
+    def pipeline_train_grads(self, params, input_ids, labels, *,
+                             position_ids=None, segment_ids=None,
+                             n_micro: int):
+        """1F1B (PipeDream-flush) training pass: returns
+        ((loss_sum, count), grads) with grads matching `params` exactly
+        (reference: executable_graph.cc:836 GeneratePipedreamFlushSchedule).
+
+        Bit-parity with the GPipe autodiff path is tested; memory holds
+        O(pp) stage inputs instead of O(n_micro) — use for large n_micro.
+        Embedding runs inside stage 0 and final_norm + LM head + CE inside
+        the last stage (hetu_tpu.parallel.pipeline_1f1b module docs)."""
+        from hetu_tpu.core.mesh import current_mesh
+        from hetu_tpu.parallel.pipeline import (
+            build_stage_stack, unstack_stage_grads)
+        from hetu_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
+
+        c, st = self.config, self.strategy
+        if st.pp <= 1:
+            raise ValueError("pipeline_train_grads requires pp > 1")
+        if not c.use_scan:
+            raise ValueError("1f1b requires use_scan")
+        mesh = current_mesh()
+        if mesh is None:
+            raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
+
+        stack = params["model"]["layers"]["layers"]
+        sp, layer_mask, stage_layers = build_stage_stack(
+            stack, c.num_hidden_layers, st.pp, c.pipeline_stage_layers)
+        ep = {"embed": params["model"]["embed"],
+              "final_norm": params["model"]["final_norm"]}
+        if not c.tie_word_embeddings:
+            ep["lm_head"] = params["lm_head"]
+        count = jnp.sum((labels[:, 1:] != -100).astype(jnp.float32))
+
+        cos, sin = ops.build_rope_cache(
+            c.max_position_embeddings, c.head_dim, c.rope_theta,
+            dtype=jnp.float32)
+        block = self.model.layers.block
+
+        def stage_scan(sp_slice, x0, pos, seg, mask_row):
+            def body(carry, xs):
+                lp, mj = xs if mask_row is not None else (xs, None)
+                x_c, aux_c = carry
+                out, aux = block(lp, x_c, cos=cos, sin=sin,
+                                 position_ids=pos, segment_ids=seg)
+                if mj is not None:
+                    out = jnp.where(mj > 0, out, x_c)
+                    aux = aux * mj
+                return (out, aux_c + aux), None
+
+            fn = body
+            if c.remat:
+                fn = jax.checkpoint(body, policy=_remat_policy(c.remat_policy))
+            xs = sp_slice if mask_row is None else (sp_slice, mask_row)
+            (y, aux), _ = lax.scan(fn, (x0, jnp.zeros((), jnp.float32)), xs)
+            return y, aux
+
+        def head_loss(ep_, y, lab):
+            hidden = self.model.final_norm(ep_["final_norm"], y)
+            shim = {"model": {"embed": ep_["embed"]}}
+            if not c.tie_word_embeddings:
+                shim["lm_head"] = ep_["lm_head"]
+            logits = self.logits(shim, hidden)
+            return ops.softmax_cross_entropy_sparse(
+                logits[:, :-1, :], lab[:, 1:], ignore_index=-100,
+                reduction="sum")
+
+        def stage_fn(sp_slice, ep_, x_in, feed_b, feed_s, flg):
+            emb = self.model.embed(ep_["embed"], feed_b["ids"])
+            emb = st.constrain(emb.astype(c.compute_dtype), st.act_hidden())
+            x0 = jnp.where(flg["is_first"] > 0, emb, x_in)
+            y, aux = stage_scan(sp_slice, x0,
+                                feed_s.get("position_ids"),
+                                feed_s.get("segment_ids"),
+                                flg.get("layer_mask"))
+            ce = head_loss(ep_, y, feed_b["labels"]) * flg["is_last"]
+            return y, ce, aux
+
+        ride = {}
+        if position_ids is not None:
+            ride["position_ids"] = position_ids
+        if segment_ids is not None:
+            ride["segment_ids"] = segment_ids
+        state_spec = st.pipeline_state_spec()
+
+        ce_sum, aux_sum, d_stage, d_edge = pipeline_train_1f1b(
+            stage_fn, sp, ep, input_ids, labels, ride,
+            n_micro=n_micro, mesh=mesh, hidden_size=c.hidden_size,
+            compute_dtype=c.compute_dtype, aux_seed=count,
+            state_spec=state_spec,
+            flags_extra=({"layer_mask": layer_mask}
+                         if layer_mask is not None else None))
+
+        d_layers = unstack_stage_grads(
+            d_stage, c.num_hidden_layers, st.pp, stage_layers)
+        grads = {"model": {"embed": d_edge["embed"],
+                           "layers": {"layers": d_layers},
+                           "final_norm": d_edge["final_norm"]}}
+        if not c.tie_word_embeddings:
+            grads["lm_head"] = d_edge["lm_head"]
+        return (ce_sum + aux_sum * count, count), grads

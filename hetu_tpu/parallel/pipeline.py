@@ -41,7 +41,7 @@ from jax.sharding import PartitionSpec as P
 def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                    *, n_micro: int, mesh, pp_axis: str = "pp",
                    remat: bool = True, remat_policy: str = "nothing",
-                   stage_mask=None):
+                   stage_mask=None, state_spec=None):
     """Run the circular pipeline.
 
     stage_body(stage_params_slice, x_mb, token_data_mb) -> x_mb — applies one
@@ -56,6 +56,11 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     pp = mesh.shape[pp_axis]
     T = n_micro + pp - 1
     pad = pp - 1
+    # full buffer layout: keep dp/cp/sp shards of the mb/seq dims across the
+    # stage hand-off so only the stage-dim permute moves data (a bare
+    # P(pp) would replicate-then-reslice every tick)
+    spec = state_spec if state_spec is not None else P(pp_axis)
+    tok_spec = P(*((spec[0],) + tuple(spec[1:3])))
 
     xm = x.reshape(n_micro, mb, s, h)
     tok = {k: v.reshape(n_micro, mb, s) for k, v in token_data.items()}
@@ -68,11 +73,11 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     vbody = jax.vmap(body, in_axes=(0, 0, 0) + extra_axes,
                      spmd_axis_name=pp_axis)
 
-    def shift_in(new, state):
+    def shift_in(new, state, sp=None):
         """Stage hand-off: stage 0 gets the fresh micro, stage i gets stage
         i-1's output (a collective-permute under the pp sharding)."""
         out = jnp.concatenate([new[None], state[:-1]], axis=0)
-        return lax.with_sharding_constraint(out, P(pp_axis))
+        return lax.with_sharding_constraint(out, sp if sp is not None else spec)
 
     if pad:
         xs_x = jnp.concatenate(
@@ -84,7 +89,7 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         xs_x, xs_tok = xm, tok
 
     init_x = jnp.zeros((pp, mb, s, h), x.dtype)
-    init_x = lax.with_sharding_constraint(init_x, P(pp_axis))
+    init_x = lax.with_sharding_constraint(init_x, spec)
     init_tok = {k: jnp.zeros((pp, mb, s), v.dtype) for k, v in tok.items()}
 
     # stage s processes micro t-s at tick t; anything else is fill/drain
@@ -99,7 +104,8 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         state_x, state_tok = carry
         in_x, in_tok, mask_t = xs_t
         cur_x = shift_in(in_x, state_x)
-        cur_tok = {k: shift_in(in_tok[k], state_tok[k]) for k in state_tok}
+        cur_tok = {k: shift_in(in_tok[k], state_tok[k], tok_spec)
+                   for k in state_tok}
         args = (stage_params, cur_x, cur_tok)
         if stage_mask is not None:
             args = args + (stage_mask,)
@@ -109,7 +115,7 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
             aux = jnp.sum(aux * mask_t)
         else:
             out_x, aux = out, jnp.zeros((), jnp.float32)
-        out_x = lax.with_sharding_constraint(out_x, P(pp_axis))
+        out_x = lax.with_sharding_constraint(out_x, spec)
         # collect the LAST stage's output (micro t-(pp-1) finishes at tick t)
         return (out_x, cur_tok), (out_x[-1], aux)
 
@@ -119,31 +125,16 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     return outs.reshape(B, s, h), jnp.sum(auxs)
 
 
-def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
-                         pp: int, mesh, position_ids=None, segment_ids=None,
-                         stage_layers=None, n_micro=None,
-                         remat: bool = True, remat_policy: str = "nothing"):
-    """Model-family-agnostic pipelined decoder stack.
+def build_stage_stack(stack_params, num_layers: int, pp: int, stage_layers):
+    """[L, ...] stacked layer params -> ([pp, max_k, ...] stage stacks,
+    layer_mask [pp, max_k] or None, normalized stage_layers).
 
-    block_fn(layer_params, x_mb, position_ids_mb, segment_ids_mb) ->
-    (x_mb, aux_scalar) applies ONE layer; the per-micro token riders are
-    threaded by the pipeline (None stays None).
-    stack_params: pytree with leading [num_layers, ...] dims.
-    Handles equal and heterogeneous (Malleus) stage layer counts — uneven
-    stages run as padded + masked stacks (see the llama model tests for the
-    bit-equality guarantee).  Returns (x, aux_total).
-    """
+    Hetero (Malleus) layouts pad each stage to max_k with layer-0 copies and
+    return the validity mask (padded slots are masked to identity by the
+    stage body and receive exactly zero gradient through the mask's where)."""
     import numpy as np
 
-    token_data = {}
-    if position_ids is not None:
-        token_data["position_ids"] = position_ids
-    if segment_ids is not None:
-        token_data["segment_ids"] = segment_ids
-
     L = num_layers
-    if n_micro is None:
-        n_micro = pp
     if stage_layers is None:
         if L % pp:
             raise ValueError(f"num_layers={L} must divide by pp={pp} "
@@ -158,19 +149,66 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
     if all(k == max_k for k in stage_layers):
         stage_params = jax.tree.map(
             lambda a: a.reshape((pp, max_k) + a.shape[1:]), stack_params)
-        layer_mask = None
-    else:
-        starts = np.cumsum([0] + stage_layers[:-1])
-        idx = np.zeros((pp, max_k), np.int32)
-        mask = np.zeros((pp, max_k), np.float32)
-        for s_i, (st0, k) in enumerate(zip(starts, stage_layers)):
-            idx[s_i, :k] = np.arange(st0, st0 + k)
-            mask[s_i, :k] = 1.0
-        idx_j = jnp.asarray(idx).reshape(-1)
-        stage_params = jax.tree.map(
-            lambda a: jnp.take(a, idx_j, axis=0).reshape(
-                (pp, max_k) + a.shape[1:]), stack_params)
-        layer_mask = jnp.asarray(mask)
+        return stage_params, None, stage_layers
+
+    starts = np.cumsum([0] + stage_layers[:-1])
+    idx = np.zeros((pp, max_k), np.int32)
+    mask = np.zeros((pp, max_k), np.float32)
+    for s_i, (st0, k) in enumerate(zip(starts, stage_layers)):
+        idx[s_i, :k] = np.arange(st0, st0 + k)
+        mask[s_i, :k] = 1.0
+    idx_j = jnp.asarray(idx).reshape(-1)
+    stage_params = jax.tree.map(
+        lambda a: jnp.take(a, idx_j, axis=0).reshape(
+            (pp, max_k) + a.shape[1:]), stack_params)
+    return stage_params, jnp.asarray(mask), stage_layers
+
+
+def unstack_stage_grads(d_stage, num_layers: int, pp: int, stage_layers):
+    """Inverse of build_stage_stack for GRADIENTS: [pp, max_k, ...] -> [L, ...]
+    (padded slots carry exactly-zero grads and are dropped)."""
+    import numpy as np
+
+    stage_layers = list(stage_layers)
+    max_k = max(stage_layers)
+    if all(k == max_k for k in stage_layers):
+        return jax.tree.map(
+            lambda a: a.reshape((num_layers,) + a.shape[2:]), d_stage)
+    starts = np.cumsum([0] + stage_layers[:-1])
+    flat_idx = np.concatenate(
+        [s_i * max_k + np.arange(k)
+         for s_i, (st0, k) in enumerate(zip(starts, stage_layers))])
+    flat_idx = jnp.asarray(flat_idx, jnp.int32)
+    return jax.tree.map(
+        lambda a: jnp.take(a.reshape((pp * max_k,) + a.shape[2:]),
+                           flat_idx, axis=0), d_stage)
+
+
+def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
+                         pp: int, mesh, position_ids=None, segment_ids=None,
+                         stage_layers=None, n_micro=None,
+                         remat: bool = True, remat_policy: str = "nothing",
+                         state_spec=None):
+    """Model-family-agnostic pipelined decoder stack.
+
+    block_fn(layer_params, x_mb, position_ids_mb, segment_ids_mb) ->
+    (x_mb, aux_scalar) applies ONE layer; the per-micro token riders are
+    threaded by the pipeline (None stays None).
+    stack_params: pytree with leading [num_layers, ...] dims.
+    Handles equal and heterogeneous (Malleus) stage layer counts — uneven
+    stages run as padded + masked stacks (see the llama model tests for the
+    bit-equality guarantee).  Returns (x, aux_total).
+    """
+    token_data = {}
+    if position_ids is not None:
+        token_data["position_ids"] = position_ids
+    if segment_ids is not None:
+        token_data["segment_ids"] = segment_ids
+
+    if n_micro is None:
+        n_micro = pp
+    stage_params, layer_mask, stage_layers = build_stage_stack(
+        stack_params, num_layers, pp, stage_layers)
 
     def stage_body(local_params, x_mb, tok, *mask_args):
         m = mask_args[0] if mask_args else None
@@ -195,4 +233,5 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
 
     return pipeline_apply(stage_body, stage_params, x, token_data,
                           n_micro=n_micro, mesh=mesh, remat=remat,
-                          remat_policy=remat_policy, stage_mask=layer_mask)
+                          remat_policy=remat_policy, stage_mask=layer_mask,
+                          state_spec=state_spec)

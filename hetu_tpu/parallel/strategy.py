@@ -146,6 +146,14 @@ class ParallelStrategy:
         """Token-id tensors [batch, seq]."""
         return self._act(2, None)
 
+    def pipeline_state_spec(self):
+        """PartitionSpec for stage-major pipeline buffers [pp, mb, s, h]:
+        the stage dim over pp plus act_hidden's dp/cp/sp layout, so stage
+        hand-offs move ONLY the stage-dim permute (one definition shared by
+        the GPipe and 1F1B engines)."""
+        from jax.sharding import PartitionSpec as P
+        return P("pp", *tuple(self.act_hidden().partition_spec()))
+
     def constrain(self, x, ds: Optional[DS]):
         if ds is None:
             return x

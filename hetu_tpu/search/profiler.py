@@ -61,6 +61,8 @@ def _sync(x):
 def measure_matmul_tflops(n: int = 4096, iters: int = 8,
                           dtype=jnp.bfloat16) -> float:
     """Measured MXU throughput (the per-layer compute calibration input)."""
+    if jax.default_backend() == "cpu":   # keep the CPU smoke path fast
+        n, iters = min(n, 1024), min(iters, 3)
     a = jnp.ones((n, n), dtype)
     b = jnp.ones((n, n), dtype)
     reps = 64  # amortize dispatch + remote-tunnel latency
@@ -80,6 +82,36 @@ def measure_matmul_tflops(n: int = 4096, iters: int = 8,
         _sync(f(a, b))
         times.append(time.perf_counter() - t)
     return reps * 2 * n ** 3 / min(times) / 1e12
+
+
+def measure_hbm_gbps(mbytes: int = 256, iters: int = 8) -> float:
+    """Measured HBM read+write bandwidth via a big elementwise copy-scale
+    (reference: galvatron profiles comm bandwidth; HBM is the TPU analog
+    bottleneck).  Bytes counted = read + write of the buffer."""
+    n = mbytes * 1024 * 1024 // 4
+    x = jnp.ones((n,), jnp.float32)
+    reps = 16
+
+    def body(x):
+        # scan (not an unrolled chain): each step is a sequential full
+        # read+write pass — an unrolled x*c+d chain would fuse into ONE pass
+        # and overreport bandwidth by reps x
+        def step(x, _):
+            return x * 1.0000001 + 1e-9, None
+        x, _ = jax.lax.scan(step, x, None, length=reps)
+        return x
+
+    f = jax.jit(body, donate_argnums=0)
+    x = f(x)
+    _sync(x[:1])
+    times = []
+    for _ in range(iters):
+        x = jnp.ones((n,), jnp.float32)
+        t = time.perf_counter()
+        x = f(x)
+        _sync(x[:1])
+        times.append(time.perf_counter() - t)
+    return reps * 2 * n * 4 / min(times) / 1e9
 
 
 def measure_collective_gbps(mesh, axis: str = "tp",
@@ -125,6 +157,10 @@ def profile_hardware(mesh=None, chip: Optional[str] = None,
         return prof
     try:
         prof.measured["matmul_tflops"] = round(measure_matmul_tflops(), 1)
+    except Exception:
+        pass
+    try:
+        prof.measured["hbm_gbps"] = round(measure_hbm_gbps(), 1)
     except Exception:
         pass
     if mesh is not None:

@@ -1,4 +1,6 @@
-from hetu_tpu.data.bucket import Bucket, pad_batch, pack_sequences, cp_split_batch
+from hetu_tpu.data.bucket import (Bucket, pad_batch, pack_sequences,
+                                  cp_split_batch, cp_split_uneven,
+                                  merge_cp_uneven)
 from hetu_tpu.data.dataset import JsonDataset, TokenizedDataset
 from hetu_tpu.data.dataloader import DataLoader, build_data_loader
 from hetu_tpu.data.data_collator import DataCollatorForLanguageModel

@@ -190,6 +190,50 @@ def cp_split_batch(batch: Dict[str, np.ndarray], cp: int,
     return out
 
 
+def cp_split_uneven(batch: Dict[str, np.ndarray], lengths: Sequence[int],
+                    align: int = 1, pad_id: int = 0) -> Dict[str, np.ndarray]:
+    """Heterogeneous-CP split: ring rank r owns `lengths[r]` VALID tokens.
+
+    The reference runs hetero CP rings whose members hold unequal seq shards
+    (reference: hetu/graph/ops/ParallelAttention.cc:949-1050 hetero ring with
+    per-rank valid lens).  XLA's even-sharding world realizes that as equal
+    PHYSICAL shards with per-rank valid prefixes: each rank's region is
+    padded to the common width s_max, pads carry segment 0 (masked from all
+    valid tokens by the kernel's segment machinery) and label -100.
+
+    Input batch: the usual padded/packed dict over a compact seq of
+    sum(lengths) tokens.  Output: same dict re-laid-out to seq = cp*s_max so
+    a plain cp sharding of the seq dim gives rank r exactly its tokens —
+    run it through the normal ring path, no special casing.
+    """
+    cp = len(lengths)
+    seq = batch["input_ids"].shape[1]
+    if sum(lengths) != seq:
+        raise ValueError(f"lengths {list(lengths)} must sum to seq {seq}")
+    s_max = max(lengths)
+    s_max = -(-s_max // align) * align
+    starts = np.cumsum([0] + list(lengths[:-1]))
+    out = {}
+    for key, v in batch.items():
+        fill = -100 if key == "labels" else (
+            pad_id if key == "input_ids" else 0)
+        arr = np.full((v.shape[0], cp * s_max), fill, v.dtype)
+        for r, (st0, L) in enumerate(zip(starts, lengths)):
+            arr[:, r * s_max:r * s_max + L] = v[:, st0:st0 + L]
+        out[key] = arr
+    return out
+
+
+def merge_cp_uneven(batch: Dict[str, np.ndarray], lengths: Sequence[int]
+                    ) -> Dict[str, np.ndarray]:
+    """Inverse of cp_split_uneven: drop per-rank pads, re-compact the seq."""
+    cp = len(lengths)
+    s_max = batch["input_ids"].shape[1] // cp
+    keep = np.concatenate([np.arange(r * s_max, r * s_max + L)
+                           for r, L in enumerate(lengths)])
+    return {k: v[:, keep] for k, v in batch.items()}
+
+
 def cp_split_indices(seq: int, cp: int, split: str = "sym") -> List[np.ndarray]:
     """The global token indices each cp rank owns (for reassembly/tests)."""
     dummy = {"input_ids": np.arange(seq)[None, :]}

@@ -62,6 +62,22 @@ class Trainer:
                 f"pp_schedule='1f1b' needs {type(model).__name__}"
                 ".pipeline_train_grads (use 'gpipe')")
 
+        if config.loss_scale not in ("auto", "dynamic", "none"):
+            raise ValueError(f"loss_scale must be auto|dynamic|none, got "
+                             f"{config.loss_scale!r}")
+        compute_dtype = getattr(getattr(model, "config", None),
+                                "compute_dtype", None)
+        use_scaler = (config.loss_scale == "dynamic"
+                      or (config.loss_scale == "auto"
+                          and compute_dtype == jnp.float16))
+        if use_scaler and config.pp_schedule == "1f1b" and self.strategy.pp > 1:
+            raise NotImplementedError(
+                "fp16 loss scaling with the 1f1b schedule (the manual-VJP "
+                "engine seeds cotangents internally); use gpipe or bf16")
+        from hetu_tpu.optim.grad_scaler import GradScaler
+        self._scaler = GradScaler() if use_scaler else None
+        self.scaler_state = None
+
         from hetu_tpu.utils.profiling import StepProfiler
         self.profiler = StepProfiler()
         c = config
@@ -98,9 +114,12 @@ class Trainer:
             self._pshard, self._sshard = self._make_shardings()
             self.opt_state = jax.jit(
                 self.optimizer.init, out_shardings=self._sshard)(self.params)
+            if self._scaler is not None:
+                self.scaler_state = jax.device_put(
+                    self._scaler.init(), NamedSharding(mesh, P()))
             self._step_fn = jax.jit(
                 self._train_step,
-                out_shardings=(self._pshard, self._sshard, None),
+                out_shardings=(self._pshard, self._sshard, None, None),
                 donate_argnums=(0, 1))
         return self
 
@@ -116,10 +135,20 @@ class Trainer:
             rng=rng, deterministic=c.dropout_deterministic,
             loss_reduction="sum")
 
-    def _train_step(self, params, opt_state, batches, rng):
+    def _train_step(self, params, opt_state, batches, rng, scaler_state):
         """batches: pytree with leading micro-batch dim [n_micro, mb, seq]."""
         c = self.config
-        n_micro = jax.tree.leaves(batches)[0].shape[0]
+        lead = jax.tree.leaves(batches)[0]
+        n_micro = lead.shape[0]
+        if self._scaler is not None:
+            # normalize the scale by the STATIC token-slot count so fp16
+            # cotangent magnitudes are batch-size-independent (the torch
+            # mean-loss convention) — the sum-loss would push the effective
+            # scale up by O(tokens) and overflow before calibrating
+            slots = float(n_micro * lead.shape[1] * max(lead.shape[2] - 1, 1))
+            scale = scaler_state["scale"] / slots
+        else:
+            scale = jnp.asarray(1.0, jnp.float32)
 
         if self.strategy.pp > 1:
             # pipeline mode: micro-batching happens INSIDE the model's
@@ -140,20 +169,27 @@ class Trainer:
                     segment_ids=flat.get("segment_ids"), n_micro=n_micro)
             else:
                 def pp_loss(p):
-                    return self.model(
+                    lsum_, csum_ = self.model(
                         p, flat["input_ids"], labels=flat["labels"],
                         position_ids=flat.get("position_ids"),
                         segment_ids=flat.get("segment_ids"),
                         deterministic=True, loss_reduction="sum",
                         n_micro=n_micro)
+                    # loss SCALING happens on the fp32 sum (gradscaler.h:33)
+                    return lsum_.astype(jnp.float32) * scale, (lsum_, csum_)
 
-                (lsum, csum), grads = jax.value_and_grad(
+                (_, (lsum, csum)), grads = jax.value_and_grad(
                     pp_loss, has_aux=True)(params)
         else:
             def micro(acc, xs):
                 batch, key = xs
-                (l, count), g = jax.value_and_grad(
-                    self._loss_fn, has_aux=True)(params, batch, key)
+
+                def scaled_loss(p):
+                    l, count = self._loss_fn(p, batch, key)
+                    return l.astype(jnp.float32) * scale, (l, count)
+
+                (_, (l, count)), g = jax.value_and_grad(
+                    scaled_loss, has_aux=True)(params)
                 acc_g, acc_l, acc_c = acc
                 return (jax.tree.map(jnp.add, acc_g, g), acc_l + l,
                         acc_c + count), None
@@ -166,7 +202,8 @@ class Trainer:
                 micro, (zero_g, zero, zero), (batches, keys))
 
         denom = jnp.maximum(csum, 1.0)
-        grads = jax.tree.map(lambda g: g / denom, grads)
+        # fold the unscale into the token normalize (one pass over grads)
+        grads = jax.tree.map(lambda g: g / (denom * scale), grads)
         if getattr(self.strategy, "zero_stage", 1) >= 2 and self.strategy.dp > 1:
             # ZeRO-2: keep grads dp-sharded through clip+update (GSPMD turns
             # the grad sync into reduce-scatter; params re-gather after)
@@ -174,10 +211,29 @@ class Trainer:
                 lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
                 grads, self._sshard["m"])
         grads, gnorm = optim.clip_by_global_norm(grads, c.grad_clip)
-        params, opt_state = self.optimizer.update(grads, opt_state, params)
-        metrics = {"loss": lsum / denom, "grad_norm": gnorm,
-                   "lr": self.optimizer._lr(opt_state["step"])}
-        return params, opt_state, metrics
+        metrics = {"loss": lsum / denom}
+        if self._scaler is None:
+            params, opt_state = self.optimizer.update(grads, opt_state, params)
+            metrics["grad_norm"] = gnorm
+            metrics["lr"] = self.optimizer._lr(opt_state["step"])
+            return params, opt_state, metrics, scaler_state
+
+        # AMP: skip the update on non-finite grads, back the scale off
+        # (reference: CheckFinite.cc + update_scale.cc semantics)
+        finite = self._scaler.all_finite(grads)
+        safe_grads = jax.tree.map(jnp.nan_to_num, grads)
+        new_params, new_opt = self.optimizer.update(
+            safe_grads, opt_state, params)
+        params = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
+                              new_params, params)
+        opt_state = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
+                                 new_opt, opt_state)
+        scaler_state = self._scaler.update(scaler_state, finite)
+        metrics["grad_norm"] = jnp.where(finite, gnorm, jnp.nan)
+        metrics["lr"] = self.optimizer._lr(opt_state["step"])
+        metrics["loss_scale"] = scaler_state["scale"]
+        metrics["amp_skipped"] = 1.0 - finite.astype(jnp.float32)
+        return params, opt_state, metrics, scaler_state
 
     # ------------------------------------------------------------------
     def _batch_sharding(self, ndim: int):
@@ -208,8 +264,9 @@ class Trainer:
         rng = jax.random.fold_in(jax.random.key(self.config.seed + 1),
                                  self.global_step)
         with use_mesh(self.mesh):
-            self.params, self.opt_state, metrics = self._step_fn(
-                self.params, self.opt_state, batches, rng)
+            self.params, self.opt_state, metrics, self.scaler_state = \
+                self._step_fn(self.params, self.opt_state, batches, rng,
+                              self.scaler_state)
         self.global_step += 1
         return metrics
 
@@ -283,8 +340,11 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def state(self):
-        return {"params": self.params, "opt_state": self.opt_state,
-                "step": self.global_step}
+        s = {"params": self.params, "opt_state": self.opt_state,
+             "step": self.global_step}
+        if self.scaler_state is not None:
+            s["scaler"] = self.scaler_state
+        return s
 
     def save(self, wait: bool = False):
         assert self._ckpt is not None, "no ckpt_dir configured"
@@ -301,4 +361,6 @@ class Trainer:
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.global_step = int(restored["step"])
+        if "scaler" in restored:
+            self.scaler_state = restored["scaler"]
         return self

@@ -42,6 +42,12 @@ class TrainingConfig:
     #           memory instead of O(n_micro); use when n_micro >> pp
     pp_schedule: str = "gpipe"
 
+    # AMP loss scaling (reference: hetu/graph/autocast/gradscaler.h:33):
+    # "auto" = dynamic GradScaler iff the model computes in float16 (bf16 has
+    # fp32's exponent range and needs none — the TPU default);
+    # "dynamic" = always on; "none" = always off
+    loss_scale: str = "auto"
+
     def num_micro_batches(self, dp: int) -> int:
         denom = self.micro_batch_size * dp
         if self.global_batch_size % denom:

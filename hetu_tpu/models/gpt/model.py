@@ -258,7 +258,10 @@ class GPTModel(Module):
                 position_ids=position_ids, segment_ids=segment_ids,
                 stage_layers=c.pipeline_stage_layers,
                 n_micro=n_micro, remat=c.remat, remat_policy=c.remat_policy,
-                state_spec=st.pipeline_state_spec())
+                state_spec=st.pipeline_state_spec(),
+                # see llama._pipeline_forward: cp ring ppermute is not
+                # branch-safe, so hetero-exec stays off under cp>1
+                hetero_exec="auto" if st.cp == 1 else False)
             return self.final_ln(params["final_ln"], x)
         layer_rngs = (jax.random.split(rng, c.num_hidden_layers)
                       if use_drop else None)
@@ -303,7 +306,12 @@ class GPTLMHeadModel(Module):
         self.config, self.strategy = config, strategy
         self.model = GPTModel(config, strategy)
         if not config.tie_word_embeddings:
-            lm_ds = DS.make(2, {1: "tp"}) if strategy.tp > 1 else None
+            if strategy.tp > 1 and config.vocab_size % strategy.tp:
+                raise ValueError(
+                    f"vocab size {config.vocab_size} must divide by tp="
+                    f"{strategy.tp}; pad the vocab (e.g. 50257 -> 50304)")
+            lm_ds = strategy.fsdp(
+                DS.make(2, {1: "tp"}) if strategy.tp > 1 else None, 2, 0)
             self.param("lm_head", (config.hidden_size, config.vocab_size),
                        init.normal(config.initializer_range),
                        dtype=config.param_dtype, ds=lm_ds)

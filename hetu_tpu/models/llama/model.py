@@ -80,6 +80,12 @@ class LlamaAttention(Module):
         use_attn_dropout = (c.attention_dropout > 0.0 and not deterministic
                             and rng is not None)
         if st.cp > 1:
+            if use_attn_dropout:
+                # mirror the pipeline's explicit guard — silently dropping a
+                # configured attention_dropout would be a training-semantics
+                # surprise
+                raise NotImplementedError(
+                    "attention_dropout inside ring attention (cp > 1)")
             # the ring composes with the GSPMD pipeline too (a full
             # shard_map nests cleanly inside vmap(spmd_axis_name='pp');
             # only the PARTIAL-manual shard_map mode is partitioner-hostile)
@@ -147,7 +153,7 @@ class LlamaBlock(Module):
                 c.hidden_size, c.intermediate_size,
                 MoEConfig(num_experts=c.num_experts, top_k=c.moe_top_k,
                           capacity_factor=c.moe_capacity_factor,
-                          gate=c.moe_gate),
+                          gate=c.moe_gate, dispatch=c.moe_dispatch),
                 strategy, param_dtype=c.param_dtype,
                 initializer_range=c.initializer_range)
         else:
@@ -278,7 +284,14 @@ class LlamaDecoderStack(Module):
             position_ids=position_ids, segment_ids=segment_ids,
             stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
             remat=c.remat, remat_policy=c.remat_policy,
-            state_spec=st.pipeline_state_spec())
+            state_spec=st.pipeline_state_spec(),
+            # ragged (hetero-exec) stages skip untaken-branch collectives;
+            # the cp ring's explicit ppermute spans all stages in one
+            # instruction, and the MoE dispatch's grouped collectives
+            # check-fail XLA's partitioner inside a non-uniform cond —
+            # both layouts stay padded
+            hetero_exec="auto" if (st.cp == 1 and c.num_experts == 0)
+            else False)
 
 
 class LlamaModel(Module):

@@ -6,14 +6,25 @@ all-to-all HAllToAll.py — v1-only features per SURVEY.md §2.4 EP row).
 
 TPU-first design (GShard/Switch style):
 - experts are ONE stacked parameter [E, ...] sharded over the `ep` mesh axis.
-- dispatch/combine are einsums against a one-hot routing mask with a fixed
-  per-expert capacity — static shapes, MXU-friendly, and GSPMD lowers the
-  token->expert movement to all-to-all over ep (the reference's explicit
-  HAllToAll becomes compiler-inserted; mesh axis order already makes it
-  hierarchical: ICI within a slice, DCN across).
-- router: softmax gate with top-k (k=1/2), capacity dropping, load-balance
-  auxiliary loss (Switch-style) and router z-loss; a HashGate mirrors the
-  reference's hash gate for ablations.
+- the DEFAULT dispatch is sort-based with O(T·k) index tensors: (token, slot)
+  pairs are argsorted by expert, position-in-expert comes from an exclusive
+  count prefix, and tokens scatter-add into the per-expert capacity buffers.
+  No [T, E, C] one-hot masks are ever materialized (at gbs·seq ≈ 1M tokens
+  and E=64 those are tens of GB), so MoE scales to the reference's
+  benchmark sizes.  dispatch="dense" keeps the einsum-against-one-hot path
+  for parity tests and tiny ablations.
+- routing is computed PER DATA SHARD (the [G, Tg, h] group dim is laid out
+  over dp×cp): each shard's position-in-expert prefix only scans its own
+  tokens, so dispatch never serializes across data shards (GShard's
+  per-group capacity semantics).  GSPMD lowers the group->expert buffer
+  movement to all-to-all over ep (the reference's explicit HAllToAll becomes
+  compiler-inserted; mesh axis order already makes it hierarchical: ICI
+  within a slice, DCN across).
+- gates: "topk" (GShard, default), "top1" (Switch), "ktop1" (k sequential
+  top-1 picks with renormalized leftovers — reference KTop1Gate),
+  "balance" (Sinkhorn-balanced assignment — reference BalanceAssignmentGate
+  / BASE-style), "hash" (token_id % E).  All share the Switch load-balance
+  aux loss + router z-loss.
 """
 from __future__ import annotations
 
@@ -29,6 +40,8 @@ from hetu_tpu.nn import initializers as init
 from hetu_tpu.nn.module import Module
 from hetu_tpu.parallel.strategy import ParallelStrategy
 
+GATES = ("topk", "top1", "ktop1", "balance", "hash")
+
 
 @dataclasses.dataclass
 class MoEConfig:
@@ -37,43 +50,158 @@ class MoEConfig:
     capacity_factor: float = 1.25
     router_z_loss_coef: float = 1e-3
     load_balance_coef: float = 1e-2
-    gate: str = "topk"  # "topk" | "hash"
+    gate: str = "topk"      # one of GATES
+    dispatch: str = "sort"  # "sort" (O(T·k) indices) | "dense" ([T,E,C] masks)
+    sinkhorn_iters: int = 4  # balance gate only
 
 
 def _router_probs(logits):
     return jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
 
 
-def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
-    """Returns (dispatch [T, E, C] bool, combine [T, E, C] f32, aux_loss).
-
-    T = tokens, E = experts, C = capacity.  Top-k softmax routing with
-    position-in-expert capacity dropping (GShard); aux = load-balance +
-    z-loss (reference gate variants: v1 gates Top/KTop1/Balance)."""
+def _sinkhorn(logits, iters: int):
+    """Sinkhorn normalization toward a doubly-'stochastic' plan: rows sum to
+    1, columns to T/E — the balanced-assignment relaxation the reference's
+    BalanceAssignmentGate solves with an LP."""
+    log_p = jax.nn.log_softmax(logits, axis=-1)
     T, E = logits.shape
-    probs = _router_probs(logits)                      # [T, E]
+    log_col_target = jnp.log(jnp.asarray(T / E, jnp.float32))
+    for _ in range(iters):
+        log_p = log_p - jax.nn.logsumexp(log_p, axis=0, keepdims=True) \
+            + log_col_target
+        log_p = log_p - jax.nn.logsumexp(log_p, axis=1, keepdims=True)
+    return jnp.exp(log_p)
+
+
+def select_experts(logits, ids, moe: MoEConfig
+                   ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Gate selection: logits [T, E] -> (expert_idx [T, k], gate_vals [T, k]).
+
+    Shared by the sort and dense dispatchers so they route identically."""
+    T, E = logits.shape
+    probs = _router_probs(logits)
 
     if moe.gate == "hash":
-        # reference HashGate: expert = token_id % E (no learned routing)
-        expert_idx = (ids % E)[:, None]                # [T, 1]
+        expert_idx = (ids % E)[:, None]
         gate_vals = jnp.ones((T, 1), jnp.float32)
-        k = 1
-    else:
-        k = moe.top_k
-        gate_vals, expert_idx = jax.lax.top_k(probs, k)   # [T, k]
-        # renormalize the kept gates
+    elif moe.gate == "top1":
+        # Switch: scale by the RAW router prob (the gate gradient signal)
+        gate_vals, expert_idx = jax.lax.top_k(probs, 1)
+    elif moe.gate == "ktop1":
+        # k sequential top-1 picks; each pick's gate is its probability
+        # renormalized over the experts still available (reference KTop1Gate)
+        picks, gates = [], []
+        remaining = probs
+        for _ in range(max(moe.top_k, 1)):
+            g, e = jax.lax.top_k(remaining, 1)
+            denom = jnp.sum(remaining, axis=-1, keepdims=True)
+            gates.append(g / jnp.maximum(denom, 1e-9))
+            picks.append(e)
+            remaining = remaining * (1.0 - jax.nn.one_hot(e[:, 0], E))
+        expert_idx = jnp.concatenate(picks, axis=1)
+        gate_vals = jnp.concatenate(gates, axis=1)
         gate_vals = gate_vals / jnp.maximum(
             jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    elif moe.gate == "balance":
+        plan = _sinkhorn(logits.astype(jnp.float32), moe.sinkhorn_iters)
+        _, expert_idx = jax.lax.top_k(plan, max(moe.top_k, 1))
+        gate_vals = jnp.take_along_axis(probs, expert_idx, axis=1)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    else:  # topk (GShard)
+        gate_vals, expert_idx = jax.lax.top_k(probs, moe.top_k)
+        gate_vals = gate_vals / jnp.maximum(
+            jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    return expert_idx, gate_vals
 
-    # position of each token within its expert (for capacity) — computed per
-    # k-slot sequentially so slot-0 assignments take priority
+
+def aux_losses(logits, expert_idx, moe: MoEConfig):
+    """Switch load-balance loss + router z-loss."""
+    E = logits.shape[-1]
+    probs = _router_probs(logits)
+    me = jnp.mean(probs, axis=0)
+    ce = jnp.mean(jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32),
+                  axis=0)
+    load_balance = E * jnp.sum(me * ce)
+    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32),
+                                             axis=-1)))
+    return (moe.load_balance_coef * load_balance
+            + moe.router_z_loss_coef * z)
+
+
+def sort_routing(expert_idx, gate_vals, num_experts: int, capacity: int):
+    """Sort-based routing plan with O(T·k) index tensors.
+
+    (token, slot) pairs are flattened SLOT-major (all slot-0 picks first, in
+    token order) so drop priority matches the dense path's sequential-slot
+    semantics, stably argsorted by expert, and positioned via an exclusive
+    per-expert count prefix.  Returns dict of [T*k] arrays:
+      dest: flat index into [E*C] buffers (E*C = trash for dropped entries)
+      tok:  source token index
+      gate: combine weight
+      keep: survived capacity
+    """
+    T, k = expert_idx.shape
+    TK = T * k
+    e_flat = expert_idx.T.reshape(TK)       # slot-major
+    g_flat = gate_vals.T.reshape(TK)
+    order = jnp.argsort(e_flat, stable=True)
+    e_s = e_flat[order]
+    counts = jnp.zeros((num_experts,), jnp.int32).at[e_flat].add(1)
+    starts = jnp.cumsum(counts) - counts    # exclusive prefix
+    pos = jnp.arange(TK, dtype=jnp.int32) - starts[e_s]
+    keep = pos < capacity
+    dest = jnp.where(keep, e_s * capacity + pos, num_experts * capacity)
+    tok = order % T                         # slot-major: f = slot*T + t
+    return {"dest": dest, "tok": tok, "gate": g_flat[order], "keep": keep}
+
+
+def scatter_to_experts(xt, plan, num_experts: int, capacity: int):
+    """xt [T, h] --scatter-add--> [E, C, h].  Dropped entries land in (and
+    are discarded with) a trash row, so they contribute exactly-zero output
+    and gradient."""
+    T, h = xt.shape
+    E, C = num_experts, capacity
+    buf = jnp.zeros((E * C + 1, h), xt.dtype)
+    buf = buf.at[plan["dest"]].add(xt[plan["tok"]])
+    return buf[: E * C].reshape(E, C, h)
+
+
+def gather_from_experts(out_ec, plan, num_tokens: int):
+    """[E, C, h'] --gate-weighted gather--> [T, h'] (dropped entries gather
+    through a clamped index but are zeroed by the keep mask)."""
+    E, C, h = out_ec.shape
+    out_flat = out_ec.reshape(E * C, h)
+    safe = jnp.minimum(plan["dest"], E * C - 1)
+    w = (plan["keep"] * plan["gate"]).astype(out_flat.dtype)
+    contrib = out_flat[safe] * w[:, None]
+    y = jnp.zeros((num_tokens, h), out_flat.dtype)
+    return y.at[plan["tok"]].add(contrib)
+
+
+def sort_dispatch_combine(xt, plan, expert_fn, num_experts: int,
+                          capacity: int):
+    """xt [T, h] --scatter--> [E, C, h] --expert_fn--> [E, C, h'] --gather-->
+    [T, h']."""
+    out = expert_fn(scatter_to_experts(xt, plan, num_experts, capacity))
+    return gather_from_experts(out, plan, xt.shape[0])
+
+
+def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
+    """DENSE routing (parity/ablation path): returns (dispatch [T, E, C]
+    bool, combine [T, E, C] f32, aux_loss).  Memory O(T·E·C) — use
+    dispatch="sort" beyond toy sizes."""
+    T, E = logits.shape
+    expert_idx, gate_vals = select_experts(logits, ids, moe)
+    k = expert_idx.shape[1]
+
     dispatch = jnp.zeros((T, E, capacity), jnp.bool_)
     combine = jnp.zeros((T, E, capacity), jnp.float32)
     fill = jnp.zeros((E,), jnp.int32)
     for slot in range(k):
-        e = expert_idx[:, slot]                        # [T]
-        onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)  # [T, E]
-        pos_in_e = (jnp.cumsum(onehot, axis=0) - onehot)  # arrivals before t
+        e = expert_idx[:, slot]
+        onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)
+        pos_in_e = (jnp.cumsum(onehot, axis=0) - onehot)
         pos = jnp.take_along_axis(pos_in_e, e[:, None], axis=1)[:, 0] + fill[e]
         keep = pos < capacity
         pos_c = jnp.clip(pos, 0, capacity - 1)
@@ -82,18 +210,9 @@ def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
         upd = upd * keep[:, None, None]
         dispatch = dispatch | (upd > 0)
         combine = combine + upd * gate_vals[:, slot][:, None, None]
-        fill = fill + jnp.sum(
-            jax.nn.one_hot(e, E, dtype=jnp.int32) * keep[:, None], axis=0)
+        fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
 
-    # aux losses
-    me = jnp.mean(probs, axis=0)                       # mean prob per expert
-    ce = jnp.mean(
-        jax.nn.one_hot(expert_idx[:, 0], E, dtype=jnp.float32), axis=0)
-    load_balance = E * jnp.sum(me * ce)
-    z = jnp.mean(jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32),
-                                             axis=-1)))
-    aux = moe.load_balance_coef * load_balance + moe.router_z_loss_coef * z
-    return dispatch, combine, aux
+    return dispatch, combine, aux_losses(logits, expert_idx, moe)
 
 
 class MoELayer(Module):
@@ -104,6 +223,10 @@ class MoELayer(Module):
                  moe: MoEConfig, strategy: ParallelStrategy,
                  param_dtype=jnp.float32, initializer_range: float = 0.02):
         super().__init__()
+        if moe.gate not in GATES:
+            raise ValueError(f"gate={moe.gate!r} not in {GATES}")
+        if moe.dispatch not in ("sort", "dense"):
+            raise ValueError(f"dispatch={moe.dispatch!r}")
         self.moe, self.strategy = moe, strategy
         self.hidden, self.inter = hidden_size, intermediate_size
         E = moe.num_experts
@@ -118,8 +241,78 @@ class MoELayer(Module):
         self.param("w_down", (E, intermediate_size, hidden_size),
                    init.normal(initializer_range), dtype=param_dtype, ds=dn_ds)
 
+    # -- expert compute (shared by both dispatchers) ------------------------
+    def _experts(self, params, buf):
+        """buf [..., E, C, h] -> [..., E, C, h] (leading dims broadcast)."""
+        x = buf
+        gu = jnp.einsum("...ecd,edki->...ecki", x,
+                        params["w_gate_up"].astype(x.dtype))
+        hidden = ops.swiglu(gu[..., 0, :], gu[..., 1, :])
+        return jnp.einsum("...eci,eih->...ech", hidden,
+                          params["w_down"].astype(x.dtype))
+
+    def _group_dims(self, b: int, s: int) -> Tuple[int, int]:
+        """(db, cs) — how many shards the batch/seq dims split into for
+        shard-local routing; 1 when the dim does not divide evenly (falls
+        back to one global group, still correct just not shard-local)."""
+        st = self.strategy
+        db = st.dp if st.dp > 1 and b % st.dp == 0 else 1
+        cs = st.cp if st.cp > 1 and s % st.cp == 0 else 1
+        return db, cs
+
     def forward(self, params, x, *, token_ids: Optional[jnp.ndarray] = None):
         """x: [b, s, h] -> ([b, s, h], aux_loss)."""
+        moe, st = self.moe, self.strategy
+        b, s, h = x.shape
+        E = moe.num_experts
+
+        if moe.dispatch == "dense":
+            return self._forward_dense(params, x, token_ids)
+
+        # ---- grouped sort dispatch: G = dp*cp data shards route locally ----
+        db, cs = self._group_dims(b, s)
+        G = db * cs
+        Tg = (b // db) * (s // cs)
+        capacity = int(moe.capacity_factor * Tg * max(moe.top_k, 1) / E)
+        capacity = max(8, min(Tg, -(-capacity // 8) * 8))  # mult of 8
+
+        # [b, s, h] -> [G, Tg, h], group dim laid out over (dp, cp) so the
+        # regroup is data-movement-free under the activation sharding
+        xg = x.reshape(db, b // db, cs, s // cs, h)
+        xg = xg.transpose(0, 2, 1, 3, 4).reshape(G, Tg, h)
+        if token_ids is not None:
+            ig = token_ids.reshape(db, b // db, cs, s // cs)
+            ig = ig.transpose(0, 2, 1, 3).reshape(G, Tg)
+        else:
+            ig = jnp.tile(jnp.arange(Tg, dtype=jnp.int32)[None], (G, 1))
+        group_axes = tuple(a for a, n in (("dp", db), ("cp", cs)) if n > 1)
+        if group_axes:
+            xg = DS.make(3, {0: group_axes}).constrain(xg)
+
+        def route_one(xt, ids):
+            logits = xt.astype(jnp.float32) @ params["router"]
+            expert_idx, gate_vals = select_experts(logits, ids, moe)
+            plan = sort_routing(expert_idx, gate_vals, E, capacity)
+            aux = aux_losses(logits, expert_idx, moe)
+            return scatter_to_experts(xt, plan, E, capacity), plan, aux
+
+        buf, plan, aux = jax.vmap(route_one)(xg, ig)   # [G, E, C, h]
+        ep_spec = {1: "ep"} if st.ep > 1 else {}
+        if group_axes or ep_spec:
+            buf = DS.make(4, {0: group_axes, **ep_spec}).constrain(buf)
+        out = self._experts(params, buf)               # [G, E, C, h]
+        if group_axes or ep_spec:
+            out = DS.make(4, {0: group_axes, **ep_spec}).constrain(out)
+
+        yg = jax.vmap(lambda o, p: gather_from_experts(o, p, Tg))(
+            out, plan)                                 # [G, Tg, h]
+        if group_axes:
+            yg = DS.make(3, {0: group_axes}).constrain(yg)
+        y = yg.reshape(db, cs, b // db, s // cs, h)
+        y = y.transpose(0, 2, 1, 3, 4).reshape(b, s, h)
+        return y, jnp.mean(aux)
+
+    def _forward_dense(self, params, x, token_ids):
         moe, st = self.moe, self.strategy
         b, s, h = x.shape
         T = b * s
@@ -133,18 +326,11 @@ class MoELayer(Module):
                else jnp.arange(T, dtype=jnp.int32))
         dispatch, combine, aux = topk_routing(logits, ids, moe, capacity)
 
-        # dispatch tokens into per-expert buffers [E, C, h]
         buf = jnp.einsum("th,tec->ech", xt, dispatch.astype(x.dtype))
         if st.ep > 1:
             buf = DS.make(3, {0: "ep"}).constrain(buf)
-        # expert FFN (batched over E; ep-sharded -> local experts only)
-        gu = jnp.einsum("ecd,edki->ecki", buf,
-                        params["w_gate_up"].astype(x.dtype))
-        hidden = ops.swiglu(gu[:, :, 0, :], gu[:, :, 1, :])
-        out = jnp.einsum("eci,eih->ech", hidden,
-                         params["w_down"].astype(x.dtype))
+        out = self._experts(params, buf)
         if st.ep > 1:
             out = DS.make(3, {0: "ep"}).constrain(out)
-        # combine back to tokens
         y = jnp.einsum("ech,tec->th", out, combine.astype(x.dtype))
         return y.reshape(b, s, h), aux

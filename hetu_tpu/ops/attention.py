@@ -56,6 +56,17 @@ except ImportError:  # pallas kernel not built yet / not importable on CPU
     _pallas_fa = None
 
 
+def _pallas_compatible(q, k) -> bool:
+    """Mirror the Pallas kernel's shape gates (clamped block divisibility,
+    lane-aligned head dim) so the auto path can fall back instead of raising
+    mid-trace."""
+    from hetu_tpu.ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
+                                                     DEFAULT_BLOCK_Q)
+    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
+    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
+    return sq % bq == 0 and sk % bk == 0 and d % 128 == 0
+
+
 def flash_attention(q, k, v, *, causal: bool = True,
                     segment_ids: Optional[jnp.ndarray] = None,
                     softmax_scale: Optional[float] = None,
@@ -63,7 +74,8 @@ def flash_attention(q, k, v, *, causal: bool = True,
     """Fused attention entry point. Routes to the Pallas TPU kernel when
     running on TPU with compatible shapes; XLA composition otherwise."""
     if use_pallas is None:
-        use_pallas = jax.default_backend() == "tpu" and _pallas_fa is not None
+        use_pallas = (jax.default_backend() == "tpu" and _pallas_fa is not None
+                      and _pallas_compatible(q, k))
     if use_pallas:
         if _pallas_fa is None:
             raise RuntimeError("use_pallas=True but the Pallas kernel is unavailable")

@@ -31,14 +31,19 @@ class GradScaler:
         # promote to fp32 before scaling: 2**16 overflows float16's max
         return loss.astype(jnp.float32) * state["scale"]
 
-    def unscale_and_check(self, grads, state) -> Tuple[Any, jnp.ndarray]:
-        """Unscale grads; return (grads, all_finite) — CheckFinite analog."""
-        inv = 1.0 / state["scale"]
-        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
-        finite = jnp.all(jnp.stack([
+    @staticmethod
+    def all_finite(grads) -> jnp.ndarray:
+        """CheckFinite analog — ONE definition of grad finiteness (the
+        trainer's skip-update predicate uses this too)."""
+        return jnp.all(jnp.stack([
             jnp.all(jnp.isfinite(g)) for g in jax.tree.leaves(grads)
         ]))
-        return grads, finite
+
+    def unscale_and_check(self, grads, state) -> Tuple[Any, jnp.ndarray]:
+        """Unscale grads; return (grads, all_finite)."""
+        inv = 1.0 / state["scale"]
+        grads = jax.tree.map(lambda g: g.astype(jnp.float32) * inv, grads)
+        return grads, self.all_finite(grads)
 
     def update(self, state, all_finite):
         """update_scale op: grow on streaks of finite steps, back off on inf."""

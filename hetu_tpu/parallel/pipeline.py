@@ -41,7 +41,7 @@ from jax.sharding import PartitionSpec as P
 def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                    *, n_micro: int, mesh, pp_axis: str = "pp",
                    remat: bool = True, remat_policy: str = "nothing",
-                   stage_mask=None, state_spec=None):
+                   stage_mask=None, state_spec=None, hetero_exec: bool = False):
     """Run the circular pipeline.
 
     stage_body(stage_params_slice, x_mb, token_data_mb) -> x_mb — applies one
@@ -49,6 +49,15 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     stage_params: pytree with leading [pp, ...] dims (sharded over pp).
     x: [B, s, h] global activations (B divides by n_micro).
     token_data: dict of [B, s] arrays riding along (positions/segments).
+
+    hetero_exec: run the per-tick stage computation under `jax.shard_map`
+    manual over ONLY the pp axis (dp/tp/cp stay automatic/GSPMD) instead of
+    `jax.vmap(spmd_axis_name=pp)`.  Under vmap every stage traces one shared
+    program, so a hetero (Malleus) layout's padded layers become `select`s
+    that still PAY max(stage_layers) compute per tick; under shard_map each
+    stage's `lax.cond` stays a real XLA conditional, so a stage executes
+    exactly its own layer count — the point of uneven stage assignment
+    (reference: define_and_run_graph.cc:159 DeducePipeline hetero stages).
     """
     B, s, h = x.shape
     assert B % n_micro == 0, (B, n_micro)
@@ -70,8 +79,13 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         from hetu_tpu.nn.remat import remat_policy as _policy
         body = jax.checkpoint(stage_body, policy=_policy(remat_policy))
     extra_axes = (0,) if stage_mask is not None else ()
-    vbody = jax.vmap(body, in_axes=(0, 0, 0) + extra_axes,
-                     spmd_axis_name=pp_axis)
+    if hetero_exec:
+        vbody = _shard_map_stage_body(body, mesh, pp_axis, spec, tok_spec,
+                                      token_data, has_mask=stage_mask
+                                      is not None)
+    else:
+        vbody = jax.vmap(body, in_axes=(0, 0, 0) + extra_axes,
+                         spmd_axis_name=pp_axis)
 
     def shift_in(new, state, sp=None):
         """Stage hand-off: stage 0 gets the fresh micro, stage i gets stage
@@ -123,6 +137,39 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                              (xs_x, xs_tok, aux_mask))
     outs = ys[pad:] if pad else ys          # [n_micro, mb, s, h]
     return outs.reshape(B, s, h), jnp.sum(auxs)
+
+
+def _shard_map_stage_body(body, mesh, pp_axis: str, spec, tok_spec,
+                          token_data: Dict, has_mask: bool):
+    """Wrap a per-stage body in `jax.shard_map` manual over ONLY the pp axis.
+
+    Every other mesh axis (dp/cp/tp/...) stays automatic, so the body's own
+    with_sharding_constraint calls keep composing via GSPMD.  Inside, the
+    stage dim has local extent 1 (this device group's stage); `lax.cond`
+    on per-stage values stays a real branch instead of vmap's `select`.
+    """
+    from jax.sharding import PartitionSpec
+    Ppp = PartitionSpec(pp_axis)
+
+    def manual(sp, x, tok, *mask_args):
+        sp1 = jax.tree.map(lambda a: a[0], sp)
+        tok1 = {k: v[0] for k, v in tok.items()}
+        args = (sp1, x[0], tok1)
+        if mask_args:
+            args = args + (mask_args[0][0],)
+        out = body(*args)
+        if isinstance(out, tuple):
+            ox, aux = out
+        else:
+            ox, aux = out, jnp.zeros((), jnp.float32)
+        return ox[None], jnp.reshape(aux, (1,)).astype(jnp.float32)
+
+    in_specs = (Ppp, Ppp, {k: Ppp for k in token_data})
+    if has_mask:
+        in_specs = in_specs + (Ppp,)
+    return jax.shard_map(manual, mesh=mesh, in_specs=in_specs,
+                         out_specs=(Ppp, Ppp),
+                         axis_names=frozenset({pp_axis}))
 
 
 def build_stage_stack(stack_params, num_layers: int, pp: int, stage_layers):
@@ -188,16 +235,20 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
                          pp: int, mesh, position_ids=None, segment_ids=None,
                          stage_layers=None, n_micro=None,
                          remat: bool = True, remat_policy: str = "nothing",
-                         state_spec=None):
+                         state_spec=None, hetero_exec="auto"):
     """Model-family-agnostic pipelined decoder stack.
 
     block_fn(layer_params, x_mb, position_ids_mb, segment_ids_mb) ->
     (x_mb, aux_scalar) applies ONE layer; the per-micro token riders are
     threaded by the pipeline (None stays None).
     stack_params: pytree with leading [num_layers, ...] dims.
-    Handles equal and heterogeneous (Malleus) stage layer counts — uneven
-    stages run as padded + masked stacks (see the llama model tests for the
-    bit-equality guarantee).  Returns (x, aux_total).
+    Handles equal and heterogeneous (Malleus) stage layer counts.  With
+    hetero_exec (default "auto": on whenever stages are uneven) each stage
+    runs under shard_map-over-pp and executes exactly its own layer count —
+    padded slots are untaken `lax.cond` branches, so a Malleus layout
+    actually saves the straggler's compute.  hetero_exec=False keeps the
+    padded+masked vmap path (every stage pays max(stage_layers) per tick).
+    Returns (x, aux_total).
     """
     token_data = {}
     if position_ids is not None:
@@ -209,9 +260,30 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
         n_micro = pp
     stage_params, layer_mask, stage_layers = build_stage_stack(
         stack_params, num_layers, pp, stage_layers)
+    if hetero_exec == "auto":
+        hetero_exec = layer_mask is not None
+    hetero_exec = bool(hetero_exec) and layer_mask is not None
 
     def stage_body(local_params, x_mb, tok, *mask_args):
         m = mask_args[0] if mask_args else None
+
+        def _vary(v):
+            # both cond branches must agree on varying-manual-axes typing
+            # inside the shard_map-over-pp region; constants come out
+            # unvarying, so promote them
+            try:
+                vma = getattr(jax.typeof(v), "vma", frozenset())
+            except Exception:
+                return v
+            if hetero_exec and "pp" not in vma:
+                return lax.pcast(v, ("pp",), to="varying")
+            return v
+
+        def run_layer(layer_params, x_c):
+            out, aux = block_fn(layer_params, x_c,
+                                tok.get("position_ids"),
+                                tok.get("segment_ids"))
+            return _vary(out), _vary(jnp.asarray(aux, jnp.float32))
 
         def body(carry, xs):
             if m is None:
@@ -219,19 +291,27 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
             else:
                 layer_params, mj = xs
             x_c, aux_c = carry
-            out, aux = block_fn(layer_params, x_c,
-                                tok.get("position_ids"),
-                                tok.get("segment_ids"))
-            if m is not None:
-                out = jnp.where(mj > 0, out, x_c)   # padded layer = identity
-                aux = aux * mj
+            if m is not None and hetero_exec:
+                # real branch (shard_map keeps it a conditional): a padded
+                # slot costs nothing and its params get exactly-zero grads
+                out, aux = lax.cond(
+                    mj > 0, run_layer,
+                    lambda _lp, x_: (_vary(x_),
+                                     _vary(jnp.zeros((), jnp.float32))),
+                    layer_params, x_c)
+            else:
+                out, aux = run_layer(layer_params, x_c)
+                if m is not None:
+                    out = jnp.where(mj > 0, out, x_c)  # padded = identity
+                    aux = aux * mj
             return (out, aux_c + aux), None
 
         xs = local_params if m is None else (local_params, m)
-        (out, aux), _ = lax.scan(body, (x_mb, jnp.zeros((), jnp.float32)), xs)
+        (out, aux), _ = lax.scan(
+            body, (x_mb, _vary(jnp.zeros((), jnp.float32))), xs)
         return out, aux
 
     return pipeline_apply(stage_body, stage_params, x, token_data,
                           n_micro=n_micro, mesh=mesh, remat=remat,
                           remat_policy=remat_policy, stage_mask=layer_mask,
-                          state_spec=state_spec)
+                          state_spec=state_spec, hetero_exec=hetero_exec)

@@ -195,6 +195,14 @@ def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
     if mesh is None:
         raise ValueError("ring_attention_gspmd needs a mesh "
                          "(use hetu_tpu.use_mesh)")
+    # inside a partial-manual region (e.g. the hetero-exec pipeline's
+    # shard_map over pp) the inner shard_map must be built against the
+    # tracing context's AbstractMesh — its axis_types record which axes are
+    # already Manual; handing it the concrete Mesh is a mesh mismatch
+    abstract = jax.sharding.get_abstract_mesh()
+    if abstract is not None and any(
+            "Manual" in str(t) for t in getattr(abstract, "axis_types", ())):
+        mesh = abstract
 
     # layouts come from the strategy — one source of truth with the model
     qkv_spec = strategy.act_attn().partition_spec()

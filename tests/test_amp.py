@@ -1,0 +1,87 @@
+"""AMP / fp16 loss-scaling tests (reference: hetu/graph/autocast/
+gradscaler.h:33 + ops/CheckFinite.cc + ops/update_scale.cc): the trainer
+must scale the loss, check grads finite, SKIP the update and back the scale
+off on overflow, and grow it back on finite streaks."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.engine import Trainer, TrainingConfig
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.optim.grad_scaler import GradScaler
+from hetu_tpu.parallel import ParallelStrategy
+
+
+def _batch(gbs=4, s=32, seed=0):
+    from hetu_tpu.data import pad_batch
+    rng = np.random.default_rng(seed)
+    return pad_batch([rng.integers(1, 250, size=s - 4) for _ in range(gbs)], s)
+
+
+def test_fp16_trainer_enables_scaler_and_trains():
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16)
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
+                        lr=1e-3, warmup_steps=2, total_steps=20, log_every=100)
+    tr = Trainer(LlamaLMHeadModel(cfg), tc).build()
+    assert tr._scaler is not None and tr.scaler_state is not None
+    m = [tr.train_step(_batch()) for _ in range(6)]
+    losses = [float(x["loss"]) for x in m]
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses
+    assert all("loss_scale" in x for x in m)
+    assert sum(float(x["amp_skipped"]) for x in m) == 0.0
+
+
+def test_bf16_trainer_has_no_scaler():
+    cfg = LlamaConfig.tiny(remat=False)  # bf16 default
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
+                        total_steps=10)
+    tr = Trainer(LlamaLMHeadModel(cfg), tc)
+    assert tr._scaler is None
+
+
+def test_overflow_skips_update_and_backs_off():
+    # a scale near fp16 max forces inf in the scaled backward -> the step
+    # must be SKIPPED (params unchanged) and the scale halved
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16)
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
+                        lr=1e-3, warmup_steps=2, total_steps=20, log_every=100)
+    tr = Trainer(LlamaLMHeadModel(cfg), tc)
+    tr._scaler = GradScaler(init_scale=2.0 ** 40)  # absurd: guaranteed inf
+    tr.build()
+    p_before = jax.tree.map(np.asarray, tr.params)
+    m = tr.train_step(_batch())
+    assert float(m["amp_skipped"]) == 1.0
+    assert float(m["loss_scale"]) == 2.0 ** 39     # backed off by 0.5
+    for a, b in zip(jax.tree.leaves(p_before), jax.tree.leaves(tr.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    # step counter must not advance on a skipped step
+    assert int(tr.opt_state["step"]) == 0
+    # keep stepping: scale keeps halving until the update lands
+    for _ in range(30):
+        m = tr.train_step(_batch())
+        if float(m["amp_skipped"]) == 0.0:
+            break
+    assert float(m["amp_skipped"]) == 0.0
+    assert int(tr.opt_state["step"]) == 1
+
+
+def test_scale_grows_on_finite_streak():
+    s = GradScaler(init_scale=2.0 ** 10, growth_interval=3)
+    st = s.init()
+    for _ in range(3):
+        st = s.update(st, jnp.asarray(True))
+    assert float(st["scale"]) == 2.0 ** 11
+    assert int(st["growth_tracker"]) == 0
+
+
+def test_fp16_with_1f1b_rejected():
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16,
+                           num_hidden_layers=2)
+    from hetu_tpu.core.mesh import MeshConfig
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
+                        pp_schedule="1f1b")
+    with pytest.raises(NotImplementedError):
+        Trainer(LlamaLMHeadModel(cfg, st), tc, st)

@@ -1,9 +1,11 @@
 """Datasets (reference: python/hetu/data/dataset.py JsonDataset +
 tokenizer stack data/tokenizers/).
 
-Tokenizers: any object with an `encode(str) -> list[int]` method works —
-HF transformers tokenizers (baked into the image) satisfy this, mirroring the
-reference's HF/SentencePiece/tiktoken wrappers.
+Tokenizers: any object with an `encode(str) -> list[int]` method works.
+The in-tree stack is hetu_tpu.data.tokenizers (ByteLevelBPETokenizer —
+dependency-free train/save/load, GPT-2 file format — plus the explicit
+HFTokenizer delegate), mirroring the reference's vendored
+GPT2/SentencePiece/tiktoken/HF wrappers.
 """
 from __future__ import annotations
 

@@ -1,0 +1,8 @@
+"""In-tree tokenizer stack (reference: python/hetu/data/tokenizers/ — the
+reference vendors GPT2-BPE, SentencePiece, tiktoken and an HF wrapper; this
+package vendors a self-contained byte-level BPE (train/save/load, no
+downloads) plus a thin HF delegate for pretrained vocabularies)."""
+from hetu_tpu.data.tokenizers.bpe import ByteLevelBPETokenizer
+from hetu_tpu.data.tokenizers.hf import HFTokenizer, build_tokenizer
+
+__all__ = ["ByteLevelBPETokenizer", "HFTokenizer", "build_tokenizer"]

@@ -357,10 +357,22 @@ class Trainer:
         assert self._ckpt is not None, "no ckpt_dir configured"
         if self.params is None:
             self.build()
-        restored = self._ckpt.restore(step, target=self.state())
+        target = self.state()
+        try:
+            restored = self._ckpt.restore(step, target=target)
+        except ValueError:
+            # scaler presence differs between the checkpoint and the current
+            # config (bf16-saved -> fp16 resume or vice versa): retry with
+            # the presence toggled; a missing scaler keeps its fresh init
+            if "scaler" in target:
+                target = {k: v for k, v in target.items() if k != "scaler"}
+            else:
+                from hetu_tpu.optim.grad_scaler import GradScaler
+                target = dict(target, scaler=GradScaler().init())
+            restored = self._ckpt.restore(step, target=target)
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
         self.global_step = int(restored["step"])
-        if "scaler" in restored:
+        if "scaler" in restored and self._scaler is not None:
             self.scaler_state = restored["scaler"]
         return self

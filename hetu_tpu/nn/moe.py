@@ -280,11 +280,12 @@ class MoELayer(Module):
         # regroup is data-movement-free under the activation sharding
         xg = x.reshape(db, b // db, cs, s // cs, h)
         xg = xg.transpose(0, 2, 1, 3, 4).reshape(G, Tg, h)
-        if token_ids is not None:
-            ig = token_ids.reshape(db, b // db, cs, s // cs)
-            ig = ig.transpose(0, 2, 1, 3).reshape(G, Tg)
-        else:
-            ig = jnp.tile(jnp.arange(Tg, dtype=jnp.int32)[None], (G, 1))
+        if token_ids is None:
+            # hash-gate default ids are the GLOBAL flat index (the dense
+            # path's convention) — group-local arange would re-route tokens
+            token_ids = jnp.arange(b * s, dtype=jnp.int32).reshape(b, s)
+        ig = token_ids.reshape(db, b // db, cs, s // cs)
+        ig = ig.transpose(0, 2, 1, 3).reshape(G, Tg)
         group_axes = tuple(a for a, n in (("dp", db), ("cp", cs)) if n > 1)
         if group_axes:
             xg = DS.make(3, {0: group_axes}).constrain(xg)

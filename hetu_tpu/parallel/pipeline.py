@@ -80,9 +80,11 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         body = jax.checkpoint(stage_body, policy=_policy(remat_policy))
     extra_axes = (0,) if stage_mask is not None else ()
     if hetero_exec:
-        vbody = _shard_map_stage_body(body, mesh, pp_axis, spec, tok_spec,
-                                      token_data, has_mask=stage_mask
-                                      is not None)
+        # note: only the stage-dim (pp) layout is named in the shard_map
+        # specs — the dp/cp/tp parts of state_spec stay AUTO axes and are
+        # honored by the body's own sharding constraints
+        vbody = _shard_map_stage_body(body, mesh, pp_axis, token_data,
+                                      has_mask=stage_mask is not None)
     else:
         vbody = jax.vmap(body, in_axes=(0, 0, 0) + extra_axes,
                          spmd_axis_name=pp_axis)
@@ -139,8 +141,8 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     return outs.reshape(B, s, h), jnp.sum(auxs)
 
 
-def _shard_map_stage_body(body, mesh, pp_axis: str, spec, tok_spec,
-                          token_data: Dict, has_mask: bool):
+def _shard_map_stage_body(body, mesh, pp_axis: str, token_data: Dict,
+                          has_mask: bool):
     """Wrap a per-stage body in `jax.shard_map` manual over ONLY the pp axis.
 
     Every other mesh axis (dp/cp/tp/...) stays automatic, so the body's own

@@ -76,6 +76,27 @@ def test_scale_grows_on_finite_streak():
     assert int(st["growth_tracker"]) == 0
 
 
+def test_bf16_checkpoint_resumes_into_fp16_trainer(tmp_path):
+    # scaler presence differs between save and resume configs: restore must
+    # toggle rather than raise (code-review finding on orbax strictness)
+    cfg_b = LlamaConfig.tiny(remat=False)
+    tc_b = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
+                          total_steps=10, ckpt_dir=str(tmp_path))
+    tr_b = Trainer(LlamaLMHeadModel(cfg_b), tc_b).build()
+    tr_b.train_step(_batch())
+    tr_b.save(wait=True)
+
+    cfg_f = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16)
+    tc_f = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
+                          total_steps=10, ckpt_dir=str(tmp_path))
+    tr_f = Trainer(LlamaLMHeadModel(cfg_f), tc_f).build()
+    tr_f.restore()
+    assert tr_f.global_step == 1
+    assert tr_f.scaler_state is not None      # fresh init survives
+    m = tr_f.train_step(_batch())
+    assert np.isfinite(float(m["loss"]))
+
+
 def test_fp16_with_1f1b_rejected():
     cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16,
                            num_hidden_layers=2)

@@ -111,6 +111,28 @@ def test_grouped_routing_is_shard_local():
                                rtol=1e-5, atol=1e-6)
 
 
+def test_grouped_hash_gate_uses_global_token_index():
+    # regression (code review): with token_ids=None the grouped sort path
+    # must hash the GLOBAL flat index like the dense path, not a per-group
+    # arange — with ample capacity grouped sort == global dense exactly
+    rng = np.random.default_rng(7)
+    h, E = 8, 4
+    x = jnp.asarray(rng.normal(size=(4, 16, h)), jnp.float32)
+    moe_s = MoEConfig(num_experts=E, gate="hash", capacity_factor=8.0)
+    moe_d = MoEConfig(num_experts=E, gate="hash", capacity_factor=8.0,
+                      dispatch="dense")
+    st_g = ParallelStrategy(mesh=MeshConfig(dp=2))
+    ls = MoELayer(h, 16, moe_s, st_g)
+    ld = MoELayer(h, 16, moe_d, ParallelStrategy())
+    mesh = st_g.build_mesh()
+    with ht.use_mesh(mesh):
+        p = ls.init(jax.random.key(3), mesh=mesh)
+        ys, _ = jax.jit(lambda p_, x_: ls(p_, x_))(p, x)
+    yd, _ = ld(p, x)
+    np.testing.assert_allclose(np.asarray(ys), np.asarray(yd),
+                               rtol=1e-4, atol=1e-5)
+
+
 def test_balance_gate_spreads_load():
     # adversarial logits that all prefer expert 0: balance must spread
     rng = np.random.default_rng(5)

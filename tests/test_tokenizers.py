@@ -1,0 +1,70 @@
+"""In-tree tokenizer tests (reference: python/hetu/data/tokenizers/ — the
+vendored GPT2-BPE stack; here train/save/load/encode/decode run with no
+downloads and no external tokenizer runtime)."""
+import pytest
+
+from hetu_tpu.data.tokenizers import ByteLevelBPETokenizer, build_tokenizer
+
+CORPUS = [
+    "the quick brown fox jumps over the lazy dog",
+    "the quick brown fox is quick and the dog is lazy",
+    "pack my box with five dozen liquor jugs",
+    "how vexingly quick daft zebras jump!",
+    "sphinx of black quartz, judge my vow",
+] * 4
+
+
+def test_train_roundtrip():
+    tok = ByteLevelBPETokenizer.train(CORPUS, vocab_size=400)
+    for text in CORPUS[:5] + ["unseen words survive byte fallback éø"]:
+        ids = tok.encode(text)
+        assert all(isinstance(i, int) for i in ids)
+        assert tok.decode(ids) == text
+
+
+def test_merges_compress():
+    tok = ByteLevelBPETokenizer.train(CORPUS, vocab_size=400)
+    text = "the quick brown fox"
+    n_bpe = len(tok.encode(text))
+    n_bytes = len(text.encode("utf-8"))
+    assert n_bpe < n_bytes  # learned merges actually merge
+    # a frequent corpus word ends up in far fewer units than its bytes
+    assert len(tok.encode("quick")) < len("quick")
+
+
+def test_byte_fallback_never_unk():
+    # any utf-8 text must encode (byte-level: no <unk> possible)
+    tok = ByteLevelBPETokenizer.train(["abc"], vocab_size=300)
+    weird = "日本語 \U0001f600 \x00\x7f"
+    assert tok.decode(tok.encode(weird)) == weird
+
+
+def test_save_load_gpt2_format(tmp_path):
+    tok = ByteLevelBPETokenizer.train(CORPUS, vocab_size=400)
+    d = str(tmp_path / "tok")
+    tok.save(d)
+    assert (tmp_path / "tok" / "vocab.json").exists()
+    assert (tmp_path / "tok" / "merges.txt").exists()
+    tok2 = ByteLevelBPETokenizer.load(d)
+    text = "the quick brown fox"
+    assert tok2.encode(text) == tok.encode(text)
+    assert tok2.vocab_size == tok.vocab_size
+
+    tok3 = build_tokenizer("bpe", d)
+    assert tok3.encode(text) == tok.encode(text)
+
+
+def test_special_tokens_have_ids_and_are_skipped_on_decode():
+    tok = ByteLevelBPETokenizer.train(CORPUS, vocab_size=350,
+                                      special_tokens=("<|endoftext|>",))
+    eot = tok.token_to_id("<|endoftext|>")
+    assert eot is not None
+    ids = tok.encode("hello") + [eot]
+    assert tok.decode(ids) == "hello"
+
+
+def test_build_tokenizer_validates():
+    with pytest.raises(ValueError):
+        build_tokenizer("nope")
+    with pytest.raises(ValueError):
+        build_tokenizer("bpe")

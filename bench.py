@@ -135,6 +135,19 @@ def main():
             try:
                 from hetu_tpu.search.profiler import profile_hardware
                 prof = profile_hardware(measure=True)
+                try:
+                    # activation units from XLA's compiled-memory analysis —
+                    # the cost model's calibration input (search.calibrate)
+                    from hetu_tpu.search.calibrate import \
+                        measure_activation_units
+                    units = measure_activation_units()
+                    if units:
+                        prof.measured.update(
+                            act_boundary_units=units["boundary_units"],
+                            act_full_units=units["full_units"])
+                except Exception as e:
+                    print(f"# activation calibration failed: {e!r}",
+                          file=sys.stderr)
                 prof.save("hardware_profile_%s.json" % prof.chip)
                 print(f"# hardware profile saved: hardware_profile_"
                       f"{prof.chip}.json {prof.measured}", file=sys.stderr)
@@ -143,7 +156,7 @@ def main():
 
         t = threading.Thread(target=_profile, daemon=True)
         t.start()
-        t.join(300.0)
+        t.join(480.0)
 
 
 if __name__ == "__main__":

@@ -49,10 +49,6 @@ def _word_to_units(word: str) -> Tuple[str, ...]:
     return tuple(_B2U[b] for b in word.encode("utf-8"))
 
 
-def _pairs(units: Sequence[str]):
-    return set(zip(units[:-1], units[1:]))
-
-
 class ByteLevelBPETokenizer:
     """encode/decode with learned merges.
 
@@ -118,36 +114,71 @@ class ByteLevelBPETokenizer:
               special_tokens: Sequence[str] = ("<|endoftext|>",)
               ) -> "ByteLevelBPETokenizer":
         """Classic BPE: start from the 256 byte units, repeatedly merge the
-        most frequent adjacent pair until vocab_size."""
+        most frequent adjacent pair until vocab_size.
+
+        Incremental: pair counts are adjusted only where a merge touches
+        (with a pair->words index and a lazy max-heap for the argmax), so a
+        merge costs O(occurrences), not O(corpus) — real vocab sizes train
+        in seconds, not hours."""
+        import heapq
+
         word_freq: Counter = Counter()
         for t in texts:
             word_freq.update(_PAT.findall(t))
         words = {w: list(_word_to_units(w)) for w in word_freq}
 
+        pair_freq: Counter = Counter()
+        pair_words: Dict[Tuple[str, str], set] = {}
+        for w, units in words.items():
+            f = word_freq[w]
+            for p in zip(units[:-1], units[1:]):
+                pair_freq[p] += f
+                pair_words.setdefault(p, set()).add(w)
+
+        heap = [(-c, p) for p, c in pair_freq.items()]
+        heapq.heapify(heap)
+
+        def bump(p, delta, w):
+            pair_freq[p] += delta
+            if delta > 0:
+                pair_words.setdefault(p, set()).add(w)
+                heapq.heappush(heap, (-pair_freq[p], p))
+
         vocab: Dict[str, int] = {u: i for i, u in
                                  enumerate(sorted(_B2U.values()))}
         merges: List[Tuple[str, str]] = []
         target = vocab_size - len(special_tokens)
-        while len(vocab) < target:
-            pair_freq: Counter = Counter()
-            for w, units in words.items():
+        while len(vocab) < target and heap:
+            # lazy heap: pop until the entry matches the live count
+            neg, pair = heapq.heappop(heap)
+            cnt = pair_freq.get(pair, 0)
+            if -neg != cnt:
+                if cnt > 0:
+                    heapq.heappush(heap, (-cnt, pair))
+                continue
+            if cnt < 2:
+                break
+            a, b = pair
+            ab = a + b
+            merges.append(pair)
+            vocab[ab] = len(vocab)
+            # apply the merge only where it occurs, adjusting neighbors
+            for w in pair_words.pop(pair, ()):
+                units = words[w]
                 f = word_freq[w]
-                for p in zip(units[:-1], units[1:]):
-                    pair_freq[p] += f
-            if not pair_freq:
-                break
-            (a, b), f = pair_freq.most_common(1)[0]
-            if f < 2:
-                break
-            merges.append((a, b))
-            vocab[a + b] = len(vocab)
-            for w, units in words.items():
                 i = 0
                 while i < len(units) - 1:
-                    if units[i] == a and units[i + 1] == b:
-                        units[i:i + 2] = [a + b]
-                    else:
+                    if units[i] != a or units[i + 1] != b:
                         i += 1
+                        continue
+                    if i > 0:
+                        bump((units[i - 1], a), -f, w)
+                        bump((units[i - 1], ab), f, w)
+                    if i + 2 < len(units):
+                        bump((b, units[i + 2]), -f, w)
+                        bump((ab, units[i + 2]), f, w)
+                    units[i:i + 2] = [ab]
+            del pair_freq[pair]
         return cls(vocab, merges, special_tokens)
 
     # -- GPT-2 file format --------------------------------------------------
@@ -174,6 +205,6 @@ class ByteLevelBPETokenizer:
                     continue
                 a, b = line.split(" ")
                 merges.append((a, b))
-        keep = [t for t in special_tokens if t in vocab] or [
-            t for t in special_tokens]
-        return cls(vocab, merges, keep)
+        # pass requested specials through unchanged: __init__ appends any
+        # that are missing from the vocab and keeps existing ids for the rest
+        return cls(vocab, merges, list(special_tokens))

@@ -31,8 +31,19 @@ class AmpelosPlanner:
     num_layers: int
     tp_candidates: Sequence[int] = (1, 2, 4, 8)
     n_micro: Optional[int] = None
-    tp_efficiency: float = 0.85   # per-doubling scaling efficiency of TP
-                                  # (collective overhead; cost-model knob)
+    tp_efficiency: float = 0.85   # per-doubling scaling efficiency of TP;
+                                  # default is coarse — calibrate it from the
+                                  # hardware profile via `from_cost_model`
+                                  # (search.calibrate.tp_efficiency_from_cost)
+
+    @staticmethod
+    def from_cost_model(num_layers: int, cost, **kw) -> "AmpelosPlanner":
+        """tp_efficiency derived from the (measured) compute/ICI numbers in
+        the CostModel's HardwareProfile instead of the hardcoded default."""
+        from hetu_tpu.search.calibrate import tp_efficiency_from_cost
+        return AmpelosPlanner(num_layers=num_layers,
+                              tp_efficiency=tp_efficiency_from_cost(cost),
+                              **kw)
 
     def _score(self, cfg: Dict, tp: int) -> float:
         """Pipeline-limited relative step time: a layer's compute is split

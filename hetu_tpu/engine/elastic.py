@@ -35,14 +35,34 @@ class ElasticController:
 
     def __init__(self, client: CoordinationClient,
                  trainer_factory: Callable[[Dict], object],
-                 planner_fn: Callable[[list], Dict]):
+                 planner_fn: Callable[[list], Dict],
+                 expected_world: Optional[int] = None,
+                 rendezvous_timeout: float = 300.0):
         # checkpoint cadence belongs to TrainingConfig.ckpt_every; the
         # controller only saves at stop/exit boundaries
         self.client = client
         self.trainer_factory = trainer_factory
         self.planner_fn = planner_fn
+        self.expected_world = expected_world
+        self.rendezvous_timeout = rendezvous_timeout
         self.generation = 0
         self.trainer = None
+
+    def _startup_rendezvous(self):
+        """Wait for the full expected membership before the FIRST plan —
+        without this the earliest worker plans for a partial cluster and the
+        late joiners deadlock on a consumed vote round (reference: the
+        elastic server knows the launch world size up front)."""
+        if not self.expected_world:
+            return
+        deadline = time.time() + self.rendezvous_timeout
+        while len(self.client.membership()) < self.expected_world:
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"rendezvous: {len(self.client.membership())}/"
+                    f"{self.expected_world} workers after "
+                    f"{self.rendezvous_timeout}s")
+            time.sleep(0.2)
 
     # ------------------------------------------------------------------
     def _replan(self) -> Dict:
@@ -66,6 +86,9 @@ class ElasticController:
         logger.info(f"[gen {self.generation}] rebuilding with strategy "
                     f"{plan.get('strategy')}")
         self.trainer = self.trainer_factory(plan)
+        if getattr(self.trainer, "params", None) is None and \
+                hasattr(self.trainer, "build"):
+            self.trainer.build()   # accept unbuilt trainers from the factory
         if getattr(self.trainer, "_ckpt", None) is not None:
             try:
                 self.trainer.restore()
@@ -84,6 +107,7 @@ class ElasticController:
     def run(self, batches, num_steps: int) -> object:
         """The elastic loop (reference: workers re-entering Trainer after
         WorkerStop).  Returns the final trainer."""
+        self._startup_rendezvous()
         self._rebuild()
         it = iter(batches)
         steps_done = self.trainer.global_step

@@ -1,0 +1,195 @@
+"""Elastic cluster launcher: spawn, monitor and relaunch worker processes.
+
+Rebuild of the reference's launch tooling (reference: python/hetu/rpc/
+pssh_start.py — parallel-ssh worker start with env plumbed through,
+pssh_start_elastic.py — relaunch loop, heturpc_elastic_server.py:497 node
+re-detection + worker restart).  TPU-single-host realization: workers are
+local subprocesses (multi-host launch is this launcher invoked per host by
+the operator's scheduler — on TPU pods that is usually the platform's own
+pod runtime, so ssh fan-out stays out of scope by design); the coordination
+server (hetu_tpu.rpc.server) does heartbeat death detection and stop-flag
+broadcast, and THIS launcher owns the process lifecycle: spawn, reap,
+restart-with-backoff, kill (failure injection for elastic tests).
+
+Worker contract (env):
+  HETU_TPU_COORD      host:port of the coordination server
+  HETU_TPU_WORKER_ID  stable launcher slot id (0..n-1; a relaunched worker
+                      keeps its slot but gets a FRESH coordination rank —
+                      the server's split-brain guard demands it)
+  HETU_TPU_NUM_WORKERS
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from hetu_tpu.rpc.server import CoordinationServer
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("launcher")
+
+
+class WorkerProc:
+    """One launcher slot: the current process + restart accounting."""
+
+    def __init__(self, worker_id: int, popen: subprocess.Popen):
+        self.worker_id = worker_id
+        self.popen = popen
+        self.restarts = 0
+        self.exit_code: Optional[int] = None
+        self.killed_by_launcher = False
+
+
+class ElasticLauncher:
+    """pssh_start_elastic analog (local processes instead of pssh)."""
+
+    def __init__(self, worker_cmd: Sequence[str], num_workers: int,
+                 env: Optional[Dict[str, str]] = None,
+                 server: Optional[CoordinationServer] = None,
+                 host: str = "127.0.0.1", port: int = 0,
+                 max_restarts: int = 0, restart_backoff: float = 1.0,
+                 heartbeat_timeout: float = 10.0,
+                 log_dir: Optional[str] = None):
+        self.worker_cmd = list(worker_cmd)
+        self.num_workers = num_workers
+        self.extra_env = dict(env or {})
+        self.max_restarts = max_restarts
+        self.restart_backoff = restart_backoff
+        self.log_dir = log_dir
+        self._owns_server = server is None
+        self.server = server or CoordinationServer(
+            host=host, port=port, heartbeat_timeout=heartbeat_timeout)
+        self.workers: Dict[int, WorkerProc] = {}
+        self._log_files: List = []
+
+    # ------------------------------------------------------------------
+    @property
+    def coord_address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    def _spawn(self, worker_id: int, restarts: int = 0) -> WorkerProc:
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        env["HETU_TPU_COORD"] = self.coord_address
+        env["HETU_TPU_WORKER_ID"] = str(worker_id)
+        env["HETU_TPU_NUM_WORKERS"] = str(self.num_workers)
+        stdout = stderr = None
+        if self.log_dir:
+            os.makedirs(self.log_dir, exist_ok=True)
+            f = open(os.path.join(
+                self.log_dir, f"worker{worker_id}.log"), "ab")
+            self._log_files.append(f)
+            stdout = stderr = f
+        popen = subprocess.Popen(self.worker_cmd, env=env,
+                                 stdout=stdout, stderr=stderr)
+        wp = WorkerProc(worker_id, popen)
+        wp.restarts = restarts
+        logger.info(f"spawned worker {worker_id} pid={popen.pid}"
+                    + (f" (restart #{restarts})" if restarts else ""))
+        return wp
+
+    def start(self) -> "ElasticLauncher":
+        for i in range(self.num_workers):
+            self.workers[i] = self._spawn(i)
+        return self
+
+    # ------------------------------------------------------------------
+    def poll(self) -> Dict[int, Optional[int]]:
+        """Reap exits; relaunch eligible crashed workers (reference:
+        pssh_start_elastic relaunch loop).  Returns worker_id -> exit code
+        (None = still running)."""
+        out: Dict[int, Optional[int]] = {}
+        for wid, wp in list(self.workers.items()):
+            rc = wp.popen.poll()
+            if rc is None:
+                out[wid] = None
+                continue
+            if wp.exit_code is None:
+                wp.exit_code = rc
+                logger.info(f"worker {wid} exited rc={rc}")
+                if (rc != 0 and not wp.killed_by_launcher
+                        and wp.restarts < self.max_restarts):
+                    time.sleep(self.restart_backoff)
+                    self.workers[wid] = self._spawn(wid, wp.restarts + 1)
+                    out[wid] = None
+                    continue
+            out[wid] = rc
+        return out
+
+    def kill(self, worker_id: int, sig: int = signal.SIGKILL,
+             relaunch: bool = False):
+        """Failure injection: kill a worker (reference: the Malleus/elastic
+        experiments kill ranks mid-run).  relaunch=False marks the kill as
+        launcher-intended so poll() does not restart it."""
+        wp = self.workers[worker_id]
+        wp.killed_by_launcher = not relaunch
+        try:
+            wp.popen.send_signal(sig)
+        except ProcessLookupError:
+            pass
+
+    def wait(self, timeout: float = 300.0,
+             poll_interval: float = 0.5) -> Dict[int, int]:
+        """Until every slot has exited (post-relaunch). Returns exit codes."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            codes = self.poll()
+            if all(c is not None for c in codes.values()):
+                return {k: int(v) for k, v in codes.items()}
+            time.sleep(poll_interval)
+        raise TimeoutError(
+            f"workers still running at timeout: "
+            f"{[k for k, v in self.poll().items() if v is None]}")
+
+    def shutdown(self):
+        for wp in self.workers.values():
+            if wp.popen.poll() is None:
+                wp.killed_by_launcher = True
+                wp.popen.terminate()
+        deadline = time.time() + 5
+        for wp in self.workers.values():
+            while wp.popen.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if wp.popen.poll() is None:
+                wp.popen.kill()
+        for f in self._log_files:
+            try:
+                f.close()
+            except OSError:
+                pass
+        if self._owns_server:
+            self.server.close()
+
+
+def main(argv: Optional[Sequence[str]] = None):
+    """CLI: python -m hetu_tpu.rpc.launcher -n 4 [--max-restarts 1] --
+    python worker.py args...  (reference: pssh_start.py CLI)."""
+    import argparse
+    ap = argparse.ArgumentParser(prog="hetu_tpu.rpc.launcher")
+    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("--max-restarts", type=int, default=0)
+    ap.add_argument("--heartbeat-timeout", type=float, default=10.0)
+    ap.add_argument("--log-dir", default=None)
+    ap.add_argument("cmd", nargs=argparse.REMAINDER,
+                    help="worker command (prefix with --)")
+    args = ap.parse_args(argv)
+    cmd = args.cmd[1:] if args.cmd and args.cmd[0] == "--" else args.cmd
+    if not cmd:
+        ap.error("missing worker command")
+    launcher = ElasticLauncher(
+        cmd, args.num_workers, max_restarts=args.max_restarts,
+        heartbeat_timeout=args.heartbeat_timeout, log_dir=args.log_dir)
+    launcher.start()
+    try:
+        codes = launcher.wait(timeout=10 ** 9)
+    finally:
+        launcher.shutdown()
+    sys.exit(max(codes.values()))
+
+
+if __name__ == "__main__":
+    main()

@@ -109,43 +109,64 @@ class CoordinationServer:
                 for rank, info in list(self._workers.items()):
                     if info.get("alive") and \
                             now - info["last_beat"] > self.heartbeat_timeout:
-                        info["alive"] = False
-                        logger.warning(f"worker {rank} lost (heartbeat "
-                                       f"timeout); signaling stop to "
-                                       f"survivors")
-                        self._kv["__membership_change__"] = now
                         # stop BOTH the dead worker (if it resurrects, it must
                         # not rejoin the old mesh — split-brain guard) and the
                         # survivors so they can re-mesh
                         # (reference: WorkerStop broadcast on worker loss)
-                        self._stop_flags.add(rank)
-                        for r, w in self._workers.items():
-                            if w.get("alive"):
-                                self._stop_flags.add(r)
+                        self._mark_lost_locked(rank, "heartbeat timeout")
 
     # ------------------------------------------------------------------
     def _serve_conn(self, conn: socket.socket):
-        with conn:
-            while not self._shutdown:
-                try:
-                    req = _recv(conn)
-                except OSError as e:
-                    logger.debug(f"conn recv error: {e}")
-                    return
-                if req is None:
-                    return
-                try:
-                    resp = self._handle(req)
-                except Exception as e:  # never kill the server on bad input
-                    logger.warning(f"handler error for {req.get('op')}: {e!r}")
-                    resp = {"ok": False, "error": str(e)}
-                try:
-                    _send(conn, resp)
-                except OSError as e:
-                    logger.warning(f"conn send error: {e}")
-                    return
+        # each client holds ONE persistent socket, so a broken connection IS
+        # process death — detect it instantly instead of waiting out the
+        # heartbeat timeout (which can false-positive when a worker's GIL is
+        # pinned inside a long XLA compile).  Heartbeats stay as the backstop
+        # for network partitions (reference: gRPC channel-break detection).
+        state = {"rank": None, "clean": False}
+        try:
+            with conn:
+                while not self._shutdown:
+                    try:
+                        req = _recv(conn)
+                    except OSError as e:
+                        logger.debug(f"conn recv error: {e}")
+                        return
+                    if req is None:
+                        return
+                    try:
+                        resp = self._handle(req, state)
+                    except Exception as e:  # never die on bad input
+                        logger.warning(
+                            f"handler error for {req.get('op')}: {e!r}")
+                        resp = {"ok": False, "error": str(e)}
+                    try:
+                        _send(conn, resp)
+                    except OSError as e:
+                        logger.warning(f"conn send error: {e}")
+                        return
+        finally:
+            if state["rank"] is not None and not state["clean"]:
+                self._mark_lost(state["rank"], why="connection lost")
 
-    def _handle(self, req: Dict[str, Any]) -> Dict[str, Any]:
+    def _mark_lost(self, rank: int, why: str):
+        with self._lock:
+            self._mark_lost_locked(rank, why)
+
+    def _mark_lost_locked(self, rank: int, why: str):
+        info = self._workers.get(rank)
+        if info is None or not info.get("alive"):
+            return
+        info["alive"] = False
+        logger.warning(f"worker {rank} lost ({why}); signaling stop "
+                       "to survivors")
+        self._kv["__membership_change__"] = time.time()
+        self._stop_flags.add(rank)
+        for r, w in self._workers.items():
+            if w.get("alive"):
+                self._stop_flags.add(r)
+
+    def _handle(self, req: Dict[str, Any],
+                conn_state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         op = req.get("op")
         with self._lock:
             if op == "connect":        # Connect + GetRank
@@ -154,6 +175,8 @@ class CoordinationServer:
                 self._workers[rank] = {
                     "info": req.get("info", {}), "alive": True,
                     "last_beat": time.time()}
+                if conn_state is not None:
+                    conn_state["rank"] = rank
                 return {"ok": True, "rank": rank,
                         "world_size": self.world_size}
             if op == "heartbeat":      # HeartBeat
@@ -238,6 +261,8 @@ class CoordinationServer:
                 rank = req["rank"]
                 if rank in self._workers:
                     self._workers[rank]["alive"] = False
+                if conn_state is not None:
+                    conn_state["clean"] = True
                 return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
 

@@ -60,6 +60,20 @@ class CostModel:
     global_batch: int
     seq_len: int
     mxu_efficiency: float = 0.5   # fraction of peak the model sustains
+    # activation footprint in `act units` (1 unit = one [b, s, h] bf16
+    # boundary buffer).  Defaults are coarse; hetu_tpu.search.calibrate
+    # replaces them with XLA's compiled-memory analysis of the real block
+    act_boundary_units: float = 1.0
+    act_full_units: float = 12.0
+
+    def __post_init__(self):
+        # a saved hardware profile (bench.py writes act_* keys from the
+        # compiled-memory analysis) calibrates the activation model on load
+        m = self.hw.measured
+        if "act_boundary_units" in m:
+            self.act_boundary_units = float(m["act_boundary_units"])
+        if "act_full_units" in m:
+            self.act_full_units = float(m["act_full_units"])
 
     def _allreduce_gbps(self, axis: str, size: int) -> float:
         """Measured per-axis allreduce bus bandwidth when the profiler
@@ -128,9 +142,9 @@ class CostModel:
         if c.sequence_parallel and c.tp > 1:
             act_per_layer /= c.tp
         if c.remat:
-            acts = act_per_layer * layers_local  # boundaries only
+            acts = act_per_layer * layers_local * self.act_boundary_units
         else:
-            acts = act_per_layer * layers_local * 12  # rough multiplier
+            acts = act_per_layer * layers_local * self.act_full_units
         if c.pp > 1:
             acts *= min(c.n_micro, c.pp)  # in-flight micros
         logits = b_local * seq_local * self.vocab * 4 / max(c.tp, 1)

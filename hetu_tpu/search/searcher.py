@@ -78,9 +78,11 @@ def choose_recompute_layers(cost: CostModel, c: StrategyCandidate,
     layer_flops_t = (cost._flops_per_token() / cost.num_layers *
                      cost.global_batch * cost.seq_len /
                      (c.num_devices * cost.hw.bf16_tflops * 1e12 * 0.5))
-    # memory quantized in act_units
+    # memory quantized in act_units — calibrated from XLA's compiled-memory
+    # analysis (hetu_tpu.search.calibrate), not a hardcoded guess
     time = [layer_flops_t * 4 / 3, layer_flops_t]
-    mem = [1, 13]  # boundary-only vs full activations (rough 12x + boundary)
+    mem = [max(1, round(cost.act_boundary_units)),
+           max(2, round(cost.act_boundary_units + cost.act_full_units))]
     trans = np.zeros((2, 2))
     budget = max(1, int(act_budget_bytes / act_unit))
     L = int(cost.num_layers // max(c.pp, 1))

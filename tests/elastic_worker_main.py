@@ -1,0 +1,116 @@
+"""Worker entry for the multi-process elastic integration test.
+
+Run by ElasticLauncher subprocesses (NOT collected by pytest): joins the
+coordination server from HETU_TPU_COORD, trains a tiny LLaMA through the
+ElasticController, and appends status records to a per-worker jsonl the
+test asserts on (generation count, resumed step, final step).
+
+The leader (min alive rank) owns the shared checkpoint dir; survivors
+re-plan when the server declares a worker dead and resume from the
+checkpoint (reference flow: pssh_start_elastic.py worker re-entry +
+heturpc_elastic_server WorkerStop broadcast)."""
+import json
+import os
+import sys
+import time
+
+
+def log_status(path, rec):
+    with open(path, "a") as f:
+        f.write(json.dumps(rec) + "\n")
+
+
+def main():
+    import jax
+    jax.config.update("jax_platforms", "cpu")
+    import numpy as np
+
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.engine.elastic import ElasticController
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.rpc.client import CoordinationClient
+    from hetu_tpu.data import pad_batch
+
+    host, port = os.environ["HETU_TPU_COORD"].split(":")
+    worker_id = int(os.environ["HETU_TPU_WORKER_ID"])
+    workdir = sys.argv[1]
+    num_steps = int(sys.argv[2])
+    status_path = os.path.join(workdir, f"status_w{worker_id}.jsonl")
+    ckpt_dir = os.path.join(workdir, "ckpt")
+
+    client = CoordinationClient(host, int(port), heartbeat_interval=0.3,
+                                info={"worker_id": worker_id})
+    log_status(status_path, {"event": "connected", "rank": client.rank})
+
+    cfg = LlamaConfig.tiny(remat=False)
+    rng = np.random.default_rng(0)
+    batch = pad_batch([rng.integers(1, 250, size=28) for _ in range(4)], 32)
+
+    def trainer_factory(plan):
+        # the current LEADER owns the shared checkpoint dir (the reference
+        # saves from rank 0); later generations' leaders restore from it
+        leader = min(client.membership())
+        tc = TrainingConfig(
+            global_batch_size=4, micro_batch_size=2, seq_len=32, lr=1e-3,
+            warmup_steps=2, total_steps=num_steps, log_every=1000,
+            ckpt_every=10 ** 9,   # controller saves at stop/exit boundaries
+            ckpt_dir=ckpt_dir if client.rank == leader else None)
+        tr = Trainer(LlamaLMHeadModel(cfg), tc)
+        log_status(status_path, {
+            "event": "build", "rank": client.rank, "leader": leader,
+            "alive": client.membership(), "plan": plan.get("strategy")})
+        return tr
+
+    def planner_fn(alive):
+        return {"strategy": {"dp": len(alive), "tp": 1, "pp": 1}}
+
+    ctl = ElasticController(
+        client, trainer_factory, planner_fn,
+        expected_world=int(os.environ.get("HETU_TPU_NUM_WORKERS", "0")))
+
+    class Batches:
+        def __iter__(self):
+            return self
+
+        def __next__(self):
+            time.sleep(0.05)      # pace steps so kills land mid-training
+            return batch
+
+    gen_log = []
+
+    orig_rebuild = ctl._rebuild
+
+    def rebuild_logged():
+        orig_rebuild()
+        gen_log.append(ctl.generation)
+        log_status(status_path, {
+            "event": "generation", "generation": ctl.generation,
+            "resumed_step": ctl.trainer.global_step})
+
+    ctl._rebuild = rebuild_logged
+
+    if len(sys.argv) > 3 and int(sys.argv[3]) == worker_id:
+        # self-terminating straggler variant (when the test asks for it)
+        steps_before_death = int(sys.argv[4])
+
+        class DyingBatches(Batches):
+            def __next__(self):
+                if (ctl.trainer is not None
+                        and ctl.trainer.global_step >= steps_before_death):
+                    log_status(status_path, {"event": "suicide",
+                                             "step": ctl.trainer.global_step})
+                    os._exit(17)
+                return super().__next__()
+
+        trainer = ctl.run(DyingBatches(), num_steps)
+    else:
+        trainer = ctl.run(Batches(), num_steps)
+
+    log_status(status_path, {
+        "event": "done", "rank": client.rank,
+        "final_step": trainer.global_step, "generations": gen_log})
+    client.exit()
+
+
+if __name__ == "__main__":
+    main()

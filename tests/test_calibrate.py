@@ -1,0 +1,95 @@
+"""Cost-model calibration tests (reference: Galvatron profiler->cost-model
+loop): activation units from XLA's compiled-memory analysis, TP efficiency
+from the hardware profile, predicted-vs-actual validation API."""
+import dataclasses
+
+import numpy as np
+import pytest
+
+from hetu_tpu.search.calibrate import (apply_activation_calibration,
+                                       measure_activation_units,
+                                       tp_efficiency_from_cost, validate)
+from hetu_tpu.search.cost_model import CostModel, StrategyCandidate
+from hetu_tpu.search.profiler import HardwareProfile
+
+
+def _cost(**kw):
+    d = dict(hw=HardwareProfile.preset("v5e"), num_layers=4, hidden=256,
+             intermediate=704, vocab=2048, num_params=8_000_000,
+             global_batch=4, seq_len=128)
+    d.update(kw)
+    return CostModel(**d)
+
+
+def test_measure_activation_units_from_xla():
+    units = measure_activation_units(hidden=128, intermediate=352, heads=4,
+                                     batch=2, seq=64, layers=2)
+    if units is None:
+        pytest.skip("backend exposes no compiled-memory analysis")
+    assert units["full_units"] > units["boundary_units"] > 0
+    # full activations are several boundary buffers per layer
+    assert units["full_units"] >= 2.0, units
+
+
+def test_apply_calibration_changes_memory_model():
+    cost = _cost()
+    before = cost.per_device_memory(StrategyCandidate(remat=False))
+    units = {"boundary_units": 2.0, "full_units": 20.0}
+    apply_activation_calibration(cost, units=units)
+    after = cost.per_device_memory(StrategyCandidate(remat=False))
+    assert cost.act_full_units == 20.0
+    assert after > before  # 20 units > default 12 units
+
+
+def test_tp_efficiency_is_physical():
+    cost = _cost()
+    eff = tp_efficiency_from_cost(cost)
+    assert 0.05 <= eff <= 1.0
+    # a much slower interconnect must lower the efficiency
+    slow_hw = dataclasses.replace(cost.hw, ici_allreduce_gbps=1.0)
+    slow = _cost(hw=slow_hw)
+    assert tp_efficiency_from_cost(slow) < eff
+
+
+def test_ampelos_from_cost_model():
+    from hetu_tpu.engine.ampelos import AmpelosPlanner
+    cost = _cost()
+    p = AmpelosPlanner.from_cost_model(8, cost)
+    assert 0.05 <= p.tp_efficiency <= 1.0
+    plan = p.plan([1.0, 1.0, 0.5, 1.0])
+    assert "stages" in plan
+
+
+def test_searcher_uses_calibrated_units():
+    from hetu_tpu.search.searcher import choose_recompute_layers
+    cost = _cost()
+    cost.act_boundary_units, cost.act_full_units = 1.0, 12.0
+    c = StrategyCandidate()
+    # generous budget -> no recompute anywhere; tiny budget -> all recompute
+    none_needed = choose_recompute_layers(cost, c, act_budget_bytes=1e12)
+    assert not any(none_needed)
+    all_needed = choose_recompute_layers(cost, c, act_budget_bytes=1e3)
+    assert all(all_needed)
+
+
+@pytest.mark.slow
+def test_validate_predicted_vs_actual_api():
+    """API-level check on CPU (the <=20% error criterion is a real-chip
+    property; here we only require sane, positive numbers)."""
+    import jax
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+
+    cost = _cost(global_batch=4, seq_len=64)
+
+    def builder(c):
+        cfg = LlamaConfig.tiny(remat=c.remat)
+        tc = TrainingConfig(global_batch_size=4, micro_batch_size=4,
+                            seq_len=64, total_steps=100, log_every=1000)
+        return Trainer(LlamaLMHeadModel(cfg), tc).build()
+
+    rows = validate(cost, [StrategyCandidate(remat=False)], builder, steps=2)
+    assert len(rows) == 1
+    assert rows[0]["actual_s"] > 0 and rows[0]["predicted_s"] > 0
+    assert "error" in rows[0]

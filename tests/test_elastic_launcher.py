@@ -1,0 +1,124 @@
+"""Multi-process elastic integration tests (reference: pssh_start_elastic.py
++ heturpc_elastic_server.py:497 — worker processes under a launcher, death
+detection, re-plan, checkpoint-resume continuity, relaunch)."""
+import json
+import os
+import signal
+import sys
+import time
+
+import pytest
+
+from hetu_tpu.rpc.launcher import ElasticLauncher
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_worker_main.py")
+
+
+def _read_status(workdir, wid):
+    path = os.path.join(workdir, f"status_w{wid}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _env():
+    env = dict(PYTHONPATH=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+@pytest.mark.slow
+def test_kill_midrun_survivors_replan_and_resume(tmp_path):
+    """Kill a non-leader mid-training: the server must detect the death,
+    stop-flag the survivors, and the leader must re-plan + resume from its
+    checkpoint with step continuity (BASELINE elastic criterion)."""
+    workdir = str(tmp_path)
+    num_steps = 150   # ~7.5s of paced steps — the kill lands mid-training
+    launcher = ElasticLauncher(
+        [sys.executable, WORKER, workdir, str(num_steps)],
+        num_workers=3, env=_env(), heartbeat_timeout=30.0,
+        log_dir=os.path.join(workdir, "logs"))
+    launcher.start()
+    try:
+        # wait for everyone to connect and make some progress
+        deadline = time.time() + 180
+        while time.time() < deadline:
+            if all(any(r["event"] == "generation"
+                       for r in _read_status(workdir, w)) for w in range(3)):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("workers never reached generation 1: " + repr(
+                {w: _read_status(workdir, w) for w in range(3)}))
+        time.sleep(3.0)   # let a few train steps land
+        # slot != coordination rank (assignment is connect-order); kill the
+        # MAX-rank slot so the checkpoint-owning leader (min rank) survives
+        slot_rank = {w: _read_status(workdir, w)[0]["rank"]
+                     for w in range(3)}
+        victim = max(slot_rank, key=slot_rank.get)
+        survivors = [w for w in range(3) if w != victim]
+        launcher.kill(victim, sig=signal.SIGKILL)
+
+        codes = launcher.wait(timeout=420)
+    finally:
+        launcher.shutdown()
+
+    # survivors exited clean; the killed worker did not
+    assert all(codes[w] == 0 for w in survivors), codes
+    assert codes[victim] != 0, codes
+
+    # both survivors re-planned into generation 2 with the shrunk membership
+    for w in survivors:
+        recs = _read_status(workdir, w)
+        gens = [r for r in recs if r["event"] == "generation"]
+        assert len(gens) >= 2, (w, recs)
+        builds = [r for r in recs if r["event"] == "build"]
+        assert len(builds[-1]["alive"]) == 2, builds[-1]
+        assert builds[-1]["plan"] == {"dp": 2, "tp": 1, "pp": 1}
+        done = [r for r in recs if r["event"] == "done"]
+        assert done and done[0]["final_step"] >= num_steps, (w, recs)
+
+    # the leader's post-kill generation RESUMED from checkpoint, not step 0
+    leader_slot = min((w for w in survivors), key=slot_rank.get)
+    recs_l = _read_status(workdir, leader_slot)
+    gen2 = [r for r in recs_l if r["event"] == "generation"][-1]
+    assert gen2["resumed_step"] > 0, recs_l
+
+
+@pytest.mark.slow
+def test_crashed_worker_is_relaunched(tmp_path):
+    """A worker that dies by itself gets relaunched by the launcher
+    (max_restarts) and rejoins with a FRESH coordination rank
+    (reference: pssh_start_elastic relaunch + split-brain guard)."""
+    workdir = str(tmp_path)
+    num_steps = 40
+    # worker 1 self-kills at step 5 (argv: die_worker_id, die_at_step)
+    launcher = ElasticLauncher(
+        [sys.executable, WORKER, workdir, str(num_steps), "1", "5"],
+        num_workers=2, env=_env(), heartbeat_timeout=30.0, max_restarts=1,
+        restart_backoff=0.5, log_dir=os.path.join(workdir, "logs"))
+    launcher.start()
+    try:
+        codes = launcher.wait(timeout=420)
+    finally:
+        launcher.shutdown()
+
+    recs1 = _read_status(workdir, 1)
+    assert any(r["event"] == "suicide" for r in recs1), recs1
+    # the relaunched incarnation reconnected (a later 'connected' record)
+    connects = [r for r in recs1 if r["event"] == "connected"]
+    assert len(connects) == 2, recs1
+    # fresh rank, not a zombie resume of the old one
+    assert connects[1]["rank"] != connects[0]["rank"], connects
+    # NOTE: the relaunched worker re-enters with die-step already passed?
+    # no — its fresh controller restarts and hits step>=5 again; it dies
+    # again but has exhausted max_restarts=1, so slot 1 ends nonzero while
+    # worker 0 finishes alone
+    assert codes[0] == 0, codes
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v"]))

@@ -39,6 +39,7 @@ class HotSwitchTrainer(Trainer):
         self.model_factory = model_factory
         self.strategies = list(strategies)
         self.active_id = 0
+        self.last_switch_profile = None
         self._handles: Dict[int, StrategyHandle] = {}
         self._steps: Dict[int, object] = {}
         model0 = model_factory(strategies[0])
@@ -80,6 +81,17 @@ class HotSwitchTrainer(Trainer):
                                "switching strategies")
         t0 = time.perf_counter()
         dst = self._handle(sid)
+        # byte accounting BEFORE the move (needs the live src shardings) —
+        # the reference's ProfileRunningDetails (switch_exec_graph.cc:1904)
+        from hetu_tpu.parallel.switch import profile_switch
+        try:
+            prof = profile_switch(
+                self.params, jax.tree.map(lambda x: x.sharding, self.params),
+                dst.param_shardings)
+        except Exception as e:
+            logger.warning(f"switch byte profiling failed: {e!r}")
+            prof = None
+        self.last_switch_profile = prof  # reset even on failure (no stale reads)
         switcher = StrategySwitcher(self._handles)
         self.params, new_state = switcher.switch(
             self.params, self.opt_state, sid, mode=mode)
@@ -107,11 +119,16 @@ class HotSwitchTrainer(Trainer):
                 self._step_fn = jax.jit(
                     self._train_step,
                     out_shardings=(dst.param_shardings, dst.state_shardings,
-                                   None),
+                                   None, None),
                     donate_argnums=(0, 1))
             self._steps[sid] = self._step_fn
+        detail = ""
+        if prof is not None:
+            prof.wall_s = time.perf_counter() - t0
+            self.last_switch_profile = prof
+            detail = f"; params {prof.describe()}"
         logger.info(f"hot-switch -> strategy {sid} ({dst.strategy.describe()}) "
-                    f"in {time.perf_counter() - t0:.3f}s")
+                    f"in {time.perf_counter() - t0:.3f}s{detail}")
         return self
 
     def build(self, rng=None):

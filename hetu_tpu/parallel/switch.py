@@ -26,6 +26,7 @@ import enum
 from typing import Any, Dict, Optional
 
 import jax
+import numpy as np
 
 from hetu_tpu.parallel.strategy import ParallelStrategy
 
@@ -41,6 +42,97 @@ def switch_tree(tree, new_shardings, donate: bool = True):
     executed by the runtime)."""
     return jax.tree.map(
         lambda x, s: jax.device_put(x, s, donate=donate), tree, new_shardings)
+
+
+# ----------------------------------------------------------------------
+# Switch profiling — the analog of SwitchExecGraph::ProfileRunningDetails
+# (reference: switch_exec_graph.cc:1904 — per-device send/recv bytes for
+# the ParamSlice program).  The comm program is compiler-planned here, so
+# instead of instrumenting it we compute the same numbers analytically
+# from the (src, dst) sharding index maps: each device must fetch exactly
+# the part of its destination slice it does not already hold.
+# ----------------------------------------------------------------------
+
+@dataclasses.dataclass
+class SwitchProfile:
+    """Byte accounting for one hot switch.  All tallies are recv-side and
+    aggregate over devices, so replication counts once per replica (the
+    reference's per-device recv tallies do the same):
+    total_bytes == moved_bytes + local_bytes == the destination layout's
+    aggregate memory footprint; logical_bytes is the tree payload counted
+    once."""
+    total_bytes: int = 0          # aggregate dst footprint over devices
+    logical_bytes: int = 0        # tree payload, each element counted once
+    moved_bytes: int = 0          # bytes crossing devices (recv side)
+    local_bytes: int = 0          # bytes already resident at the dst slice
+    per_device_recv: Dict[str, int] = dataclasses.field(default_factory=dict)
+    wall_s: float = 0.0
+
+    def describe(self) -> str:
+        frac = self.moved_bytes / self.total_bytes if self.total_bytes else 0.0
+        return (f"moved {self.moved_bytes / 1e6:.1f} MB of "
+                f"{self.total_bytes / 1e6:.1f} MB dst footprint ({frac:.0%}; "
+                f"payload {self.logical_bytes / 1e6:.1f} MB) "
+                f"in {self.wall_s:.3f}s")
+
+
+def _slice_volume(idx, shape) -> int:
+    vol = 1
+    for sl, n in zip(idx, shape):
+        start = 0 if sl.start is None else sl.start
+        stop = n if sl.stop is None else sl.stop
+        vol *= max(0, stop - start)
+    return vol
+
+
+def _overlap_volume(a, b, shape) -> int:
+    vol = 1
+    for sa, sb, n in zip(a, b, shape):
+        a0 = 0 if sa.start is None else sa.start
+        a1 = n if sa.stop is None else sa.stop
+        b0 = 0 if sb.start is None else sb.start
+        b1 = n if sb.stop is None else sb.stop
+        vol *= max(0, min(a1, b1) - max(a0, b0))
+        if vol == 0:
+            return 0
+    return vol
+
+
+def profile_switch(tree, old_shardings, new_shardings) -> SwitchProfile:
+    """Analytic bytes-moved accounting for resharding `tree` from
+    `old_shardings` to `new_shardings` (reference: ProfileRunningDetails'
+    send/recv byte tallies, switch_exec_graph.cc:1904).
+
+    For every leaf and every device d: recv bytes = |dst slice on d| minus
+    the overlap with the src slice d already holds.  The overlap rule is
+    exact for the slice lattice both engines use (rectangular sub-blocks).
+    """
+    prof = SwitchProfile()
+    leaves = jax.tree.leaves(tree)
+    olds = jax.tree.leaves(old_shardings)
+    news = jax.tree.leaves(new_shardings)
+    for x, os_, ns in zip(leaves, olds, news):
+        shape = tuple(x.shape)
+        nbytes = int(np.dtype(x.dtype).itemsize)
+        if not shape:                       # scalar: replication only
+            prof.logical_bytes += nbytes
+            continue
+        src_map = os_.devices_indices_map(shape)
+        dst_map = ns.devices_indices_map(shape)
+        prof.logical_bytes += int(np.prod(shape)) * nbytes
+        for dev, didx in dst_map.items():
+            want = _slice_volume(didx, shape)
+            sidx = src_map.get(dev)
+            have = _overlap_volume(didx, sidx, shape) if sidx is not None else 0
+            moved = (want - have) * nbytes
+            if moved:
+                key = str(dev.id)
+                prof.per_device_recv[key] = \
+                    prof.per_device_recv.get(key, 0) + moved
+            prof.total_bytes += want * nbytes
+            prof.moved_bytes += moved
+            prof.local_bytes += have * nbytes
+    return prof
 
 
 @dataclasses.dataclass

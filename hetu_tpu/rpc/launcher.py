@@ -188,7 +188,9 @@ def main(argv: Optional[Sequence[str]] = None):
         codes = launcher.wait(timeout=10 ** 9)
     finally:
         launcher.shutdown()
-    sys.exit(max(codes.values()))
+    # signal-killed workers report NEGATIVE return codes; any nonzero
+    # (either sign) must fail the launch
+    sys.exit(1 if any(c != 0 for c in codes.values()) else 0)
 
 
 if __name__ == "__main__":

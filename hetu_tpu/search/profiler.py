@@ -58,30 +58,51 @@ def _sync(x):
     return float(np.asarray(jax.tree.leaves(x)[0]).reshape(-1)[0])
 
 
+def _diff_time(f_full, f_half, iters: int):
+    """Differential timing: run the probe at two rep counts and use the
+    TIME DIFFERENCE, which cancels every constant cost (dispatch, remote-
+    tunnel round trip, host fetch) exactly — regardless of how much of it
+    overlaps device compute.  Plain subtraction of a measured scalar
+    round-trip is wrong in both directions here (round-2 captures: 73
+    TFLOP/s uncorrected, 209 > 197-peak fully-corrected); the two-point
+    scheme read 189-196 on the same chip.  Returns seconds per
+    work_diff_units of extra work."""
+    _sync(f_full()); _sync(f_half())        # compile both
+    t_full, t_half = [], []
+    for _ in range(iters):
+        t = time.perf_counter()
+        _sync(f_half())
+        t_half.append(time.perf_counter() - t)
+        t = time.perf_counter()
+        _sync(f_full())
+        t_full.append(time.perf_counter() - t)
+    dt = min(t_full) - min(t_half)
+    if dt <= 0.05 * min(t_full):
+        raise RuntimeError(
+            f"differential probe too noisy: t_full={min(t_full):.4f}s "
+            f"t_half={min(t_half):.4f}s")
+    return dt
+
+
 def measure_matmul_tflops(n: int = 4096, iters: int = 8,
                           dtype=jnp.bfloat16) -> float:
     """Measured MXU throughput (the per-layer compute calibration input)."""
+    reps = 512
     if jax.default_backend() == "cpu":   # keep the CPU smoke path fast
-        n, iters = min(n, 1024), min(iters, 3)
+        n, iters, reps = min(n, 1024), min(iters, 3), 8
     a = jnp.ones((n, n), dtype)
     b = jnp.ones((n, n), dtype)
-    reps = 64  # amortize dispatch + remote-tunnel latency
 
-    def body(a, b):
-        out = jnp.zeros((), jnp.float32)
-        x = a
-        for _ in range(reps):
-            x = (x @ b).astype(dtype)
-        return out + jnp.sum(x.astype(jnp.float32))
+    def body(reps):
+        def run(a, b):
+            x = jax.lax.fori_loop(
+                0, reps, lambda i, x: (x @ b).astype(dtype), a)
+            return jnp.sum(x.astype(jnp.float32))
+        g = jax.jit(run)
+        return lambda: g(a, b)
 
-    f = jax.jit(body)
-    _sync(f(a, b))
-    times = []
-    for _ in range(iters):
-        t = time.perf_counter()
-        _sync(f(a, b))
-        times.append(time.perf_counter() - t)
-    return reps * 2 * n ** 3 / min(times) / 1e12
+    dt = _diff_time(body(reps), body(reps // 2), iters)
+    return (reps // 2) * 2 * n ** 3 / dt / 1e12
 
 
 def measure_hbm_gbps(mbytes: int = 256, iters: int = 8) -> float:
@@ -89,29 +110,25 @@ def measure_hbm_gbps(mbytes: int = 256, iters: int = 8) -> float:
     (reference: galvatron profiles comm bandwidth; HBM is the TPU analog
     bottleneck).  Bytes counted = read + write of the buffer."""
     n = mbytes * 1024 * 1024 // 4
-    x = jnp.ones((n,), jnp.float32)
-    reps = 16
+    reps = 64
+    if jax.default_backend() == "cpu":
+        n, reps, iters = n // 8, 8, min(iters, 3)
+    x0 = jnp.ones((n,), jnp.float32)
 
-    def body(x):
-        # scan (not an unrolled chain): each step is a sequential full
-        # read+write pass — an unrolled x*c+d chain would fuse into ONE pass
-        # and overreport bandwidth by reps x
-        def step(x, _):
-            return x * 1.0000001 + 1e-9, None
-        x, _ = jax.lax.scan(step, x, None, length=reps)
-        return x
+    def body(reps):
+        def run(x):
+            # scan (not an unrolled chain): each step is a sequential full
+            # read+write pass — an unrolled x*c+d chain would fuse into ONE
+            # pass and overreport bandwidth by reps x
+            def step(x, _):
+                return x * 1.0000001 + 1e-9, None
+            x, _ = jax.lax.scan(step, x, None, length=reps)
+            return x[:1]
+        g = jax.jit(run)
+        return lambda: g(x0)
 
-    f = jax.jit(body, donate_argnums=0)
-    x = f(x)
-    _sync(x[:1])
-    times = []
-    for _ in range(iters):
-        x = jnp.ones((n,), jnp.float32)
-        t = time.perf_counter()
-        x = f(x)
-        _sync(x[:1])
-        times.append(time.perf_counter() - t)
-    return reps * 2 * n * 4 / min(times) / 1e9
+    dt = _diff_time(body(reps), body(reps // 2), iters)
+    return (reps // 2) * 2 * n * 4 / dt / 1e9
 
 
 def measure_collective_gbps(mesh, axis: str = "tp",
@@ -122,21 +139,24 @@ def measure_collective_gbps(mesh, axis: str = "tp",
     if size <= 1:
         return None
     n = mbytes * 1024 * 1024 // 4
-    x = jnp.ones((n,), jnp.float32)
+    x0 = jnp.ones((n,), jnp.float32)
     from jax.sharding import PartitionSpec as P
 
-    fn = jax.jit(jax.shard_map(
-        lambda v: jax.lax.psum(v, axis), mesh=mesh, in_specs=P(),
-        out_specs=P(), check_vma=False))
-    _sync(fn(x))
-    times = []
-    for _ in range(5):
-        t = time.perf_counter()
-        _sync(fn(x))
-        times.append(time.perf_counter() - t)
-    # bus bytes for ring allreduce: 2 * (size-1)/size * payload
-    bus = 2 * (size - 1) / size * n * 4
-    return bus / min(times) / 1e9
+    def body(reps):
+        def run(v):
+            def step(i, v):
+                # fresh dependency each round so XLA cannot collapse the
+                # loop into a single psum
+                return jax.lax.psum(v, axis) * (1.0 / size)
+            return jax.lax.fori_loop(0, reps, step, v)[:1]
+        g = jax.jit(jax.shard_map(run, mesh=mesh, in_specs=P(),
+                                  out_specs=P(), check_vma=False))
+        return lambda: g(x0)
+
+    dt = _diff_time(body(8), body(4), iters=5)
+    # bus bytes for ring allreduce: 2 * (size-1)/size * payload, per round
+    bus = 4 * 2 * (size - 1) / size * n * 4
+    return bus / dt / 1e9
 
 
 def profile_hardware(mesh=None, chip: Optional[str] = None,

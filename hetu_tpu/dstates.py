@@ -357,3 +357,196 @@ def dup(ndim: int) -> DistributedStates:
 
 def split0(ndim: int, axis: Union[str, Sequence[str]] = "tp") -> DistributedStates:
     return DistributedStates.make(ndim, {0: axis})
+
+
+# ---------------------------------------------------------------------------
+# Hetero layout unions — the analog of DistributedStatesUnion
+# (reference: hetu/graph/distributed_states.h:158-321: a list of per-group
+# DistributedStates plus `hetero_dim`, the tensor dim partitioned across
+# groups, with possibly UNEVEN extents).
+#
+# TPU-native reading: a union describes one logical tensor executed by
+# SEVERAL compiled programs over disjoint sub-meshes (hetero dp groups with
+# different tp degrees, hetero pipeline stage groups with different layer
+# counts).  Inside one group everything is an ordinary DistributedStates /
+# GSPMD layout; the union layer owns only the cross-group partition: which
+# slice of `hetero_dim` each group holds and how big it is.  Uneven extents
+# execute as equal physical shards + valid-length metadata where a single
+# program needs them (the hetero-CP design, data/bucket.py cp_split_uneven),
+# or as genuinely different per-group shapes when the groups are separate
+# programs (parallel/hetero_dp.py).
+# ---------------------------------------------------------------------------
+
+HETERO_REPLICATED = -1   # groups replicate the tensor (hetero over params)
+
+
+def partition_extents(shares: Sequence[int], total: int) -> Tuple[int, ...]:
+    """Partition `total` units into len(shares) positive integer extents
+    proportional to shares (largest-remainder rounding).  The cross-group
+    partition primitive shared by DistributedStatesUnion.extents and the
+    Malleus hetero-dp row planner."""
+    n = len(shares)
+    if total < n:
+        raise ValueError(
+            f"cannot give each of {n} groups a nonzero extent of {total}")
+    s = sum(shares)
+    raw = [total * sh / s for sh in shares]
+    out = [max(1, int(r)) for r in raw]
+    rema = sorted(range(n), key=lambda i: raw[i] - int(raw[i]),
+                  reverse=True)
+    i = 0
+    while sum(out) < total:
+        out[rema[i % n]] += 1
+        i += 1
+    i = 0
+    while sum(out) > total:
+        j = rema[-1 - (i % n)]
+        if out[j] > 1:
+            out[j] -= 1
+        i += 1
+    return tuple(out)
+
+
+@dataclasses.dataclass(frozen=True)
+class DistributedStatesUnion:
+    """Union of per-group layouts partitioned (unevenly) across groups.
+
+    groups:     inner layout per hetero group (all the same rank).
+    hetero_dim: tensor dim split ACROSS groups, or HETERO_REPLICATED (-1)
+                when every group holds the full tensor (params under hetero
+                dp; reference hetero_dim -1 "dup" unions).
+    shares:     relative extent of each group along hetero_dim (layer counts
+                per stage group, batch rows per dp group...).  None = even.
+    """
+
+    groups: Tuple[DistributedStates, ...]
+    hetero_dim: int = HETERO_REPLICATED
+    shares: Optional[Tuple[int, ...]] = None
+
+    # -- constructors -------------------------------------------------------
+    @staticmethod
+    def even(ds: DistributedStates, n_groups: int,
+             hetero_dim: int = HETERO_REPLICATED) -> "DistributedStatesUnion":
+        """The homogeneous union (reference: all-same ds_union_map entries)."""
+        return DistributedStatesUnion((ds,) * n_groups, hetero_dim).validate()
+
+    def validate(self) -> "DistributedStatesUnion":
+        if not self.groups:
+            raise ValueError("union needs at least one group")
+        ndim = self.groups[0].ndim
+        for g in self.groups:
+            if g.ndim != ndim:
+                raise ValueError(f"rank mismatch across union groups: {self}")
+        if self.hetero_dim != HETERO_REPLICATED and not (
+                0 <= self.hetero_dim < ndim):
+            raise ValueError(f"hetero_dim {self.hetero_dim} out of range "
+                             f"for rank {ndim}")
+        if self.shares is not None:
+            if len(self.shares) != len(self.groups):
+                raise ValueError(
+                    f"{len(self.shares)} shares for {len(self.groups)} groups")
+            if self.hetero_dim == HETERO_REPLICATED:
+                raise ValueError("shares require a real hetero_dim")
+            if any(s <= 0 for s in self.shares):
+                raise ValueError(f"shares must be positive: {self.shares}")
+            # canonicalize: gcd-reduce, and drop all-equal shares entirely so
+            # semantically identical unions compare equal ((2,2) == (1,1)
+            # == None for every total)
+            import math
+            g = math.gcd(*self.shares) if len(self.shares) > 1 \
+                else self.shares[0]
+            norm = tuple(s // g for s in self.shares)
+            if len(set(norm)) == 1:
+                norm = None
+            if norm != self.shares:
+                return dataclasses.replace(self, shares=norm)
+        return self
+
+    # -- basic properties ---------------------------------------------------
+    @property
+    def num_groups(self) -> int:
+        return len(self.groups)
+
+    @property
+    def ndim(self) -> int:
+        return self.groups[0].ndim
+
+    def get(self, i: int) -> DistributedStates:
+        return self.groups[i]
+
+    def is_hetero(self) -> bool:
+        """True when the union is not expressible as one homogeneous layout:
+        groups differ, or extents are uneven (reference: is_hetero over
+        DistributedStatesUnion)."""
+        if any(g != self.groups[0] for g in self.groups[1:]):
+            return True
+        return self.shares is not None and len(set(self.shares)) > 1
+
+    # -- the cross-group partition ------------------------------------------
+    def extents(self, total: int) -> Tuple[int, ...]:
+        """Per-group extent along hetero_dim summing exactly to `total`,
+        proportional to shares (largest-remainder rounding, every group
+        nonzero)."""
+        if self.hetero_dim == HETERO_REPLICATED:
+            return (total,) * self.num_groups
+        return partition_extents(self.shares or (1,) * self.num_groups,
+                                 total)
+
+    def offsets(self, total: int) -> Tuple[Tuple[int, int], ...]:
+        """Per-group [start, stop) along hetero_dim."""
+        ext = self.extents(total)
+        bounds, acc = [], 0
+        for e in ext:
+            bounds.append((acc, acc + e))
+            acc += e
+        return tuple(bounds)
+
+    def padded_extent(self, total: int) -> int:
+        """The equal physical shard size for single-program execution of an
+        uneven union (pad-to-max + valid-len metadata — the hetero-CP
+        execution form, data/bucket.py cp_split_uneven)."""
+        return max(self.extents(total))
+
+    def split_host(self, arr, axis: Optional[int] = None):
+        """Split a host array into per-group pieces along hetero_dim (the
+        data-dispatch step feeding per-group programs)."""
+        axis = self.hetero_dim if axis is None else axis
+        if axis == HETERO_REPLICATED:
+            return [arr] * self.num_groups
+        bounds = self.offsets(arr.shape[axis])
+        sl = [slice(None)] * arr.ndim
+        out = []
+        for (a, b) in bounds:
+            sl[axis] = slice(a, b)
+            out.append(arr[tuple(sl)])
+        return out
+
+    def __str__(self):
+        gs = "; ".join(str(g) for g in self.groups)
+        hd = ("dup" if self.hetero_dim == HETERO_REPLICATED
+              else f"dim{self.hetero_dim}")
+        sh = f" shares={list(self.shares)}" if self.shares else ""
+        return f"DSUnion[{gs} | hetero={hd}{sh}]"
+
+
+def union_deduce_comm(src: DistributedStatesUnion,
+                      dst: DistributedStatesUnion
+                      ) -> Tuple[Tuple[CommPlan, ...], ...]:
+    """Comm plans converting one union into another.  Uniform return shape:
+    a tuple of CommPlan-sequences (iterate `for seq in plans: for p in seq`).
+
+    Homogeneous-to-homogeneous with matching group structure lowers to the
+    ordinary per-group deduce_comm, one sequence per group (each group
+    converts inside its own mesh).  Anything that changes the cross-group
+    partition (group count or uneven extents) is a single GENERIC sequence —
+    executed by the switch engine's device_put program, not by single-mesh
+    collectives (reference: the union branches of SubstituteCommOp / hetero
+    switch planning)."""
+    src = src.validate()
+    dst = dst.validate()
+    if (src.num_groups == dst.num_groups
+            and src.hetero_dim == dst.hetero_dim
+            and src.shares == dst.shares):
+        return tuple(deduce_comm(s, d)
+                     for s, d in zip(src.groups, dst.groups))
+    return ((CommPlan(CommType.GENERIC),),)

@@ -91,3 +91,55 @@ class MalleusPlanner:
             st["devices"] = members
             st["speed"] = round(float(spd), 3)
         return cfg
+
+
+def plan_hetero_dp_shares(profile: StragglerProfile,
+                          group_devices: Sequence[Sequence[int]],
+                          group_dp: Sequence[int],
+                          total_rows: int) -> List[int]:
+    """Assign per-group batch rows proportional to measured group throughput
+    (reference: Malleus's uneven batch shares across unequal device groups,
+    python/hetu/engine/strategy.py:99).
+
+    Each group's devices are organised as dp replicas of tp members; a tp
+    replica runs at its slowest member's speed, so group throughput is the
+    sum of per-replica min speeds.  Every group's row count is a positive
+    multiple of its dp degree (so the slice shards evenly over the group's
+    dp axis); total_rows must be expressible that way or this raises.
+    """
+    speeds = profile.speeds
+    rates = []
+    for devs, dp in zip(group_devices, group_dp):
+        if len(devs) % dp:
+            raise ValueError(f"group of {len(devs)} devices with dp={dp}")
+        tp = len(devs) // dp
+        rate = sum(min(speeds[i] for i in devs[r * tp:(r + 1) * tp])
+                   for r in range(dp))
+        rates.append(rate)
+    # proportional target, then snap to dp multiples: start from the floor
+    # multiple (>= dp) and hand out the remaining rows in dp-sized chunks to
+    # the groups whose deficit vs target is largest per chunk
+    n = len(rates)
+    if total_rows < sum(group_dp):
+        raise ValueError(
+            f"total_rows={total_rows} cannot give every group one row per "
+            f"dp replica (need >= {sum(group_dp)})")
+    s = sum(rates)
+    target = [total_rows * r / s for r in rates]
+    rows = [max(dp, int(t) - int(t) % dp)
+            for t, dp in zip(target, group_dp)]
+    rem = total_rows - sum(rows)
+    if rem < 0:
+        raise ValueError(
+            f"total_rows={total_rows} not expressible as dp multiples "
+            f"{list(group_dp)} near the throughput split {target}")
+    while rem > 0:
+        cand = [i for i in range(n) if group_dp[i] <= rem]
+        if not cand:
+            raise ValueError(
+                f"{rem} rows left over: total_rows={total_rows} is not "
+                f"expressible as positive dp multiples of {list(group_dp)}")
+        i = max(cand, key=lambda i: (target[i] - rows[i]) / group_dp[i])
+        rows[i] += group_dp[i]
+        rem -= group_dp[i]
+    return rows

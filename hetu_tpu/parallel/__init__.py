@@ -1,1 +1,2 @@
 from hetu_tpu.parallel.strategy import ParallelStrategy
+from hetu_tpu.parallel.hetero_dp import HeteroDPEngine, HeteroDPGroup

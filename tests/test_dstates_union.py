@@ -1,0 +1,92 @@
+"""DistributedStatesUnion tests (reference: distributed_states.h:158-321 —
+the hetero union formalism; here: cross-group partition math + comm
+deduction)."""
+import numpy as np
+import pytest
+
+from hetu_tpu.dstates import (
+    CommType, DistributedStates as DS, DistributedStatesUnion as DSU,
+    HETERO_REPLICATED, union_deduce_comm,
+)
+
+
+def test_validate_rejects_bad_unions():
+    with pytest.raises(ValueError):
+        DSU((), hetero_dim=0).validate()
+    with pytest.raises(ValueError):  # rank mismatch
+        DSU((DS.dup(2), DS.dup(3)), hetero_dim=0).validate()
+    with pytest.raises(ValueError):  # hetero_dim out of range
+        DSU((DS.dup(2),), hetero_dim=5).validate()
+    with pytest.raises(ValueError):  # shares/groups length mismatch
+        DSU((DS.dup(2), DS.dup(2)), hetero_dim=0, shares=(1,)).validate()
+    with pytest.raises(ValueError):  # shares on a replicated union
+        DSU((DS.dup(2),), hetero_dim=HETERO_REPLICATED,
+            shares=(1,)).validate()
+    with pytest.raises(ValueError):  # nonpositive share
+        DSU((DS.dup(2), DS.dup(2)), hetero_dim=0, shares=(0, 2)).validate()
+
+
+def test_even_union_is_not_hetero():
+    u = DSU.even(DS.make(2, {0: "dp"}), 3, hetero_dim=0)
+    assert u.num_groups == 3 and not u.is_hetero()
+    assert u.extents(9) == (3, 3, 3)
+    # different inner layouts -> hetero even with equal shares
+    v = DSU((DS.make(2, {0: "dp"}), DS.make(2, {0: "tp"})), hetero_dim=0)
+    assert v.is_hetero()
+
+
+def test_uneven_extents_partition_exactly():
+    u = DSU((DS.dup(2),) * 3, hetero_dim=0, shares=(5, 2, 1)).validate()
+    for total in (8, 16, 17, 100):
+        ext = u.extents(total)
+        assert sum(ext) == total
+        assert all(e >= 1 for e in ext)
+        # ordering follows shares
+        assert ext[0] >= ext[1] >= ext[2]
+    assert u.extents(8) == (5, 2, 1)
+    assert u.offsets(8) == ((0, 5), (5, 7), (7, 8))
+    assert u.padded_extent(8) == 5
+
+
+def test_replicated_union_extents():
+    u = DSU((DS.dup(2),) * 2, hetero_dim=HETERO_REPLICATED)
+    assert u.extents(8) == (8, 8)
+    parts = u.split_host(np.arange(8))
+    assert len(parts) == 2 and parts[0].shape == (8,)
+
+
+def test_split_host_matches_offsets():
+    u = DSU((DS.dup(2),) * 2, hetero_dim=0, shares=(3, 1)).validate()
+    x = np.arange(32).reshape(8, 4)
+    a, b = u.split_host(x)
+    assert a.shape == (6, 4) and b.shape == (2, 4)
+    np.testing.assert_array_equal(np.concatenate([a, b], 0), x)
+
+
+def test_union_deduce_comm_per_group_vs_generic():
+    src = DSU((DS.make(2, {0: "dp"}), DS.make(2, {0: "dp"})), hetero_dim=0)
+    dst = DSU((DS.dup(2), DS.dup(2)), hetero_dim=0)
+    plans = union_deduce_comm(src, dst)
+    assert len(plans) == 2
+    assert plans[0][0].kind is CommType.ALL_GATHER
+    # changing the cross-group partition is a generic hetero reshard
+    # (uniform return shape: always a tuple of plan-sequences)
+    dst2 = DSU((DS.dup(2),) * 2, hetero_dim=0, shares=(3, 1)).validate()
+    plans2 = union_deduce_comm(src, dst2)
+    assert plans2[0][0].kind is CommType.GENERIC
+    # semantically identical share tuples are canonicalized, not GENERIC
+    src_eq = DSU((DS.dup(2),) * 2, hetero_dim=0, shares=(2, 2)).validate()
+    assert src_eq.shares is None
+    plans3 = union_deduce_comm(src_eq, DSU((DS.dup(2),) * 2, hetero_dim=0))
+    assert plans3[0][0].kind is CommType.NONE
+    # gcd reduction: (4, 2) == (2, 1)
+    assert DSU((DS.dup(2),) * 2, hetero_dim=0,
+               shares=(4, 2)).validate().shares == (2, 1)
+
+
+def test_extents_rejects_impossible_totals():
+    u = DSU((DS.dup(2),) * 3, hetero_dim=0, shares=(1, 1, 2)).validate()
+    with pytest.raises(ValueError):
+        u.extents(2)  # 3 groups cannot all get a nonzero slice of 2
+    with pytest.raises(ValueError):
+        u.extents(0)

@@ -127,9 +127,41 @@ class EmbeddingCache:
         self.buffer[slots] = np.asarray(rows, self.buffer.dtype)
         self._dirty.update(int(i) for i in ids)
 
+    def flush_dirty(self):
+        """Push every dirty resident row to flush_fn (checkpoint-time sync;
+        eviction handles steady-state write-back)."""
+        if not self._dirty or self.flush_fn is None:
+            self._dirty.clear()
+            return
+        ids = sorted(i for i in self._dirty if i in self._slot_of)
+        if ids:
+            rows = np.stack([self.buffer[self._slot_of[i]] for i in ids])
+            self.flush_fn(np.asarray(ids, np.int64), rows)
+        self._dirty.clear()
+
     def stats(self) -> dict:
         out = np.zeros(4, np.int64)
         self._lib.lru_stats(self._h,
                             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return {"hits": int(out[0]), "misses": int(out[1]),
                 "evictions": int(out[2]), "resident": int(out[3])}
+
+
+def ps_backed_cache(client, name: str, rows: int, dim: int, capacity: int,
+                    init: str = "normal", scale: float = 0.02,
+                    seed: int = 0, dtype=np.float32) -> "EmbeddingCache":
+    """EmbeddingCache backed by the coordination server's PS tables — the
+    full HET shape: server-resident table (reference: v1 ps-lite server),
+    client LRU of hot rows, write-back on eviction (reference:
+    hetu/v1/src/hetu_cache).  `client` is a rpc.CoordinationClient."""
+    r = client.ps_init(name, rows, dim, init=init, scale=scale, seed=seed)
+    if r["dim"] != dim or r["rows"] != rows:
+        raise ValueError(
+            f"PS table {name!r} exists with shape ({r['rows']}, {r['dim']})"
+            f" != requested ({rows}, {dim})")
+    return EmbeddingCache(
+        capacity, dim,
+        fetch_fn=lambda ids: client.ps_pull(name, ids),
+        flush_fn=lambda ids, vals: client.ps_push(name, ids, vals,
+                                                  mode="assign"),
+        dtype=dtype)

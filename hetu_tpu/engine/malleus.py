@@ -116,9 +116,11 @@ def plan_hetero_dp_shares(profile: StragglerProfile,
         rate = sum(min(speeds[i] for i in devs[r * tp:(r + 1) * tp])
                    for r in range(dp))
         rates.append(rate)
-    # proportional target, then snap to dp multiples: start from the floor
-    # multiple (>= dp) and hand out the remaining rows in dp-sized chunks to
-    # the groups whose deficit vs target is largest per chunk
+    # proportional target, then snap to dp multiples: exact DP over
+    # "rows_g = positive multiple of dp_g, sum == total_rows" minimizing
+    # total deviation from the throughput-proportional target (a greedy
+    # floor+fixup can wrongly reject feasible configs, e.g. dp=[2,3]
+    # total=9 with skewed rates)
     n = len(rates)
     if total_rows < sum(group_dp):
         raise ValueError(
@@ -126,20 +128,35 @@ def plan_hetero_dp_shares(profile: StragglerProfile,
             f"dp replica (need >= {sum(group_dp)})")
     s = sum(rates)
     target = [total_rows * r / s for r in rates]
-    rows = [max(dp, int(t) - int(t) % dp)
-            for t, dp in zip(target, group_dp)]
-    rem = total_rows - sum(rows)
-    if rem < 0:
+    INF = float("inf")
+    # cost[t] = best deviation allocating t rows to groups[0..g]; choice
+    # tracks the per-group row count realizing it
+    cost = [INF] * (total_rows + 1)
+    cost[0] = 0.0
+    choice: List[Dict[int, int]] = []
+    for g in range(n):
+        dp = group_dp[g]
+        nxt = [INF] * (total_rows + 1)
+        pick: Dict[int, int] = {}
+        for t in range(total_rows + 1):
+            if cost[t] is INF:
+                continue
+            k = dp
+            while t + k <= total_rows:
+                c = cost[t] + abs(k - target[g])
+                if c < nxt[t + k]:
+                    nxt[t + k] = c
+                    pick[t + k] = k
+                k += dp
+        cost = nxt
+        choice.append(pick)
+    if cost[total_rows] is INF:
         raise ValueError(
-            f"total_rows={total_rows} not expressible as dp multiples "
-            f"{list(group_dp)} near the throughput split {target}")
-    while rem > 0:
-        cand = [i for i in range(n) if group_dp[i] <= rem]
-        if not cand:
-            raise ValueError(
-                f"{rem} rows left over: total_rows={total_rows} is not "
-                f"expressible as positive dp multiples of {list(group_dp)}")
-        i = max(cand, key=lambda i: (target[i] - rows[i]) / group_dp[i])
-        rows[i] += group_dp[i]
-        rem -= group_dp[i]
+            f"total_rows={total_rows} is not expressible as positive "
+            f"multiples of group dp degrees {list(group_dp)}")
+    rows = [0] * n
+    t = total_rows
+    for g in range(n - 1, -1, -1):
+        rows[g] = choice[g][t]
+        t -= rows[g]
     return rows

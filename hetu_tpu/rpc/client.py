@@ -136,6 +136,42 @@ class CoordinationClient:
         self.should_stop = stop
         return stop
 
+    # -- parameter-server embedding tables (reference: v1 ps-lite worker
+    # ops ParameterServerCommunicate.py pull/push; server side handlers in
+    # rpc/server.py ps_init/ps_pull/ps_push) ---------------------------
+    def ps_init(self, name: str, rows: int, dim: int, init: str = "zeros",
+                scale: float = 0.02, seed: int = 0) -> Dict[str, Any]:
+        """Create (idempotently) a server-resident embedding table."""
+        return self._call({"op": "ps_init", "name": name, "rows": rows,
+                           "dim": dim, "init": init, "scale": scale,
+                           "seed": seed})
+
+    def ps_pull(self, name: str, ids):
+        """ids [n] -> float32 rows [n, dim] (the PS pull)."""
+        import base64
+
+        import numpy as np
+        ids = np.asarray(ids, np.int64)
+        resp = self._call({"op": "ps_pull", "name": name,
+                           "ids": ids.tolist()})
+        return np.frombuffer(base64.b64decode(resp["data"]),
+                             np.float32).reshape(
+                                 len(ids), int(resp["dim"])).copy()
+
+    def ps_push(self, name: str, ids, rows, mode: str = "assign",
+                lr: float = 0.01):
+        """Write rows back: mode 'assign' (last write wins), 'add'
+        (duplicates accumulate), or 'sgd' (row -= lr * grad, server-side
+        sparse update — the reference PS optimizer path)."""
+        import base64
+
+        import numpy as np
+        ids = np.asarray(ids, np.int64)
+        data = base64.b64encode(
+            np.ascontiguousarray(rows, np.float32).tobytes()).decode()
+        self._call({"op": "ps_push", "name": name, "ids": ids.tolist(),
+                    "data": data, "mode": mode, "lr": lr})
+
     def exit(self):
         try:
             self._call({"op": "exit", "rank": self.rank})

@@ -15,12 +15,15 @@ minus protoc codegen).
 """
 from __future__ import annotations
 
+import base64
 import json
 import socket
 import struct
 import threading
 import time
 from typing import Any, Dict, Optional, Set
+
+import numpy as np
 
 from hetu_tpu.utils.logging import get_logger
 
@@ -71,6 +74,11 @@ class CoordinationServer:
         self._barrier_gen: Dict[str, int] = {}
         self._votes: Dict[str, Dict[int, Any]] = {}
         self._stop_flags: Set[int] = set()
+        # PS embedding tables live under their OWN lock: a large pull's
+        # base64 encode must not stall heartbeats on the coordination lock
+        # (the monitor would mark every worker lost mid-transfer)
+        self._ps: Dict[str, np.ndarray] = {}
+        self._ps_lock = threading.Lock()
         self._shutdown = False
         self._threads = []
         self._accept_thread = threading.Thread(target=self._accept_loop,
@@ -168,6 +176,8 @@ class CoordinationServer:
     def _handle(self, req: Dict[str, Any],
                 conn_state: Optional[Dict[str, Any]] = None) -> Dict[str, Any]:
         op = req.get("op")
+        if isinstance(op, str) and op.startswith("ps_"):
+            return self._handle_ps(op, req)
         with self._lock:
             if op == "connect":        # Connect + GetRank
                 rank = self._next_rank
@@ -265,6 +275,59 @@ class CoordinationServer:
                     conn_state["clean"] = True
                 return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
+
+    def _handle_ps(self, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Parameter-server embedding tables (reference: v1 PS — hetu/v1
+        ps-lite server PSFhandle_embedding.cc pull/push handlers and
+        server-side sparse SGD; the HET-paper backing store behind client
+        LRU caches, data/embedding_cache.py).  Runs under _ps_lock, NOT the
+        coordination lock — see __init__."""
+        with self._ps_lock:
+            if op == "ps_init":        # idempotent table create
+                name = req["name"]
+                created = name not in self._ps
+                if created:
+                    rows, dim = int(req["rows"]), int(req["dim"])
+                    kind = req.get("init", "zeros")
+                    if kind == "zeros":
+                        tab = np.zeros((rows, dim), np.float32)
+                    elif kind == "normal":
+                        rng = np.random.default_rng(int(req.get("seed", 0)))
+                        tab = (rng.standard_normal((rows, dim)) *
+                               float(req.get("scale", 0.02))).astype(
+                                   np.float32)
+                    else:
+                        raise ValueError(f"unknown init {kind!r}")
+                    self._ps[name] = tab
+                t = self._ps[name]
+                return {"ok": True, "created": created,
+                        "rows": t.shape[0], "dim": t.shape[1]}
+            if op == "ps_pull":        # ids -> base64 float32 rows
+                t = self._ps[req["name"]]
+                ids = np.asarray(req["ids"], np.int64)
+                data = np.ascontiguousarray(t[ids]) if len(ids) else \
+                    np.zeros((0, t.shape[1]), np.float32)
+            elif op == "ps_push":      # assign / add / server-side sgd
+                t = self._ps[req["name"]]
+                ids = np.asarray(req["ids"], np.int64)
+                rows = np.frombuffer(
+                    base64.b64decode(req["data"]), np.float32).reshape(
+                        len(ids), t.shape[1])
+                mode = req.get("mode", "assign")
+                if mode == "assign":
+                    t[ids] = rows          # last write wins per duplicate
+                elif mode == "add":        # duplicates accumulate
+                    np.add.at(t, ids, rows)
+                elif mode == "sgd":        # row -= lr * grad, duplicates sum
+                    np.add.at(t, ids, -float(req.get("lr", 0.01)) * rows)
+                else:
+                    raise ValueError(f"unknown push mode {mode!r}")
+                return {"ok": True}
+            else:
+                raise ValueError(f"unknown op {op!r}")
+        # encode OUTSIDE the ps lock too: only the gather needs the table
+        return {"ok": True, "dim": int(data.shape[1]),
+                "data": base64.b64encode(data.tobytes()).decode()}
 
     def close(self):
         self._shutdown = True

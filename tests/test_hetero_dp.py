@@ -113,3 +113,16 @@ def test_malleus_hetero_dp_shares():
         plan_hetero_dp_shares(p2, [[0, 1, 2, 3], [4, 5, 6, 7]], [2, 2], 21)
     with pytest.raises(ValueError):  # fewer rows than dp replicas
         plan_hetero_dp_shares(p2, [[0, 1, 2, 3], [4, 5, 6, 7]], [2, 2], 3)
+
+
+def test_malleus_shares_exact_dp_over_greedy():
+    """The DP partitioner finds feasible dp-multiple splits a floor+fixup
+    greedy would reject (dp=[2,3], total=9 -> only [6,3] works)."""
+    from hetu_tpu.engine.malleus import (StragglerProfile,
+                                         plan_hetero_dp_shares)
+    p = StragglerProfile([0.2, 0.2, 1.0, 1.0, 1.0])
+    assert plan_hetero_dp_shares(p, [[0, 1], [2, 3, 4]], [2, 3], 9) == [6, 3]
+    import pytest
+    p6 = StragglerProfile([1.0] * 6)
+    with pytest.raises(ValueError):  # 2k+4m is always even; 7 infeasible
+        plan_hetero_dp_shares(p6, [[0, 1], [2, 3, 4, 5]], [2, 4], 7)

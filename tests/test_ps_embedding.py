@@ -1,0 +1,100 @@
+"""Parameter-server embedding tests: server-resident tables + client
+pull/push + LRU cache write-back (reference: hetu/v1 ps-lite PS —
+PSFhandle_embedding.cc pull/push handlers, server-side sparse SGD; HET
+client caches hetu/v1/src/hetu_cache)."""
+import numpy as np
+import pytest
+
+from hetu_tpu.rpc import CoordinationClient, CoordinationServer
+
+
+@pytest.fixture()
+def cluster():
+    server = CoordinationServer(world_size=1)
+    client = CoordinationClient("127.0.0.1", server.port,
+                                auto_heartbeat=False)
+    yield server, client
+    client.exit()
+    server.close()
+
+
+def test_ps_init_pull_push_roundtrip(cluster):
+    _, c = cluster
+    r = c.ps_init("emb", rows=32, dim=4, init="zeros")
+    assert r["created"] and r["rows"] == 32 and r["dim"] == 4
+    # idempotent re-init
+    assert not c.ps_init("emb", rows=32, dim=4)["created"]
+
+    rows = np.arange(12, dtype=np.float32).reshape(3, 4)
+    c.ps_push("emb", [5, 7, 9], rows)
+    got = c.ps_pull("emb", [5, 7, 9, 0])
+    np.testing.assert_array_equal(got[:3], rows)
+    np.testing.assert_array_equal(got[3], np.zeros(4))
+
+
+def test_ps_push_modes(cluster):
+    _, c = cluster
+    c.ps_init("t", rows=8, dim=2, init="zeros")
+    ones = np.ones((2, 2), np.float32)
+    c.ps_push("t", [1, 1], ones, mode="add")      # duplicates accumulate
+    np.testing.assert_array_equal(c.ps_pull("t", [1]), [[2.0, 2.0]])
+    c.ps_push("t", [1], ones[:1], mode="sgd", lr=0.5)
+    np.testing.assert_array_equal(c.ps_pull("t", [1]), [[1.5, 1.5]])
+    with pytest.raises(RuntimeError):
+        c.ps_push("t", [0], ones[:1], mode="bogus")
+    with pytest.raises(RuntimeError):  # unknown table
+        c.ps_pull("nope", [0])
+
+
+def test_ps_normal_init_deterministic(cluster):
+    _, c = cluster
+    c.ps_init("n", rows=16, dim=8, init="normal", scale=0.1, seed=3)
+    a = c.ps_pull("n", list(range(16)))
+    assert a.std() > 0.01  # actually random
+    rng = np.random.default_rng(3)
+    np.testing.assert_allclose(
+        a, (rng.standard_normal((16, 8)) * 0.1).astype(np.float32))
+
+
+def test_ps_backed_lru_cache_write_back(cluster):
+    """The full HET loop: cold pull -> local LRU -> dirty write_back ->
+    eviction/checkpoint flush reaches the PS table."""
+    from hetu_tpu.data.embedding_cache import ps_backed_cache
+    _, c = cluster
+    cache = ps_backed_cache(c, "emb2", rows=64, dim=4, capacity=4,
+                            init="normal", seed=1)
+    first = cache.lookup(np.array([1, 2, 3]))
+    np.testing.assert_array_equal(first, c.ps_pull("emb2", [1, 2, 3]))
+    st = cache.stats()
+    assert st["misses"] == 3 and st["hits"] == 0
+    # hit path
+    cache.lookup(np.array([1, 2]))
+    assert cache.stats()["hits"] == 2
+
+    # local update, then force eviction by touching new ids (capacity 4)
+    upd = np.full((2, 4), 7.0, np.float32)
+    cache.write_back(np.array([1, 2]), upd)
+    cache.lookup(np.arange(10, 16))          # evicts 1 and 2 -> flush to PS
+    np.testing.assert_array_equal(c.ps_pull("emb2", [1, 2]), upd)
+
+    # checkpoint-time flush of still-resident dirty rows
+    cache.write_back(np.array([15]), np.full((1, 4), 9.0, np.float32))
+    cache.flush_dirty()
+    np.testing.assert_array_equal(c.ps_pull("emb2", [15]),
+                                  np.full((1, 4), 9.0, np.float32))
+    assert not cache._dirty
+
+
+def test_ps_pull_empty_ids(cluster):
+    _, c = cluster
+    c.ps_init("e", rows=4, dim=3, init="zeros")
+    out = c.ps_pull("e", [])
+    assert out.shape == (0, 3)
+
+
+def test_ps_backed_cache_rejects_shape_mismatch(cluster):
+    from hetu_tpu.data.embedding_cache import ps_backed_cache
+    _, c = cluster
+    c.ps_init("m", rows=16, dim=8)
+    with pytest.raises(ValueError):
+        ps_backed_cache(c, "m", rows=16, dim=4, capacity=4)

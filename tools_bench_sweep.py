@@ -1,0 +1,71 @@
+"""One-off sweep of bench configurations on the real chip (batch size,
+remat policy, seq len) to find the best flagship operating point.  Not part
+of the driver contract; bench.py stays the official metric."""
+import json
+import sys
+import time
+
+import numpy as np
+
+
+def run(cfg_kw, batch, seq, iters=5):
+    import jax
+    import jax.numpy as jnp
+    import hetu_tpu as ht  # noqa
+    from hetu_tpu import optim
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+
+    cfg = LlamaConfig(
+        vocab_size=32000, hidden_size=1536, intermediate_size=4096,
+        num_hidden_layers=12, num_attention_heads=12,
+        num_key_value_heads=12, max_position_embeddings=max(2048, seq),
+        use_scan=True, **cfg_kw)
+    model = LlamaLMHeadModel(cfg)
+    opt = optim.AdamW(lr=1e-4)
+    params = model.init(jax.random.key(0))
+    opt_state = opt.init(params)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, size=(batch, seq)), jnp.int32)
+
+    def _step(params, opt_state, ids):
+        loss, grads = jax.value_and_grad(
+            lambda p: model(p, ids, labels=ids))(params)
+        params, opt_state = opt.update(grads, opt_state, params)
+        return params, opt_state, loss
+
+    step = jax.jit(_step, donate_argnums=(0, 1))
+    params, opt_state, loss = step(params, opt_state, ids)
+    float(loss)
+    times = []
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        params, opt_state, loss = step(params, opt_state, ids)
+        float(loss)
+        times.append(time.perf_counter() - t0)
+    dt = min(times)
+    tps = batch * seq / dt
+    mfu = tps * cfg.flops_per_token(seq) / 197e12
+    return {"cfg": cfg_kw, "batch": batch, "seq": seq,
+            "step_s": round(dt, 4), "tok_s": round(tps, 1),
+            "mfu": round(mfu, 4)}
+
+
+def main():
+    cases = [
+        ({"remat": True, "remat_policy": "nothing"}, 8, 2048),   # current
+        ({"remat": True, "remat_policy": "dots"}, 8, 2048),
+        ({"remat": False}, 8, 2048),
+        ({"remat": True, "remat_policy": "dots"}, 16, 2048),
+        ({"remat": True, "remat_policy": "nothing"}, 16, 2048),
+        ({"remat": False}, 16, 2048),
+    ]
+    for kw, b, s in cases:
+        try:
+            r = run(kw, b, s)
+        except Exception as e:
+            r = {"cfg": kw, "batch": b, "seq": s, "error": repr(e)[:200]}
+        print(json.dumps(r), flush=True)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

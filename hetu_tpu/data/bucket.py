@@ -141,7 +141,7 @@ def pack_sequences(seqs: Sequence[np.ndarray], max_len: int, pad_id: int = 0,
 
 
 def cp_split_batch(batch: Dict[str, np.ndarray], cp: int,
-                   split: str = "sym") -> List[Dict[str, np.ndarray]]:
+                   split: Optional[str] = None) -> List[Dict[str, np.ndarray]]:
     """Split a packed/padded batch along seq into per-CP-rank slices
     (reference: bucket.py:193 generate_cp_pack_data + the ring's
     HETU_PARALLEL_ATTN_SPLIT=NORMAL|STRIPE|SYM modes,
@@ -155,6 +155,10 @@ def cp_split_batch(batch: Dict[str, np.ndarray], cp: int,
     Returns a list of cp dicts, each with seq_len = total/cp.  Causality
     under any split is preserved by the ring kernel's position-based masks
     (feed the original position_ids through)."""
+    if split is None:
+        # flag-driven default (reference: HETU_PARALLEL_ATTN_SPLIT_PATTERN)
+        from hetu_tpu.utils import flags
+        split = flags.str_flag("HETU_TPU_CP_SPLIT")
     seq = batch["input_ids"].shape[1]
     if split == "sym":
         assert seq % (2 * cp) == 0, f"seq {seq} must divide by 2*cp={2*cp}"

@@ -84,13 +84,16 @@ class HotSwitchTrainer(Trainer):
         # byte accounting BEFORE the move (needs the live src shardings) —
         # the reference's ProfileRunningDetails (switch_exec_graph.cc:1904)
         from hetu_tpu.parallel.switch import profile_switch
-        try:
-            prof = profile_switch(
-                self.params, jax.tree.map(lambda x: x.sharding, self.params),
-                dst.param_shardings)
-        except Exception as e:
-            logger.warning(f"switch byte profiling failed: {e!r}")
-            prof = None
+        from hetu_tpu.utils import flags
+        prof = None
+        if flags.bool_flag("HETU_TPU_SWITCH_PROFILE"):
+            try:
+                prof = profile_switch(
+                    self.params,
+                    jax.tree.map(lambda x: x.sharding, self.params),
+                    dst.param_shardings)
+            except Exception as e:
+                logger.warning(f"switch byte profiling failed: {e!r}")
         self.last_switch_profile = prof  # reset even on failure (no stale reads)
         switcher = StrategySwitcher(self._handles)
         self.params, new_state = switcher.switch(

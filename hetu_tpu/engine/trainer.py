@@ -259,6 +259,40 @@ class Trainer:
             out[k] = jax.device_put(v, self._batch_sharding(v.ndim))
         return out
 
+    def memory_report(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
+        """XLA's compiled-memory breakdown of the train step for this batch
+        shape — the per-plan analog of the reference's micro-batch memory
+        profiler (reference: hetu/graph/profiler.h:15-39 memory records;
+        GetCUDAProfiler).  AOT lower().compile() does NOT share jit's
+        dispatch cache, so the first call per batch shape pays one full XLA
+        compile; results are memoized per shape here."""
+        batches = self.prepare_batch(host_batch)
+        key = tuple(sorted((k, tuple(v.shape))
+                           for k, v in host_batch.items()))
+        cache = getattr(self, "_memory_reports", None)
+        if cache is None:
+            cache = self._memory_reports = {}
+        if key in cache:
+            return cache[key]
+        rng = jax.random.key(0)
+        with use_mesh(self.mesh):
+            compiled = self._step_fn.lower(
+                self.params, self.opt_state, batches, rng,
+                self.scaler_state).compile()
+        mem = compiled.memory_analysis()
+        out = {}
+        for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                  "temp_size_in_bytes", "alias_size_in_bytes",
+                  "generated_code_size_in_bytes"):
+            v = getattr(mem, k, None)
+            if v is not None:
+                out[k.replace("_in_bytes", "")] = int(v)
+        # donated params/opt aliasing means live peak ~ args + temp
+        out["peak_estimate"] = (out.get("argument_size", 0)
+                                + out.get("temp_size", 0))
+        cache[key] = out
+        return out
+
     def train_step(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         batches = self.prepare_batch(host_batch)
         rng = jax.random.fold_in(jax.random.key(self.config.seed + 1),

@@ -74,8 +74,18 @@ def flash_attention(q, k, v, *, causal: bool = True,
     """Fused attention entry point. Routes to the Pallas TPU kernel when
     running on TPU with compatible shapes; XLA composition otherwise."""
     if use_pallas is None:
-        use_pallas = (jax.default_backend() == "tpu" and _pallas_fa is not None
-                      and _pallas_compatible(q, k))
+        # HETU_TPU_PALLAS=1/0 force-routes; "auto" keeps the shape gate
+        # (reference: the HETU_PARALLEL_ATTN env family, GetExecEnvs)
+        from hetu_tpu.utils import flags
+        forced = flags.str_flag("HETU_TPU_PALLAS")
+        if forced == "1":
+            use_pallas = True
+        elif forced == "0":
+            use_pallas = False
+        else:
+            use_pallas = (jax.default_backend() == "tpu"
+                          and _pallas_fa is not None
+                          and _pallas_compatible(q, k))
     if use_pallas:
         if _pallas_fa is None:
             raise RuntimeError("use_pallas=True but the Pallas kernel is unavailable")

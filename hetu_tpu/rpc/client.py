@@ -148,29 +148,25 @@ class CoordinationClient:
 
     def ps_pull(self, name: str, ids):
         """ids [n] -> float32 rows [n, dim] (the PS pull)."""
-        import base64
-
         import numpy as np
+
+        from hetu_tpu.rpc.wire import decode_rows
         ids = np.asarray(ids, np.int64)
         resp = self._call({"op": "ps_pull", "name": name,
                            "ids": ids.tolist()})
-        return np.frombuffer(base64.b64decode(resp["data"]),
-                             np.float32).reshape(
-                                 len(ids), int(resp["dim"])).copy()
+        return decode_rows(resp["data"], len(ids), int(resp["dim"]))
 
     def ps_push(self, name: str, ids, rows, mode: str = "assign",
                 lr: float = 0.01):
         """Write rows back: mode 'assign' (last write wins), 'add'
         (duplicates accumulate), or 'sgd' (row -= lr * grad, server-side
         sparse update — the reference PS optimizer path)."""
-        import base64
-
         import numpy as np
+
+        from hetu_tpu.rpc.wire import encode_rows
         ids = np.asarray(ids, np.int64)
-        data = base64.b64encode(
-            np.ascontiguousarray(rows, np.float32).tobytes()).decode()
         self._call({"op": "ps_push", "name": name, "ids": ids.tolist(),
-                    "data": data, "mode": mode, "lr": lr})
+                    "data": encode_rows(rows), "mode": mode, "lr": lr})
 
     def exit(self):
         try:

@@ -15,7 +15,6 @@ minus protoc codegen).
 """
 from __future__ import annotations
 
-import base64
 import json
 import socket
 import struct
@@ -25,6 +24,7 @@ from typing import Any, Dict, Optional, Set
 
 import numpy as np
 
+from hetu_tpu.rpc.wire import decode_rows, encode_rows
 from hetu_tpu.utils.logging import get_logger
 
 logger = get_logger("rpc.server")
@@ -276,6 +276,17 @@ class CoordinationServer:
                 return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
 
+    @staticmethod
+    def _ps_ids(table, ids) -> np.ndarray:
+        """Validated row ids: numpy's negative-index wrapping would silently
+        hit the WRONG rows, so reject out-of-range ids of either sign."""
+        ids = np.asarray(ids, np.int64)
+        if len(ids) and (ids.min() < 0 or ids.max() >= table.shape[0]):
+            raise ValueError(
+                f"row ids out of range [0, {table.shape[0]}): "
+                f"min={ids.min()} max={ids.max()}")
+        return ids
+
     def _handle_ps(self, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
         """Parameter-server embedding tables (reference: v1 PS — hetu/v1
         ps-lite server PSFhandle_embedding.cc pull/push handlers and
@@ -304,15 +315,13 @@ class CoordinationServer:
                         "rows": t.shape[0], "dim": t.shape[1]}
             if op == "ps_pull":        # ids -> base64 float32 rows
                 t = self._ps[req["name"]]
-                ids = np.asarray(req["ids"], np.int64)
+                ids = self._ps_ids(t, req["ids"])
                 data = np.ascontiguousarray(t[ids]) if len(ids) else \
                     np.zeros((0, t.shape[1]), np.float32)
             elif op == "ps_push":      # assign / add / server-side sgd
                 t = self._ps[req["name"]]
-                ids = np.asarray(req["ids"], np.int64)
-                rows = np.frombuffer(
-                    base64.b64decode(req["data"]), np.float32).reshape(
-                        len(ids), t.shape[1])
+                ids = self._ps_ids(t, req["ids"])
+                rows = decode_rows(req["data"], len(ids), t.shape[1])
                 mode = req.get("mode", "assign")
                 if mode == "assign":
                     t[ids] = rows          # last write wins per duplicate
@@ -327,7 +336,7 @@ class CoordinationServer:
                 raise ValueError(f"unknown op {op!r}")
         # encode OUTSIDE the ps lock too: only the gather needs the table
         return {"ok": True, "dim": int(data.shape[1]),
-                "data": base64.b64encode(data.tobytes()).decode()}
+                "data": encode_rows(data)}
 
     def close(self):
         self._shutdown = True

@@ -1,0 +1,18 @@
+"""Wire codec for PS row payloads: contiguous float32 + base64.
+One definition shared by client and server so the format cannot drift
+(dtype/endianness changes happen in exactly one place)."""
+from __future__ import annotations
+
+import base64
+
+import numpy as np
+
+
+def encode_rows(rows) -> str:
+    return base64.b64encode(
+        np.ascontiguousarray(rows, np.float32).tobytes()).decode()
+
+
+def decode_rows(data: str, n: int, dim: int) -> np.ndarray:
+    return np.frombuffer(base64.b64decode(data),
+                         np.float32).reshape(n, dim).copy()

@@ -1,0 +1,120 @@
+"""The runtime env-flag surface — one typed registry for every
+`HETU_TPU_*` variable, with defaults and docs.
+
+Rebuild of the reference's env-driven runtime controls (reference:
+hetu/graph/executable_graph.cc:1163-1313 GetExecEnvs — HETU_STRAGGLER,
+HETU_MEMORY_PROFILE, HETU_PARALLEL_ATTN_SPLIT_PATTERN, event timing...;
+SURVEY §5.6 layer 3).  XLA owns op scheduling, so the TPU flag set controls
+the layers above it: profiling, kernel routing, CP split mode, switch
+accounting, and the multi-process bootstrap.
+
+Usage:
+    from hetu_tpu.utils import flags
+    if flags.bool_flag("HETU_TPU_EVENT_TIMING"): ...
+    mode = flags.str_flag("HETU_TPU_CP_SPLIT")      # validated default
+    flags.describe()                                # the full surface
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Flag:
+    name: str
+    kind: str            # "bool" | "str" | "int"
+    default: object
+    doc: str
+    choices: Optional[Tuple[str, ...]] = None
+
+
+REGISTRY: Dict[str, Flag] = {f.name: f for f in [
+    # -- profiling / observability (reference: HETU_EVENT_TIMING,
+    #    HETU_MEMORY_PROFILE, profiler.h) --------------------------------
+    Flag("HETU_TPU_EVENT_TIMING", "bool", False,
+         "log per-step wall time from the trainer loop"),
+    Flag("HETU_TPU_TRACE_DIR", "str", "",
+         "capture a jax.profiler trace of a step window into this dir"),
+    Flag("HETU_TPU_MEMORY_PROFILE", "bool", False,
+         "log per-step device memory stats + compiled-plan memory analysis"),
+    Flag("HETU_TPU_SWITCH_PROFILE", "bool", True,
+         "per-hot-switch byte accounting (ProfileRunningDetails analog)"),
+    Flag("HETU_TPU_LOG_LEVEL", "str", "INFO",
+         "root log level for hetu_tpu loggers"),
+    # -- kernel / execution routing (reference: HETU_PARALLEL_ATTN*) -----
+    Flag("HETU_TPU_PALLAS", "str", "auto",
+         "flash-attention kernel routing: auto (shape-gated), 1 (force "
+         "Pallas), 0 (force the XLA composition)",
+         choices=("auto", "1", "0")),
+    Flag("HETU_TPU_CP_SPLIT", "str", "sym",
+         "default context-parallel split pattern "
+         "(reference: HETU_PARALLEL_ATTN_SPLIT_PATTERN SYM/STRIPE/NORMAL)",
+         choices=("sym", "stripe", "normal")),
+    # -- multi-process bootstrap (core/distributed.py) -------------------
+    Flag("HETU_TPU_COORDINATOR", "str", "",
+         "jax.distributed coordinator address host:port"),
+    Flag("HETU_TPU_NUM_PROCESSES", "int", 0,
+         "world size for multi-process init (0 = single process)"),
+    Flag("HETU_TPU_PROCESS_ID", "int", 0,
+         "this process's rank for multi-process init"),
+    Flag("HETU_TPU_CONTROL", "str", "",
+         "coordination-server address host:port (KV/barrier/elastic)"),
+]}
+
+
+def _lookup(name: str) -> Flag:
+    try:
+        return REGISTRY[name]
+    except KeyError:
+        raise KeyError(f"unknown flag {name!r}; known: {sorted(REGISTRY)}")
+
+
+_TRUE = ("1", "true", "True", "TRUE", "yes", "on")
+_FALSE = ("0", "false", "False", "FALSE", "no", "off", "")
+
+
+def bool_flag(name: str) -> bool:
+    f = _lookup(name)
+    raw = os.environ.get(name)
+    if raw is None:
+        return bool(f.default)
+    if raw in _TRUE:
+        return True
+    if raw in _FALSE:
+        return False
+    raise ValueError(
+        f"{name}={raw!r} is not a boolean; use one of {_TRUE + _FALSE}")
+
+
+def str_flag(name: str) -> str:
+    f = _lookup(name)
+    val = os.environ.get(name, f.default)
+    if f.choices and val not in f.choices:
+        raise ValueError(
+            f"{name}={val!r} invalid; choices: {f.choices}")
+    return val
+
+
+def int_flag(name: str) -> int:
+    f = _lookup(name)
+    raw = os.environ.get(name)
+    return int(raw) if raw else int(f.default)
+
+
+def describe() -> str:
+    """Human-readable flag table (the GetExecEnvs surface, documented)."""
+    lines = []
+    for f in REGISTRY.values():
+        cur = os.environ.get(f.name)
+        cur_s = f" [set: {cur}]" if cur is not None else ""
+        lines.append(f"{f.name} ({f.kind}, default {f.default!r}){cur_s}\n"
+                     f"    {f.doc}")
+    return "\n".join(lines)
+
+
+def active() -> Dict[str, str]:
+    """The HETU_TPU_* vars actually set in this environment
+    (reference: GetExecEnvs logging)."""
+    return {k: v for k, v in os.environ.items() if k.startswith("HETU_TPU_")}

@@ -28,9 +28,10 @@ logger = get_logger("profiling")
 
 
 def env_flags() -> Dict[str, str]:
-    """The runtime-behavior env surface (reference: GetExecEnvs)."""
-    return {k: v for k, v in os.environ.items()
-            if k.startswith("HETU_TPU_")}
+    """The runtime-behavior env surface (reference: GetExecEnvs); the full
+    typed registry with docs lives in hetu_tpu.utils.flags."""
+    from hetu_tpu.utils import flags
+    return flags.active()
 
 
 class StepProfiler:

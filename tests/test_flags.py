@@ -1,0 +1,73 @@
+"""Env-flag surface tests (reference: GetExecEnvs,
+executable_graph.cc:1163-1313 — the runtime-behavior env contract)."""
+import numpy as np
+import pytest
+
+from hetu_tpu.utils import flags
+
+
+def test_defaults():
+    assert flags.bool_flag("HETU_TPU_SWITCH_PROFILE") is True
+    assert flags.bool_flag("HETU_TPU_EVENT_TIMING") is False
+    assert flags.str_flag("HETU_TPU_CP_SPLIT") == "sym"
+    assert flags.str_flag("HETU_TPU_PALLAS") == "auto"
+    assert flags.int_flag("HETU_TPU_NUM_PROCESSES") == 0
+
+
+def test_env_overrides(monkeypatch):
+    monkeypatch.setenv("HETU_TPU_EVENT_TIMING", "1")
+    assert flags.bool_flag("HETU_TPU_EVENT_TIMING") is True
+    monkeypatch.setenv("HETU_TPU_SWITCH_PROFILE", "0")
+    assert flags.bool_flag("HETU_TPU_SWITCH_PROFILE") is False
+    monkeypatch.setenv("HETU_TPU_CP_SPLIT", "stripe")
+    assert flags.str_flag("HETU_TPU_CP_SPLIT") == "stripe"
+    monkeypatch.setenv("HETU_TPU_CP_SPLIT", "bogus")
+    with pytest.raises(ValueError):
+        flags.str_flag("HETU_TPU_CP_SPLIT")
+    monkeypatch.setenv("HETU_TPU_NUM_PROCESSES", "4")
+    assert flags.int_flag("HETU_TPU_NUM_PROCESSES") == 4
+
+
+def test_unknown_flag_rejected():
+    with pytest.raises(KeyError):
+        flags.bool_flag("HETU_TPU_NOT_A_FLAG")
+
+
+def test_describe_and_active(monkeypatch):
+    monkeypatch.setenv("HETU_TPU_TRACE_DIR", "/tmp/t")
+    text = flags.describe()
+    for name in flags.REGISTRY:
+        assert name in text
+    assert flags.active().get("HETU_TPU_TRACE_DIR") == "/tmp/t"
+
+
+def test_cp_split_flag_drives_default(monkeypatch):
+    """cp_split_batch with split=None follows HETU_TPU_CP_SPLIT
+    (reference: HETU_PARALLEL_ATTN_SPLIT_PATTERN)."""
+    from hetu_tpu.data.bucket import cp_split_batch
+    batch = {"input_ids": np.arange(16)[None, :].repeat(2, 0)}
+    monkeypatch.setenv("HETU_TPU_CP_SPLIT", "normal")
+    parts = cp_split_batch(batch, cp=2)
+    np.testing.assert_array_equal(parts[0]["input_ids"][0], np.arange(8))
+    monkeypatch.setenv("HETU_TPU_CP_SPLIT", "sym")
+    parts = cp_split_batch(batch, cp=2)
+    np.testing.assert_array_equal(
+        parts[0]["input_ids"][0],
+        np.concatenate([np.arange(4), np.arange(12, 16)]))
+
+
+def test_pallas_flag_forces_route(monkeypatch):
+    """HETU_TPU_PALLAS force-routes between the Pallas kernel (interpret
+    mode on the CPU backend) and the XLA composition; both must agree."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.ops.attention import flash_attention
+    k = jax.random.key(0)
+    q = jax.random.normal(k, (1, 256, 2, 128), jnp.float32)
+    monkeypatch.setenv("HETU_TPU_PALLAS", "0")
+    xla = flash_attention(q, q, q)
+    assert xla.shape == q.shape
+    monkeypatch.setenv("HETU_TPU_PALLAS", "1")
+    pallas = flash_attention(q, q, q)
+    np.testing.assert_allclose(np.asarray(xla), np.asarray(pallas),
+                               atol=2e-5)

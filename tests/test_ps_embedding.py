@@ -98,3 +98,16 @@ def test_ps_backed_cache_rejects_shape_mismatch(cluster):
     c.ps_init("m", rows=16, dim=8)
     with pytest.raises(ValueError):
         ps_backed_cache(c, "m", rows=16, dim=4, capacity=4)
+
+
+def test_ps_rejects_out_of_range_ids(cluster):
+    """Negative ids must error, not wrap to the last rows (numpy fancy
+    indexing would silently corrupt the wrong rows)."""
+    _, c = cluster
+    c.ps_init("r", rows=8, dim=2, init="zeros")
+    with pytest.raises(RuntimeError):
+        c.ps_pull("r", [-1])
+    with pytest.raises(RuntimeError):
+        c.ps_push("r", [8], np.ones((1, 2), np.float32))
+    # the table is untouched
+    np.testing.assert_array_equal(c.ps_pull("r", [7]), [[0.0, 0.0]])

@@ -171,3 +171,28 @@ def test_batch_strategy_dispatcher():
     assert short in (0, 1)
     with pytest.raises(ValueError):
         disp.choose([131072] * 64)  # nothing in the pool fits
+
+
+def test_memory_report_breakdown():
+    """memory_report = XLA compiled-memory analysis of the step (reference:
+    profiler.h:15-39 per-micro-batch memory records)."""
+    import numpy as np
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.data import pad_batch
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+
+    st = ParallelStrategy(mesh=MeshConfig(dp=2, tp=2))
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=64,
+                        lr=1e-3, warmup_steps=1, total_steps=10,
+                        log_every=100)
+    tr = Trainer(LlamaLMHeadModel(LlamaConfig.tiny(), st), tc, st).build()
+    rng = np.random.default_rng(0)
+    b = pad_batch([rng.integers(1, 250, size=60) for _ in range(4)], 64)
+    rep = tr.memory_report(b)
+    assert rep["temp_size"] > 0 and rep["argument_size"] > 0
+    assert rep["peak_estimate"] == rep["argument_size"] + rep["temp_size"]
+    # the report does not disturb training
+    m = tr.train_step(b)
+    assert np.isfinite(float(m["loss"]))

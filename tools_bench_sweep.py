@@ -52,12 +52,10 @@ def run(cfg_kw, batch, seq, iters=5):
 
 def main():
     cases = [
-        ({"remat": True, "remat_policy": "nothing"}, 8, 2048),   # current
-        ({"remat": True, "remat_policy": "dots"}, 8, 2048),
-        ({"remat": False}, 8, 2048),
-        ({"remat": True, "remat_policy": "dots"}, 16, 2048),
-        ({"remat": True, "remat_policy": "nothing"}, 16, 2048),
-        ({"remat": False}, 16, 2048),
+        ({"remat": True, "remat_policy": "dots"}, 12, 2048),
+        ({"remat": True, "remat_policy": "dots"}, 8, 4096),
+        ({"remat": True, "remat_policy": "offload"}, 8, 2048),
+        ({"remat": True, "remat_policy": "dots"}, 4, 2048),
     ]
     for kw, b, s in cases:
         try:

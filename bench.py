@@ -65,14 +65,15 @@ def main():
     on_tpu = jax.default_backend() in ("tpu", "axon")
     # Single v5e-class chip (16G HBM): ~440M params fp32 Adam + bf16 compute.
     if on_tpu:
-        # remat_policy "dots" (save matmul outputs, recompute the rest)
-        # measured 0.555 vs 0.524 MFU for full recompute at this size
-        # (tools_bench_sweep.py, v5e, 2026-07)
+        # measured ladder at this size (tools_bench_sweep.py, v5e, 2026-07):
+        # full recompute+scan 0.524 < dots+scan 0.556 < dots_attn+unrolled
+        # 0.586 MFU — saving dot outputs AND the named flash-attention
+        # output (no kernel re-run in bwd), layers unrolled
         cfg = LlamaConfig(
             vocab_size=32000, hidden_size=1536, intermediate_size=4096,
             num_hidden_layers=12, num_attention_heads=12,
             num_key_value_heads=12, max_position_embeddings=2048,
-            remat=True, remat_policy="dots", use_scan=True)
+            remat=True, remat_policy="dots_attn", use_scan=False)
         batch, seq, iters = 8, 2048, 6
         # v5e: 197 TFLOP/s bf16 peak; v5p would be 459.
         peak_flops = 197e12

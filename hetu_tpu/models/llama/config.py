@@ -43,7 +43,7 @@ class LlamaConfig:
     use_scan: bool = True          # lax.scan over layers (compile-time + pipeline friendly)
     remat: bool = True             # gradient checkpointing per block
                                    # (reference: recompute/recompute.cc pass)
-    remat_policy: str = "nothing"  # nothing | dots | offload — what each
+    remat_policy: str = "nothing"  # nothing|dots|dots_attn|offload — what each
                                    # block saves (jax.checkpoint_policies;
                                    # 'offload' stages dot outputs to host,
                                    # the reference's activation_cpu_offload)

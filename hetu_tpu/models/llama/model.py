@@ -104,6 +104,11 @@ class LlamaAttention(Module):
                 q, k, v, causal=True, segment_ids=segment_ids,
                 use_pallas=None if c.use_flash_attention else False)
         attn = st.constrain(attn, st.act_attn())
+        # named so the "dots_attn" remat policy can SAVE the kernel output:
+        # recomputing flash attention in the bwd is the single most
+        # expensive recompute under the dot-only policies (nn/remat.py)
+        from jax.ad_checkpoint import checkpoint_name
+        attn = checkpoint_name(attn, "attn_out")
         out = self.o_proj(params["o_proj"], attn.reshape(b, s, self.n_q * hd))
         return out
 

@@ -6,7 +6,7 @@ from __future__ import annotations
 
 import jax
 
-REMAT_POLICIES = ("nothing", "dots", "offload")
+REMAT_POLICIES = ("nothing", "dots", "dots_attn", "offload")
 
 
 def remat_policy(name: str):
@@ -15,6 +15,13 @@ def remat_policy(name: str):
         return cp.nothing_saveable
     if name == "dots":
         return cp.dots_with_no_batch_dims_saveable
+    if name == "dots_attn":
+        # dots + the named attention-kernel output (models tag it
+        # checkpoint_name "attn_out"): the flash kernel is the costliest
+        # thing the dot-only policy recomputes
+        return cp.save_from_both_policies(
+            cp.dots_with_no_batch_dims_saveable,
+            cp.save_only_these_names("attn_out"))
     if name == "offload":
         return cp.offload_dot_with_no_batch_dims("device", "pinned_host")
     raise ValueError(f"unknown remat_policy {name!r}; one of {REMAT_POLICIES}")

@@ -252,3 +252,204 @@ def ring_attention_fallback(q, k, v, *, strategy: ParallelStrategy,
     else:
         out = ops.attention(q, k, v, causal=causal, segment_ids=segment_ids)
     return strategy.constrain(out, strategy.act_attn())
+
+
+# ---------------------------------------------------------------------------
+# Hetero ring: ring members with UNEQUAL effective TP degrees
+# (reference: ParallelAttention.cc:949-1050 — kv head-dim resplit between
+# ring neighbors with different tp).
+#
+# TPU mapping: the mesh stays rectangular (cp, tp); a rank with effective
+# degree e < tp physically holds its kv heads e-way sharded with tp/e-fold
+# replication, BLOCK-MAJOR: device t of that rank stores sender-block
+# t // (tp/e) (heads [blk*H/e, (blk+1)*H/e)).  Block-major assignment makes
+# every device's stored block a SUPERSET of its own q-head block, so the
+# reference's head-resplit all-to-all at each ring hop degenerates into a
+# LOCAL head slice: for a block of origin rank o, device (r, t) computes
+# with heads at sub-offset (t % (tp/e_o)) * H/tp of the traveling buffer.
+# The price is the same one the reference pays: blocks of low-tp ranks are
+# tp/e-fold larger on the wire (replication) — bandwidth, not correctness.
+#
+# Backward: dk/dv piggyback on the rotating (padded) buffer; each device
+# column t only ever touches the head range of q-block t, so when a block
+# arrives home it carries the COMPLETE grads for the owner's q-block heads
+# at one known sub-offset — sliced back out to the uniform [H/tp] layout
+# with no grouped collectives.
+# ---------------------------------------------------------------------------
+
+def _head_slice(x, off, n):
+    """dynamic_slice of n heads at (traced) head-offset `off`; x [b,h,s,d]."""
+    return lax.dynamic_slice_in_dim(x, off, n, axis=1)
+
+
+def _head_add(buf, upd, off):
+    cur = lax.dynamic_slice_in_dim(buf, off, upd.shape[1], axis=1)
+    return lax.dynamic_update_slice_in_dim(buf, cur + upd, off, axis=1)
+
+
+def _hetero_pad(full, h_loc, m_max):
+    pad = h_loc * m_max
+    return jnp.pad(full, ((0, 0), (0, pad), (0, 0), (0, 0)))
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11, 12))
+def _hetero_ring(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, tp_axis,
+                 scale, causal, block_sizes, tp_eff):
+    o, _ = _hetero_ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
+                                 axis_name, tp_axis, scale, causal,
+                                 block_sizes, tp_eff)
+    return o
+
+
+def _hetero_geometry(axis_name, tp_axis, tp_eff):
+    cp = lax.axis_size(axis_name)
+    tp = lax.axis_size(tp_axis)
+    if len(tp_eff) != cp:
+        raise ValueError(f"tp_eff has {len(tp_eff)} entries for cp={cp}")
+    for e in tp_eff:
+        if tp % e:
+            raise ValueError(f"tp_eff {e} must divide tp={tp}")
+    m = tuple(tp // e for e in tp_eff)          # replication per rank
+    return cp, tp, m, max(m)
+
+
+def _hetero_blk_build(x, t, m_r, m_max, h_loc, tp_axis):
+    if m_max == 1:      # fully homogeneous: the block IS the local shard
+        return x
+    full = lax.all_gather(x, tp_axis, axis=1, tiled=True)
+    full = _hetero_pad(full, h_loc, m_max)
+    return _head_slice(full, (t // m_r) * (h_loc * m_r), h_loc * m_max)
+
+
+def _hetero_ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
+                          tp_axis, scale, causal, block_sizes, tp_eff):
+    b, h_loc, sq, d = q.shape
+    cp, tp, m, m_max = _hetero_geometry(axis_name, tp_axis, tp_eff)
+    r = lax.axis_index(axis_name)
+    t = lax.axis_index(tp_axis)
+    m_arr = jnp.asarray(m, jnp.int32)
+    m_r = m_arr[r]
+    block_q = _pick_block(sq, block_sizes[0])
+    block_k = _pick_block(k.shape[2], block_sizes[1])
+    use_seg = q_seg is not None
+
+    k_blk = _hetero_blk_build(k, t, m_r, m_max, h_loc, tp_axis)
+    v_blk = _hetero_blk_build(v, t, m_r, m_max, h_loc, tp_axis)
+    kpos_i, kseg_i = kv_pos, kv_seg
+
+    o = jnp.zeros((b, h_loc, sq, d), jnp.float32)
+    lse = jnp.full((b, h_loc, sq), NEG_INF, jnp.float32)
+    k_i, v_i = k_blk, v_blk
+    for i in range(cp):
+        origin = (r - i) % cp
+        sub = (t % m_arr[origin]) * h_loc       # head-resplit = local slice
+        k_c = _head_slice(k_i, sub, h_loc)
+        v_c = _head_slice(v_i, sub, h_loc)
+        o_i, lse_i = _fwd(q, k_c, v_c, q_pos, kpos_i,
+                          q_seg if use_seg else None,
+                          kseg_i if use_seg else None,
+                          scale=scale, causal=causal,
+                          block_q=block_q, block_k=block_k)
+        o, lse = _merge(o, lse, o_i.astype(jnp.float32), lse_i)
+        if i != cp - 1:
+            rot = [k_i, v_i, kpos_i] + ([kseg_i] if use_seg else [])
+            rot = _rotate(rot, axis_name)
+            if use_seg:
+                k_i, v_i, kpos_i, kseg_i = rot
+            else:
+                k_i, v_i, kpos_i = rot
+    return o.astype(q.dtype), lse
+
+
+def _hetero_vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
+                    tp_axis, scale, causal, block_sizes, tp_eff):
+    o, lse = _hetero_ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg,
+                                   axis_name, tp_axis, scale, causal,
+                                   block_sizes, tp_eff)
+    return o, (q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg)
+
+
+def _hetero_vjp_bwd(axis_name, tp_axis, scale, causal, block_sizes, tp_eff,
+                    res, do):
+    q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg = res
+    b, h_loc, sq, d = q.shape
+    cp, tp, m, m_max = _hetero_geometry(axis_name, tp_axis, tp_eff)
+    r = lax.axis_index(axis_name)
+    t = lax.axis_index(tp_axis)
+    m_arr = jnp.asarray(m, jnp.int32)
+    m_r = m_arr[r]
+    block_q = _pick_block(sq, block_sizes[0])
+    block_k = _pick_block(k.shape[2], block_sizes[1])
+    use_seg = q_seg is not None
+
+    k_blk = _hetero_blk_build(k, t, m_r, m_max, h_loc, tp_axis)
+    v_blk = _hetero_blk_build(v, t, m_r, m_max, h_loc, tp_axis)
+    delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    dq = jnp.zeros(q.shape, jnp.float32)
+    dk_blk = jnp.zeros(k_blk.shape, jnp.float32)
+    dv_blk = jnp.zeros(v_blk.shape, jnp.float32)
+    k_i, v_i, kpos_i, kseg_i = k_blk, v_blk, kv_pos, kv_seg
+    for i in range(cp):
+        origin = (r - i) % cp
+        sub = (t % m_arr[origin]) * h_loc
+        k_c = _head_slice(k_i, sub, h_loc)
+        v_c = _head_slice(v_i, sub, h_loc)
+        dq_c, dk_c, dv_c = _bwd(
+            q, k_c, v_c, o, lse, do, q_pos, kpos_i,
+            q_seg if use_seg else None, kseg_i if use_seg else None,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k,
+            delta=delta)
+        dq = dq + dq_c
+        dk_blk = _head_add(dk_blk, dk_c, sub)
+        dv_blk = _head_add(dv_blk, dv_c, sub)
+        rot = [k_i, v_i, kpos_i, dk_blk, dv_blk] + \
+            ([kseg_i] if use_seg else [])
+        rot = _rotate(rot, axis_name)
+        if use_seg:
+            k_i, v_i, kpos_i, dk_blk, dv_blk, kseg_i = rot
+        else:
+            k_i, v_i, kpos_i, dk_blk, dv_blk = rot
+    # home again: this device column only ever touched q-block t's head
+    # range, whose complete grads sit at sub-offset (t % m_r) * h_loc
+    sub_home = (t % m_r) * h_loc
+    dk = _head_slice(dk_blk, sub_home, h_loc)
+    dv = _head_slice(dv_blk, sub_home, h_loc)
+    return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
+            None, None, None, None)
+
+
+_hetero_ring.defvjp(_hetero_vjp_fwd, _hetero_vjp_bwd)
+
+
+def hetero_ring_attention(q, k, v, *, tp_eff, axis_name: str = "cp",
+                          tp_axis: str = "tp", q_positions=None,
+                          kv_positions=None, segment_ids=None,
+                          kv_segment_ids=None, causal: bool = True,
+                          softmax_scale: Optional[float] = None,
+                          block_q: int = 512, block_k: int = 512):
+    """Ring attention where ring member r runs at effective TP degree
+    tp_eff[r] (each a divisor of the mesh tp size).  shard_map-internal;
+    local layout [b, s_loc, heads_loc, d] like ring_attention.  With all
+    tp_eff == tp this is numerically the homogeneous ring."""
+    b, s, hh, d = q.shape
+    scale = softmax_scale if softmax_scale is not None else d ** -0.5
+    cp_rank = lax.axis_index(axis_name)
+    if q_positions is None:
+        base = cp_rank * s + jnp.arange(s, dtype=jnp.int32)
+        q_positions = jnp.broadcast_to(base, (b, s))
+    if kv_positions is None:
+        kv_positions = q_positions
+    if kv_segment_ids is None:
+        kv_segment_ids = segment_ids
+    qt = q.transpose(0, 2, 1, 3)
+    kt = k.transpose(0, 2, 1, 3)
+    vt = v.transpose(0, 2, 1, 3)
+    o = _hetero_ring(
+        qt, kt, vt, q_positions.astype(jnp.int32),
+        kv_positions.astype(jnp.int32),
+        segment_ids.astype(jnp.int32) if segment_ids is not None else None,
+        kv_segment_ids.astype(jnp.int32) if kv_segment_ids is not None
+        else None,
+        axis_name, tp_axis, scale, causal, (block_q, block_k),
+        tuple(int(e) for e in tp_eff))
+    return o.transpose(0, 2, 1, 3)

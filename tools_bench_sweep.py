@@ -19,7 +19,7 @@ def run(cfg_kw, batch, seq, iters=5):
         vocab_size=32000, hidden_size=1536, intermediate_size=4096,
         num_hidden_layers=12, num_attention_heads=12,
         num_key_value_heads=12, max_position_embeddings=max(2048, seq),
-        use_scan=True, **cfg_kw)
+        **cfg_kw)
     model = LlamaLMHeadModel(cfg)
     opt = optim.AdamW(lr=1e-4)
     params = model.init(jax.random.key(0))
@@ -52,11 +52,11 @@ def run(cfg_kw, batch, seq, iters=5):
 
 def main():
     cases = [
-        ({"remat": True, "remat_policy": "dots"}, 12, 2048),
-        ({"remat": True, "remat_policy": "dots"}, 8, 4096),
-        ({"remat": True, "remat_policy": "offload"}, 8, 2048),
-        ({"remat": True, "remat_policy": "dots"}, 4, 2048),
+        ({"remat": True, "remat_policy": "dots_attn"}, 8, 2048),
+        ({"remat": True, "remat_policy": "dots", "use_scan": False}, 8, 2048),
+        ({"remat": True, "remat_policy": "dots_attn", "use_scan": False}, 8, 2048),
     ]
+
     for kw, b, s in cases:
         try:
             r = run(kw, b, s)

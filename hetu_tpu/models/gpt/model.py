@@ -133,6 +133,10 @@ class GPTAttention(Module):
                 q, k, v, causal=True, segment_ids=segment_ids,
                 use_pallas=None if c.use_flash_attention else False)
         attn = st.constrain(attn, st.act_attn())
+        # named so the "dots_attn" remat policy can save the kernel output
+        # (mirrors models/llama/model.py)
+        from jax.ad_checkpoint import checkpoint_name
+        attn = checkpoint_name(attn, "attn_out")
         return self.o_proj(params["o_proj"], attn.reshape(b, s, h))
 
 

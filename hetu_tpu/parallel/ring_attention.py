@@ -210,7 +210,16 @@ def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
     use_seg = segment_ids is not None
     use_pos = position_ids is not None
 
+    tp_eff = strategy.cp_tp_eff
+
     def local(q, k, v, seg, pos):
+        if tp_eff is not None:
+            return hetero_ring_attention(
+                q, k, v, tp_eff=tp_eff, axis_name="cp", tp_axis="tp",
+                segment_ids=seg if use_seg else None,
+                q_positions=pos if use_pos else None,
+                kv_positions=pos if use_pos else None,
+                causal=causal)
         return ring_attention(
             q, k, v, axis_name="cp",
             segment_ids=seg if use_seg else None,
@@ -324,6 +333,7 @@ def _hetero_blk_build(x, t, m_r, m_max, h_loc, tp_axis):
 def _hetero_ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
                           tp_axis, scale, causal, block_sizes, tp_eff):
     b, h_loc, sq, d = q.shape
+    h_kv = k.shape[1]        # GQA: kv heads per device can differ from q's
     cp, tp, m, m_max = _hetero_geometry(axis_name, tp_axis, tp_eff)
     r = lax.axis_index(axis_name)
     t = lax.axis_index(tp_axis)
@@ -333,8 +343,8 @@ def _hetero_ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
     block_k = _pick_block(k.shape[2], block_sizes[1])
     use_seg = q_seg is not None
 
-    k_blk = _hetero_blk_build(k, t, m_r, m_max, h_loc, tp_axis)
-    v_blk = _hetero_blk_build(v, t, m_r, m_max, h_loc, tp_axis)
+    k_blk = _hetero_blk_build(k, t, m_r, m_max, h_kv, tp_axis)
+    v_blk = _hetero_blk_build(v, t, m_r, m_max, h_kv, tp_axis)
     kpos_i, kseg_i = kv_pos, kv_seg
 
     o = jnp.zeros((b, h_loc, sq, d), jnp.float32)
@@ -342,9 +352,9 @@ def _hetero_ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
     k_i, v_i = k_blk, v_blk
     for i in range(cp):
         origin = (r - i) % cp
-        sub = (t % m_arr[origin]) * h_loc       # head-resplit = local slice
-        k_c = _head_slice(k_i, sub, h_loc)
-        v_c = _head_slice(v_i, sub, h_loc)
+        sub = (t % m_arr[origin]) * h_kv        # head-resplit = local slice
+        k_c = _head_slice(k_i, sub, h_kv)
+        v_c = _head_slice(v_i, sub, h_kv)
         o_i, lse_i = _fwd(q, k_c, v_c, q_pos, kpos_i,
                           q_seg if use_seg else None,
                           kseg_i if use_seg else None,
@@ -373,6 +383,7 @@ def _hetero_vjp_bwd(axis_name, tp_axis, scale, causal, block_sizes, tp_eff,
                     res, do):
     q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg = res
     b, h_loc, sq, d = q.shape
+    h_kv = k.shape[1]        # GQA: kv heads per device can differ from q's
     cp, tp, m, m_max = _hetero_geometry(axis_name, tp_axis, tp_eff)
     r = lax.axis_index(axis_name)
     t = lax.axis_index(tp_axis)
@@ -382,8 +393,8 @@ def _hetero_vjp_bwd(axis_name, tp_axis, scale, causal, block_sizes, tp_eff,
     block_k = _pick_block(k.shape[2], block_sizes[1])
     use_seg = q_seg is not None
 
-    k_blk = _hetero_blk_build(k, t, m_r, m_max, h_loc, tp_axis)
-    v_blk = _hetero_blk_build(v, t, m_r, m_max, h_loc, tp_axis)
+    k_blk = _hetero_blk_build(k, t, m_r, m_max, h_kv, tp_axis)
+    v_blk = _hetero_blk_build(v, t, m_r, m_max, h_kv, tp_axis)
     delta = jnp.sum(do.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
     dq = jnp.zeros(q.shape, jnp.float32)
     dk_blk = jnp.zeros(k_blk.shape, jnp.float32)
@@ -391,9 +402,9 @@ def _hetero_vjp_bwd(axis_name, tp_axis, scale, causal, block_sizes, tp_eff,
     k_i, v_i, kpos_i, kseg_i = k_blk, v_blk, kv_pos, kv_seg
     for i in range(cp):
         origin = (r - i) % cp
-        sub = (t % m_arr[origin]) * h_loc
-        k_c = _head_slice(k_i, sub, h_loc)
-        v_c = _head_slice(v_i, sub, h_loc)
+        sub = (t % m_arr[origin]) * h_kv
+        k_c = _head_slice(k_i, sub, h_kv)
+        v_c = _head_slice(v_i, sub, h_kv)
         dq_c, dk_c, dv_c = _bwd(
             q, k_c, v_c, o, lse, do, q_pos, kpos_i,
             q_seg if use_seg else None, kseg_i if use_seg else None,
@@ -411,9 +422,9 @@ def _hetero_vjp_bwd(axis_name, tp_axis, scale, causal, block_sizes, tp_eff,
             k_i, v_i, kpos_i, dk_blk, dv_blk = rot
     # home again: this device column only ever touched q-block t's head
     # range, whose complete grads sit at sub-offset (t % m_r) * h_loc
-    sub_home = (t % m_r) * h_loc
-    dk = _head_slice(dk_blk, sub_home, h_loc)
-    dv = _head_slice(dv_blk, sub_home, h_loc)
+    sub_home = (t % m_r) * h_kv
+    dk = _head_slice(dk_blk, sub_home, h_kv)
+    dv = _head_slice(dv_blk, sub_home, h_kv)
     return (dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype),
             None, None, None, None)
 

@@ -29,6 +29,10 @@ class ParallelStrategy:
 
     mesh: MeshConfig = MeshConfig()
     sequence_parallel: bool = False
+    # hetero CP: effective tp degree per cp ring member (each a divisor of
+    # mesh.tp; None = homogeneous). Routes ring attention through the
+    # head-resplit hetero ring (reference: ParallelAttention.cc:949-1050)
+    cp_tp_eff: Optional[Tuple[int, ...]] = None
     zero: bool = True          # ZeRO-1 (optimizer-state sharding over dp)
     zero_stage: int = 1        # 1 = opt state; 2 = +grads; 3 = +params (FSDP)
                                # (reference: distributed_states.h zero flag +
@@ -161,6 +165,8 @@ class ParallelStrategy:
 
     def describe(self) -> str:
         bits = [str(self.mesh)]
+        if self.cp_tp_eff is not None:
+            bits.append(f"cptp{list(self.cp_tp_eff)}")
         if self.sequence_parallel:
             bits.append("sp")
         if self.zero:

@@ -102,3 +102,36 @@ def test_hetero_ring_validates_geometry():
     with pytest.raises(ValueError):  # non-divisor degree
         _run_ring(lambda a, b_, c: hetero_ring_attention(
             a, b_, c, tp_eff=(3, 2)), q, k, v, mesh)
+
+
+@pytest.mark.parametrize("tp_eff", [(2, 1), (2, 2)])
+def test_hetero_ring_gqa(tp_eff):
+    """GQA: kv heads per device != q heads per device — the resplit must
+    use the KV head count (fwd + grads vs dense GQA attention)."""
+    hkv = 2                        # 4 q heads, 2 kv heads globally
+    rng = np.random.default_rng(5)
+    q = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    k = jnp.asarray(rng.normal(size=(B, S, hkv, D)), jnp.float32)
+    v = jnp.asarray(rng.normal(size=(B, S, hkv, D)), jnp.float32)
+    w = jnp.asarray(rng.normal(size=(B, S, H, D)), jnp.float32)
+    mesh = create_mesh(MeshConfig(cp=2, tp=2))
+    spec = P(None, "cp", "tp", None)
+
+    def loss_ring(q, k, v):
+        def local(q, k, v, w):
+            o = hetero_ring_attention(q, k, v, tp_eff=tp_eff)
+            return jax.lax.psum(jnp.sum(o * w), ("cp", "tp"))
+        f = jax.shard_map(local, mesh=mesh,
+                          in_specs=(spec, spec, spec, spec),
+                          out_specs=P(), check_vma=False)
+        return f(q, k, v, w)
+
+    def loss_gold(q, k, v):
+        return jnp.sum(attention(q, k, v, causal=True) * w)
+
+    np.testing.assert_allclose(float(loss_ring(q, k, v)),
+                               float(loss_gold(q, k, v)), rtol=1e-5)
+    g_ring = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    g_gold = jax.grad(loss_gold, argnums=(0, 1, 2))(q, k, v)
+    for a, b_ in zip(g_ring, g_gold):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_), atol=3e-5)

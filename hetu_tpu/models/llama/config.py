@@ -28,8 +28,10 @@ class LlamaConfig:
     num_experts: int = 0
     moe_top_k: int = 2
     moe_capacity_factor: float = 1.25
-    moe_gate: str = "topk"       # topk | top1 | ktop1 | balance | hash
+    moe_gate: str = "topk"       # topk|top1|ktop1|balance|hash|sam
     moe_dispatch: str = "sort"   # sort (O(T·k) indices) | dense ([T,E,C])
+    moe_sam_group_size: int = 0  # sam gate: experts per locality group
+                                 # (0 = auto; see nn/moe.py MoEConfig)
 
     # heterogeneous pipeline: per-stage layer counts (sum = num_hidden_layers,
     # len = pp). None = equal split. The Malleus planner emits this

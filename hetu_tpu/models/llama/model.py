@@ -158,7 +158,8 @@ class LlamaBlock(Module):
                 c.hidden_size, c.intermediate_size,
                 MoEConfig(num_experts=c.num_experts, top_k=c.moe_top_k,
                           capacity_factor=c.moe_capacity_factor,
-                          gate=c.moe_gate, dispatch=c.moe_dispatch),
+                          gate=c.moe_gate, dispatch=c.moe_dispatch,
+                          sam_group_size=c.moe_sam_group_size),
                 strategy, param_dtype=c.param_dtype,
                 initializer_range=c.initializer_range)
         else:

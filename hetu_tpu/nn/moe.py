@@ -40,7 +40,7 @@ from hetu_tpu.nn import initializers as init
 from hetu_tpu.nn.module import Module
 from hetu_tpu.parallel.strategy import ParallelStrategy
 
-GATES = ("topk", "top1", "ktop1", "balance", "hash")
+GATES = ("topk", "top1", "ktop1", "balance", "hash", "sam")
 
 
 @dataclasses.dataclass
@@ -53,6 +53,29 @@ class MoEConfig:
     gate: str = "topk"      # one of GATES
     dispatch: str = "sort"  # "sort" (O(T·k) indices) | "dense" ([T,E,C] masks)
     sinkhorn_iters: int = 4  # balance gate only
+    # SAM gate (reference: v1 layers/SAMGate.py — locality-aware routing):
+    # experts are grouped (one group per host/ICI neighborhood); all k picks
+    # land in the token's best group so the dispatch all-to-all stays local.
+    # 0 = auto (largest divisor of num_experts <= 8, the reference's
+    # num_local_gpus default)
+    sam_group_size: int = 0
+
+    def resolved_sam_group_size(self) -> int:
+        """Experts per SAM locality group (NOT the group count — that is
+        num_experts // this).  Validates divisibility and that top_k fits
+        inside one group (SAM picks all k experts from a single group)."""
+        gs = self.sam_group_size
+        if gs == 0:
+            gs = next(g for g in range(min(8, self.num_experts), 0, -1)
+                      if self.num_experts % g == 0)
+        if self.num_experts % gs:
+            raise ValueError(f"sam_group_size {gs} must divide "
+                             f"num_experts {self.num_experts}")
+        if max(self.top_k, 1) > gs:
+            raise ValueError(
+                f"sam gate needs top_k ({self.top_k}) <= group size ({gs}):"
+                " all k picks come from one group")
+        return gs
 
 
 def _router_probs(logits):
@@ -108,6 +131,20 @@ def select_experts(logits, ids, moe: MoEConfig
         gate_vals = jnp.take_along_axis(probs, expert_idx, axis=1)
         gate_vals = gate_vals / jnp.maximum(
             jnp.sum(gate_vals, axis=-1, keepdims=True), 1e-9)
+    elif moe.gate == "sam":
+        # SAM (reference: SAMGate.py samgating): pick the single best GROUP
+        # by total gate mass, then top-k experts WITHIN that group — all of
+        # a token's experts share one locality domain.  Gate values are the
+        # raw probs of the picks (the reference does not renormalize).
+        gs = moe.resolved_sam_group_size()
+        G = E // gs
+        k = max(moe.top_k, 1)
+        grouped = probs.reshape(T, G, gs)
+        top1_group = jnp.argmax(jnp.sum(grouped, axis=-1), axis=-1)  # [T]
+        group_probs = jnp.take_along_axis(
+            grouped, top1_group[:, None, None], axis=1)[:, 0]        # [T, gs]
+        gate_vals, local_idx = jax.lax.top_k(group_probs, k)
+        expert_idx = top1_group[:, None] * gs + local_idx
     else:  # topk (GShard)
         gate_vals, expert_idx = jax.lax.top_k(probs, moe.top_k)
         gate_vals = gate_vals / jnp.maximum(
@@ -125,8 +162,21 @@ def aux_losses(logits, expert_idx, moe: MoEConfig):
     load_balance = E * jnp.sum(me * ce)
     z = jnp.mean(jnp.square(jax.nn.logsumexp(logits.astype(jnp.float32),
                                              axis=-1)))
-    return (moe.load_balance_coef * load_balance
-            + moe.router_z_loss_coef * z)
+    aux = (moe.load_balance_coef * load_balance
+           + moe.router_z_loss_coef * z)
+    if moe.gate == "sam":
+        # alignment loss (reference: SamMax.cu — hinge on every expert
+        # OUTSIDE the chosen group whose gate exceeds the weakest chosen
+        # in-group expert): pushes gate mass INTO one locality group
+        gs = moe.resolved_sam_group_size()
+        T = logits.shape[0]
+        chosen = jnp.take_along_axis(probs, expert_idx, axis=1)
+        tmp = jnp.min(chosen, axis=-1, keepdims=True)       # k-th pick
+        group_of = expert_idx[:, :1] // gs                  # [T, 1]
+        outside = (jnp.arange(E)[None, :] // gs) != group_of
+        hinge = jnp.where(outside, jnp.maximum(probs - tmp, 0.0), 0.0)
+        aux = aux + moe.load_balance_coef * jnp.sum(hinge) / T
+    return aux
 
 
 def sort_routing(expert_idx, gate_vals, num_experts: int, capacity: int):

@@ -168,3 +168,60 @@ def test_moe_ep_sort_matches_single_device():
         y2, _ = jax.jit(lambda p, x: layer2(p, x))(p2, x)
     np.testing.assert_allclose(np.asarray(y1), np.asarray(y2),
                                rtol=1e-4, atol=1e-5)
+
+
+def test_sam_gate_routes_within_one_group():
+    """SAM (reference: v1 SAMGate.py + test_moe_sam.py): all k picks land
+    in the token's best-mass group; alignment hinge penalizes outside
+    experts beating the weakest chosen one."""
+    from hetu_tpu.nn.moe import MoEConfig, aux_losses, select_experts
+    rng = np.random.default_rng(0)
+    T, E, gs = 64, 8, 4
+    logits = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    moe = MoEConfig(num_experts=E, top_k=2, gate="sam", sam_group_size=gs)
+    idx, vals = select_experts(logits, None, moe)
+    assert idx.shape == (T, 2)
+    # both picks share one group, and it's the argmax-mass group
+    probs = jax.nn.softmax(logits, axis=-1)
+    gmass = probs.reshape(T, E // gs, gs).sum(-1)
+    best = np.asarray(jnp.argmax(gmass, axis=-1))
+    np.testing.assert_array_equal(np.asarray(idx[:, 0] // gs), best)
+    np.testing.assert_array_equal(np.asarray(idx[:, 1] // gs), best)
+    # picks are the top-2 within the group by prob, gate vals are the RAW
+    # probs of those picks (reference does not renormalize)
+    grp = np.take_along_axis(np.asarray(probs).reshape(T, E // gs, gs),
+                             best[:, None, None], axis=1)[:, 0]
+    order = np.argsort(-grp, axis=-1)[:, :2]
+    np.testing.assert_array_equal(np.asarray(idx % gs), order)
+    np.testing.assert_allclose(np.asarray(vals),
+                               np.take_along_axis(grp, order, axis=-1),
+                               rtol=1e-6)
+    aux = aux_losses(logits, idx, moe)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+    # auto group size: largest divisor <= 8
+    assert MoEConfig(num_experts=12,
+                     gate="sam").resolved_sam_group_size() == 6
+    import pytest
+    with pytest.raises(ValueError):  # non-divisor group size
+        MoEConfig(num_experts=8, gate="sam",
+                  sam_group_size=3).resolved_sam_group_size()
+    with pytest.raises(ValueError):  # top_k cannot exceed the group size
+        MoEConfig(num_experts=8, top_k=4, gate="sam",
+                  sam_group_size=2).resolved_sam_group_size()
+
+
+def test_sam_gate_trains_in_layer():
+    """SAM-gated MoE layer end-to-end (fwd + grads, sort dispatch)."""
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    cfg = LlamaConfig.tiny(remat=False, num_experts=4, moe_gate="sam",
+                           moe_top_k=2, moe_sam_group_size=2)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(0))
+    ids = jnp.asarray(np.random.default_rng(0).integers(1, 250, (2, 32)),
+                      jnp.int32)
+    loss, grads = jax.value_and_grad(
+        lambda p: model(p, ids, labels=ids))(params)
+    assert np.isfinite(float(loss))
+    assert all(np.isfinite(np.asarray(g)).all()
+               for g in jax.tree.leaves(grads))

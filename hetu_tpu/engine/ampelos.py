@@ -83,3 +83,124 @@ class AmpelosPlanner:
         score, cfg = best
         cfg["score"] = round(float(score), 4)
         return cfg
+
+
+class AmpelosILP:
+    """Exact joint ILP — the direct analog of the reference's PuLP model
+    (reference: python/hetu/engine/strategy_ampelos.py): for each candidate
+    tp, jointly choose the device->stage assignment AND per-stage layer
+    counts minimizing the pipeline bottleneck, then pick the best tp.
+
+    Formulation per tp (pp = n // tp stages):
+      binaries x[d,s] (device d in stage s), integers L[s] >= 1,
+      continuous t;  minimize t
+      s.t.  sum_s x[d,s] = 1;  sum_d x[d,s] = tp;  sum_s L[s] = num_layers;
+            L[s] * inv_d - M (1 - x[d,s]) <= t   (stage runs at its
+                                                  slowest member)
+    Solved with scipy.optimize.milp (HiGHS).  The speed-sorted enumeration
+    (AmpelosPlanner) is near-optimal in practice; the ILP certifies it and
+    covers corner cases the heuristic cannot (integer layer effects).
+    """
+
+    def __init__(self, num_layers: int, tp_candidates=(1, 2, 4, 8),
+                 n_micro: Optional[int] = None, tp_efficiency: float = 0.85):
+        self.num_layers = num_layers
+        self.tp_candidates = tp_candidates
+        self.n_micro = n_micro
+        self.tp_efficiency = tp_efficiency
+
+    def _solve_tp(self, speeds, tp):
+        from scipy.optimize import LinearConstraint, milp
+        from scipy.sparse import lil_matrix
+
+        n = len(speeds)
+        pp = n // tp
+        eff_tp = tp * (self.tp_efficiency ** max(
+            int(np.log2(tp)) if tp > 1 else 0, 0))
+        inv = [1.0 / (s * eff_tp) for s in speeds]
+        nx = n * pp          # x[d,s] at d*pp+s
+        nv = nx + pp + 1     # + L[s] + t
+        M = self.num_layers * max(inv)
+
+        cons = []
+        # each device in exactly one stage
+        a = lil_matrix((n, nv))
+        for d in range(n):
+            for s in range(pp):
+                a[d, d * pp + s] = 1.0
+        cons.append(LinearConstraint(a.tocsr(), 1.0, 1.0))
+        # each stage holds exactly tp devices
+        a = lil_matrix((pp, nv))
+        for s in range(pp):
+            for d in range(n):
+                a[s, d * pp + s] = 1.0
+        cons.append(LinearConstraint(a.tocsr(), float(tp), float(tp)))
+        # layers sum
+        a = lil_matrix((1, nv))
+        for s in range(pp):
+            a[0, nx + s] = 1.0
+        cons.append(LinearConstraint(a.tocsr(), float(self.num_layers),
+                                     float(self.num_layers)))
+        # bottleneck: L[s]*inv_d + M*x[d,s] - t <= M
+        a = lil_matrix((n * pp, nv))
+        for d in range(n):
+            for s in range(pp):
+                r = d * pp + s
+                a[r, nx + s] = inv[d]
+                a[r, d * pp + s] = M
+                a[r, nx + pp] = -1.0
+        cons.append(LinearConstraint(a.tocsr(), -np.inf, M))
+
+        c = np.zeros(nv)
+        c[nx + pp] = 1.0                       # minimize t
+        integrality = np.concatenate([
+            np.ones(nx), np.ones(pp), np.zeros(1)])
+        from scipy.optimize import Bounds
+        lb = np.concatenate([np.zeros(nx), np.ones(pp), np.zeros(1)])
+        ub = np.concatenate([np.ones(nx),
+                             np.full(pp, float(self.num_layers)),
+                             np.asarray([np.inf])])
+        res = milp(c, constraints=cons, integrality=integrality,
+                   bounds=Bounds(lb, ub))
+        if not res.success:
+            return None
+        x = res.x[:nx].reshape(n, pp).round().astype(int)
+        L = res.x[nx:nx + pp].round().astype(int)
+        members = [list(np.nonzero(x[:, s])[0]) for s in range(pp)]
+        # canonical stage order: fastest stage first (matches the sorted
+        # enumeration's convention)
+        order = sorted(range(pp),
+                       key=lambda s: -min(speeds[d] for d in members[s]))
+        return (float(res.x[-1]), [int(L[s]) for s in order],
+                [[int(d) for d in members[s]] for s in order])
+
+    def plan(self, speeds: Sequence[float]) -> Dict:
+        from hetu_tpu.utils.parallel_config import generate_ds_parallel_config
+        n = len(speeds)
+        best = None
+        for tp in self.tp_candidates:
+            if n % tp or self.num_layers < n // tp:
+                continue
+            pp = n // tp
+            sol = self._solve_tp(speeds, tp)
+            if sol is None:
+                continue
+            t, layers, members = sol
+            n_micro = self.n_micro or max(2 * pp, 1)
+            score = t * (n_micro + pp - 1) / n_micro
+            if best is None or score < best[0]:
+                best = (score, tp, layers, members)
+        if best is None:
+            raise ValueError(f"no feasible ILP plan for {n} devices, "
+                             f"{self.num_layers} layers")
+        score, tp, layers, members = best
+        cfg = generate_ds_parallel_config(
+            num_layers=self.num_layers, dp=1, tp=tp, pp=len(layers),
+            stage_layers=layers)
+        for st, mem, spd in zip(cfg["stages"], members,
+                                [min(speeds[d] for d in m)
+                                 for m in members]):
+            st["devices"] = mem
+            st["speed"] = round(float(spd), 3)
+        cfg["score"] = round(float(score), 4)
+        return cfg

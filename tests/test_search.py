@@ -86,3 +86,26 @@ def test_cost_model_uses_measured_bandwidth():
     hw.measured["allreduce_gbps_tp4"] = hw.ici_allreduce_gbps * 10
     t_measured, _ = cost.evaluate(c)
     assert t_measured < t_preset  # faster measured bw -> less comm time
+
+
+def test_ampelos_ilp_certifies_enumeration():
+    """The exact ILP (reference: strategy_ampelos.py PuLP model) must match
+    or beat the speed-sorted enumeration on random straggler instances —
+    and emit a well-formed hetero config."""
+    import numpy as np
+    from hetu_tpu.engine.ampelos import AmpelosILP, AmpelosPlanner
+    rng = np.random.default_rng(0)
+    for trial in range(4):
+        speeds = rng.choice([1.0, 0.5, 0.25], size=8,
+                            p=[0.6, 0.3, 0.1]).tolist()
+        ilp = AmpelosILP(num_layers=12, tp_candidates=(1, 2, 4))
+        enum = AmpelosPlanner(num_layers=12, tp_candidates=(1, 2, 4))
+        c_ilp, c_enum = ilp.plan(speeds), enum.plan(speeds)
+        assert c_ilp["score"] <= c_enum["score"] + 1e-9, (speeds, trial)
+        # well-formed: layers partition [0, num_layers), devices partition
+        spans = [tuple(s["layers"]) for s in c_ilp["stages"]]
+        assert spans[0][0] == 0 and spans[-1][1] == 12
+        assert all(a[1] == b[0] for a, b in zip(spans, spans[1:]))
+        devs = sorted(d for s in c_ilp["stages"] for d in s["devices"])
+        assert devs == list(range(8))
+        assert all(isinstance(d, int) for d in devs)

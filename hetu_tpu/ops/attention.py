@@ -61,10 +61,13 @@ def _pallas_compatible(q, k) -> bool:
     lane-aligned head dim) so the auto path can fall back instead of raising
     mid-trace."""
     from hetu_tpu.ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
-                                                     DEFAULT_BLOCK_Q)
+                                                     DEFAULT_BLOCK_Q,
+                                                     fit_block)
     sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
-    bq, bk = min(DEFAULT_BLOCK_Q, sq), min(DEFAULT_BLOCK_K, sk)
-    return sq % bq == 0 and sk % bk == 0 and d % 128 == 0
+    bq, bk = fit_block(DEFAULT_BLOCK_Q, sq), fit_block(DEFAULT_BLOCK_K, sk)
+    return ((bq >= 128 or bq == min(DEFAULT_BLOCK_Q, sq))
+            and (bk >= 128 or bk == min(DEFAULT_BLOCK_K, sk))
+            and d % 128 == 0)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,

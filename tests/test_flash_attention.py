@@ -112,6 +112,62 @@ def test_cross_length_causal_alignment():
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
 
 
+def test_custom_block_mask_all_dead_row_non_causal():
+    # a custom mask with an all-False row must yield ZERO output for that
+    # q block even without causal/position masking (the dummy-pair guard)
+    b, s, bq = 1, 256, 64
+    q, k, v = _qkv(b=b, s=s, seed=9)
+    nq = nk = s // bq
+    mask = tuple(tuple(r != 0 for _ in range(nk)) for r in range(nq))
+    out = flash_attention(q, k, v, causal=False, block_q=bq, block_k=bq,
+                          block_mask=mask)
+    out = np.asarray(out)
+    assert np.abs(out[:, :bq]).max() == 0.0          # dead row -> zeros
+    ref = np.asarray(attention(q, k, v, causal=False))
+    np.testing.assert_allclose(out[:, bq:], ref[:, bq:], **TOL)
+
+
+def test_custom_block_mask_gradients():
+    # dead k-column in the mask must produce zero dk/dv for that block and
+    # parity elsewhere vs a reference masked by the same tile pattern
+    b, s, blk = 1, 128, 32
+    q, k, v = _qkv(b=b, s=s, seed=10)
+    n = s // blk
+    mask = tuple(tuple(c != 1 for c in range(n)) for _ in range(n))
+    bias = np.zeros((s, s), np.float32)
+    bias[:, blk:2 * blk] = -1e30                     # same dead column
+    bias = jnp.asarray(bias[None, None])
+
+    g1 = jax.grad(lambda q, k, v: (flash_attention(
+        q, k, v, causal=False, block_q=blk, block_k=blk,
+        block_mask=mask) ** 2).sum(), (0, 1, 2))(q, k, v)
+    g2 = jax.grad(lambda q, k, v: (attention(
+        q, k, v, causal=False, bias=bias) ** 2).sum(), (0, 1, 2))(q, k, v)
+    assert float(jnp.abs(g1[1][:, blk:2 * blk]).max()) == 0.0
+    for a, b_ in zip(g1, g2):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b_),
+                                   rtol=5e-3, atol=5e-3)
+
+
+def test_block_mask_shape_mismatch_raises():
+    q, k, v = _qkv(s=256)
+    with pytest.raises(ValueError):
+        flash_attention(q, k, v, causal=True, block_q=128, block_k=128,
+                        block_mask=((True,),))
+
+
+def test_fit_block_keeps_pallas_path():
+    # 512-divisible-but-not-1024 seq lens ladder down instead of raising
+    from hetu_tpu.ops.pallas.flash_attention import fit_block
+    assert fit_block(1024, 1536) == 768
+    assert fit_block(1024, 2048) == 1024
+    assert fit_block(128, 200) == 100
+    q, k, v = _qkv(s=384, seed=11)                   # 384 = 3*128
+    out = flash_attention(q, k, v, causal=True, block_q=256, block_k=256)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
+
+
 def test_multiblock_asymmetric_gradients():
     # regression coverage: the bwd DMA clamps under multi-block asymmetric
     # block shapes (block_q != block_k) with skip active

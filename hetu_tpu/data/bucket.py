@@ -140,6 +140,18 @@ def pack_sequences(seqs: Sequence[np.ndarray], max_len: int, pad_id: int = 0,
             "position_ids": position_ids, "segment_ids": segment_ids}
 
 
+def stripe_granularity(seq: int, cp: int):
+    """The stripe split's block granularity: finest g = seq/(cp*m) giving
+    every rank >= 2 blocks (m from cp down to 2), or None if none divides.
+    ONE rule shared by the data split below and the ring's static step
+    masks (parallel/ring_attention.ring_step_masks) — drift between the two
+    would make the masks skip live tiles."""
+    for m in range(cp, 1, -1):
+        if seq % (cp * m) == 0:
+            return seq // (cp * m)
+    return None
+
+
 def cp_split_batch(batch: Dict[str, np.ndarray], cp: int,
                    split: Optional[str] = None) -> List[Dict[str, np.ndarray]]:
     """Split a packed/padded batch along seq into per-CP-rank slices
@@ -169,13 +181,7 @@ def cp_split_batch(batch: Dict[str, np.ndarray], cp: int,
                for lo, hi in owner]
     elif split == "stripe":
         assert seq % cp == 0, f"seq {seq} must divide by cp={cp}"
-        # finest stripe granularity giving every rank >= 2 blocks (one block
-        # per rank would degenerate into the contiguous 'normal' split)
-        g = None
-        for m in range(cp, 1, -1):
-            if seq % (cp * m) == 0:
-                g = seq // (cp * m)
-                break
+        g = stripe_granularity(seq, cp)
         if g is None:
             raise ValueError(
                 f"stripe split needs seq ({seq}) divisible by cp*m for some "

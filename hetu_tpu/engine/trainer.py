@@ -27,7 +27,6 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 import hetu_tpu as ht
 from hetu_tpu import optim
 from hetu_tpu.core.mesh import use_mesh
-from hetu_tpu.data.bucket import cp_split_batch
 from hetu_tpu.engine.trainer_config import TrainingConfig
 from hetu_tpu.optim.optimizer import zero_shardings
 from hetu_tpu.parallel.strategy import ParallelStrategy
@@ -44,6 +43,21 @@ class Trainer:
         self.model = model
         self.config = config
         self.strategy = strategy or getattr(model, "strategy", ParallelStrategy())
+        self._cp_split = None
+        if self.strategy.cp > 1:
+            # the trainer owns the data layout: resolve the CP split pattern
+            # once (reference: HETU_PARALLEL_ATTN_SPLIT drives both the data
+            # split and the ring's AttnInfo masks), reorder batches to match
+            # (prepare_batch) and declare it around the traced step calls so
+            # the ring schedules only live tiles (_declared scope below).
+            from hetu_tpu.utils import flags as _flags
+            self._cp_split = (self.strategy.cp_split
+                              or _flags.str_flag("HETU_TPU_CP_SPLIT"))
+        self._cp_perm_cache = {}
+        self._cp_layout_used = False   # a step traced under this layout?
+        # non-contiguous CP layouts require host pre-shifted labels
+        # (_cp_reorder) — array adjacency stops meaning token adjacency
+        self._labels_shifted = self._cp_split not in (None, "normal")
         self.mesh = mesh if mesh is not None else self.strategy.build_mesh()
         self.params = None
         self.opt_state = None
@@ -85,6 +99,12 @@ class Trainer:
             lr=optim.cosine_schedule(c.lr, c.warmup_steps, c.total_steps,
                                      c.min_lr_ratio),
             b1=c.beta1, b2=c.beta2, eps=c.eps, weight_decay=c.weight_decay)
+
+    def _declared(self):
+        """Context declaring this trainer's CP data layout to the ring for
+        the duration of a (possibly tracing) step call."""
+        from hetu_tpu.parallel.ring_attention import declared_cp_split
+        return declared_cp_split(self._cp_split)
 
     # ------------------------------------------------------------------
     def _make_shardings(self):
@@ -133,7 +153,7 @@ class Trainer:
             position_ids=batch.get("position_ids"),
             segment_ids=batch.get("segment_ids"),
             rng=rng, deterministic=c.dropout_deterministic,
-            loss_reduction="sum")
+            loss_reduction="sum", labels_shifted=self._labels_shifted)
 
     def _train_step(self, params, opt_state, batches, rng, scaler_state):
         """batches: pytree with leading micro-batch dim [n_micro, mb, seq]."""
@@ -166,7 +186,8 @@ class Trainer:
                 (lsum, csum), grads = self.model.pipeline_train_grads(
                     params, flat["input_ids"], flat["labels"],
                     position_ids=flat.get("position_ids"),
-                    segment_ids=flat.get("segment_ids"), n_micro=n_micro)
+                    segment_ids=flat.get("segment_ids"), n_micro=n_micro,
+                    labels_shifted=self._labels_shifted)
             else:
                 def pp_loss(p):
                     lsum_, csum_ = self.model(
@@ -174,7 +195,8 @@ class Trainer:
                         position_ids=flat.get("position_ids"),
                         segment_ids=flat.get("segment_ids"),
                         deterministic=True, loss_reduction="sum",
-                        n_micro=n_micro)
+                        n_micro=n_micro,
+                        labels_shifted=self._labels_shifted)
                     # loss SCALING happens on the fp32 sum (gradscaler.h:33)
                     return lsum_.astype(jnp.float32) * scale, (lsum_, csum_)
 
@@ -246,10 +268,64 @@ class Trainer:
             spec[2] = "cp"
         return NamedSharding(self.mesh, P(*spec))
 
+    def _cp_reorder(self, host_batch: Dict[str, np.ndarray]):
+        """Apply the declared CP split's seq permutation (reference:
+        bucket.py:193 generate_cp_pack_data — pre-shift labels, then deal
+        the seq across ranks for causal balance).
+
+        Pre-shifting labels (labels[t] := labels[t+1], tail -100) makes the
+        next-token objective permutation-safe; the models consume them with
+        labels_shifted=True. position_ids are synthesized when absent so
+        rotary + ring masking see true token positions after the reorder."""
+        split = self._cp_split
+        if split in (None, "normal"):
+            return host_batch
+        seq = host_batch["input_ids"].shape[1]
+        perm = self._cp_perm_cache.get(seq)
+        if perm is None:
+            from hetu_tpu.data.bucket import cp_split_indices
+            try:
+                perm = np.concatenate(
+                    cp_split_indices(seq, self.strategy.cp, split))
+            except (AssertionError, ValueError) as e:
+                if not self._cp_layout_used:
+                    # nothing traced yet: fall back to the contiguous layout
+                    # instead of failing runs whose seq doesn't divide the
+                    # fancier split (flag defaults are not an opt-in wall)
+                    logger.warning(
+                        f"seq {seq} incompatible with cp_split={split!r} at "
+                        f"cp={self.strategy.cp} ({e}); falling back to "
+                        f"'normal'")
+                    self._cp_split = "normal"
+                    self._labels_shifted = False
+                    return host_batch
+                raise ValueError(
+                    f"seq {seq} incompatible with cp_split={split!r} at "
+                    f"cp={self.strategy.cp} after steps already ran under "
+                    f"this layout: {e}; pad the bucket ladder or set "
+                    f"HETU_TPU_CP_SPLIT=normal") from None
+            self._cp_perm_cache[seq] = perm
+        self._cp_layout_used = True
+        out = dict(host_batch)
+        if "labels" in out:
+            lab = out["labels"]
+            shifted = np.full_like(lab, -100)
+            shifted[:, :-1] = lab[:, 1:]
+            out["labels"] = shifted
+        if "position_ids" not in out:
+            out["position_ids"] = np.broadcast_to(
+                np.arange(seq, dtype=np.int32),
+                out["input_ids"].shape).copy()
+        for k, v in out.items():
+            if v.ndim >= 2 and v.shape[1] == seq:
+                out[k] = np.ascontiguousarray(v[:, perm])
+        return out
+
     def prepare_batch(self, host_batch: Dict[str, np.ndarray]):
         """Reshape [gbs, seq] -> [n_micro, mb*dp, seq], device_put sharded.
         (reference: trainer.py:465 prepare_feed_dict)"""
         c, st = self.config, self.strategy
+        host_batch = self._cp_reorder(host_batch)
         n_micro = c.num_micro_batches(st.dp)
         out = {}
         for k, v in host_batch.items():
@@ -275,7 +351,7 @@ class Trainer:
         if key in cache:
             return cache[key]
         rng = jax.random.key(0)
-        with use_mesh(self.mesh):
+        with use_mesh(self.mesh), self._declared():
             compiled = self._step_fn.lower(
                 self.params, self.opt_state, batches, rng,
                 self.scaler_state).compile()
@@ -297,7 +373,7 @@ class Trainer:
         batches = self.prepare_batch(host_batch)
         rng = jax.random.fold_in(jax.random.key(self.config.seed + 1),
                                  self.global_step)
-        with use_mesh(self.mesh):
+        with use_mesh(self.mesh), self._declared():
             self.params, self.opt_state, metrics, self.scaler_state = \
                 self._step_fn(self.params, self.opt_state, batches, rng,
                               self.scaler_state)
@@ -347,7 +423,8 @@ class Trainer:
                     position_ids=batch.get("position_ids"),
                     segment_ids=batch.get("segment_ids"),
                     deterministic=True, loss_reduction="sum",
-                    include_aux_loss=False)
+                    include_aux_loss=False,
+                    labels_shifted=self._labels_shifted)
             with use_mesh(self.mesh):
                 self._eval_fn = jax.jit(eval_step)
         total, count = 0.0, 0.0
@@ -363,8 +440,9 @@ class Trainer:
             if st.cp > 1:
                 spec[1] = "cp"
             sh = NamedSharding(self.mesh, P(*spec))
+            host_batch = self._cp_reorder(host_batch)
             batch = {k: jax.device_put(v, sh) for k, v in host_batch.items()}
-            with use_mesh(self.mesh):
+            with use_mesh(self.mesh), self._declared():
                 lsum, csum = self._eval_fn(self.params, batch)
             total += float(lsum)
             count += float(csum)

@@ -323,7 +323,7 @@ class GPTLMHeadModel(Module):
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, loss_reduction: str = "mean", rng=None,
                 deterministic=True, n_micro=None,
-                include_aux_loss: bool = True):
+                include_aux_loss: bool = True, labels_shifted: bool = False):
         # include_aux_loss: accepted for API uniformity with the MoE-capable
         # LLaMA family; GPT has no router losses so it is a no-op
         hidden = self.model(params["model"], input_ids,
@@ -338,14 +338,19 @@ class GPTLMHeadModel(Module):
         logits = self.strategy.constrain(logits, self.strategy.act_logits())
         if labels is None:
             return logits
-        tgt = labels[:, 1:]
+        # labels_shifted: host pre-shifted targets (CP seq reorder) — see
+        # LlamaLMHeadModel.forward
+        if labels_shifted:
+            lg, tgt = logits, labels
+        else:
+            lg, tgt = logits[:, :-1, :], labels[:, 1:]
         if loss_reduction not in ("mean", "sum"):
             raise ValueError(f"loss_reduction must be 'mean' or 'sum', got "
                              f"{loss_reduction!r}")
         if loss_reduction == "sum":
             loss = ops.softmax_cross_entropy_sparse(
-                logits[:, :-1, :], tgt, ignore_index=-100, reduction="sum")
+                lg, tgt, ignore_index=-100, reduction="sum")
             count = jnp.sum((tgt != -100).astype(jnp.float32))
             return loss, count
         return ops.softmax_cross_entropy_sparse(
-            logits[:, :-1, :], tgt, ignore_index=-100)
+            lg, tgt, ignore_index=-100)

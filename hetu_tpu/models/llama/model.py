@@ -371,9 +371,15 @@ class LlamaLMHeadModel(Module):
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, rng=None, deterministic=True,
                 loss_reduction: str = "mean", n_micro=None,
-                include_aux_loss: bool = True):
+                include_aux_loss: bool = True, labels_shifted: bool = False):
         """include_aux_loss: fold MoE router losses into the returned loss
-        (disable for evaluation so perplexity stays comparable to dense)."""
+        (disable for evaluation so perplexity stays comparable to dense).
+
+        labels_shifted: labels[t] is ALREADY the next-token target of
+        input[t] (host-side pre-shift) — required when the seq axis was
+        reordered (CP sym/stripe splits), where array adjacency no longer
+        means token adjacency (reference: bucket.py:193
+        generate_cp_pack_data pre-shifts before the CP split)."""
         hidden, aux = self.model(params["model"], input_ids,
                                  position_ids=position_ids,
                                  segment_ids=segment_ids,
@@ -382,8 +388,12 @@ class LlamaLMHeadModel(Module):
         logits = self.logits(params, hidden)
         if labels is None:
             return logits
-        # next-token objective: logits[t] predicts labels[t+1]
-        tgt = labels[:, 1:]
+        # next-token objective: logits[t] predicts labels[t+1] (or labels[t]
+        # when pre-shifted)
+        if labels_shifted:
+            lg, tgt = logits, labels
+        else:
+            lg, tgt = logits[:, :-1, :], labels[:, 1:]
         if loss_reduction not in ("mean", "sum"):
             raise ValueError(f"loss_reduction must be 'mean' or 'sum', got "
                              f"{loss_reduction!r}")
@@ -391,7 +401,7 @@ class LlamaLMHeadModel(Module):
             # (sum, token_count) — lets grad accumulation / DP weight micro
             # batches by their true token counts instead of mean-of-means
             loss = ops.softmax_cross_entropy_sparse(
-                logits[:, :-1, :], tgt, ignore_index=-100, reduction="sum")
+                lg, tgt, ignore_index=-100, reduction="sum")
             count = jnp.sum((tgt != -100).astype(jnp.float32))
             # aux (MoE router losses) scales with the token count so that
             # sum/count recovers mean-loss + aux
@@ -399,13 +409,13 @@ class LlamaLMHeadModel(Module):
                 loss = loss + aux * count
             return loss, count
         loss = ops.softmax_cross_entropy_sparse(
-            logits[:, :-1, :], tgt, ignore_index=-100)
+            lg, tgt, ignore_index=-100)
         return loss + aux if include_aux_loss else loss
 
     # ------------------------------------------------------------------
     def pipeline_train_grads(self, params, input_ids, labels, *,
                              position_ids=None, segment_ids=None,
-                             n_micro: int):
+                             n_micro: int, labels_shifted: bool = False):
         """1F1B (PipeDream-flush) training pass: returns
         ((loss_sum, count), grads) with grads matching `params` exactly
         (reference: executable_graph.cc:836 GeneratePipedreamFlushSchedule).
@@ -435,7 +445,8 @@ class LlamaLMHeadModel(Module):
               "final_norm": params["model"]["final_norm"]}
         if not c.tie_word_embeddings:
             ep["lm_head"] = params["lm_head"]
-        count = jnp.sum((labels[:, 1:] != -100).astype(jnp.float32))
+        count = jnp.sum(((labels if labels_shifted else labels[:, 1:])
+                         != -100).astype(jnp.float32))
 
         cos, sin = ops.build_rope_cache(
             c.max_position_embeddings, c.head_dim, c.rope_theta,
@@ -466,9 +477,12 @@ class LlamaLMHeadModel(Module):
             if not c.tie_word_embeddings:
                 shim["lm_head"] = ep_["lm_head"]
             logits = self.logits(shim, hidden)
+            if labels_shifted:
+                lg, tgt = logits, lab
+            else:
+                lg, tgt = logits[:, :-1, :], lab[:, 1:]
             return ops.softmax_cross_entropy_sparse(
-                logits[:, :-1, :], lab[:, 1:], ignore_index=-100,
-                reduction="sum")
+                lg, tgt, ignore_index=-100, reduction="sum")
 
         def stage_fn(sp_slice, ep_, x_in, feed_b, feed_s, flg):
             emb = self.model.embed(ep_["embed"], feed_b["ids"])

@@ -27,6 +27,7 @@ wraps it for use from global-view (jit) model code.
 """
 from __future__ import annotations
 
+import contextlib
 import functools
 from typing import Optional
 
@@ -35,7 +36,9 @@ import jax.numpy as jnp
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
-from hetu_tpu.ops.pallas.flash_attention import NEG_INF, _bwd, _fwd
+from hetu_tpu.ops.pallas.flash_attention import (NEG_INF, _bwd, _fwd,
+                                                 causal_block_mask,
+                                                 fit_block, full_block_mask)
 from hetu_tpu.parallel.strategy import ParallelStrategy
 
 
@@ -57,29 +60,196 @@ def _rotate(xs, axis_name):
 
 
 def _pick_block(seq: int, want: int) -> int:
-    """Largest block <= want that divides seq (lane-aligned when possible) —
-    avoids the silent-tail-drop hazard of a non-dividing block."""
-    bs = min(want, seq)
-    while seq % bs:
-        bs -= 128 if bs > 128 else 1
-        if bs <= 0:
-            raise ValueError(f"cannot block seq len {seq}")
-    return bs
+    """Largest block <= want that divides seq — the kernel's fit_block
+    rule (one shared block-geometry policy; avoids the silent-tail-drop
+    hazard of a non-dividing block)."""
+    return fit_block(want, seq)
+
+
+# ---------------------------------------------------------------------------
+# Ring-step live-tile masks (AttnInfo analog).
+#
+# The reference precomputes per-(rank, origin) mask kinds — causal / full /
+# EMPTY — so dead blocks never execute (ParallelAttention.cc:212
+# GenerateAttnInfo). In one-program SPMD the per-rank mask choice becomes a
+# lax.cond on the rank index: for each ring step i>0 there are at most two
+# mask patterns across ranks ("origin before me" vs "origin after me",
+# predicate r >= i), each branch running the Pallas kernel on a compressed
+# tile grid. The in-kernel position masks stay on as the exact per-token
+# guard; the static masks only bound which TILES get scheduled, so they must
+# be (and are) conservative supersets.
+#
+# Per split pattern (data/bucket.py cp_split_batch):
+#   normal — step 0 is the within-chunk causal triangle; steps from later
+#            chunks are fully dead (skipped without running the kernel).
+#            No lockstep wall-clock win (the ring waits on the busiest
+#            rank), but dead steps stop burning MXU.
+#   stripe — every (rank, origin) pair reduces to the SAME stripe-granular
+#            triangle: uniform mask, no cond, ~2x tile reduction per step.
+#   sym    — head+tail chunks: 2 of 4 quadrants are dead at every step
+#            (which 2 depends on r vs origin -> the cond), so every rank
+#            schedules exactly half the tiles every step: a true 2x.
+# ---------------------------------------------------------------------------
+
+# The process-wide declared CP data layout (the analog of the reference's
+# HETU_PARALLEL_ATTN_SPLIT env flag, ParallelAttention.cc:196-204). Set by
+# whoever reorders the data (the Trainer); consulted by ring_attention_gspmd
+# when the strategy doesn't declare cp_split explicitly. None = undeclared =
+# no static skipping.
+_DECLARED_CP_SPLIT: Optional[str] = None
+
+
+def declare_cp_split(split: Optional[str]):
+    """Declare the CP split pattern of the batches this process feeds to
+    ring attention (must match the actual seq reorder, or tiles holding live
+    scores get skipped)."""
+    global _DECLARED_CP_SPLIT
+    if split not in (None, "normal", "stripe", "sym"):
+        raise ValueError(f"split must be sym|stripe|normal|None, got {split!r}")
+    _DECLARED_CP_SPLIT = split
+
+
+@contextlib.contextmanager
+def declared_cp_split(split: Optional[str]):
+    """Scoped declare_cp_split — the Trainer wraps its (traced) step calls
+    so its declaration cannot leak onto unrelated ring users in the same
+    process (mask choice is captured at trace time)."""
+    global _DECLARED_CP_SPLIT
+    prev = _DECLARED_CP_SPLIT
+    declare_cp_split(split)
+    try:
+        yield
+    finally:
+        _DECLARED_CP_SPLIT = prev
+
+
+def _stripe_mask(s: int, bq: int, bk: int, g: int):
+    """Union-over-ranks live tiles for the stripe split at granularity g:
+    tile (qi, ki) can contain a visible pair for SOME (rank, origin) iff its
+    max q stripe is >= its min k stripe."""
+    return tuple(
+        tuple((qi * bq + bq - 1) // g >= (ki * bk) // g
+              for ki in range(s // bk))
+        for qi in range(s // bq))
+
+
+def _stripe_granularity(s_loc: int, cp: int):
+    """cp_split_batch's stripe granularity, from the shared rule (which
+    takes the GLOBAL seq = s_loc * cp)."""
+    from hetu_tpu.data.bucket import stripe_granularity
+    return stripe_granularity(s_loc * cp, cp)
+
+
+def ring_step_masks(split, s_loc: int, bq: int, bk: int, cp: int,
+                    causal: bool):
+    """(mask_step0, mask_origin_before, mask_origin_after) static tile grids,
+    or None to disable skipping. mask_origin_after=None = step fully dead."""
+    if not causal or split is None or cp == 1:
+        return None
+    if s_loc % bq or s_loc % bk:
+        return None
+    tri = causal_block_mask(s_loc, s_loc, bq, bk, q_offset=0, k_offset=0)
+    if split == "normal":
+        return (tri, full_block_mask(s_loc, s_loc, bq, bk), None)
+    if split == "stripe":
+        g = _stripe_granularity(s_loc, cp)
+        if g is None:
+            return None
+        m = _stripe_mask(s_loc, bq, bk, g)
+        return (m, m, m)
+    if split == "sym":
+        half = s_loc // 2
+        if s_loc % 2 or half % bq or half % bk:
+            return None
+        nk, hk = s_loc // bk, half // bk
+        hq = half // bq
+        tri_h = causal_block_mask(half, half, bq, bk, q_offset=0, k_offset=0)
+        # step 0 (origin == me): [qh|kh] diag, [qh|kt] dead, [qt|kh] full,
+        # [qt|kt] diag
+        c = tuple(tri_h[qi] + (False,) * (nk - hk) for qi in range(hq)) + \
+            tuple((True,) * hk + tri_h[qi] for qi in range(hq))
+        # origin strictly before me: k head chunk fully visible, k tail dead
+        a = tuple((True,) * hk + (False,) * (nk - hk)
+                  for _ in range(s_loc // bq))
+        # origin strictly after me: my head rows dead, my tail rows full
+        b = tuple((False,) * nk for _ in range(hq)) + \
+            tuple((True,) * nk for _ in range(hq))
+        return (c, a, b)
+    raise ValueError(f"split must be sym|stripe|normal|None, got {split!r}")
+
+
+def _masked_fwd(i, masks, axis_name, q, k_i, v_i, q_pos, kpos_i, q_seg,
+                kseg_i, *, scale, causal, block_q, block_k):
+    """One ring step's forward with static tile skipping (cond on rank)."""
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    if masks is None:
+        return _fwd(q, k_i, v_i, q_pos, kpos_i, q_seg, kseg_i, **kw)
+    if i == 0:
+        return _fwd(q, k_i, v_i, q_pos, kpos_i, q_seg, kseg_i,
+                    block_mask=masks[0], **kw)
+    if masks[1] == masks[2]:            # uniform across ranks (stripe)
+        return _fwd(q, k_i, v_i, q_pos, kpos_i, q_seg, kseg_i,
+                    block_mask=masks[1], **kw)
+    b, h, sq, d = q.shape
+
+    def before():
+        return _fwd(q, k_i, v_i, q_pos, kpos_i, q_seg, kseg_i,
+                    block_mask=masks[1], **kw)
+
+    def after():
+        if masks[2] is None:            # entirely dead step for these ranks
+            return (jnp.zeros((b, h, sq, d), q.dtype),
+                    jnp.full((b, h, sq), NEG_INF, jnp.float32))
+        return _fwd(q, k_i, v_i, q_pos, kpos_i, q_seg, kseg_i,
+                    block_mask=masks[2], **kw)
+
+    r = lax.axis_index(axis_name)
+    return lax.cond(r >= i, before, after)
+
+
+def _masked_bwd(i, masks, axis_name, q, k_i, v_i, o, lse, do, q_pos, kpos_i,
+                q_seg, kseg_i, *, scale, causal, block_q, block_k, delta):
+    """One ring step's backward with static tile skipping (cond on rank)."""
+    kw = dict(scale=scale, causal=causal, block_q=block_q, block_k=block_k)
+    if masks is None:
+        return _bwd(q, k_i, v_i, o, lse, do, q_pos, kpos_i, q_seg, kseg_i,
+                    delta=delta, **kw)
+    if i == 0:
+        return _bwd(q, k_i, v_i, o, lse, do, q_pos, kpos_i, q_seg, kseg_i,
+                    delta=delta, block_mask=masks[0], **kw)
+    if masks[1] == masks[2]:
+        return _bwd(q, k_i, v_i, o, lse, do, q_pos, kpos_i, q_seg, kseg_i,
+                    delta=delta, block_mask=masks[1], **kw)
+
+    def before():
+        return _bwd(q, k_i, v_i, o, lse, do, q_pos, kpos_i, q_seg, kseg_i,
+                    delta=delta, block_mask=masks[1], **kw)
+
+    def after():
+        if masks[2] is None:
+            return (jnp.zeros(q.shape, jnp.float32),
+                    jnp.zeros(k_i.shape, jnp.float32),
+                    jnp.zeros(v_i.shape, jnp.float32))
+        return _bwd(q, k_i, v_i, o, lse, do, q_pos, kpos_i, q_seg, kseg_i,
+                    delta=delta, block_mask=masks[2], **kw)
+
+    r = lax.axis_index(axis_name)
+    return lax.cond(r >= i, before, after)
 
 
 # All arrays here are LOCAL shards: q/k/v [b, h, s_loc, d] (head-major, the
 # kernel's native layout); positions/segments [b, s_loc].
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(7, 8, 9, 10, 11))
 def _ring(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale, causal,
-          block_sizes):
+          block_sizes, masks):
     o, _ = _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
-                          scale, causal, block_sizes)
+                          scale, causal, block_sizes, masks)
     return o
 
 
 def _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale,
-                   causal, block_sizes):
+                   causal, block_sizes, masks):
     b, h, sq, d = q.shape
     cp = lax.axis_size(axis_name)
     block_q = _pick_block(sq, block_sizes[0])
@@ -90,11 +260,10 @@ def _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale,
     k_i, v_i, kpos_i = k, v, kv_pos
     kseg_i = kv_seg
     for i in range(cp):
-        o_i, lse_i = _fwd(q, k_i, v_i, q_pos, kpos_i,
-                          q_seg if use_seg else None,
-                          kseg_i if use_seg else None,
-                          scale=scale, causal=causal,
-                          block_q=block_q, block_k=block_k)
+        o_i, lse_i = _masked_fwd(
+            i, masks, axis_name, q, k_i, v_i, q_pos, kpos_i,
+            q_seg if use_seg else None, kseg_i if use_seg else None,
+            scale=scale, causal=causal, block_q=block_q, block_k=block_k)
         o, lse = _merge(o, lse, o_i.astype(jnp.float32), lse_i)
         if i != cp - 1:
             if use_seg:
@@ -106,13 +275,13 @@ def _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale,
 
 
 def _ring_vjp_fwd(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name, scale,
-                  causal, block_sizes):
+                  causal, block_sizes, masks):
     o, lse = _ring_fwd_impl(q, k, v, q_pos, kv_pos, q_seg, kv_seg, axis_name,
-                            scale, causal, block_sizes)
+                            scale, causal, block_sizes, masks)
     return o, (q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg)
 
 
-def _ring_vjp_bwd(axis_name, scale, causal, block_sizes, res, do):
+def _ring_vjp_bwd(axis_name, scale, causal, block_sizes, masks, res, do):
     q, k, v, o, lse, q_pos, kv_pos, q_seg, kv_seg = res
     b, h, sq, d = q.shape
     cp = lax.axis_size(axis_name)
@@ -127,8 +296,8 @@ def _ring_vjp_bwd(axis_name, scale, causal, block_sizes, res, do):
     dk_i = jnp.zeros(k.shape, jnp.float32)
     dv_i = jnp.zeros(v.shape, jnp.float32)
     for i in range(cp):
-        dq_c, dk_c, dv_c = _bwd(
-            q, k_i, v_i, o, lse, do, q_pos, kpos_i,
+        dq_c, dk_c, dv_c = _masked_bwd(
+            i, masks, axis_name, q, k_i, v_i, o, lse, do, q_pos, kpos_i,
             q_seg if use_seg else None, kseg_i if use_seg else None,
             scale=scale, causal=causal, block_q=block_q, block_k=block_k,
             delta=delta)
@@ -154,13 +323,25 @@ def ring_attention(q, k, v, *, axis_name: str = "cp",
                    q_positions=None, kv_positions=None,
                    segment_ids=None, kv_segment_ids=None,
                    causal: bool = True, softmax_scale: Optional[float] = None,
-                   block_q: int = 512, block_k: int = 512):
+                   block_q: int = 512, block_k: int = 512,
+                   split: Optional[str] = "auto"):
     """Ring attention over `axis_name`. shard_map-internal: all args are the
     LOCAL shard, layout [b, s_loc, heads_loc, d]; positions are GLOBAL token
-    positions of the local tokens (per-segment positions for packed rows)."""
+    positions of the local tokens (per-segment positions for packed rows).
+
+    `split` names the CP split pattern the data pipeline used
+    (data/bucket.py cp_split_batch: normal|stripe|sym) and turns on static
+    ring-step tile skipping (the AttnInfo analog — see ring_step_masks).
+    "auto": "normal" when positions are generated here (contiguous chunks),
+    no skipping when the caller supplied positions (their layout is unknown).
+    The positions remain the exact mask; a wrong `split` can only be wrong
+    by skipping live tiles, so pass None if unsure."""
     b, s, hh, d = q.shape
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     cp_rank = lax.axis_index(axis_name)
+    if split == "auto":
+        split = "normal" if (q_positions is None and kv_positions is None) \
+            else None
     if q_positions is None:
         # contiguous chunks: global offset = rank * s_loc
         base = cp_rank * s + jnp.arange(s, dtype=jnp.int32)
@@ -169,6 +350,14 @@ def ring_attention(q, k, v, *, axis_name: str = "cp",
         kv_positions = q_positions
     if kv_segment_ids is None:
         kv_segment_ids = segment_ids
+    cp = lax.axis_size(axis_name)
+    bq = _pick_block(s, block_q)
+    bk = _pick_block(s, block_k)
+    if split == "sym" and s % 2 == 0:
+        # blocks must respect the head/tail chunk boundary
+        bq = _pick_block(s // 2, block_q)
+        bk = _pick_block(s // 2, block_k)
+    masks = ring_step_masks(split, s, bq, bk, cp, causal)
     qt = q.transpose(0, 2, 1, 3)
     kt = k.transpose(0, 2, 1, 3)
     vt = v.transpose(0, 2, 1, 3)
@@ -176,19 +365,26 @@ def ring_attention(q, k, v, *, axis_name: str = "cp",
               kv_positions.astype(jnp.int32),
               segment_ids.astype(jnp.int32) if segment_ids is not None else None,
               kv_segment_ids.astype(jnp.int32) if kv_segment_ids is not None else None,
-              axis_name, scale, causal, (block_q, block_k))
+              axis_name, scale, causal, (bq, bk), masks)
     return o.transpose(0, 2, 1, 3)
 
 
 def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
                          segment_ids=None, position_ids=None,
-                         causal: bool = True, mesh=None):
+                         causal: bool = True, mesh=None,
+                         split: Optional[str] = "auto"):
     """Global-view wrapper: q/k/v [b, s, h, d] logically sharded
     (dp, cp, tp, -) — runs the ring inside a shard_map over the strategy mesh
     (reference: ParallelAttentionOpImpl::DoCompute dispatching AttnCommRing).
 
     position_ids: per-segment positions (packed rows) or None for contiguous;
     combined with segment_ids they encode exactly the causal+membership mask.
+
+    split: CP split pattern for static ring-step tile skipping. "auto" =
+    the HETU_TPU_CP_SPLIT flag when position_ids came from the data pipeline
+    (whose cp_split_batch uses the same flag default — the single source of
+    truth, like the reference's HETU_PARALLEL_ATTN_SPLIT), "normal" when
+    positions are contiguous. Pass None for custom position layouts.
     """
     from hetu_tpu.core.mesh import current_mesh
     mesh = mesh or current_mesh()
@@ -209,11 +405,19 @@ def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
     tok_spec = strategy.act_tokens().partition_spec()
     use_seg = segment_ids is not None
     use_pos = position_ids is not None
+    if split == "auto":
+        # the split must DESCRIBE the caller's data layout (None = not
+        # declared -> no static skipping); internally-generated positions
+        # are contiguous chunks = "normal" by construction
+        split = ((strategy.cp_split or _DECLARED_CP_SPLIT) if use_pos
+                 else "normal")
 
     tp_eff = strategy.cp_tp_eff
 
     def local(q, k, v, seg, pos):
         if tp_eff is not None:
+            # hetero ring: no static step masks yet (uneven per-member
+            # shapes make the tile grids per-origin; positions still mask)
             return hetero_ring_attention(
                 q, k, v, tp_eff=tp_eff, axis_name="cp", tp_axis="tp",
                 segment_ids=seg if use_seg else None,
@@ -225,7 +429,7 @@ def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
             segment_ids=seg if use_seg else None,
             q_positions=pos if use_pos else None,
             kv_positions=pos if use_pos else None,
-            causal=causal)
+            causal=causal, split=split)
 
     if not use_seg:
         segment_ids = jnp.zeros(q.shape[:2], jnp.int32)

@@ -33,6 +33,13 @@ class ParallelStrategy:
     # mesh.tp; None = homogeneous). Routes ring attention through the
     # head-resplit hetero ring (reference: ParallelAttention.cc:949-1050)
     cp_tp_eff: Optional[Tuple[int, ...]] = None
+    # CP split pattern of the data actually fed to the model
+    # (data/bucket.py cp_split_batch: "normal" | "stripe" | "sym").  Drives
+    # static ring-step tile skipping (the AttnInfo analog) — it must DESCRIBE
+    # the layout, not request one, so None (no skipping, positions still
+    # mask exactly) is the safe default; the Trainer resolves it from
+    # HETU_TPU_CP_SPLIT and reorders batches to match.
+    cp_split: Optional[str] = None
     zero: bool = True          # ZeRO-1 (optimizer-state sharding over dp)
     zero_stage: int = 1        # 1 = opt state; 2 = +grads; 3 = +params (FSDP)
                                # (reference: distributed_states.h zero flag +

@@ -280,6 +280,24 @@ class LlamaDecoderStack(Module):
         if mesh is None:
             raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
 
+        if st.pp_tp_eff is not None:
+            # unequal effective TP per stage in ONE program (reference:
+            # distributed_states.h:158 unions over unequal stage groups)
+            from hetu_tpu.parallel.hetero_pp import (
+                llama_block_maker, staged_stack_forward_hetero_tp)
+            if c.num_experts > 0 or st.sequence_parallel or st.cp > 1:
+                raise NotImplementedError(
+                    "pp_tp_eff composes with dense blocks, no SP, cp=1")
+            return staged_stack_forward_hetero_tp(
+                llama_block_maker(c, cos, sin, tp=st.tp),
+                self.block.param_specs(), params["layers"], x,
+                num_layers=self.num_layers, pp=st.pp, tp=st.tp,
+                tp_eff=st.pp_tp_eff, mesh=mesh,
+                position_ids=position_ids, segment_ids=segment_ids,
+                stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
+                remat=c.remat, remat_policy=c.remat_policy,
+                state_spec=st.pipeline_state_spec())
+
         def block_fn(layer_params, x_mb, pos_mb, seg_mb):
             return self.block(layer_params, x_mb, cos=cos, sin=sin,
                               position_ids=pos_mb, segment_ids=seg_mb)

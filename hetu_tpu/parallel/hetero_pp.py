@@ -1,0 +1,246 @@
+"""Per-stage sub-mesh heterogeneity inside ONE pipeline program.
+
+The last structural hetero capability of the reference: a pipeline whose
+stages run at UNEQUAL tensor-parallel degrees, expressed as
+DistributedStatesUnions over unequal device groups and deduced per stage
+(reference: hetu/graph/distributed_states.h:158-321 + define_and_run_graph.cc
+:159 DeducePipeline). On a rectangular TPU mesh the per-stage degree becomes
+an EFFECTIVE degree e_s (a divisor of the mesh tp extent) with
+m_s = tp/e_s-fold block-major replication — the same trick the hetero CP
+ring uses for unequal-TP ring members (parallel/ring_attention.py
+_hetero_blk_build): device t of a stage computes head/channel block
+t // m_s, so every needed weight block is a LOCAL slice of an all-gathered
+buffer, and the row-parallel reduction is psum(partial)/m_s (each distinct
+block contributes m_s identical copies).
+
+Execution model: ONE jit program, `jax.shard_map` manual over (pp, tp) —
+dp/cp stay automatic — with a `lax.switch` on the stage index choosing that
+stage's static (e_s, layer_count) branch. Stage layer counts compose with
+the degree heterogeneity (a Malleus plan sets both).
+
+The price is the reference's own price for hetero TP: replicated compute on
+low-degree stages (m_s-fold) + the per-layer weight all-gather. The planner
+weighs that against what it buys (e.g. smaller TP collectives on the
+latency-bound stages); this module only makes the layout EXECUTABLE in one
+program.
+"""
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from hetu_tpu.parallel.pipeline import build_stage_stack
+
+
+def _blk(w, dim: int, t, e: int, m: int, tp_axis: str):
+    """Block-major effective-degree weight slice: the [dim]-sharded weight's
+    block t//m of e, as a LOCAL slice of the tp all-gather (m==1: the local
+    shard IS the block)."""
+    if m == 1:
+        return w
+    full = lax.all_gather(w, tp_axis, axis=dim, tiled=True)
+    size_e = full.shape[dim] // e
+    return lax.dynamic_slice_in_dim(full, (t // m) * size_e, size_e, axis=dim)
+
+
+def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
+    """block_maker(e, m) -> block_fn(layer_params, x, pos, seg) -> (x, aux)
+    running the LLaMA block manual-over-tp at effective degree e.
+
+    Mirrors models/llama/model.py LlamaBlock exactly (pre-norm, fused qkv
+    [h, n_kv, group+2, hd], RoPE, flash attention, row o_proj, SwiGLU MLP)
+    — golden-parity tested against it. Dense only (no MoE/dropout here)."""
+    from hetu_tpu import ops
+    from jax.ad_checkpoint import checkpoint_name
+
+    hd = cfg.head_dim
+    n_q, n_kv = cfg.num_attention_heads, cfg.num_key_value_heads
+    group = n_q // n_kv
+
+    def maker(e: int, m: int) -> Callable:
+        if n_kv % e:
+            raise ValueError(f"num_key_value_heads={n_kv} must divide by "
+                             f"effective tp degree {e}")
+        kv_e = n_kv // e
+
+        def block(lp, x, pos, seg):
+            t = lax.axis_index(tp_axis)
+            b, s, h = x.shape
+            xin = ops.rms_norm(x, lp["input_norm"]["weight"],
+                               cfg.rms_norm_eps)
+            wqkv = _blk(lp["attn"]["wqkv"], 1, t, e, m, tp_axis)
+            qkv = jnp.einsum("bsh,hkgd->bskgd", xin,
+                             wqkv.astype(x.dtype))
+            q = qkv[..., :group, :].reshape(b, s, kv_e * group, hd)
+            k = qkv[..., group, :]
+            v = qkv[..., group + 1, :]
+            q = ops.apply_rotary(q, cos, sin, pos)
+            k = ops.apply_rotary(k, cos, sin, pos)
+            attn = ops.flash_attention(
+                q, k, v, causal=True, segment_ids=seg,
+                use_pallas=None if cfg.use_flash_attention else False)
+            attn = checkpoint_name(attn, "attn_out")
+            wo = _blk(lp["attn"]["o_proj"]["weight"], 0, t, e, m, tp_axis)
+            h1 = attn.reshape(b, s, kv_e * group * hd) @ wo.astype(x.dtype)
+            h1 = lax.psum(h1, tp_axis) / m
+            x = x + h1
+            xin2 = ops.rms_norm(x, lp["post_norm"]["weight"],
+                                cfg.rms_norm_eps)
+            wgu = _blk(lp["mlp"]["w_gate_up"], 2, t, e, m, tp_axis)
+            gu = jnp.einsum("bsh,hci->bsci", xin2, wgu.astype(x.dtype))
+            hidden = ops.swiglu(gu[:, :, 0, :], gu[:, :, 1, :])
+            wd = _blk(lp["mlp"]["down_proj"]["weight"], 0, t, e, m, tp_axis)
+            h2 = hidden @ wd.astype(x.dtype)
+            h2 = lax.psum(h2, tp_axis) / m
+            return x + h2, jnp.zeros((), jnp.float32)
+
+        return block
+
+    return maker
+
+
+def _manual_specs(param_spec_tree, keep=("pp", "tp"), lead=("pp", None)):
+    """Model ParamSpec tree (one layer) -> PartitionSpecs naming ONLY the
+    manual axes (auto axes like dp must stay unmentioned), with the stacked
+    (pp, layer) lead dims prepended."""
+    from hetu_tpu.nn.module import ParamSpec
+
+    def one(psp):
+        ds = getattr(psp, "ds", None)
+        if ds is None:
+            return P(*lead)
+        ent = []
+        for axes in ds.spec:
+            ax = [a for a in (axes or ()) if a in keep]
+            ent.append(ax[0] if len(ax) == 1 else (tuple(ax) or None))
+        return P(*(lead + tuple(ent)))
+    return jax.tree.map(one, param_spec_tree,
+                        is_leaf=lambda x: isinstance(x, ParamSpec))
+
+
+def staged_stack_forward_hetero_tp(
+        block_maker: Callable, param_ds_tree, stack_params, x, *,
+        num_layers: int, pp: int, tp: int, tp_eff: Sequence[int], mesh,
+        position_ids=None, segment_ids=None, stage_layers=None,
+        n_micro: Optional[int] = None, remat: bool = True,
+        remat_policy: str = "nothing", state_spec=None,
+        pp_axis: str = "pp", tp_axis: str = "tp"):
+    """GPipe pipeline where stage s runs at effective TP degree tp_eff[s].
+
+    block_maker(e, m) -> block_fn(local_layer_params, x_mb, pos, seg);
+    param_ds_tree: the model's per-layer DS tree (for the manual in_specs).
+    Everything else mirrors pipeline.staged_stack_forward."""
+    tp_eff = tuple(int(e) for e in tp_eff)
+    if len(tp_eff) != pp:
+        raise ValueError(f"tp_eff has {len(tp_eff)} entries for pp={pp}")
+    for e in tp_eff:
+        if e < 1 or tp % e:
+            raise ValueError(f"tp_eff {e} must divide mesh tp={tp}")
+
+    B, s, h = x.shape
+    if n_micro is None:
+        n_micro = pp
+    assert B % n_micro == 0, (B, n_micro)
+    mb = B // n_micro
+    T = n_micro + pp - 1
+    pad = pp - 1
+    spec = state_spec if state_spec is not None else P(pp_axis)
+    tok_spec = P(*((spec[0],) + tuple(spec[1:3])))
+
+    stage_params, _, stage_layers = build_stage_stack(
+        stack_params, num_layers, pp, stage_layers)
+
+    token_data = {}
+    if position_ids is not None:
+        token_data["position_ids"] = position_ids
+    if segment_ids is not None:
+        token_data["segment_ids"] = segment_ids
+
+    xm = x.reshape(n_micro, mb, s, h)
+    tok = {k: v.reshape(n_micro, mb, s) for k, v in token_data.items()}
+
+    pspecs = _manual_specs(param_ds_tree, keep=(pp_axis, tp_axis),
+                           lead=(pp_axis, None))
+
+    def stage_branch(stage_i: int):
+        e = tp_eff[stage_i]
+        m = tp // e
+        k_s = stage_layers[stage_i]
+        block = block_maker(e, m)
+
+        def run(sp1, x_mb, tok1):
+            def body(carry, lp):
+                x_c, aux_c = carry
+                out, aux = block(lp, x_c, tok1.get("position_ids"),
+                                 tok1.get("segment_ids"))
+                return (out, aux_c + aux), None
+
+            fn = body
+            if remat:
+                from hetu_tpu.nn.remat import remat_policy as _policy
+                fn = jax.checkpoint(body, policy=_policy(remat_policy))
+            sliced = jax.tree.map(lambda a: a[:k_s], sp1)
+            (y, aux), _ = lax.scan(
+                fn, (x_mb, jnp.zeros((), jnp.float32)), sliced)
+            return y, aux
+
+        return run
+
+    def manual(sp, x_b, tok_b):
+        # local views: stage dim extent 1, weights local tp shards
+        sp1 = jax.tree.map(lambda a: a[0], sp)
+        tok1 = {k: v[0] for k, v in tok_b.items()}
+        p = lax.axis_index(pp_axis)
+        branches = [stage_branch(i) for i in range(pp)]
+        y, aux = lax.switch(p, branches, sp1, x_b[0], tok1)
+        return y[None], jnp.reshape(aux, (1,)).astype(jnp.float32)
+
+    Ppp = P(pp_axis)
+    vbody = jax.shard_map(
+        manual, mesh=mesh,
+        in_specs=(pspecs, Ppp, {k: Ppp for k in token_data}),
+        out_specs=(Ppp, Ppp),
+        axis_names=frozenset({pp_axis, tp_axis}), check_vma=False)
+
+    def shift_in(new, state, sp=None):
+        out = jnp.concatenate([new[None], state[:-1]], axis=0)
+        return lax.with_sharding_constraint(
+            out, sp if sp is not None else spec)
+
+    if pad:
+        xs_x = jnp.concatenate(
+            [xm, jnp.zeros((pad,) + xm.shape[1:], xm.dtype)])
+        xs_tok = {k: jnp.concatenate(
+            [v, jnp.zeros((pad,) + v.shape[1:], v.dtype)])
+            for k, v in tok.items()}
+    else:
+        xs_x, xs_tok = xm, tok
+
+    init_x = lax.with_sharding_constraint(
+        jnp.zeros((pp, mb, s, h), x.dtype), spec)
+    init_tok = {k: jnp.zeros((pp, mb, s), v.dtype) for k, v in tok.items()}
+
+    ticks = jnp.arange(T)
+    stages = jnp.arange(pp)
+    micro_idx = ticks[:, None] - stages[None, :]
+    aux_mask = ((micro_idx >= 0) & (micro_idx < n_micro)).astype(jnp.float32)
+
+    def step(carry, xs_t):
+        state_x, state_tok = carry
+        in_x, in_tok, mask_t = xs_t
+        cur_x = shift_in(in_x, state_x)
+        cur_tok = {k: shift_in(in_tok[k], state_tok[k], tok_spec)
+                   for k in state_tok}
+        out_x, aux = vbody(stage_params, cur_x, cur_tok)
+        aux = jnp.sum(aux * mask_t)
+        out_x = lax.with_sharding_constraint(out_x, spec)
+        return (out_x, cur_tok), (out_x[-1], aux)
+
+    _, (ys, auxs) = lax.scan(step, (init_x, init_tok),
+                             (xs_x, xs_tok, aux_mask))
+    outs = ys[pad:] if pad else ys
+    return outs.reshape(B, s, h), jnp.sum(auxs)

@@ -40,6 +40,12 @@ class ParallelStrategy:
     # mask exactly) is the safe default; the Trainer resolves it from
     # HETU_TPU_CP_SPLIT and reorders batches to match.
     cp_split: Optional[str] = None
+    # hetero PP: effective tp degree per pipeline stage (each a divisor of
+    # mesh.tp; None = homogeneous). Routes the decoder stack through the
+    # one-program hetero-TP pipeline (parallel/hetero_pp.py — the
+    # distributed_states.h:158 unequal-stage-group capability on a
+    # rectangular mesh)
+    pp_tp_eff: Optional[Tuple[int, ...]] = None
     zero: bool = True          # ZeRO-1 (optimizer-state sharding over dp)
     zero_stage: int = 1        # 1 = opt state; 2 = +grads; 3 = +params (FSDP)
                                # (reference: distributed_states.h zero flag +

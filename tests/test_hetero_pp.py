@@ -1,0 +1,94 @@
+"""Hetero-TP pipeline: unequal effective TP degree per stage in ONE program
+(reference: distributed_states.h:158-321 unions over unequal device groups +
+define_and_run_graph.cc:159 DeducePipeline)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel import ParallelStrategy
+
+
+def _cfg(**kw):
+    return LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                            use_flash_attention=False, use_scan=True, **kw)
+
+
+def _golden(cfg, ids):
+    model = LlamaLMHeadModel(cfg, ParallelStrategy())
+    p = model.init(jax.random.key(1))
+    return model, p, model(p, ids)
+
+
+def _ids(b=4, s=64, vocab=256, seed=0):
+    return jnp.asarray(np.random.default_rng(seed).integers(0, vocab, (b, s)),
+                       jnp.int32)
+
+
+@pytest.mark.parametrize("tp_eff", [(2, 1), (1, 2), (2, 2), (1, 1)])
+def test_hetero_tp_pipeline_matches_single_device(tp_eff):
+    cfg = _cfg()
+    ids = _ids()
+    _, _, golden = _golden(cfg, ids)
+
+    st = ParallelStrategy(mesh=MeshConfig(pp=2, tp=2), pp_tp_eff=tp_eff)
+    mesh = st.build_mesh(devices=jax.devices()[:4])
+    model = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(1), mesh=mesh)
+        out = jax.jit(lambda p, x: model(p, x, n_micro=2))(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_hetero_tp_pipeline_gradients():
+    cfg = _cfg()
+    ids = _ids(seed=3)
+    gmodel, gp, _ = _golden(cfg, ids)
+
+    def gloss(p):
+        return gmodel(p, ids, labels=ids)
+    g_ref = jax.grad(gloss)(gp)
+
+    st = ParallelStrategy(mesh=MeshConfig(pp=2, tp=2), pp_tp_eff=(2, 1))
+    mesh = st.build_mesh(devices=jax.devices()[:4])
+    model = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(1), mesh=mesh)
+        g = jax.jit(jax.grad(
+            lambda p: model(p, ids, labels=ids, n_micro=2)))(params)
+    flat_ref = jax.tree.leaves_with_path(g_ref)
+    flat = dict(jax.tree.leaves_with_path(g))
+    assert len(flat) == len(flat_ref)
+    for path, a in flat_ref:
+        b = flat[path]
+        np.testing.assert_allclose(np.asarray(b), np.asarray(a),
+                                   rtol=3e-3, atol=3e-3,
+                                   err_msg=str(path))
+
+
+def test_hetero_tp_with_uneven_stage_layers():
+    # Malleus composition: unequal layers AND unequal tp per stage
+    cfg = _cfg(num_hidden_layers=3, pipeline_stage_layers=(2, 1))
+    ids = _ids(seed=4)
+    _, _, golden = _golden(cfg, ids)
+
+    st = ParallelStrategy(mesh=MeshConfig(pp=2, tp=2), pp_tp_eff=(2, 1))
+    mesh = st.build_mesh(devices=jax.devices()[:4])
+    model = LlamaLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(1), mesh=mesh)
+        out = jax.jit(lambda p, x: model(p, x, n_micro=2))(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-3, atol=2e-3)
+
+
+def test_bad_tp_eff_rejected():
+    from hetu_tpu.parallel.hetero_pp import staged_stack_forward_hetero_tp
+    with pytest.raises(ValueError):
+        staged_stack_forward_hetero_tp(
+            lambda e, m: None, {}, {}, jnp.zeros((2, 8, 4)),
+            num_layers=2, pp=2, tp=2, tp_eff=(3, 1), mesh=None)

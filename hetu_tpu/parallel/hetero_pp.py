@@ -36,6 +36,58 @@ from jax.sharding import PartitionSpec as P
 from hetu_tpu.parallel.pipeline import build_stage_stack
 
 
+def _pv(x, axes):
+    """pvary x onto any of `axes` not already in its varying-manual-axes set.
+
+    check_vma=True is load-bearing here, not just a lint: with it off, JAX
+    wraps every op in the manual body in unspecified-sharding constraints,
+    and the one landing INSIDE a bf16 psum's reducer region becomes a `copy`
+    HLO that crashes XLA:CPU's AllReducePromotion pass (CloneAllReduce ->
+    CreateBinary(copy) check-fail) under the full dp+ZeRO+remat train step."""
+    vma = jax.typeof(x).vma
+    need = tuple(a for a in axes if a not in vma)
+    if not need:
+        return x
+    if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
+        # pvary's transpose is a psum of the cotangent in x's dtype; route
+        # it through f32 so no 16-bit all-reduce reaches XLA:CPU.
+        return lax.pvary(x.astype(jnp.float32), need).astype(x.dtype)
+    return lax.pvary(x, need)
+
+
+def _widen_16bit() -> bool:
+    """True when 16-bit collectives from this partial-manual region must be
+    widened to f32 (XLA:CPU AllReducePromotion crash — see _pv). TPU keeps
+    16-bit collectives: the pass doesn't run there and half the bytes ride
+    the ICI."""
+    return jax.default_backend() == "cpu"
+
+
+def _al(*xs):
+    """Align the varying-manual-axes sets of xs to their union (pvary each
+    missing axis) so elementwise/contraction ops type-check under
+    check_vma=True."""
+    union = set()
+    for x in xs:
+        union |= set(jax.typeof(x).vma)
+    union = tuple(union)
+    return tuple(_pv(x, union) for x in xs)
+
+
+def _psum_wide(x, axis):
+    """psum with f32 accumulation for 16-bit inputs.
+
+    Two birds: wider reduction numerics, and a hard guarantee that no 16-bit
+    all-reduce is emitted from this partial-manual region — XLA:CPU's
+    AllReducePromotion pass check-fails (CreateBinary on a `copy` reducer
+    root) on 16-bit all-reduces whose reducer carries the partial-manual
+    sdy constraint (see _pv docstring; minimal repro: bf16 psum inside a
+    shard_map with any auto axis)."""
+    if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
+        return lax.psum(x.astype(jnp.float32), axis).astype(x.dtype)
+    return lax.psum(x, axis)
+
+
 def _blk(w, dim: int, t, e: int, m: int, tp_axis: str):
     """Block-major effective-degree weight slice: the [dim]-sharded weight's
     block t//m of e, as a LOCAL slice of the tp all-gather (m==1: the local
@@ -44,7 +96,8 @@ def _blk(w, dim: int, t, e: int, m: int, tp_axis: str):
         return w
     full = lax.all_gather(w, tp_axis, axis=dim, tiled=True)
     size_e = full.shape[dim] // e
-    return lax.dynamic_slice_in_dim(full, (t // m) * size_e, size_e, axis=dim)
+    idx = _pv((t // m) * size_e, jax.typeof(full).vma)
+    return lax.dynamic_slice_in_dim(full, idx, size_e, axis=dim)
 
 
 def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
@@ -70,32 +123,44 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
         def block(lp, x, pos, seg):
             t = lax.axis_index(tp_axis)
             b, s, h = x.shape
-            xin = ops.rms_norm(x, lp["input_norm"]["weight"],
-                               cfg.rms_norm_eps)
+            nw, nw2 = _al(lp["input_norm"]["weight"], lp["post_norm"]["weight"],
+                          x)[:2]
+            xin = ops.rms_norm(x, nw, cfg.rms_norm_eps)
             wqkv = _blk(lp["attn"]["wqkv"], 1, t, e, m, tp_axis)
-            qkv = jnp.einsum("bsh,hkgd->bskgd", xin,
+            xin_t, wqkv = _al(xin, wqkv)
+            qkv = jnp.einsum("bsh,hkgd->bskgd", xin_t,
                              wqkv.astype(x.dtype))
             q = qkv[..., :group, :].reshape(b, s, kv_e * group, hd)
             k = qkv[..., group, :]
             v = qkv[..., group + 1, :]
-            q = ops.apply_rotary(q, cos, sin, pos)
-            k = ops.apply_rotary(k, cos, sin, pos)
+            q, k, cos_a, sin_a, pos_a = _al(q, k, cos, sin,
+                                            jnp.zeros((), jnp.int32)
+                                            if pos is None else pos)
+            pos_a = None if pos is None else pos_a
+            q = ops.apply_rotary(q, cos_a, sin_a, pos_a)
+            k = ops.apply_rotary(k, cos_a, sin_a, pos_a)
+            if seg is not None:
+                q, k, v, seg = _al(q, k, v, seg)
+            else:
+                q, k, v = _al(q, k, v)
             attn = ops.flash_attention(
                 q, k, v, causal=True, segment_ids=seg,
                 use_pallas=None if cfg.use_flash_attention else False)
             attn = checkpoint_name(attn, "attn_out")
             wo = _blk(lp["attn"]["o_proj"]["weight"], 0, t, e, m, tp_axis)
-            h1 = attn.reshape(b, s, kv_e * group * hd) @ wo.astype(x.dtype)
-            h1 = lax.psum(h1, tp_axis) / m
+            attn2, wo = _al(attn.reshape(b, s, kv_e * group * hd), wo)
+            h1 = attn2 @ wo.astype(x.dtype)
+            h1, x = _al(_psum_wide(h1, tp_axis) / m, x)
             x = x + h1
-            xin2 = ops.rms_norm(x, lp["post_norm"]["weight"],
-                                cfg.rms_norm_eps)
+            xin2 = ops.rms_norm(x, _al(nw2, x)[0], cfg.rms_norm_eps)
             wgu = _blk(lp["mlp"]["w_gate_up"], 2, t, e, m, tp_axis)
-            gu = jnp.einsum("bsh,hci->bsci", xin2, wgu.astype(x.dtype))
+            xin2_t, wgu = _al(xin2, wgu)
+            gu = jnp.einsum("bsh,hci->bsci", xin2_t, wgu.astype(x.dtype))
             hidden = ops.swiglu(gu[:, :, 0, :], gu[:, :, 1, :])
             wd = _blk(lp["mlp"]["down_proj"]["weight"], 0, t, e, m, tp_axis)
+            hidden, wd = _al(hidden, wd)
             h2 = hidden @ wd.astype(x.dtype)
-            h2 = lax.psum(h2, tp_axis) / m
+            h2, x = _al(_psum_wide(h2, tp_axis) / m, x)
             return x + h2, jnp.zeros((), jnp.float32)
 
         return block
@@ -204,7 +269,7 @@ def staged_stack_forward_hetero_tp(
         manual, mesh=mesh,
         in_specs=(pspecs, Ppp, {k: Ppp for k in token_data}),
         out_specs=(Ppp, Ppp),
-        axis_names=frozenset({pp_axis, tp_axis}), check_vma=False)
+        axis_names=frozenset({pp_axis, tp_axis}), check_vma=True)
 
     def shift_in(new, state, sp=None):
         out = jnp.concatenate([new[None], state[:-1]], axis=0)

@@ -59,6 +59,14 @@ class MoEConfig:
     # 0 = auto (largest divisor of num_experts <= 8, the reference's
     # num_local_gpus default)
     sam_group_size: int = 0
+    # weight of the SAM group-alignment hinge loss, separate from the
+    # load-balance coefficient (reference: SAMGate.py keeps distinct
+    # balance_loss/alignment_loss weights); None = follow load_balance_coef
+    sam_alignment_coef: float | None = None
+
+    def resolved_sam_alignment_coef(self) -> float:
+        return (self.load_balance_coef if self.sam_alignment_coef is None
+                else self.sam_alignment_coef)
 
     def resolved_sam_group_size(self) -> int:
         """Experts per SAM locality group (NOT the group count — that is
@@ -175,7 +183,7 @@ def aux_losses(logits, expert_idx, moe: MoEConfig):
         group_of = expert_idx[:, :1] // gs                  # [T, 1]
         outside = (jnp.arange(E)[None, :] // gs) != group_of
         hinge = jnp.where(outside, jnp.maximum(probs - tmp, 0.0), 0.0)
-        aux = aux + moe.load_balance_coef * jnp.sum(hinge) / T
+        aux = aux + moe.resolved_sam_alignment_coef() * jnp.sum(hinge) / T
     return aux
 
 

@@ -565,13 +565,19 @@ def flash_attention(q, k, v, *, causal: bool = True,
     b, sq, hq, d = q.shape
     sk = k.shape[1]
     bq0, bk0 = min(block_q, sq), min(block_k, sk)
+    default_blocks = block_q == DEFAULT_BLOCK_Q and block_k == DEFAULT_BLOCK_K
     block_q = fit_block(block_q, sq)
     block_k = fit_block(block_k, sk)
-    # explicit block choices that divide are honored as-is; a ladder shrink
-    # below lane alignment means no reasonable block exists
-    if (block_q != bq0 and block_q < 128) or (block_k != bk0 and block_k < 128):
+    # under the DEFAULT ladder, a shrink below lane alignment means the seq
+    # len fits no reasonable tile — reject and point at the bucket ladder.
+    # An EXPLICIT caller block choice is honored at whatever divisor
+    # fit_block lands on (the caller opted out of the default geometry).
+    if default_blocks and ((block_q != bq0 and block_q < 128)
+                           or (block_k != bk0 and block_k < 128)):
         raise ValueError(f"seq lens ({sq},{sk}) fit no lane-aligned block "
-                         f"ladder; pad via the bucket ladder")
+                         f"ladder (best: q={block_q}, k={block_k}); pad via "
+                         f"the bucket ladder or pass block_q/block_k "
+                         f"explicitly")
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     # contiguous positions on both sides -> tiles above the diagonal are
     # never scheduled (the causal 2x), fwd AND bwd

@@ -70,6 +70,11 @@ class HeteroDPEngine:
                  groups: List[HeteroDPGroup]):
         if not groups:
             raise ValueError("need at least one group")
+        for gi, g in enumerate(groups):
+            if int(g.share) < 1:
+                raise ValueError(
+                    f"hetero-dp group {gi} ({g.strategy.describe()}): share "
+                    f"must be a positive integer, got {g.share!r}")
         self.optimizer = optimizer
         self.groups = groups
         self.models = [model_factory(g.strategy) for g in groups]
@@ -124,6 +129,15 @@ class HeteroDPEngine:
         The batch is split along dim 0 by the union's shares."""
         ids = np.asarray(host_batch["input_ids"])
         parts = self.batch_union.split_host(ids)
+        for gi, (part, grp) in enumerate(zip(parts, self.groups)):
+            dp = max(grp.strategy.dp, 1)
+            if part.shape[0] == 0 or part.shape[0] % dp:
+                raise ValueError(
+                    f"hetero-dp group {gi} ({grp.strategy.describe()}, "
+                    f"share={grp.share}): batch slice of {part.shape[0]} "
+                    f"rows is not a positive multiple of its dp degree "
+                    f"{dp} — resize the global batch ({ids.shape[0]}) or "
+                    f"the union shares {list(self.batch_union.shares)}")
         sums, counts, grads = [], [], []
         for gi, part in enumerate(parts):
             with use_mesh(self.meshes[gi]):

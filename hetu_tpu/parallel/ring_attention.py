@@ -408,8 +408,12 @@ def ring_attention_gspmd(q, k, v, *, strategy: ParallelStrategy,
     if split == "auto":
         # the split must DESCRIBE the caller's data layout (None = not
         # declared -> no static skipping); internally-generated positions
-        # are contiguous chunks = "normal" by construction
-        split = ((strategy.cp_split or _DECLARED_CP_SPLIT) if use_pos
+        # are contiguous chunks = "normal" by construction.  The SCOPED
+        # declaration wins over strategy.cp_split: it is set by whoever
+        # actually reordered the data (the Trainer, incl. its
+        # incompatible-seq fallback to 'normal'), so it is the ground truth
+        # about the layout even when the strategy asked for another split.
+        split = ((_DECLARED_CP_SPLIT or strategy.cp_split) if use_pos
                  else "normal")
 
     tp_eff = strategy.cp_tp_eff

@@ -25,6 +25,10 @@ class StrategyCandidate:
     zero: bool = True
     remat: bool = True
     n_micro: int = 1
+    # hetero CP ring: per-ring-member effective TP degree (None = uniform).
+    # Carries the bandwidth price parallel/ring_attention.py documents:
+    # the rotating KV buffer is padded to the widest member.
+    cp_tp_eff: Optional[tuple] = None
 
     @property
     def num_devices(self):
@@ -117,6 +121,20 @@ class CostModel:
         if c.cp > 1:
             b_local = self.global_batch / max(c.dp, 1)
             kv_bytes = 2 * b_local * (self.seq_len / c.cp) * self.hidden * 2
+            if c.cp_tp_eff:
+                # hetero-ring KV inflation (parallel/ring_attention.py
+                # "Hetero ring" design note): the rotating buffer is padded
+                # to the widest member, so every hop moves m_max = tp/min(e)
+                # times the homogeneous bytes, and each rank pre-gathers KV
+                # over the full tp axis once per layer.  This is why a
+                # cp_tp_eff plan must BEAT homogeneous CP by more than its
+                # straggler savings to be worth picking.
+                m_max = max(c.tp // max(e, 1) for e in c.cp_tp_eff)
+                if m_max > 1:
+                    kv_bytes *= m_max
+                    ag = kv_bytes * (c.tp - 1) / max(c.tp, 1)
+                    t_comm += self.num_layers * ag / (
+                        self._allreduce_gbps("tp", c.tp) * 1e9)
             t_comm += self.num_layers * (c.cp - 1) * kv_bytes / (
                 self.hw.ici_p2p_gbps * 1e9)
 

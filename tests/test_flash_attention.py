@@ -84,9 +84,20 @@ def test_lse_values():
 
 
 def test_indivisible_seq_raises():
+    # DEFAULT ladder: a seq len whose largest divisor sits far below lane
+    # alignment is rejected with a pointer at the bucket ladder
+    q, k, v = _qkv(s=1025)  # largest divisor <= 1024 is 205... 41 < 128
+    with pytest.raises(ValueError, match="bucket ladder"):
+        flash_attention(q, k, v)
+
+
+def test_explicit_blocks_ladder_below_128():
+    # an EXPLICIT block choice opts out of the default geometry: fit_block's
+    # divisor (here 100) is honored instead of raising
     q, k, v = _qkv(s=200)
-    with pytest.raises(ValueError):
-        flash_attention(q, k, v, block_q=128, block_k=128)
+    out = flash_attention(q, k, v, block_q=128, block_k=128)
+    ref = attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref), **TOL)
 
 
 def test_future_block_gives_zero_and_neginf_lse():

@@ -126,3 +126,23 @@ def test_malleus_shares_exact_dp_over_greedy():
     p6 = StragglerProfile([1.0] * 6)
     with pytest.raises(ValueError):  # 2k+4m is always even; 7 infeasible
         plan_hetero_dp_shares(p6, [[0, 1], [2, 3, 4, 5]], [2, 4], 7)
+
+
+def test_share_and_dp_degree_validated():
+    # non-positive share rejected at construction
+    devs = jax.devices()
+    cfg = LlamaConfig.tiny(remat=False, num_key_value_heads=4)
+    groups = [
+        HeteroDPGroup(ParallelStrategy(mesh=MeshConfig(dp=2, tp=2),
+                                       zero=False), devs[:4], 0),
+        HeteroDPGroup(ParallelStrategy(mesh=MeshConfig(tp=4),
+                                       zero=False), devs[4:8], 1),
+    ]
+    with pytest.raises(ValueError, match="share"):
+        HeteroDPEngine(lambda st: LlamaLMHeadModel(cfg, st),
+                       optim.SGD(lr=0.1), groups)
+    # a batch slice not divisible by the group's dp degree is a named error
+    eng, _ = _engine(shares=(3, 1))
+    eng.build()
+    with pytest.raises(ValueError, match="group 0.*dp degree"):
+        eng.train_step({"input_ids": _ids(rows=4)})  # group 0 gets 3 rows, dp=2

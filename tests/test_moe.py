@@ -126,3 +126,13 @@ def test_aux_loss_excluded_for_eval():
     with_aux = float(model(params, ids, labels=ids))
     without = float(model(params, ids, labels=ids, include_aux_loss=False))
     assert with_aux > without  # router losses are positive
+
+
+def test_sam_alignment_coef_independent():
+    """SAM's alignment hinge has its own coefficient (reference: SAMGate.py
+    separate balance/alignment weights); default follows load_balance_coef."""
+    cfg = MoEConfig(num_experts=8, top_k=2, gate="sam", sam_group_size=4)
+    assert cfg.resolved_sam_alignment_coef() == cfg.load_balance_coef
+    cfg2 = MoEConfig(num_experts=8, top_k=2, gate="sam", sam_group_size=4,
+                     sam_alignment_coef=0.5)
+    assert cfg2.resolved_sam_alignment_coef() == 0.5

@@ -120,3 +120,28 @@ def test_trainer_cp_sym_loss_matches_single_device(monkeypatch):
         m = tr.train_step(batch)
         losses[name] = float(m["loss"])
     assert abs(losses["cp"] - losses["single"]) < 2e-3, losses
+
+def test_scoped_declaration_wins_over_strategy_split():
+    """The scoped declaration is ground truth about the data layout: data
+    fed in NORMAL order under declared 'normal' must stay golden even when
+    the strategy still says cp_split='sym' (the Trainer's
+    incompatible-seq fallback scenario) — under the old precedence the sym
+    step masks would skip live tiles."""
+    from hetu_tpu.parallel.ring_attention import declared_cp_split
+    b, s, h, d, cp = 2, 256, 2, 32, 4
+    q0, k0, v0 = _qkv(b, s, h, d, seed=5)
+    golden = np.asarray(attention(q0, k0, v0, causal=True))
+
+    perm = np.concatenate(cp_split_indices(s, cp, "normal"))
+    pos = np.broadcast_to(np.arange(s, dtype=np.int32), (b, s))[:, perm]
+    q, k, v = (x[:, perm] for x in (q0, k0, v0))
+
+    st = ParallelStrategy(mesh=MeshConfig(cp=cp), cp_split="sym")
+    mesh = st.build_mesh()
+    with ht.use_mesh(mesh), declared_cp_split("normal"):
+        out = jax.jit(lambda q, k, v, p: ring_attention_gspmd(
+            q, k, v, strategy=st, mesh=mesh, position_ids=p))(
+                q, k, v, jnp.asarray(pos))
+    inv = np.argsort(perm)
+    np.testing.assert_allclose(np.asarray(out)[:, inv], golden,
+                               rtol=2e-3, atol=2e-3)

@@ -109,3 +109,22 @@ def test_ampelos_ilp_certifies_enumeration():
         devs = sorted(d for s in c_ilp["stages"] for d in s["devices"])
         assert devs == list(range(8))
         assert all(isinstance(d, int) for d in devs)
+
+
+def test_cost_model_hetero_ring_kv_inflation():
+    """A hetero cp_tp_eff plan pays the padded-buffer bandwidth price
+    (parallel/ring_attention.py hetero design note): it must never be
+    predicted FASTER than the same homogeneous CP layout."""
+    hw = HardwareProfile.preset("v5e")
+    cost = CostModel(hw=hw, num_layers=8, hidden=1024, intermediate=2816,
+                     vocab=32000, num_params=300_000_000,
+                     global_batch=32, seq_len=4096)
+    homo = StrategyCandidate(cp=4, tp=2)
+    hetero = StrategyCandidate(cp=4, tp=2, cp_tp_eff=(2, 1, 1, 1))
+    t_homo, _ = cost.evaluate(homo)
+    t_het, _ = cost.evaluate(hetero)
+    assert t_het > t_homo
+    # uniform cp_tp_eff == homogeneous: no inflation term
+    t_uni, _ = cost.evaluate(StrategyCandidate(cp=4, tp=2,
+                                               cp_tp_eff=(2, 2, 2, 2)))
+    assert t_uni == t_homo

@@ -4,5 +4,8 @@ package vendors a self-contained byte-level BPE (train/save/load, no
 downloads) plus a thin HF delegate for pretrained vocabularies)."""
 from hetu_tpu.data.tokenizers.bpe import ByteLevelBPETokenizer
 from hetu_tpu.data.tokenizers.hf import HFTokenizer, build_tokenizer
+from hetu_tpu.data.tokenizers.sp_model import SentencePieceTokenizer
+from hetu_tpu.data.tokenizers.tiktoken_bpe import TikTokenizer
 
-__all__ = ["ByteLevelBPETokenizer", "HFTokenizer", "build_tokenizer"]
+__all__ = ["ByteLevelBPETokenizer", "HFTokenizer", "build_tokenizer",
+           "SentencePieceTokenizer", "TikTokenizer"]

@@ -37,7 +37,9 @@ class HFTokenizer:
 
 def build_tokenizer(kind: str, path: Optional[str] = None, **kw):
     """kind: "bpe" (in-tree byte-level BPE; path = saved vocab dir) |
-    "hf" (pretrained via transformers; path = model name or dir)."""
+    "hf" (pretrained via transformers; path = model name or dir) |
+    "sp" (sentencepiece .model file, runtime-free loader) |
+    "tiktoken" (tiktoken rank file)."""
     if kind == "bpe":
         from hetu_tpu.data.tokenizers.bpe import ByteLevelBPETokenizer
         if path is None:
@@ -47,4 +49,14 @@ def build_tokenizer(kind: str, path: Optional[str] = None, **kw):
         if path is None:
             raise ValueError("hf tokenizer needs a model name or dir")
         return HFTokenizer(path, **kw)
-    raise ValueError(f"unknown tokenizer kind {kind!r} (bpe|hf)")
+    if kind == "sp":
+        from hetu_tpu.data.tokenizers.sp_model import SentencePieceTokenizer
+        if path is None:
+            raise ValueError("sp tokenizer needs a .model file path")
+        return SentencePieceTokenizer(path, **kw)
+    if kind == "tiktoken":
+        from hetu_tpu.data.tokenizers.tiktoken_bpe import TikTokenizer
+        if path is None:
+            raise ValueError("tiktoken tokenizer needs a rank file path")
+        return TikTokenizer(path, **kw)
+    raise ValueError(f"unknown tokenizer kind {kind!r} (bpe|hf|sp|tiktoken)")

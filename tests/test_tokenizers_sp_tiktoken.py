@@ -1,0 +1,121 @@
+"""SentencePiece (.model proto, runtime-free) + tiktoken-format tokenizers
+(reference: python/hetu/data/tokenizers/{sentencepiece,tiktoken}_tokenizer.py).
+"""
+import base64
+
+import pytest
+
+from hetu_tpu.data.tokenizers.sp_model import (
+    SentencePieceTokenizer, parse_model_proto, write_model_proto)
+from hetu_tpu.data.tokenizers.tiktoken_bpe import (
+    TikTokenizer, bpe_merge, save_tiktoken_ranks)
+from hetu_tpu.data.tokenizers.hf import build_tokenizer
+
+WS = "▁"
+
+
+def _llama_style_pieces():
+    """LLaMA layout: control ids 0-2, byte pieces, then text pieces."""
+    pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3)]
+    pieces += [(f"<0x{b:02X}>", 0.0, 6) for b in range(256)]
+    for i, (text, score) in enumerate([
+            (WS, -2.0), (WS + "the", -3.0), (WS + "quick", -4.0),
+            (WS + "brown", -4.5), (WS + "fox", -5.0), ("t", -8.0),
+            ("h", -8.1), ("e", -8.2), ("q", -8.3), ("u", -8.4),
+            ("i", -8.5), ("c", -8.6), ("k", -8.7), (WS + "t", -7.0),
+            ("he", -6.0)]):
+        pieces.append((text, score, 1))
+    return pieces
+
+
+def test_sp_proto_roundtrip():
+    pieces = _llama_style_pieces()
+    blob = write_model_proto(pieces, model_type=1, unk_id=0, bos_id=1,
+                             eos_id=2, byte_fallback=True)
+    got, trainer, norm = parse_model_proto(blob)
+    assert len(got) == len(pieces)
+    for (t1, s1, y1), (t2, s2, y2) in zip(got, pieces):
+        assert t1 == t2 and y1 == y2
+        assert s1 == pytest.approx(s2, abs=1e-6)  # f32 storage
+    assert trainer["model_type"] == 1
+    assert trainer["bos_id"] == 1 and trainer["pad_id"] == -1
+    assert norm["add_dummy_prefix"] is True
+
+
+def test_sp_unigram_encode_decode(tmp_path):
+    blob = write_model_proto(_llama_style_pieces(), model_type=1,
+                             byte_fallback=True)
+    p = tmp_path / "tokenizer.model"
+    p.write_bytes(blob)
+    tok = SentencePieceTokenizer(str(p))
+    ids = tok.encode("the quick brown fox", add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    # Viterbi must pick the whole-word pieces over char chains
+    assert tok.id_to_piece(ids[1]) == WS + "the"
+    assert tok.decode(ids) == "the quick brown fox"
+    # byte fallback: OOV char round-trips through <0xXX> pieces
+    ids2 = tok.encode("the ©")
+    assert tok.decode(ids2) == "the ©"
+    # factory
+    tok2 = build_tokenizer("sp", str(p))
+    assert tok2.encode("the") == tok.encode("the")
+
+
+def test_sp_bpe_model():
+    pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+              (WS, -1.0, 1), ("a", -2.0, 1), ("b", -2.1, 1),
+              ("ab", -0.5, 1), (WS + "ab", -0.2, 1), ("abab", -0.9, 1)]
+    blob = write_model_proto(pieces, model_type=2)
+    tok = SentencePieceTokenizer(model_bytes=blob)
+    # best-score-first merges: a+b -> ab, ws+ab -> wsab; then no wsab+ab
+    ids = tok.encode("abab")
+    assert [tok.id_to_piece(i) for i in ids] == [WS + "ab", "ab"]
+    assert tok.decode(ids) == "abab"
+    # unknown char without byte pieces -> unk id
+    assert tok.unk_id in tok.encode("axb")
+
+
+def _toy_ranks():
+    ranks = {bytes([b]): b for b in range(256)}
+    nxt = 256
+    for merge in (b"he", b"ll", b"llo", b"hello", b" w", b"or", b"ld"):
+        ranks[merge] = nxt
+        nxt += 1
+    return ranks
+
+
+def test_tiktoken_rank_file_and_merge(tmp_path):
+    path = tmp_path / "toy.tiktoken"
+    save_tiktoken_ranks(_toy_ranks(), str(path))
+    tok = TikTokenizer(str(path))
+    ids = tok.encode("hello world", add_bos=True, add_eos=True)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    assert tok.decode(ids[1:-1]) == "hello world"
+    # merge order: lowest rank first -> "hello" fuses fully
+    assert tok.token_to_id("hello") in ids
+    assert tok.vocab_size == len(_toy_ranks()) + 3
+
+
+def test_tiktoken_pure_python_matches_package(tmp_path):
+    """bpe_merge (the no-package path) must agree with the compiled
+    tiktoken Encoding on every piece."""
+    tiktoken = pytest.importorskip("tiktoken")
+    ranks = _toy_ranks()
+    enc = tiktoken.Encoding(name="toy", pat_str=r".*",
+                            mergeable_ranks=ranks, special_tokens={})
+    for text in ("hello", "world", "hold", "ohelp", "lllo"):
+        piece = text.encode()
+        assert bpe_merge(piece, ranks) == enc.encode(
+            text, disallowed_special=()), text
+
+
+def test_tiktoken_without_package(tmp_path, monkeypatch):
+    """The slow path alone (as if tiktoken were absent) still round-trips."""
+    path = tmp_path / "toy.tiktoken"
+    save_tiktoken_ranks(_toy_ranks(), str(path))
+    tok = TikTokenizer(str(path))
+    tok._fast = None
+    ids = tok.encode("hello world")
+    assert tok.decode(ids) == "hello world"
+    tok2 = build_tokenizer("tiktoken", str(path))
+    assert tok2.encode("hello world") == ids

@@ -53,22 +53,45 @@ class ElasticLauncher:
                  host: str = "127.0.0.1", port: int = 0,
                  max_restarts: int = 0, restart_backoff: float = 1.0,
                  heartbeat_timeout: float = 10.0,
-                 log_dir: Optional[str] = None):
+                 log_dir: Optional[str] = None,
+                 coord_address: Optional[str] = None,
+                 world_size: Optional[int] = None,
+                 worker_id_base: int = 0):
+        """Single-host mode: owns (or is handed) the CoordinationServer.
+
+        Per-host mode (the pssh_start.py per-node invocation): pass
+        `coord_address` of the CENTRAL coordination server — this launcher
+        then only owns its local process slots.  `world_size` is the TOTAL
+        worker count across hosts (what workers rendezvous on) and
+        `worker_id_base` offsets this host's slot ids so every slot id is
+        cluster-unique (the reference rewrites per-host rank offsets in its
+        pssh args, elastic_arg_parser.py)."""
         self.worker_cmd = list(worker_cmd)
         self.num_workers = num_workers
         self.extra_env = dict(env or {})
         self.max_restarts = max_restarts
         self.restart_backoff = restart_backoff
         self.log_dir = log_dir
-        self._owns_server = server is None
-        self.server = server or CoordinationServer(
-            host=host, port=port, heartbeat_timeout=heartbeat_timeout)
+        self.world_size = world_size or num_workers
+        self.worker_id_base = worker_id_base
+        self._coord_address = coord_address
+        if coord_address is not None:
+            if server is not None:
+                raise ValueError("pass either server= or coord_address=")
+            self._owns_server = False
+            self.server = None
+        else:
+            self._owns_server = server is None
+            self.server = server or CoordinationServer(
+                host=host, port=port, heartbeat_timeout=heartbeat_timeout)
         self.workers: Dict[int, WorkerProc] = {}
         self._log_files: List = []
 
     # ------------------------------------------------------------------
     @property
     def coord_address(self) -> str:
+        if self._coord_address is not None:
+            return self._coord_address
         return f"{self.server.host}:{self.server.port}"
 
     def _spawn(self, worker_id: int, restarts: int = 0) -> WorkerProc:
@@ -76,7 +99,7 @@ class ElasticLauncher:
         env.update(self.extra_env)
         env["HETU_TPU_COORD"] = self.coord_address
         env["HETU_TPU_WORKER_ID"] = str(worker_id)
-        env["HETU_TPU_NUM_WORKERS"] = str(self.num_workers)
+        env["HETU_TPU_NUM_WORKERS"] = str(self.world_size)
         stdout = stderr = None
         if self.log_dir:
             os.makedirs(self.log_dir, exist_ok=True)
@@ -94,7 +117,8 @@ class ElasticLauncher:
 
     def start(self) -> "ElasticLauncher":
         for i in range(self.num_workers):
-            self.workers[i] = self._spawn(i)
+            wid = self.worker_id_base + i
+            self.workers[wid] = self._spawn(wid)
         return self
 
     # ------------------------------------------------------------------
@@ -170,10 +194,18 @@ def main(argv: Optional[Sequence[str]] = None):
     python worker.py args...  (reference: pssh_start.py CLI)."""
     import argparse
     ap = argparse.ArgumentParser(prog="hetu_tpu.rpc.launcher")
-    ap.add_argument("-n", "--num-workers", type=int, required=True)
+    ap.add_argument("-n", "--num-workers", type=int, required=True,
+                    help="workers on THIS host")
     ap.add_argument("--max-restarts", type=int, default=0)
     ap.add_argument("--heartbeat-timeout", type=float, default=10.0)
     ap.add_argument("--log-dir", default=None)
+    ap.add_argument("--coord-address", default=None,
+                    help="central coordination server host:port (per-host "
+                         "mode; omit to own a local server)")
+    ap.add_argument("--world-size", type=int, default=None,
+                    help="total workers across hosts (default: -n)")
+    ap.add_argument("--worker-id-base", type=int, default=0,
+                    help="this host's slot-id offset")
     ap.add_argument("cmd", nargs=argparse.REMAINDER,
                     help="worker command (prefix with --)")
     args = ap.parse_args(argv)
@@ -182,7 +214,9 @@ def main(argv: Optional[Sequence[str]] = None):
         ap.error("missing worker command")
     launcher = ElasticLauncher(
         cmd, args.num_workers, max_restarts=args.max_restarts,
-        heartbeat_timeout=args.heartbeat_timeout, log_dir=args.log_dir)
+        heartbeat_timeout=args.heartbeat_timeout, log_dir=args.log_dir,
+        coord_address=args.coord_address, world_size=args.world_size,
+        worker_id_base=args.worker_id_base)
     launcher.start()
     try:
         codes = launcher.wait(timeout=10 ** 9)

@@ -79,14 +79,23 @@ def test_elastic_survives_worker_loss(tmp_path):
     ghost_thread = threading.Thread(target=ghost_loop, daemon=True)
     ghost_thread.start()
 
-    def kill_later():
-        time.sleep(4.0)
-        ghost_stop.set()
-        ghost_hb._shutdown = True  # heartbeats stop; the rank is declared dead
+    def batches():
+        """Event-driven kill: after 3 steps under the 2-worker plan, stop
+        the ghost's heartbeats and BLOCK until the server's stop flag is
+        visible on the survivor — the controller then deterministically
+        re-plans before the next step (no sleep races under CPU load)."""
+        for i in range(60):
+            if i == 3:
+                ghost_stop.set()
+                ghost_hb._shutdown = True   # rank 1 stops heartbeating
+                deadline = time.time() + 60.0
+                while not (me.should_stop and me.check_stop()):
+                    assert time.time() < deadline, \
+                        "worker loss was never signaled"
+                    time.sleep(0.05)
+            yield batch
 
-    threading.Thread(target=kill_later, daemon=True).start()
-
-    trainer = ctl.run([batch] * 40, num_steps=14)
+    trainer = ctl.run(batches(), num_steps=14)
     assert trainer.global_step >= 14
     # both strategies were used: pre-loss dp4xtp2, post-loss dp8
     assert any("tp2" in s for s in strategies_used)

@@ -122,3 +122,72 @@ def test_crashed_worker_is_relaunched(tmp_path):
 
 if __name__ == "__main__":
     sys.exit(pytest.main([__file__, "-v"]))
+
+
+@pytest.mark.slow
+def test_two_host_launchers_one_coordination_service(tmp_path):
+    """The multi-host flow (reference: pssh_start.py per-node launch +
+    heturpc_elastic_server.py central service): TWO per-host launcher
+    instances join one central coordination server; a worker dies on host
+    B; the survivors across BOTH hosts re-plan to world=3 and the leader
+    resumes from checkpoint."""
+    from hetu_tpu.rpc.server import CoordinationServer
+
+    workdir = str(tmp_path)
+    num_steps = 150
+    server = CoordinationServer(heartbeat_timeout=30.0)
+    addr = f"127.0.0.1:{server.port}"
+    cmd = [sys.executable, WORKER, workdir, str(num_steps)]
+    host_a = ElasticLauncher(cmd, num_workers=2, env=_env(),
+                             coord_address=addr, world_size=4,
+                             worker_id_base=0,
+                             log_dir=os.path.join(workdir, "logs_a"))
+    host_b = ElasticLauncher(cmd, num_workers=2, env=_env(),
+                             coord_address=addr, world_size=4,
+                             worker_id_base=2,
+                             log_dir=os.path.join(workdir, "logs_b"))
+    host_a.start()
+    host_b.start()
+    try:
+        deadline = time.time() + 240
+        while time.time() < deadline:
+            if all(any(r["event"] == "generation"
+                       for r in _read_status(workdir, w)) for w in range(4)):
+                break
+            time.sleep(0.5)
+        else:
+            pytest.fail("4-worker cluster never reached generation 1: "
+                        + repr({w: _read_status(workdir, w)
+                                for w in range(4)}))
+        time.sleep(2.0)
+        # kill the max-rank worker ON HOST B (slots 2,3) so the global
+        # leader survives and owns the checkpoint
+        slot_rank = {w: _read_status(workdir, w)[0]["rank"]
+                     for w in range(4)}
+        victim = max((2, 3), key=lambda w: slot_rank[w])
+        host_b.kill(victim, sig=signal.SIGKILL)
+
+        codes = {}
+        codes.update(host_a.wait(timeout=420))
+        codes.update(host_b.wait(timeout=420))
+    finally:
+        host_a.shutdown()
+        host_b.shutdown()
+        server.close()
+
+    survivors = [w for w in range(4) if w != victim]
+    assert all(codes[w] == 0 for w in survivors), codes
+    assert codes[victim] != 0, codes
+    # every survivor (on both hosts) re-planned with the 3-member world
+    for w in survivors:
+        recs = _read_status(workdir, w)
+        builds = [r for r in recs if r["event"] == "build"]
+        assert len(builds[-1]["alive"]) == 3, (w, builds[-1])
+        assert builds[-1]["plan"]["dp"] == 3, (w, builds[-1])
+        done = [r for r in recs if r["event"] == "done"]
+        assert done and done[0]["final_step"] >= num_steps, (w, recs)
+    # leader continuity: post-kill generation resumed from checkpoint
+    leader_slot = min(survivors, key=lambda w: slot_rank[w])
+    recs_l = _read_status(workdir, leader_slot)
+    gen2 = [r for r in recs_l if r["event"] == "generation"][-1]
+    assert gen2["resumed_step"] > 0, recs_l

@@ -118,12 +118,12 @@ class HotSwitchTrainer(Trainer):
         self._pshard, self._sshard = dst.param_shardings, dst.state_shardings
         self._step_fn = self._steps.get(sid)
         if self._step_fn is None:
+            # one plan POOL per strategy (out_shardings differ): within it,
+            # one compiled plan per batch-shape bucket — the full
+            # (strategy, shape-plan) pool of define_and_run_graph.cc:1174
             with use_mesh(dst.mesh):
-                self._step_fn = jax.jit(
-                    self._train_step,
-                    out_shardings=(dst.param_shardings, dst.state_shardings,
-                                   None, None),
-                    donate_argnums=(0, 1))
+                self._step_fn = self._make_step_pool(
+                    dst.param_shardings, dst.state_shardings)
             self._steps[sid] = self._step_fn
         detail = ""
         if prof is not None:

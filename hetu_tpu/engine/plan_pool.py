@@ -7,19 +7,34 @@ DeduceShapePlan :303).  The TPU analog: one AOT-compiled pjit executable per
 (strategy id, abstract input shapes), cached here.  Shape plans come from the
 data pipeline's bucket ladder, so the pool stays small and step dispatch is
 a dict lookup — the same amortization the reference gets from _execute_plan.
+
+Retrace guard (reference: executable_graph.cc:1163-1313 HETU_SHAPE_MISMATCH
+handling): every new shape signature is a full XLA compile.  The pool logs
+each one (first at INFO, later ones at WARNING — a growing pool usually
+means the data pipeline is feeding unbucketed shapes) and refuses to grow
+past `max_plans`, so silent recompile-per-batch can't eat a training run.
 """
 from __future__ import annotations
 
 import dataclasses
+import time
 from typing import Any, Callable, Dict, Optional, Tuple
 
 import jax
 
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("plan_pool")
+
 
 def _shape_key(tree) -> Tuple:
     leaves = jax.tree.leaves(tree)
-    return tuple((tuple(l.shape), str(l.dtype)) for l in leaves
-                 if hasattr(l, "shape"))
+    # pytree STRUCTURE is part of the key: identical leaf shapes under
+    # different field names (e.g. position_ids vs segment_ids riders) are
+    # different programs
+    return (str(jax.tree.structure(tree)),) + tuple(
+        (tuple(l.shape), str(l.dtype)) for l in leaves
+        if hasattr(l, "shape"))
 
 
 @dataclasses.dataclass
@@ -29,20 +44,42 @@ class PlanPool:
 
     fn: Callable
     jit_kwargs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    # refuse to compile more than this many distinct plans (None = unbounded)
+    max_plans: Optional[int] = None
+    name: str = "step"
 
     def __post_init__(self):
         self._plans: Dict[Tuple, Any] = {}
         self._jitted = jax.jit(self.fn, **self.jit_kwargs)
 
-    def get(self, strategy_id: int, *args) -> Any:
+    def lower(self, *args):
+        """Passthrough to the jitted fn's AOT lowering (memory reports)."""
+        return self._jitted.lower(*args)
+
+    def get(self, strategy_id, *args) -> Any:
         key = (strategy_id,) + _shape_key(args)
         plan = self._plans.get(key)
         if plan is None:
+            n = len(self._plans)
+            if self.max_plans is not None and n >= self.max_plans:
+                raise RuntimeError(
+                    f"plan pool '{self.name}' hit max_plans={self.max_plans} "
+                    f"and a NEW shape signature arrived — every distinct "
+                    f"batch shape is a full XLA recompile, so this usually "
+                    f"means the data pipeline feeds unbucketed shapes. Pad "
+                    f"through the bucket ladder (hetu_tpu.data.bucket) or "
+                    f"raise HETU_TPU_MAX_PLANS. New signature: {key[1:]}")
+            t0 = time.perf_counter()
             plan = self._jitted.lower(*args).compile()
             self._plans[key] = plan
+            msg = (f"plan pool '{self.name}': compiled plan #{n + 1} "
+                   f"(strategy {strategy_id}) in "
+                   f"{time.perf_counter() - t0:.1f}s")
+            # plan #1 is expected; growth beyond it deserves visibility
+            (logger.info if n == 0 else logger.warning)(msg)
         return plan
 
-    def __call__(self, *args, strategy_id: int = 0):
+    def __call__(self, *args, strategy_id=0):
         return self.get(strategy_id, *args)(*args)
 
     @property

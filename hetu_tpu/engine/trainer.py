@@ -53,6 +53,14 @@ class Trainer:
             from hetu_tpu.utils import flags as _flags
             self._cp_split = (self.strategy.cp_split
                               or _flags.str_flag("HETU_TPU_CP_SPLIT"))
+            if self._cp_split != "normal":
+                # the default differs from the reference's NORMAL: make the
+                # host-side seq permutation + label pre-shift visible so
+                # tooling that assumes positional order isn't surprised
+                logger.info(
+                    f"cp={self.strategy.cp}: seq axis host-permuted to the "
+                    f"'{self._cp_split}' split (labels pre-shifted); set "
+                    f"strategy.cp_split or HETU_TPU_CP_SPLIT to change")
         self._cp_perm_cache = {}
         self._cp_layout_used = False   # a step traced under this layout?
         # non-contiguous CP layouts require host pre-shifted labels
@@ -84,10 +92,6 @@ class Trainer:
         use_scaler = (config.loss_scale == "dynamic"
                       or (config.loss_scale == "auto"
                           and compute_dtype == jnp.float16))
-        if use_scaler and config.pp_schedule == "1f1b" and self.strategy.pp > 1:
-            raise NotImplementedError(
-                "fp16 loss scaling with the 1f1b schedule (the manual-VJP "
-                "engine seeds cotangents internally); use gpipe or bf16")
         from hetu_tpu.optim.grad_scaler import GradScaler
         self._scaler = GradScaler() if use_scaler else None
         self.scaler_state = None
@@ -137,11 +141,29 @@ class Trainer:
             if self._scaler is not None:
                 self.scaler_state = jax.device_put(
                     self._scaler.init(), NamedSharding(mesh, P()))
-            self._step_fn = jax.jit(
-                self._train_step,
-                out_shardings=(self._pshard, self._sshard, None, None),
-                donate_argnums=(0, 1))
+            self._step_fn = self._make_step_pool(self._pshard, self._sshard)
         return self
+
+    def _make_step_pool(self, pshard, sshard):
+        """One compiled train step per batch-shape signature (the
+        reference's ExecGraphPlan pool, define_and_run_graph.cc:1174/:303):
+        multi-bucket training compiles once per bucket length and dispatches
+        per batch, with the pool's retrace guard replacing jit's silent
+        recompiles."""
+        from hetu_tpu.engine.plan_pool import PlanPool
+        from hetu_tpu.utils import flags
+        return PlanPool(
+            self._train_step,
+            jit_kwargs=dict(out_shardings=(pshard, sshard, None, None),
+                            donate_argnums=(0, 1)),
+            max_plans=flags.int_flag("HETU_TPU_MAX_PLANS") or None,
+            name="train_step")
+
+    def _plan_dispatch_key(self):
+        """Traced-behavior inputs that are NOT visible in the batch shapes:
+        the CP data layout declared around the trace (it changes the ring's
+        static tile masks and the label convention)."""
+        return (self._cp_split, self._labels_shifted)
 
     # ------------------------------------------------------------------
     def _loss_fn(self, params, batch, rng):
@@ -174,10 +196,10 @@ class Trainer:
             # pipeline mode: micro-batching happens INSIDE the model's
             # circular pipeline (reference CrucialRun micro loop); feed the
             # whole global batch at once
-            if not c.dropout_deterministic:
+            if not c.dropout_deterministic and c.pp_schedule == "1f1b":
                 raise NotImplementedError(
-                    "dropout is not supported inside the pipeline "
-                    "(dropout_deterministic=False with pp > 1)")
+                    "dropout inside the 1f1b schedule (the manual-VJP "
+                    "recompute would need replayed masks); use gpipe")
             flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in batches.items()}
 
             if c.pp_schedule == "1f1b":
@@ -187,15 +209,17 @@ class Trainer:
                     params, flat["input_ids"], flat["labels"],
                     position_ids=flat.get("position_ids"),
                     segment_ids=flat.get("segment_ids"), n_micro=n_micro,
-                    labels_shifted=self._labels_shifted)
+                    labels_shifted=self._labels_shifted,
+                    loss_scale=scale)
             else:
                 def pp_loss(p):
                     lsum_, csum_ = self.model(
                         p, flat["input_ids"], labels=flat["labels"],
                         position_ids=flat.get("position_ids"),
                         segment_ids=flat.get("segment_ids"),
-                        deterministic=True, loss_reduction="sum",
-                        n_micro=n_micro,
+                        rng=None if c.dropout_deterministic else rng,
+                        deterministic=c.dropout_deterministic,
+                        loss_reduction="sum", n_micro=n_micro,
                         labels_shifted=self._labels_shifted)
                     # loss SCALING happens on the fp32 sum (gradscaler.h:33)
                     return lsum_.astype(jnp.float32) * scale, (lsum_, csum_)
@@ -376,7 +400,8 @@ class Trainer:
         with use_mesh(self.mesh), self._declared():
             self.params, self.opt_state, metrics, self.scaler_state = \
                 self._step_fn(self.params, self.opt_state, batches, rng,
-                              self.scaler_state)
+                              self.scaler_state,
+                              strategy_id=self._plan_dispatch_key())
         self.global_step += 1
         return metrics
 

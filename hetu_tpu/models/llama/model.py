@@ -219,14 +219,13 @@ class LlamaDecoderStack(Module):
         st = self.strategy
         use_drop = not deterministic and rng is not None
         if st.pp > 1:
-            if use_drop:
-                raise NotImplementedError("dropout inside the pipeline")
             if not c.use_scan:
                 raise ValueError("pipeline parallelism requires use_scan")
             return self._pipeline_forward(params, x, cos=cos, sin=sin,
                                           position_ids=position_ids,
                                           segment_ids=segment_ids,
-                                          n_micro=n_micro)
+                                          n_micro=n_micro,
+                                          rng=rng if use_drop else None)
         layer_rngs = (jax.random.split(rng, self.num_layers)
                       if use_drop else None)
 
@@ -267,7 +266,7 @@ class LlamaDecoderStack(Module):
         return x, aux_total
 
     def _pipeline_forward(self, params, x, *, cos, sin, position_ids,
-                          segment_ids, n_micro: Optional[int]):
+                          segment_ids, n_micro: Optional[int], rng=None):
         """pp > 1: run the decoder stack through the circular SPMD pipeline
         (hetu_tpu.parallel.pipeline; reference: executable_graph.cc:803/:836
         pipeline schedules).  Uneven stage_layers (the Malleus layout) run as
@@ -288,6 +287,9 @@ class LlamaDecoderStack(Module):
             if c.num_experts > 0 or st.sequence_parallel or st.cp > 1:
                 raise NotImplementedError(
                     "pp_tp_eff composes with dense blocks, no SP, cp=1")
+            if rng is not None:
+                raise NotImplementedError(
+                    "dropout inside the hetero-TP pipeline")
             return staged_stack_forward_hetero_tp(
                 llama_block_maker(c, cos, sin, tp=st.tp),
                 self.block.param_specs(), params["layers"], x,
@@ -298,9 +300,10 @@ class LlamaDecoderStack(Module):
                 remat=c.remat, remat_policy=c.remat_policy,
                 state_spec=st.pipeline_state_spec())
 
-        def block_fn(layer_params, x_mb, pos_mb, seg_mb):
+        def block_fn(layer_params, x_mb, pos_mb, seg_mb, rng=None):
             return self.block(layer_params, x_mb, cos=cos, sin=sin,
-                              position_ids=pos_mb, segment_ids=seg_mb)
+                              position_ids=pos_mb, segment_ids=seg_mb,
+                              rng=rng, deterministic=rng is None)
 
         return staged_stack_forward(
             block_fn, params["layers"], x,
@@ -308,7 +311,7 @@ class LlamaDecoderStack(Module):
             position_ids=position_ids, segment_ids=segment_ids,
             stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
             remat=c.remat, remat_policy=c.remat_policy,
-            state_spec=st.pipeline_state_spec(),
+            state_spec=st.pipeline_state_spec(), rng=rng,
             # ragged (hetero-exec) stages skip untaken-branch collectives;
             # the cp ring's explicit ppermute spans all stages in one
             # instruction, and the MoE dispatch's grouped collectives
@@ -433,7 +436,8 @@ class LlamaLMHeadModel(Module):
     # ------------------------------------------------------------------
     def pipeline_train_grads(self, params, input_ids, labels, *,
                              position_ids=None, segment_ids=None,
-                             n_micro: int, labels_shifted: bool = False):
+                             n_micro: int, labels_shifted: bool = False,
+                             loss_scale=1.0):
         """1F1B (PipeDream-flush) training pass: returns
         ((loss_sum, count), grads) with grads matching `params` exactly
         (reference: executable_graph.cc:836 GeneratePipedreamFlushSchedule).
@@ -450,6 +454,11 @@ class LlamaLMHeadModel(Module):
         c, st = self.config, self.strategy
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
+        if st.pp_tp_eff is not None:
+            raise NotImplementedError(
+                "per-stage hetero TP (pp_tp_eff) is only implemented on the "
+                "GPipe path (pp_schedule='gpipe'); the 1f1b schedule would "
+                "silently run all stages at homogeneous TP")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()
@@ -524,7 +533,7 @@ class LlamaLMHeadModel(Module):
             stage_fn, sp, ep, input_ids, labels, ride,
             n_micro=n_micro, mesh=mesh, hidden_size=c.hidden_size,
             compute_dtype=c.compute_dtype, aux_seed=count,
-            state_spec=state_spec,
+            state_spec=state_spec, loss_scale=loss_scale,
             flags_extra=({"layer_mask": layer_mask}
                          if layer_mask is not None else None))
 

@@ -34,6 +34,7 @@ from typing import Callable, Dict
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
@@ -41,7 +42,8 @@ from jax.sharding import PartitionSpec as P
 def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                    *, n_micro: int, mesh, pp_axis: str = "pp",
                    remat: bool = True, remat_policy: str = "nothing",
-                   stage_mask=None, state_spec=None, hetero_exec: bool = False):
+                   stage_mask=None, state_spec=None, hetero_exec: bool = False,
+                   stage_const=None):
     """Run the circular pipeline.
 
     stage_body(stage_params_slice, x_mb, token_data_mb) -> x_mb — applies one
@@ -79,7 +81,15 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         from hetu_tpu.nn.remat import remat_policy as _policy
         body = jax.checkpoint(stage_body, policy=_policy(remat_policy))
     extra_axes = (0,) if stage_mask is not None else ()
+    # stage_const: optional per-stage constants with a leading [pp] dim
+    # (e.g. the global-layer offset feeding pipeline dropout rng derivation)
+    if stage_const is not None:
+        extra_axes = extra_axes + (0,)
     if hetero_exec:
+        if stage_const is not None:
+            raise NotImplementedError(
+                "stage_const (pipeline dropout) uses the padded vmap path; "
+                "pass hetero_exec=False")
         # note: only the stage-dim (pp) layout is named in the shard_map
         # specs — the dp/cp/tp parts of state_spec stay AUTO axes and are
         # honored by the body's own sharding constraints
@@ -125,6 +135,8 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
         args = (stage_params, cur_x, cur_tok)
         if stage_mask is not None:
             args = args + (stage_mask,)
+        if stage_const is not None:
+            args = args + (stage_const,)
         out = vbody(*args)
         if isinstance(out, tuple):
             out_x, aux = out                 # [pp, mb, s, h], [pp]
@@ -237,7 +249,7 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
                          pp: int, mesh, position_ids=None, segment_ids=None,
                          stage_layers=None, n_micro=None,
                          remat: bool = True, remat_policy: str = "nothing",
-                         state_spec=None, hetero_exec="auto"):
+                         state_spec=None, hetero_exec="auto", rng=None):
     """Model-family-agnostic pipelined decoder stack.
 
     block_fn(layer_params, x_mb, position_ids_mb, segment_ids_mb) ->
@@ -250,6 +262,14 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
     padded slots are untaken `lax.cond` branches, so a Malleus layout
     actually saves the straggler's compute.  hetero_exec=False keeps the
     padded+masked vmap path (every stage pays max(stage_layers) per tick).
+
+    rng: enables dropout INSIDE the pipeline.  Per-micro random bits ride
+    the token stream (so each micro keeps its bits as it moves through the
+    stages) and each stage folds in its GLOBAL layer index, giving every
+    (micro, layer) pair an independent mask — the fold_in(stage, round)
+    scheme the reference gets implicitly from per-op RNG states.  With rng,
+    block_fn is called as block_fn(lp, x, pos, seg, rng=key).  Forces the
+    padded vmap execution path (hetero_exec off).
     Returns (x, aux_total).
     """
     token_data = {}
@@ -266,8 +286,28 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
         hetero_exec = layer_mask is not None
     hetero_exec = bool(hetero_exec) and layer_mask is not None
 
-    def stage_body(local_params, x_mb, tok, *mask_args):
-        m = mask_args[0] if mask_args else None
+    stage_const = None
+    if rng is not None:
+        hetero_exec = False
+        B = x.shape[0]
+        mb = B // n_micro
+        bits = jax.vmap(lambda k: jax.random.bits(k, dtype=jnp.uint32))(
+            jax.random.split(rng, n_micro))                  # [n_micro]
+        rider = jnp.broadcast_to(
+            jnp.repeat(bits, mb)[:, None], (B, x.shape[1]))
+        token_data = dict(token_data, dropout_rng=rider)
+        # exclusive prefix sum: stage s's first global layer index
+        offs = np.concatenate([[0], np.cumsum(stage_layers)[:-1]])
+        stage_const = jnp.asarray(offs, jnp.uint32)
+
+    has_mask = layer_mask is not None
+    has_rng = rng is not None
+
+    def stage_body(local_params, x_mb, tok, *extra):
+        m = extra[0] if has_mask else None
+        offset = extra[1 if has_mask else 0] if has_rng else None
+        micro_key = (jax.random.key(tok["dropout_rng"][0, 0])
+                     if has_rng else None)
 
         def _vary(v):
             # both cond branches must agree on varying-manual-axes typing
@@ -281,10 +321,15 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
                 return lax.pcast(v, ("pp",), to="varying")
             return v
 
-        def run_layer(layer_params, x_c):
+        def run_layer(layer_params, x_c, gid=None):
+            kw = {}
+            if has_rng:
+                # (micro bits, global layer id) -> independent mask per
+                # (micro, layer) — stage offset makes the id global
+                kw["rng"] = jax.random.fold_in(micro_key, gid)
             out, aux = block_fn(layer_params, x_c,
                                 tok.get("position_ids"),
-                                tok.get("segment_ids"))
+                                tok.get("segment_ids"), **kw)
             return _vary(out), _vary(jnp.asarray(aux, jnp.float32))
 
         def body(carry, xs):
@@ -292,7 +337,11 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
                 layer_params = xs
             else:
                 layer_params, mj = xs
-            x_c, aux_c = carry
+            if has_rng:
+                x_c, aux_c, gid = carry
+            else:
+                x_c, aux_c = carry
+                gid = None
             if m is not None and hetero_exec:
                 # real branch (shard_map keeps it a conditional): a padded
                 # slot costs nothing and its params get exactly-zero grads
@@ -302,18 +351,23 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
                                      _vary(jnp.zeros((), jnp.float32))),
                     layer_params, x_c)
             else:
-                out, aux = run_layer(layer_params, x_c)
+                out, aux = run_layer(layer_params, x_c, gid)
                 if m is not None:
                     out = jnp.where(mj > 0, out, x_c)  # padded = identity
                     aux = aux * mj
-            return (out, aux_c + aux), None
+            new_c = ((out, aux_c + aux, gid + 1) if has_rng
+                     else (out, aux_c + aux))
+            return new_c, None
 
         xs = local_params if m is None else (local_params, m)
-        (out, aux), _ = lax.scan(
-            body, (x_mb, _vary(jnp.zeros((), jnp.float32))), xs)
-        return out, aux
+        carry0 = (x_mb, _vary(jnp.zeros((), jnp.float32)))
+        if has_rng:
+            carry0 = carry0 + (offset,)
+        out_carry, _ = lax.scan(body, carry0, xs)
+        return out_carry[0], out_carry[1]
 
     return pipeline_apply(stage_body, stage_params, x, token_data,
                           n_micro=n_micro, mesh=mesh, remat=remat,
                           remat_policy=remat_policy, stage_mask=layer_mask,
-                          state_spec=state_spec, hetero_exec=hetero_exec)
+                          state_spec=state_spec, hetero_exec=hetero_exec,
+                          stage_const=stage_const)

@@ -58,7 +58,8 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
                         n_micro: int, mesh, hidden_size: int,
                         compute_dtype, pp_axis: str = "pp",
                         aux_seed=1.0, state_spec: Optional[P] = None,
-                        flags_extra: Optional[Dict] = None):
+                        flags_extra: Optional[Dict] = None,
+                        loss_scale=1.0):
     """Run the 1F1B schedule and return loss pieces + gradients.
 
     stage_fn(stage_params_slice, edge_params, x_in, feed_bcast, feed_stage,
@@ -185,6 +186,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
               g_stage0, g_edge0,
               jnp.zeros((pp,), jnp.float32), jnp.zeros((pp,), jnp.float32))
     aux_seed = jnp.asarray(aux_seed, jnp.float32)
+    loss_scale = jnp.asarray(loss_scale, jnp.float32)
 
     def step(carry, xs):
         (prev_y, prev_dx, ride_st, buf_x, buf_ride,
@@ -210,8 +212,11 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
         x_b = read(buf_x)
         ride_b = {k: read(buf_ride[k]) for k in buf_ride}
         dy = shift_up(prev_dx)
-        dce = bv * is_last                      # loss seed fires at last stage
-        daux = aux_seed * bv
+        # loss seed fires at the last stage; loss_scale multiplies BOTH seeds
+        # (fp16 GradScaler: the scaled-loss cotangents flow through the f16
+        # chain, the trainer unscales the returned grads — gradscaler.h:33)
+        dce = bv * is_last * loss_scale
+        daux = aux_seed * bv * loss_scale
         feed_bb = {"ids": ids_b, "labels": lab}
         dsp, dep, dx = vbwd(stage_params, edge_params, x_b, feed_bb,
                             ride_b, flags, dy, dce, daux)

@@ -39,10 +39,15 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "capture a jax.profiler trace of a step window into this dir"),
     Flag("HETU_TPU_MEMORY_PROFILE", "bool", False,
          "log per-step device memory stats + compiled-plan memory analysis"),
-    Flag("HETU_TPU_SWITCH_PROFILE", "bool", True,
-         "per-hot-switch byte accounting (ProfileRunningDetails analog)"),
+    Flag("HETU_TPU_SWITCH_PROFILE", "bool", False,
+         "per-hot-switch byte accounting (ProfileRunningDetails analog); "
+         "off by default — the tree walk costs host time per switch"),
     Flag("HETU_TPU_LOG_LEVEL", "str", "INFO",
          "root log level for hetu_tpu loggers"),
+    Flag("HETU_TPU_MAX_PLANS", "int", 8,
+         "max compiled train-step plans per strategy (one per batch-shape "
+         "bucket); a new shape past the cap is a loud error instead of a "
+         "silent recompile (HETU_SHAPE_MISMATCH analog); 0 = unbounded"),
     # -- kernel / execution routing (reference: HETU_PARALLEL_ATTN*) -----
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "flash-attention kernel routing: auto (shape-gated), 1 (force "

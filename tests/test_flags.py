@@ -7,7 +7,7 @@ from hetu_tpu.utils import flags
 
 
 def test_defaults():
-    assert flags.bool_flag("HETU_TPU_SWITCH_PROFILE") is True
+    assert flags.bool_flag("HETU_TPU_SWITCH_PROFILE") is False
     assert flags.bool_flag("HETU_TPU_EVENT_TIMING") is False
     assert flags.str_flag("HETU_TPU_CP_SPLIT") == "sym"
     assert flags.str_flag("HETU_TPU_PALLAS") == "auto"
@@ -17,8 +17,8 @@ def test_defaults():
 def test_env_overrides(monkeypatch):
     monkeypatch.setenv("HETU_TPU_EVENT_TIMING", "1")
     assert flags.bool_flag("HETU_TPU_EVENT_TIMING") is True
-    monkeypatch.setenv("HETU_TPU_SWITCH_PROFILE", "0")
-    assert flags.bool_flag("HETU_TPU_SWITCH_PROFILE") is False
+    monkeypatch.setenv("HETU_TPU_SWITCH_PROFILE", "1")
+    assert flags.bool_flag("HETU_TPU_SWITCH_PROFILE") is True
     monkeypatch.setenv("HETU_TPU_CP_SPLIT", "stripe")
     assert flags.str_flag("HETU_TPU_CP_SPLIT") == "stripe"
     monkeypatch.setenv("HETU_TPU_CP_SPLIT", "bogus")

@@ -196,3 +196,49 @@ def test_memory_report_breakdown():
     # the report does not disturb training
     m = tr.train_step(b)
     assert np.isfinite(float(m["loss"]))
+
+
+def test_multibucket_training_two_plans_and_loss_parity():
+    """Variable-length training across the bucket ladder (reference:
+    define_and_run_graph.cc:1174 plan-pool Run + :303 DeduceShapePlan):
+    two seq buckets -> exactly two compiled plans, and the short bucket's
+    step loss equals the same data padded to the long bucket."""
+    rng = np.random.default_rng(7)
+    ids64 = rng.integers(1, 250, size=(4, 64)).astype(np.int32)
+    ids32 = rng.integers(1, 250, size=(4, 32)).astype(np.int32)
+
+    def batch(ids):
+        return {"input_ids": ids, "labels": ids.copy()}
+
+    def padded(ids, to):
+        pad = np.zeros((ids.shape[0], to - ids.shape[1]), np.int32)
+        return {"input_ids": np.concatenate([ids, pad], 1),
+                "labels": np.concatenate(
+                    [ids, np.full_like(pad, -100)], 1)}
+
+    t, _ = _make_trainer(dp=1, tp=1, gbs=4, mbs=2)
+    t.build(jax.random.key(9))
+    t.train([batch(ids64), batch(ids32), batch(ids64), batch(ids32)])
+    assert t._step_fn.num_plans == 2   # one compile per bucket, ever
+    assert t.global_step == 4
+
+    # loss parity: short bucket == same data right-padded to the long bucket
+    ta, _ = _make_trainer(dp=1, tp=1, gbs=4, mbs=2)
+    ta.build(jax.random.key(9))
+    la = float(ta.train_step(batch(ids32))["loss"])
+    tb, _ = _make_trainer(dp=1, tp=1, gbs=4, mbs=2)
+    tb.build(jax.random.key(9))
+    lb = float(tb.train_step(padded(ids32, 64))["loss"])
+    np.testing.assert_allclose(la, lb, rtol=1e-5)
+
+
+def test_plan_pool_cap_errors_loudly(monkeypatch):
+    monkeypatch.setenv("HETU_TPU_MAX_PLANS", "1")
+    rng = np.random.default_rng(8)
+    t, _ = _make_trainer(dp=1, tp=1, gbs=4, mbs=4)
+    t.build()
+    t.train_step({"input_ids": rng.integers(1, 250, size=(4, 64)).astype(np.int32),
+                  "labels": rng.integers(1, 250, size=(4, 64)).astype(np.int32)})
+    with pytest.raises(RuntimeError, match="bucket ladder"):
+        t.train_step({"input_ids": rng.integers(1, 250, size=(4, 32)).astype(np.int32),
+                      "labels": rng.integers(1, 250, size=(4, 32)).astype(np.int32)})

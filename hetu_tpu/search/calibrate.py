@@ -164,3 +164,33 @@ def validate(cost: CostModel, candidates: Sequence[StrategyCandidate],
                      "error": round(abs(predicted - actual) / actual, 3)})
         logger.info(f"validate {rows[-1]}")
     return rows
+
+
+def rank_order_agreement(rows: Sequence[Dict[str, float]],
+                         tie_rtol: float = 0.0) -> Tuple[bool, float]:
+    """Kendall-tau agreement between predicted and measured step times.
+
+    The search only needs the cost model to ORDER candidates correctly
+    (the argmin is what ships); absolute error is secondary.  Pairs whose
+    MEASURED times differ by less than `tie_rtol` (relative) are ties —
+    the hardware itself cannot distinguish them, so neither ordering is
+    wrong.  Returns (no_discordant_pairs, tau); tau = 1.0 means the model
+    ranks every distinguishable pair the way the hardware does."""
+    n = len(rows)
+    if n < 2:
+        return True, 1.0
+    concordant = discordant = 0
+    for i in range(n):
+        for j in range(i + 1, n):
+            dp = rows[i]["predicted_s"] - rows[j]["predicted_s"]
+            da = rows[i]["actual_s"] - rows[j]["actual_s"]
+            if abs(da) <= tie_rtol * max(rows[i]["actual_s"],
+                                         rows[j]["actual_s"]):
+                continue
+            if dp * da > 0:
+                concordant += 1
+            elif dp * da < 0:
+                discordant += 1
+    total = concordant + discordant
+    tau = (concordant - discordant) / total if total else 1.0
+    return discordant == 0, tau

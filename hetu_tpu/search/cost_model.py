@@ -103,7 +103,8 @@ class CostModel:
 
         # TP comm: 4 allreduces of [b_local, s, h] bf16 per layer (2 fwd+2 bwd),
         # halved arithmetic but same bytes under SP (reduce-scatter+allgather)
-        t_comm = 0.0
+        t_comm = 0.0      # per-layer comm, overlappable with compute
+        t_dp = 0.0        # grad-sync tail, serialized after backward
         if c.tp > 1:
             b_local = self.global_batch / max(c.dp * c.cp, 1)
             bytes_per = b_local * self.seq_len * self.hidden * 2
@@ -115,7 +116,7 @@ class CostModel:
         if c.dp > 1:
             shard_bytes = 4 * self.num_params / max(c.tp * c.pp, 1)
             ring = 2 * (c.dp - 1) / c.dp * shard_bytes
-            t_comm += ring / (self._allreduce_gbps("dp", c.dp) * 1e9)
+            t_dp += ring / (self._allreduce_gbps("dp", c.dp) * 1e9)
 
         # CP ring: kv blocks circulate cp-1 times
         if c.cp > 1:
@@ -138,8 +139,19 @@ class CostModel:
             t_comm += self.num_layers * (c.cp - 1) * kv_bytes / (
                 self.hw.ici_p2p_gbps * 1e9)
 
-        # pipeline bubble
-        busy = compute + t_comm
+        # comm/compute overlap (reference: overlap_coefficient.json:2): with
+        # a measured coefficient k in [1, 2], per-layer collectives overlap
+        # the compute stream but slow it —
+        #   max(C, M) + (k-1)*min(C, M)
+        # (M=0 -> C; full overlap M=C -> k*C; k=2 == fully serial).  The DP
+        # grad-sync tail stays serial — it fires after the backward.
+        # Without a measurement, keep the conservative serial sum.
+        k = self.hw.measured.get("overlap_coef")
+        if k:
+            busy = (max(compute, t_comm) + (k - 1.0) * min(compute, t_comm)
+                    + t_dp)
+        else:
+            busy = compute + t_comm + t_dp
         if c.pp > 1:
             m = max(c.n_micro, c.pp)
             busy *= (m + c.pp - 1) / m

@@ -159,6 +159,72 @@ def measure_collective_gbps(mesh, axis: str = "tp",
     return bus / dt / 1e9
 
 
+def measure_overlap_coef(mesh=None, axis: Optional[str] = None,
+                         n: int = 2048, iters: int = 5) -> float:
+    """Compute-vs-communication overlap slowdown coefficient (reference:
+    tools/Galvatron/.../overlap_coefficient.json:2 — they measure how much
+    compute slows when comm overlaps it and feed the factor to the search).
+
+    Stream A = an MXU matmul chain.  Stream B = a psum chain over `axis`
+    when a mesh axis with >1 members is available (real pod); on a single
+    chip, an HBM-streaming chain — the same memory/DMA subsystem a real
+    ICI transfer contends on, which is what makes overlap non-free.
+    Each stream and the joint program are timed DIFFERENTIALLY (reps vs
+    reps/2) so tunnel/dispatch constants cancel.
+
+    Returns k = t_joint / max(t_A, t_B), clipped to [1.0, 2.0]:
+    1.0 = perfect overlap, 2.0 = fully serialized."""
+    dtype = jnp.bfloat16
+    mm_reps, mem_reps = 64, 32
+    if jax.default_backend() == "cpu":
+        n, mm_reps, mem_reps, iters = 512, 32, 16, 3
+    a0 = jnp.ones((n, n), dtype)
+    b0 = jnp.ones((n, n), dtype)
+    m0 = jnp.ones((8 * n * n,), jnp.float32)
+
+    def mm_chain(x, reps):
+        x = jax.lax.fori_loop(0, reps, lambda i, x: (x @ b0).astype(dtype), x)
+        return jnp.sum(x.astype(jnp.float32))
+
+    use_psum = (mesh is not None and axis is not None
+                and int(mesh.shape.get(axis, 1)) > 1)
+    if use_psum:
+        from jax.sharding import PartitionSpec as P
+        size = int(mesh.shape[axis])
+
+        def comm_chain(v, reps):
+            def run(v):
+                return jax.lax.fori_loop(
+                    0, reps, lambda i, v: jax.lax.psum(v, axis) * (1.0 / size),
+                    v)
+            return jnp.sum(jax.shard_map(run, mesh=mesh, in_specs=P(),
+                                         out_specs=P())(v)[:1])
+    else:
+        def comm_chain(v, reps):
+            def step(v, _):
+                return v * 1.0000001 + 1e-9, None
+            v, _ = jax.lax.scan(step, v, None, length=reps)
+            return jnp.sum(v[:1])
+
+    def f_mm(reps):
+        g = jax.jit(lambda a: mm_chain(a, reps))
+        return lambda: g(a0)
+
+    def f_comm(reps):
+        g = jax.jit(lambda v: comm_chain(v, reps))
+        return lambda: g(m0)
+
+    def f_joint(mmr, cmr):
+        g = jax.jit(lambda a, v: mm_chain(a, mmr) + comm_chain(v, cmr))
+        return lambda: g(a0, m0)
+
+    t_mm = _diff_time(f_mm(mm_reps), f_mm(mm_reps // 2), iters)
+    t_cm = _diff_time(f_comm(mem_reps), f_comm(mem_reps // 2), iters)
+    t_j = _diff_time(f_joint(mm_reps, mem_reps),
+                     f_joint(mm_reps // 2, mem_reps // 2), iters)
+    return float(np.clip(t_j / max(t_mm, t_cm), 1.0, 2.0))
+
+
 def profile_hardware(mesh=None, chip: Optional[str] = None,
                      measure: bool = True) -> HardwareProfile:
     """Measure what is measurable on the current devices, fill the rest from
@@ -181,6 +247,15 @@ def profile_hardware(mesh=None, chip: Optional[str] = None,
         pass
     try:
         prof.measured["hbm_gbps"] = round(measure_hbm_gbps(), 1)
+    except Exception:
+        pass
+    try:
+        ov_axis = None
+        if mesh is not None:   # first >1 axis: the psum path needs a ring
+            ov_axis = next((a for a in mesh.axis_names
+                            if int(mesh.shape[a]) > 1), None)
+        prof.measured["overlap_coef"] = round(
+            measure_overlap_coef(mesh=mesh, axis=ov_axis), 3)
     except Exception:
         pass
     if mesh is not None:

@@ -128,3 +128,102 @@ def test_cost_model_hetero_ring_kv_inflation():
     t_uni, _ = cost.evaluate(StrategyCandidate(cp=4, tp=2,
                                                cp_tp_eff=(2, 2, 2, 2)))
     assert t_uni == t_homo
+
+
+def test_overlap_coef_in_step_time():
+    """A measured overlap coefficient < 2 must make comm-heavy configs
+    cheaper than the serial model, never cheaper than pure compute."""
+    hw = HardwareProfile.preset("v5e")
+    cost = CostModel(hw=hw, num_layers=8, hidden=1024, intermediate=2816,
+                     vocab=32000, num_params=300_000_000,
+                     global_batch=32, seq_len=2048)
+    c = StrategyCandidate(dp=1, tp=4)
+    t_serial, _ = cost.evaluate(c)
+    hw.measured["overlap_coef"] = 1.2
+    t_overlap, _ = cost.evaluate(c)
+    assert t_overlap < t_serial
+    # k=2 == fully serial
+    hw.measured["overlap_coef"] = 2.0
+    t_k2, _ = cost.evaluate(c)
+    assert abs(t_k2 - t_serial) / t_serial < 1e-9
+    # no comm -> overlap coef is a no-op
+    del hw.measured["overlap_coef"]
+    single = StrategyCandidate()
+    t0, _ = cost.evaluate(single)
+    hw.measured["overlap_coef"] = 1.2
+    t1, _ = cost.evaluate(single)
+    assert t0 == t1
+
+
+def test_measure_overlap_coef_runs():
+    from hetu_tpu.search.profiler import measure_overlap_coef
+    try:
+        k = measure_overlap_coef()
+    except RuntimeError as e:   # loaded CI host: the probe refuses noise
+        pytest.skip(f"host too noisy for the differential probe: {e}")
+    assert 1.0 <= k <= 2.0
+
+
+def test_rank_order_agreement():
+    from hetu_tpu.search.calibrate import rank_order_agreement
+    rows = [{"predicted_s": 1.0, "actual_s": 2.0},
+            {"predicted_s": 2.0, "actual_s": 3.0},
+            {"predicted_s": 3.0, "actual_s": 4.0}]
+    ok, tau = rank_order_agreement(rows)
+    assert ok and tau == 1.0
+    rows[2]["actual_s"] = 1.0   # model ranks it slowest, hw fastest
+    ok, tau = rank_order_agreement(rows)
+    assert not ok and tau < 1.0
+
+
+@pytest.mark.slow
+def test_validate_rank_order_four_configs():
+    """The cost model must RANK a 4-config ladder (2 model sizes x 2 seq
+    lens) the way the hardware does.  Runs on CPU with the matmul
+    throughput measured on THIS host so predicted times share the
+    hardware's scale.  The remat dimension is deliberately NOT validated
+    here: on CPU, remat is measurably FASTER for larger models (memory
+    pressure beats the 4/3 recompute flops), the opposite of the
+    MXU-bound TPU behavior the model encodes — tools_validate_cost.py
+    runs the remat ladder on the real chip."""
+    import jax
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+    from hetu_tpu.search.calibrate import rank_order_agreement, validate
+    from hetu_tpu.search.profiler import measure_matmul_tflops
+
+    hw = HardwareProfile.preset("v5e")
+    hw.bf16_tflops = 1.0
+    hw.measured["matmul_tflops"] = min(measure_matmul_tflops(), 0.85)
+
+    sizes = {False: dict(hidden_size=256, intermediate_size=704,
+                         num_hidden_layers=4),
+             True: dict(hidden_size=512, intermediate_size=1408,
+                        num_hidden_layers=8)}
+    cand = StrategyCandidate(dp=1, tp=1, remat=False, zero=False)
+    rows_all = []
+    for big in (False, True):
+        for seq in (128, 256):
+            cfg = LlamaConfig.tiny(
+                compute_dtype=jax.numpy.float32, use_flash_attention=False,
+                remat=False, **sizes[big])
+            cost = CostModel(hw=hw, num_layers=cfg.num_hidden_layers,
+                             hidden=cfg.hidden_size,
+                             intermediate=cfg.intermediate_size,
+                             vocab=cfg.vocab_size,
+                             num_params=cfg.num_params(),
+                             global_batch=4, seq_len=seq)
+
+            def build(c, cfg=cfg, seq=seq):
+                tc = TrainingConfig(global_batch_size=4, micro_batch_size=4,
+                                    seq_len=seq, lr=1e-3, warmup_steps=1,
+                                    total_steps=10, log_every=1000)
+                return Trainer(LlamaLMHeadModel(cfg), tc,
+                               ParallelStrategy()).build()
+
+            rows_all.extend(validate(cost, [cand], build, steps=3))
+    assert len(rows_all) == 4
+    # 15% tie band: pairs the loaded host can't distinguish don't count
+    ok, tau = rank_order_agreement(rows_all, tie_rtol=0.15)
+    assert ok, (rows_all, tau)

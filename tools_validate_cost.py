@@ -1,0 +1,80 @@
+"""Real-chip cost-model validation ladder (run on the TPU; reference:
+Galvatron validates its cost model against measured per-config times).
+
+Runs remat on/off x 2 model sizes single-chip, prints predicted vs
+measured step times and the rank-order agreement (Kendall tau).  The CPU
+test suite validates the size/seq dimensions (tests/test_search.py); the
+remat dimension only means anything on the MXU, so it lives here.
+
+Usage: python tools_validate_cost.py [--profile hardware_profile_v5e.json]
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import sys
+
+
+def main():
+    import jax
+    import numpy as np
+
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+    from hetu_tpu.search.calibrate import rank_order_agreement, validate
+    from hetu_tpu.search.cost_model import CostModel, StrategyCandidate
+    from hetu_tpu.search.profiler import HardwareProfile
+
+    prof_path = None
+    if "--profile" in sys.argv:
+        prof_path = sys.argv[sys.argv.index("--profile") + 1]
+    if prof_path:
+        hw = HardwareProfile.load(prof_path)
+    else:
+        hw = HardwareProfile.preset("v5e")
+
+    sizes = {
+        "350m": dict(hidden_size=1024, intermediate_size=2816,
+                     num_hidden_layers=12, num_attention_heads=16,
+                     num_key_value_heads=16),
+        "750m": dict(hidden_size=1536, intermediate_size=4096,
+                     num_hidden_layers=16, num_attention_heads=12,
+                     num_key_value_heads=12),
+    }
+    batch, seq = 4, 2048
+    cands = [StrategyCandidate(dp=1, tp=1, remat=r, zero=False)
+             for r in (False, True)]
+
+    rows_all = []
+    for name, kw in sizes.items():
+        cfg0 = LlamaConfig(vocab_size=32000, max_position_embeddings=seq,
+                           remat=True, remat_policy="dots_attn",
+                           use_scan=True, **kw)
+        cost = CostModel(hw=hw, num_layers=cfg0.num_hidden_layers,
+                         hidden=cfg0.hidden_size,
+                         intermediate=cfg0.intermediate_size,
+                         vocab=cfg0.vocab_size, num_params=cfg0.num_params(),
+                         global_batch=batch, seq_len=seq)
+
+        def build(c, cfg0=cfg0):
+            cfg = dataclasses.replace(cfg0, remat=c.remat)
+            tc = TrainingConfig(global_batch_size=batch, micro_batch_size=batch,
+                                seq_len=seq, lr=1e-4, warmup_steps=2,
+                                total_steps=10, log_every=10 ** 9)
+            return Trainer(LlamaLMHeadModel(cfg), tc,
+                           ParallelStrategy()).build()
+
+        rows = validate(cost, cands, build, steps=4)
+        for r in rows:
+            r["model"] = name
+        rows_all.extend(rows)
+
+    ok, tau = rank_order_agreement(rows_all, tie_rtol=0.05)
+    print(json.dumps({"rows": rows_all, "rank_order_ok": ok,
+                      "kendall_tau": round(tau, 3)}, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,260 @@
+"""Multi-task LoRA batch scheduling + quota planning (the LoBRA trainer
+layer; reference: examples/lobra/trainer/batch_scheduler.py — greedy
+max-tokens micro-batching with per-task offset/size accounting and
+cross-task fusion of leftovers; examples/lobra/trainer/planner.py — the
+per-task resource planner feeding it).
+
+TPU realization: micro batches are STATIC-shaped [rows, seq+1] int32 blocks
+chosen from a bucket ladder (every distinct (rows, seq) is one compiled
+plan, so the ladder keeps the plan pool small), rows are grouped per task
+and each micro carries `batch_offset_list`/`batch_size_list` so the engine
+can run each task's contiguous row span through its own adapter tree.  The
+quota planner is the weighted-fair essence of LoBRA's planner: per-round
+task quotas proportional to weight x backlog, so no task starves and
+high-priority tasks drain first.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class MicroBatch:
+    """One static-shaped training micro: rows from >=1 tasks.
+
+    data: [batch_size, seq_length + 1] int32 (inputs = [:, :-1], labels =
+    [:, 1:] with positions past each row's `valid_lens` entry to be masked
+    to -100 — see labels()).
+    batch_offset_list/batch_size_list: per-task contiguous row spans
+    (reference: batch_scheduler.MicroBatch)."""
+    data: np.ndarray
+    batch_size: int
+    seq_length: int
+    batch_offset_list: List[int]
+    batch_size_list: List[int]
+    valid_lens: np.ndarray   # [batch_size] true token counts per row
+
+    def token_num(self) -> int:
+        return self.batch_size * self.seq_length
+
+    def task_ids(self) -> List[int]:
+        return [t for t, b in enumerate(self.batch_size_list) if b > 0]
+
+    def _span(self, task: int) -> slice:
+        off = self.batch_offset_list[task]
+        return slice(off, off + self.batch_size_list[task])
+
+    def task_rows(self, task: int) -> np.ndarray:
+        return self.data[self._span(task)]
+
+    def task_inputs(self, task: int) -> np.ndarray:
+        return self.data[self._span(task), :-1]
+
+    def task_labels(self, task: int) -> np.ndarray:
+        """Pre-shifted next-token targets with pad positions masked to
+        -100: target column j = data[:, j+1], valid while j+1 < valid_len
+        (pad_id cannot be used as the mask — 0 may be a real token)."""
+        rows = self.data[self._span(task), 1:].astype(np.int32)
+        lens = self.valid_lens[self._span(task)]
+        cols = np.arange(rows.shape[1])[None, :]
+        return np.where(cols + 1 < lens[:, None], rows, -100)
+
+
+def _bucket_len(n: int, bucket_sizes: Sequence[int]) -> int:
+    """Strict choose_bucket (hetu_tpu.data.bucket.choose_bucket clamps to
+    the largest rung; the scheduler must REJECT oversize samples instead —
+    a silently truncated sample would train on garbage)."""
+    from hetu_tpu.data.bucket import choose_bucket
+    b = choose_bucket(n, tuple(bucket_sizes))
+    if n > b:
+        raise ValueError(f"sample of length {n} exceeds the largest bucket "
+                         f"{bucket_sizes[-1]}")
+    return b
+
+
+def schedule_micro_batches(task_samples: Dict[int, List[np.ndarray]],
+                           max_tokens: int, train_task_num: int,
+                           bucket_sizes: Sequence[int], pad_id: int = 0,
+                           fuse_leftovers: bool = True) -> List[MicroBatch]:
+    """Greedy max-tokens scheduler (reference: greedy_local_batch_scheduler).
+
+    Per task: samples are bucketed by length, and each bucket emits micros
+    of `max_tokens // seq` rows.  Partially-filled leftovers are FUSED
+    across tasks at the same bucket length into one micro with per-task
+    row spans (fuse_leftovers=False keeps them single-task, padded).
+    Every sample is scheduled exactly once."""
+    bucket_sizes = sorted(bucket_sizes)
+    # task -> seq_bucket -> list of (padded row [seq+1], valid token count)
+    grouped: Dict[int, Dict[int, List[tuple]]] = {}
+    for task, samples in task_samples.items():
+        for s in samples:
+            s = np.asarray(s, np.int32)
+            b = _bucket_len(max(len(s) - 1, 1), bucket_sizes)
+            row = np.full((b + 1,), pad_id, np.int32)
+            row[:len(s)] = s
+            grouped.setdefault(task, {}).setdefault(b, []).append(
+                (row, len(s)))
+
+    def make(items, seq, offs, sizes):
+        rows = np.stack([r for r, _ in items])
+        lens = np.asarray([v for _, v in items], np.int32)
+        return MicroBatch(rows, len(items), seq, offs, sizes, lens)
+
+    micros: List[MicroBatch] = []
+    leftovers: Dict[int, List[tuple]] = {}   # seq -> [(task, items)]
+    for task in sorted(grouped):
+        for seq in sorted(grouped[task]):
+            items = grouped[task][seq]
+            cap = max(max_tokens // seq, 1)
+            while len(items) >= cap:
+                take, items = items[:cap], items[cap:]
+                offs = [0] * train_task_num
+                sizes = [0] * train_task_num
+                sizes[task] = cap
+                micros.append(make(take, seq, offs, sizes))
+            if items:
+                leftovers.setdefault(seq, []).append((task, items))
+
+    for seq in sorted(leftovers):
+        parts = leftovers[seq]
+        cap = max(max_tokens // seq, 1)
+        if not fuse_leftovers:
+            for task, items in parts:
+                offs = [0] * train_task_num
+                sizes = [0] * train_task_num
+                sizes[task] = len(items)
+                micros.append(make(items, seq, offs, sizes))
+            continue
+        # fuse across tasks, first-fit into <=cap-row micros; rows of one
+        # task stay contiguous so the engine slices one span per task
+        cur: List[tuple] = []
+        cur_rows = 0
+
+        def flush():
+            if not cur:
+                return
+            offs = [0] * train_task_num
+            sizes = [0] * train_task_num
+            data = []
+            off = 0
+            for task, items in cur:
+                offs[task] = off
+                sizes[task] = len(items)
+                off += len(items)
+                data.extend(items)
+            micros.append(make(data, seq, offs, sizes))
+
+        for task, items in sorted(parts, key=lambda p: -len(p[1])):
+            while items:
+                room = cap - cur_rows
+                if room == 0:
+                    flush()
+                    cur, cur_rows = [], 0
+                    room = cap
+                take, items = items[:room], items[room:]
+                cur.append((task, take))
+                cur_rows += len(take)
+        flush()
+    return micros
+
+
+@dataclasses.dataclass
+class TaskQuotaPlanner:
+    """Per-round task quotas: weighted-fair over backlog (the planner.py
+    essence — LoBRA allocates per-task resources each round from priority
+    and pending work; the full ILP degenerates to weighted-proportional
+    shares when every task runs on the same mesh)."""
+    weights: Dict[int, float]
+    round_tokens: int
+
+    def plan(self, backlog_tokens: Dict[int, int]) -> Dict[int, int]:
+        """backlog (pending tokens per task) -> this round's token quota.
+        Work-conserving: unused share of drained tasks is redistributed."""
+        active = {t: b for t, b in backlog_tokens.items() if b > 0}
+        quotas = {t: 0 for t in backlog_tokens}
+        remaining = self.round_tokens
+        while active and remaining > 0:
+            wsum = sum(self.weights.get(t, 1.0) for t in active)
+            gave = 0
+            for t in sorted(active):
+                share = int(remaining * self.weights.get(t, 1.0) / wsum)
+                share = min(share, active[t])
+                quotas[t] += share
+                active[t] -= share
+                gave += share
+            if gave == 0:   # shares rounded to 0: give the rest to one task
+                t = max(active, key=lambda t: self.weights.get(t, 1.0))
+                share = min(remaining, active[t])
+                quotas[t] += share
+                gave = share
+            remaining -= gave
+            active = {t: b for t, b in active.items() if b > 0}
+        return quotas
+
+
+class MultiTaskSFTEngine:
+    """Drive a MultiLoRAManager with scheduled micros (reference:
+    lobra/trainer/trainer.py train loop — per-micro, run each task's row
+    span against that task's adapters and update only those).
+
+    optimizer: an hetu_tpu.optim optimizer applied per task adapter tree."""
+
+    def __init__(self, manager, optimizer, loss_fn=None):
+        self.manager = manager
+        self.optimizer = optimizer
+        self.opt_states: Dict[str, Any] = {
+            t: optimizer.init(manager.adapters[t]) for t in manager.tasks()}
+        # loss_fn(wrapped_model, adapters, ids, labels) -> scalar mean loss;
+        # labels are PRE-SHIFTED next-token targets with pads masked to -100
+        # (MicroBatch.task_labels)
+        self._loss_fn = loss_fn or (
+            lambda model, adapters, ids, labels: model(
+                adapters, ids, labels=labels, labels_shifted=True))
+        self._step = None
+
+    def _build_step(self):
+        import jax
+
+        def step(adapters, opt_state, ids, labels):
+            loss, grads = jax.value_and_grad(
+                lambda a: self._loss_fn(self.manager.wrapped_model, a, ids,
+                                        labels)
+            )(adapters)
+            adapters, opt_state = self.optimizer.update(
+                grads, opt_state, adapters)
+            return adapters, opt_state, loss
+
+        from hetu_tpu.engine.plan_pool import PlanPool
+        # task adapters share shapes -> tasks share compiled plans; only
+        # distinct (rows, seq) shapes from the bucket ladder compile
+        self._step = PlanPool(step, jit_kwargs=dict(donate_argnums=(0, 1)),
+                              name="multitask_sft")
+
+    def train_micro(self, micro: MicroBatch) -> Dict[int, float]:
+        """Run every task span in the micro; returns task -> mean loss."""
+        import jax.numpy as jnp
+        if self._step is None:
+            self._build_step()
+        tasks = self.manager.tasks()
+        out: Dict[int, float] = {}
+        for tid in micro.task_ids():
+            task = tasks[tid]
+            ids = jnp.asarray(micro.task_inputs(tid))
+            labels = jnp.asarray(micro.task_labels(tid))
+            ad, st, loss = self._step(
+                self.manager.adapters[task], self.opt_states[task], ids,
+                labels)
+            self.manager.adapters[task] = ad
+            self.opt_states[task] = st
+            out[tid] = float(loss)
+        return out
+
+    def train(self, micros: Sequence[MicroBatch]) -> Dict[int, List[float]]:
+        hist: Dict[int, List[float]] = {}
+        for m in micros:
+            for tid, loss in self.train_micro(m).items():
+                hist.setdefault(tid, []).append(loss)
+        return hist

@@ -20,13 +20,30 @@ from typing import Any, Dict, Optional
 import jax
 import orbax.checkpoint as ocp
 
+# remote stores ride orbax's filesystem layer untouched — the TPU-native
+# analog of the reference's HDFS branch (model_saver.py:168): on TPU pods
+# the durable store is a GCS bucket, and orbax speaks gs:// natively
+# (needs the gcsfs/etils deps present in cloud images)
+_REMOTE_SCHEMES = ("gs://", "s3://", "hdfs://", "file://")
+
+
+def resolve_ckpt_path(path: str) -> str:
+    """Absolute-ify local paths; pass remote URIs through unmangled."""
+    if any(path.startswith(s) for s in _REMOTE_SCHEMES):
+        return path
+    return os.path.abspath(path)
+
 
 class CheckpointManager:
-    """Step-numbered checkpoints with retention + async save."""
+    """Step-numbered checkpoints with retention + async save.
+
+    `directory` may be a local path or a remote URI (gs://bucket/ckpts —
+    the TPU-pod durable store; reference: model_saver.py:168 remote saves).
+    """
 
     def __init__(self, directory: str, max_to_keep: int = 3,
                  async_save: bool = True):
-        self.directory = os.path.abspath(directory)
+        self.directory = resolve_ckpt_path(directory)
         options = ocp.CheckpointManagerOptions(
             max_to_keep=max_to_keep, enable_async_checkpointing=async_save)
         self._mgr = ocp.CheckpointManager(self.directory, options=options)
@@ -68,7 +85,7 @@ class CheckpointManager:
 def save_checkpoint(path: str, state: Any):
     """One-shot synchronous save (reference temp_save analog)."""
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(os.path.abspath(path), state, force=True)
+    ckptr.save(resolve_ckpt_path(path), state, force=True)
     ckptr.wait_until_finished()
     ckptr.close()
 
@@ -78,12 +95,12 @@ def load_checkpoint(path: str, target: Optional[Any] = None) -> Any:
     ckptr = ocp.StandardCheckpointer()
     try:
         if target is None:
-            return ckptr.restore(os.path.abspath(path))
+            return ckptr.restore(resolve_ckpt_path(path))
         abstract = jax.tree.map(
             lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype,
                                            sharding=getattr(x, "sharding", None))
             if hasattr(x, "shape") else x,
             target)
-        return ckptr.restore(os.path.abspath(path), abstract)
+        return ckptr.restore(resolve_ckpt_path(path), abstract)
     finally:
         ckptr.close()

@@ -1,0 +1,15 @@
+
+
+"""Checkpoint path/backend behavior (reference: python/hetu/utils/checkpoint/
+model_saver.py — local + remote stores; reshard-on-load itself is covered in
+test_trainer.py::test_checkpoint_reshard_on_load and test_hot_switch.py)."""
+
+
+def test_remote_uri_paths_pass_through():
+    """gs://... checkpoint roots must reach orbax unmangled (the reference's
+    remote-store branch, model_saver.py:168; on TPU pods the durable store
+    is GCS) while local paths still absolutify."""
+    from hetu_tpu.utils.checkpoint import resolve_ckpt_path
+    assert resolve_ckpt_path("gs://bucket/ckpts") == "gs://bucket/ckpts"
+    assert resolve_ckpt_path("hdfs://nn/ckpts") == "hdfs://nn/ckpts"
+    assert resolve_ckpt_path("relative/dir").startswith("/")

@@ -82,51 +82,95 @@ def main():
         batch, seq, iters = 2, 128, 3
         peak_flops = 1e12
 
-    model = LlamaLMHeadModel(cfg)
-    opt = optim.AdamW(lr=1e-4)
-    params = model.init(jax.random.key(0))
-    opt_state = opt.init(params)
-    ids = jnp.asarray(np.random.default_rng(0).integers(
-        0, cfg.vocab_size, size=(batch, seq)), jnp.int32)
+    def measure(cfg, batch, seq, iters):
+        """(mfu, tokens/s, step_s) of one donated AdamW train step."""
+        import jax
+        import jax.numpy as jnp
+        model = LlamaLMHeadModel(cfg)
+        opt = optim.AdamW(lr=1e-4)
+        params = model.init(jax.random.key(0))
+        opt_state = opt.init(params)
+        ids = jnp.asarray(np.random.default_rng(0).integers(
+            0, cfg.vocab_size, size=(batch, seq)), jnp.int32)
 
-    def _step(params, opt_state, ids):
-        loss, grads = jax.value_and_grad(
-            lambda p: model(p, ids, labels=ids))(params)
-        params, opt_state = opt.update(grads, opt_state, params)
-        return params, opt_state, loss
+        def _step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(
+                lambda p: model(p, ids, labels=ids))(params)
+            params, opt_state = opt.update(grads, opt_state, params)
+            return params, opt_state, loss
 
-    step = jax.jit(_step, donate_argnums=(0, 1))
-
-    # warmup/compile. NOTE: on the axon remote-TPU backend block_until_ready
-    # is effectively a no-op; a host fetch of the scalar loss is the reliable
-    # sync point, so time with float(loss) every iteration.
-    params, opt_state, loss = step(params, opt_state, ids)
-    float(loss)
-
-    times = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
+        step = jax.jit(_step, donate_argnums=(0, 1))
+        # warmup/compile. NOTE: on the axon remote-TPU backend
+        # block_until_ready is effectively a no-op; a host fetch of the
+        # scalar loss is the reliable sync point, so time with float(loss).
         params, opt_state, loss = step(params, opt_state, ids)
         float(loss)
-        times.append(time.perf_counter() - t0)
-    dt = min(times)
+        times = []
+        for _ in range(iters):
+            t0 = time.perf_counter()
+            params, opt_state, loss = step(params, opt_state, ids)
+            float(loss)
+            times.append(time.perf_counter() - t0)
+        dt = min(times)
+        tokens_per_sec = batch * seq / dt
+        mfu = tokens_per_sec * cfg.flops_per_token(seq) / peak_flops
+        return mfu, tokens_per_sec, dt
 
-    tokens_per_sec = batch * seq / dt
-    flops_per_token = cfg.flops_per_token(seq)
-    mfu = tokens_per_sec * flops_per_token / peak_flops
+    mfu, tokens_per_sec, dt = measure(cfg, batch, seq, iters)
+
+    detail = {
+        "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
+        "step_time_s": round(dt, 4),
+        "model_params_m": round(cfg.num_params() / 1e6, 1),
+        "batch": batch, "seq": seq,
+        "backend": jax.default_backend(),
+    }
+
+    # Second point: the largest model one 16G v5e fits.  fp32 Adam moments
+    # bound it: p*(2 bf16 param + 8 fp32 m/v + 2 grad) + ~2G logits/acts
+    # <= 16G -> ~1.0-1.2B params with bf16 weights (BASELINE.md targets a
+    # 7B-class DP*TP*PP run; this is the single-chip-visible ladder rung).
+    if on_tpu and "--skip-big" not in sys.argv:
+        big_ladder = [
+            (2048, 18, 5632, 16),   # ~1.06B params
+            (2048, 16, 5632, 16),   # ~0.96B
+            (1792, 16, 4864, 14),   # ~0.74B
+        ]
+        for h, L, inter, heads in big_ladder:
+            big_cfg = LlamaConfig(
+                vocab_size=32000, hidden_size=h, intermediate_size=inter,
+                num_hidden_layers=L, num_attention_heads=heads,
+                num_key_value_heads=heads, max_position_embeddings=2048,
+                param_dtype=jnp.bfloat16, remat=True,
+                remat_policy="dots_attn", use_scan=True)
+            try:
+                bmfu, btps, bdt = measure(big_cfg, 4, 2048, max(iters - 2, 2))
+                detail["big_model"] = {
+                    "model_params_m": round(big_cfg.num_params() / 1e6, 1),
+                    "mfu": round(float(bmfu), 4),
+                    "tokens_per_sec_per_chip": round(btps, 1),
+                    "step_time_s": round(bdt, 4),
+                    "batch": 4, "seq": 2048, "param_dtype": "bfloat16",
+                }
+                break
+            except Exception as e:
+                msg = str(e)
+                oom = any(t in msg.lower() for t in
+                          ("resource", "memory", "oom", "exhaust",
+                           "allocat"))
+                print(f"# big-model rung h{h}xL{L} failed "
+                      f"({type(e).__name__}): {msg[:300]}", file=sys.stderr)
+                if not oom:
+                    # a real bug, not memory pressure: smaller rungs would
+                    # hit it too — stop instead of masking the regression
+                    break
 
     print(json.dumps({
         "metric": "llama_train_mfu",
         "value": round(float(mfu), 4),
         "unit": "fraction_of_peak",
         "vs_baseline": round(float(mfu) / 0.45, 4),
-        "detail": {
-            "tokens_per_sec_per_chip": round(tokens_per_sec, 1),
-            "step_time_s": round(dt, 4),
-            "model_params_m": round(cfg.num_params() / 1e6, 1),
-            "batch": batch, "seq": seq,
-            "backend": jax.default_backend(),
-        },
+        "detail": detail,
     }), flush=True)
 
     # hardware profile AFTER the metric line is safely out: a tunnel flap

@@ -28,11 +28,11 @@ logger = get_logger("plan_pool")
 
 
 def _shape_key(tree) -> Tuple:
-    leaves = jax.tree.leaves(tree)
     # pytree STRUCTURE is part of the key: identical leaf shapes under
     # different field names (e.g. position_ids vs segment_ids riders) are
-    # different programs
-    return (str(jax.tree.structure(tree)),) + tuple(
+    # different programs.  treedef objects hash in C++ — no stringify.
+    leaves, treedef = jax.tree.flatten(tree)
+    return (treedef,) + tuple(
         (tuple(l.shape), str(l.dtype)) for l in leaves
         if hasattr(l, "shape"))
 
@@ -47,6 +47,11 @@ class PlanPool:
     # refuse to compile more than this many distinct plans (None = unbounded)
     max_plans: Optional[int] = None
     name: str = "step"
+    # which positional args the dispatch key hashes (None = all).  The
+    # Trainer keys on the batches arg alone: params/opt_state shapes are
+    # invariant per pool, and flattening a million-leaf param tree every
+    # step is hot-path host work jit's own cache never paid.
+    key_argnums: Optional[Tuple[int, ...]] = None
 
     def __post_init__(self):
         self._plans: Dict[Tuple, Any] = {}
@@ -57,7 +62,9 @@ class PlanPool:
         return self._jitted.lower(*args)
 
     def get(self, strategy_id, *args) -> Any:
-        key = (strategy_id,) + _shape_key(args)
+        keyed = (args if self.key_argnums is None
+                 else tuple(args[i] for i in self.key_argnums))
+        key = (strategy_id,) + _shape_key(keyed)
         plan = self._plans.get(key)
         if plan is None:
             n = len(self._plans)

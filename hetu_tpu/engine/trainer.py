@@ -157,7 +157,10 @@ class Trainer:
             jit_kwargs=dict(out_shardings=(pshard, sshard, None, None),
                             donate_argnums=(0, 1)),
             max_plans=flags.int_flag("HETU_TPU_MAX_PLANS") or None,
-            name="train_step")
+            name="train_step",
+            # dispatch keys hash the BATCHES pytree only — params/opt_state
+            # shapes never change within one pool
+            key_argnums=(2,))
 
     def _plan_dispatch_key(self):
         """Traced-behavior inputs that are NOT visible in the batch shapes:

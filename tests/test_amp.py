@@ -97,12 +97,15 @@ def test_bf16_checkpoint_resumes_into_fp16_trainer(tmp_path):
     assert np.isfinite(float(m["loss"]))
 
 
-def test_fp16_with_1f1b_rejected():
+def test_fp16_with_1f1b_builds_scaler():
+    """fp16 + 1f1b is supported (the scale rides the manual-VJP cotangent
+    seeds — see test_pipeline_1f1b.test_1f1b_fp16_grad_scaler for the
+    loss-parity check); the trainer must auto-enable the GradScaler."""
     cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16,
                            num_hidden_layers=2)
     from hetu_tpu.core.mesh import MeshConfig
     st = ParallelStrategy(mesh=MeshConfig(pp=2))
     tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=32,
                         pp_schedule="1f1b")
-    with pytest.raises(NotImplementedError):
-        Trainer(LlamaLMHeadModel(cfg, st), tc, st)
+    tr = Trainer(LlamaLMHeadModel(cfg, st), tc, st)
+    assert tr._scaler is not None

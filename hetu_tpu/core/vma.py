@@ -1,0 +1,61 @@
+"""Varying-manual-axes (vma) helpers for partial-manual shard_map code.
+
+One home for the check-then-promote idiom that hetero-TP, the pipeline
+stage bodies and the 1f1b round bodies all need, so the two load-bearing
+workarounds live in exactly one place:
+
+* `pvary_missing` routes 16-bit values through f32 on the CPU backend —
+  pvary's TRANSPOSE is a psum of the cotangent in the value's dtype, and a
+  16-bit all-reduce emitted from a partial-manual region check-fails
+  XLA:CPU's AllReducePromotion pass (CreateBinary on a `copy` reducer
+  root; minimal repro: bf16 psum inside a shard_map with any auto axis).
+  TPU keeps 16-bit collectives: the pass doesn't run there and half the
+  bytes ride the ICI.
+* `cast_varying` is the branch-agreement promotion (`lax.pcast` to
+  varying) used so both `lax.cond` branches and scan carries type-check;
+  it does not touch dtypes.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def vma_of(x) -> frozenset:
+    try:
+        return frozenset(getattr(jax.typeof(x), "vma", frozenset()))
+    except Exception:
+        return frozenset()
+
+
+def _widen_16bit() -> bool:
+    return jax.default_backend() == "cpu"
+
+
+def pvary_missing(x, axes):
+    """pvary x onto any of `axes` not already in its vma set (see module
+    docstring for the CPU 16-bit widening)."""
+    need = tuple(a for a in axes if a not in vma_of(x))
+    if not need:
+        return x
+    if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
+        return lax.pvary(x.astype(jnp.float32), need).astype(x.dtype)
+    return lax.pvary(x, need)
+
+
+def align(*xs):
+    """Align the vma sets of xs to their union so elementwise/contraction
+    ops type-check under check_vma=True."""
+    union = set()
+    for x in xs:
+        union |= set(vma_of(x))
+    union = tuple(union)
+    return tuple(pvary_missing(x, union) for x in xs)
+
+
+def cast_varying(x, axes):
+    """Promote x to varying over any missing `axes` (lax.pcast) — the
+    cond-branch / scan-carry agreement cast."""
+    need = tuple(a for a in axes if a not in vma_of(x))
+    return lax.pcast(x, need, to="varying") if need else x

@@ -437,7 +437,7 @@ class LlamaLMHeadModel(Module):
     def pipeline_train_grads(self, params, input_ids, labels, *,
                              position_ids=None, segment_ids=None,
                              n_micro: int, labels_shifted: bool = False,
-                             loss_scale=1.0):
+                             loss_scale=1.0, skip_dead_halves="auto"):
         """1F1B (PipeDream-flush) training pass: returns
         ((loss_sum, count), grads) with grads matching `params` exactly
         (reference: executable_graph.cc:836 GeneratePipedreamFlushSchedule).
@@ -495,7 +495,13 @@ class LlamaLMHeadModel(Module):
             if c.remat:
                 fn = jax.checkpoint(body, policy=_remat_policy(c.remat_policy))
             xs = sp_slice if mask_row is None else (sp_slice, mask_row)
-            (y, aux), _ = lax.scan(fn, (x0, jnp.zeros((), jnp.float32)), xs)
+            # under the shard_map 1f1b round bodies x0 (and hence any
+            # data-derived aux — mask-multiplied OR MoE router losses) is
+            # pp-varying, so the scan's aux carry must start varying too
+            from hetu_tpu.core.vma import cast_varying, vma_of
+            init_aux = cast_varying(jnp.zeros((), jnp.float32),
+                                    tuple(vma_of(x0)))
+            (y, aux), _ = lax.scan(fn, (x0, init_aux), xs)
             return y, aux
 
         def head_loss(ep_, y, lab):
@@ -534,6 +540,7 @@ class LlamaLMHeadModel(Module):
             n_micro=n_micro, mesh=mesh, hidden_size=c.hidden_size,
             compute_dtype=c.compute_dtype, aux_seed=count,
             state_spec=state_spec, loss_scale=loss_scale,
+            skip_dead_halves=skip_dead_halves,
             flags_extra=({"layer_mask": layer_mask}
                          if layer_mask is not None else None))
 

@@ -36,42 +36,17 @@ from jax.sharding import PartitionSpec as P
 from hetu_tpu.parallel.pipeline import build_stage_stack
 
 
-def _pv(x, axes):
-    """pvary x onto any of `axes` not already in its varying-manual-axes set.
-
-    check_vma=True is load-bearing here, not just a lint: with it off, JAX
-    wraps every op in the manual body in unspecified-sharding constraints,
-    and the one landing INSIDE a bf16 psum's reducer region becomes a `copy`
-    HLO that crashes XLA:CPU's AllReducePromotion pass (CloneAllReduce ->
-    CreateBinary(copy) check-fail) under the full dp+ZeRO+remat train step."""
-    vma = jax.typeof(x).vma
-    need = tuple(a for a in axes if a not in vma)
-    if not need:
-        return x
-    if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
-        # pvary's transpose is a psum of the cotangent in x's dtype; route
-        # it through f32 so no 16-bit all-reduce reaches XLA:CPU.
-        return lax.pvary(x.astype(jnp.float32), need).astype(x.dtype)
-    return lax.pvary(x, need)
-
-
-def _widen_16bit() -> bool:
-    """True when 16-bit collectives from this partial-manual region must be
-    widened to f32 (XLA:CPU AllReducePromotion crash — see _pv). TPU keeps
-    16-bit collectives: the pass doesn't run there and half the bytes ride
-    the ICI."""
-    return jax.default_backend() == "cpu"
-
-
-def _al(*xs):
-    """Align the varying-manual-axes sets of xs to their union (pvary each
-    missing axis) so elementwise/contraction ops type-check under
-    check_vma=True."""
-    union = set()
-    for x in xs:
-        union |= set(jax.typeof(x).vma)
-    union = tuple(union)
-    return tuple(_pv(x, union) for x in xs)
+# check_vma=True is load-bearing here, not just a lint: with it off, JAX
+# wraps every op in the manual body in unspecified-sharding constraints,
+# and the one landing INSIDE a bf16 psum's reducer region becomes a `copy`
+# HLO that crashes XLA:CPU's AllReducePromotion pass (CloneAllReduce ->
+# CreateBinary(copy) check-fail) under the full dp+ZeRO+remat train step.
+# The pvary/align/16-bit-widening idiom lives in core.vma (shared with the
+# pipeline stage bodies).
+from hetu_tpu.core.vma import align as _al
+from hetu_tpu.core.vma import pvary_missing as _pv
+from hetu_tpu.core.vma import vma_of as _vma_of
+from hetu_tpu.core.vma import _widen_16bit
 
 
 def _psum_wide(x, axis):

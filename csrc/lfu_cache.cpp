@@ -1,0 +1,111 @@
+// Host-side LFU embedding cache.
+//
+// Rebuild of the reference's frequency-based client cache (reference:
+// hetu/v1/src/hetu_cache/include/lfu_cache.h — the HET-paper LFU variant;
+// recommendation workloads follow a power law, so evict-least-frequent
+// keeps the hot head resident better than recency alone).  C ABI for
+// ctypes, drop-in alongside the LRU core (lru_cache.cpp).
+//
+// O(1) LFU: frequency buckets hold per-frequency recency lists; eviction
+// pops the least-recent entry of the minimum-frequency bucket (LRU
+// tie-break inside a bucket, the standard constant-time scheme).
+//
+// Build: make -C csrc
+
+#include <cstdint>
+#include <list>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct LfuCache {
+  struct Entry {
+    int64_t slot;
+    int64_t freq;
+    std::list<int64_t>::iterator pos;  // position in freq bucket
+  };
+  int64_t capacity;
+  std::unordered_map<int64_t, Entry> map;
+  std::unordered_map<int64_t, std::list<int64_t>> buckets;  // freq -> keys
+  int64_t min_freq = 0;
+  std::vector<int64_t> free_slots;
+  int64_t hits = 0, misses = 0, evictions = 0;
+
+  explicit LfuCache(int64_t cap) : capacity(cap) {
+    free_slots.reserve(cap);
+    for (int64_t i = cap - 1; i >= 0; --i) free_slots.push_back(i);
+    map.reserve(cap * 2);
+  }
+
+  void bump(Entry& e, int64_t key) {
+    auto& from = buckets[e.freq];
+    from.erase(e.pos);
+    if (from.empty()) {
+      buckets.erase(e.freq);
+      if (min_freq == e.freq) min_freq = e.freq + 1;
+    }
+    e.freq += 1;
+    auto& to = buckets[e.freq];
+    to.push_front(key);
+    e.pos = to.begin();
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* lfu_create(int64_t capacity) { return new LfuCache(capacity); }
+
+void lfu_destroy(void* h) { delete static_cast<LfuCache*>(h); }
+
+// Same contract as lru_lookup: per key emit slot/hit/evicted-id(-1).
+void lfu_lookup(void* h, const int64_t* keys, int64_t n, int64_t* out_slots,
+                int8_t* out_hit, int64_t* out_evicted) {
+  auto* c = static_cast<LfuCache*>(h);
+  for (int64_t i = 0; i < n; ++i) {
+    int64_t key = keys[i];
+    out_evicted[i] = -1;
+    auto it = c->map.find(key);
+    if (it != c->map.end()) {
+      c->bump(it->second, key);
+      out_slots[i] = it->second.slot;
+      out_hit[i] = 1;
+      ++c->hits;
+      continue;
+    }
+    ++c->misses;
+    out_hit[i] = 0;
+    int64_t slot;
+    if (!c->free_slots.empty()) {
+      slot = c->free_slots.back();
+      c->free_slots.pop_back();
+    } else {
+      auto& bucket = c->buckets[c->min_freq];
+      int64_t victim = bucket.back();  // least recent at min frequency
+      bucket.pop_back();
+      if (bucket.empty()) c->buckets.erase(c->min_freq);
+      auto vit = c->map.find(victim);
+      slot = vit->second.slot;
+      c->map.erase(vit);
+      out_evicted[i] = victim;
+      ++c->evictions;
+    }
+    auto& b1 = c->buckets[1];
+    b1.push_front(key);
+    c->map[key] = {slot, 1, b1.begin()};
+    c->min_freq = 1;
+    out_slots[i] = slot;
+  }
+}
+
+void lfu_stats(void* h, int64_t* out) {  // [hits, misses, evictions, size]
+  auto* c = static_cast<LfuCache*>(h);
+  out[0] = c->hits;
+  out[1] = c->misses;
+  out[2] = c->evictions;
+  out[3] = static_cast<int64_t>(c->map.size());
+}
+
+}  // extern "C"

@@ -1,4 +1,4 @@
-"""Client-side embedding cache (C++ LRU core).
+"""Client-side embedding cache (C++ LRU/LFU cores).
 
 Rebuild of the reference's HET-paper embedding caches (reference:
 hetu/v1/src/hetu_cache — LRU/LFU caches serving hot embedding rows locally,
@@ -7,9 +7,11 @@ ParameterServerCommunicate.py).
 
 TPU-era shape: big embedding tables live OFF-chip (host store / the
 coordination KV, reference kv_store), the worker keeps a host cache of hot
-rows (C++ LRU, csrc/lru_cache.cpp) and device-puts only the rows a batch
-touches.  fetch_fn supplies missing rows (e.g. from hetu_tpu.rpc's KV store
-or a memory-mapped table file).
+rows (C++ cores: csrc/lru_cache.cpp recency eviction, csrc/lfu_cache.cpp
+frequency eviction with LRU tie-break — pick per workload skew via
+policy=) and device-puts only the rows a batch touches.  fetch_fn supplies
+missing rows (e.g. from hetu_tpu.rpc's KV store or a memory-mapped table
+file).
 """
 from __future__ import annotations
 
@@ -20,41 +22,54 @@ import numpy as np
 
 from hetu_tpu.utils.native import load_native_lib
 
-_LIB = None
+_LIBS = {}
 
 
-def _lib():
-    global _LIB
-    if _LIB is not None:
-        return _LIB
-    lib = load_native_lib("liblru_cache.so", "liblru_cache.so")
-    lib.lru_create.restype = ctypes.c_void_p
-    lib.lru_create.argtypes = [ctypes.c_int64]
-    lib.lru_destroy.argtypes = [ctypes.c_void_p]
-    lib.lru_lookup.argtypes = [
-        ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64), ctypes.c_int64,
-        ctypes.POINTER(ctypes.c_int64), ctypes.POINTER(ctypes.c_int8),
-        ctypes.POINTER(ctypes.c_int64)]
-    lib.lru_stats.argtypes = [ctypes.c_void_p,
-                              ctypes.POINTER(ctypes.c_int64)]
-    _LIB = lib
+def _lib(policy: str = "lru"):
+    if policy in _LIBS:
+        return _LIBS[policy]
+    name = f"lib{policy}_cache.so"
+    lib = load_native_lib(name, name)
+    for fn, res, args in (
+            (f"{policy}_create", ctypes.c_void_p, [ctypes.c_int64]),
+            (f"{policy}_destroy", None, [ctypes.c_void_p]),
+            (f"{policy}_lookup", None, [
+                ctypes.c_void_p, ctypes.POINTER(ctypes.c_int64),
+                ctypes.c_int64, ctypes.POINTER(ctypes.c_int64),
+                ctypes.POINTER(ctypes.c_int8),
+                ctypes.POINTER(ctypes.c_int64)]),
+            (f"{policy}_stats", None, [ctypes.c_void_p,
+                                       ctypes.POINTER(ctypes.c_int64)])):
+        f = getattr(lib, fn)
+        f.restype = res
+        f.argtypes = args
+    _LIBS[policy] = lib
     return lib
 
 
 class EmbeddingCache:
-    """Host LRU cache of embedding rows backed by the C++ core."""
+    """Host cache of embedding rows backed by a C++ core (LRU or LFU)."""
 
     def __init__(self, capacity: int, dim: int,
                  fetch_fn: Callable[[np.ndarray], np.ndarray],
                  flush_fn: Optional[Callable[[np.ndarray, np.ndarray], None]] = None,
-                 dtype=np.float32):
+                 dtype=np.float32, policy: str = "lru"):
         """flush_fn(ids, rows): called with DIRTY rows (updated via
         write_back) when they are evicted, so updates reach the backing
-        store before the slot is reused (reference: PS push on eviction)."""
+        store before the slot is reused (reference: PS push on eviction).
+        policy: "lru" (recency) | "lfu" (frequency, LRU tie-break — the
+        HET lfu_cache.h variant for power-law id streams)."""
         if capacity < 1:
             raise ValueError(f"capacity must be >= 1, got {capacity}")
-        self._lib = _lib()
-        self._h = self._lib.lru_create(capacity)
+        if policy not in ("lru", "lfu"):
+            raise ValueError(f"policy must be lru|lfu, got {policy!r}")
+        self.policy = policy
+        self._lib = _lib(policy)
+        self._create = getattr(self._lib, f"{policy}_create")
+        self._destroy = getattr(self._lib, f"{policy}_destroy")
+        self._lookup = getattr(self._lib, f"{policy}_lookup")
+        self._stats = getattr(self._lib, f"{policy}_stats")
+        self._h = self._create(capacity)
         self.capacity = capacity
         self.dim = dim
         self.fetch_fn = fetch_fn
@@ -66,7 +81,7 @@ class EmbeddingCache:
 
     def __del__(self):
         try:
-            self._lib.lru_destroy(self._h)
+            self._destroy(self._h)
         except Exception:
             pass
 
@@ -75,7 +90,7 @@ class EmbeddingCache:
         slots = np.zeros(n, np.int64)
         hit = np.zeros(n, np.int8)
         evicted = np.zeros(n, np.int64)
-        self._lib.lru_lookup(
+        self._lookup(
             self._h, ids.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)), n,
             slots.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
             hit.ctypes.data_as(ctypes.POINTER(ctypes.c_int8)),
@@ -141,7 +156,7 @@ class EmbeddingCache:
 
     def stats(self) -> dict:
         out = np.zeros(4, np.int64)
-        self._lib.lru_stats(self._h,
+        self._stats(self._h,
                             out.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)))
         return {"hits": int(out[0]), "misses": int(out[1]),
                 "evictions": int(out[2]), "resident": int(out[3])}
@@ -149,10 +164,11 @@ class EmbeddingCache:
 
 def ps_backed_cache(client, name: str, rows: int, dim: int, capacity: int,
                     init: str = "normal", scale: float = 0.02,
-                    seed: int = 0, dtype=np.float32) -> "EmbeddingCache":
+                    seed: int = 0, dtype=np.float32,
+                    policy: str = "lru") -> "EmbeddingCache":
     """EmbeddingCache backed by the coordination server's PS tables — the
     full HET shape: server-resident table (reference: v1 ps-lite server),
-    client LRU of hot rows, write-back on eviction (reference:
+    client LRU/LFU of hot rows, write-back on eviction (reference:
     hetu/v1/src/hetu_cache).  `client` is a rpc.CoordinationClient."""
     r = client.ps_init(name, rows, dim, init=init, scale=scale, seed=seed)
     if r["dim"] != dim or r["rows"] != rows:
@@ -164,4 +180,4 @@ def ps_backed_cache(client, name: str, rows: int, dim: int, capacity: int,
         fetch_fn=lambda ids: client.ps_pull(name, ids),
         flush_fn=lambda ids, vals: client.ps_push(name, ids, vals,
                                                   mode="assign"),
-        dtype=dtype)
+        dtype=dtype, policy=policy)

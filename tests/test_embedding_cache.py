@@ -119,3 +119,50 @@ def test_write_back_does_not_prefetch():
     assert calls == []
     np.testing.assert_allclose(cache.lookup(np.array([42]))[0], 1.0)
     assert calls == []  # still resident, no fetch
+
+
+def test_lfu_policy_keeps_hot_rows():
+    """LFU (csrc/lfu_cache.cpp, the HET lfu_cache.h variant): frequent ids
+    survive a scan of cold ids that would evict them under LRU."""
+    table = np.arange(64, dtype=np.float32).reshape(16, 4)
+    fetches = []
+
+    def make(policy):
+        fetches.clear()
+
+        def fetch(ids):
+            fetches.extend(ids.tolist())
+            return table[ids]
+
+        from hetu_tpu.data.embedding_cache import EmbeddingCache
+        return EmbeddingCache(4, 4, fetch, policy=policy)
+
+    for policy, hot_refetched in (("lfu", False), ("lru", True)):
+        c = make(policy)
+        hot = np.array([0, 1], np.int64)
+        for _ in range(5):
+            c.lookup(hot)                       # freq(0,1) >> anything else
+        for cold in ([2, 3], [4, 5], [6, 7]):   # one-shot scans
+            c.lookup(np.array(cold, np.int64))
+        fetches.clear()
+        c.lookup(hot)
+        np.testing.assert_array_equal(c.lookup(hot), table[hot])
+        assert (len(fetches) > 0) == hot_refetched, (policy, fetches)
+
+
+def test_lfu_stats_and_tie_break():
+    from hetu_tpu.data.embedding_cache import EmbeddingCache
+    table = np.arange(40, dtype=np.float32).reshape(10, 4)
+    c = EmbeddingCache(2, 4, lambda ids: table[ids], policy="lfu")
+    c.lookup(np.array([0, 1], np.int64))     # both freq 1
+    c.lookup(np.array([0], np.int64))        # 0 -> freq 2
+    c.lookup(np.array([2], np.int64))        # evicts 1 (min freq, LRU tail)
+    st = c.stats()
+    assert st["evictions"] == 1
+    fetches = []
+    orig = c.fetch_fn
+    c.fetch_fn = lambda ids: (fetches.extend(ids.tolist()), orig(ids))[1]
+    c.lookup(np.array([0], np.int64))        # still resident
+    assert fetches == []
+    c.lookup(np.array([1], np.int64))        # was evicted -> refetched
+    assert fetches == [1]

@@ -17,13 +17,16 @@ import time
 
 def main():
     ap = argparse.ArgumentParser()
-    ap.add_argument("--mode", choices=["compute", "stall"], default="compute")
+    ap.add_argument("--mode", choices=["compute", "stall", "transfer"],
+                    default="compute")
     ap.add_argument("--duty", type=float, default=0.5,
                     help="fraction of each second spent burning")
     ap.add_argument("--seconds", type=float, default=60.0)
     ap.add_argument("--size", type=int, default=4096)
     args = ap.parse_args()
 
+    from hetu_tpu.utils.device import force_cpu_if_requested
+    force_cpu_if_requested()   # honor JAX_PLATFORMS=cpu despite the plugin
     import jax
     import jax.numpy as jnp
 
@@ -35,11 +38,22 @@ def main():
             x = (x @ x) * (1.0 / args.size)
         return jnp.sum(x.astype(jnp.float32))
 
+    import numpy as np
+    host_buf = (np.ones((args.size, args.size), np.float32)
+                if args.mode == "transfer" else None)
+
     t_end = time.time() + args.seconds
     print(f"straggler[{args.mode}] duty={args.duty} for {args.seconds}s")
     while time.time() < t_end:
         t0 = time.time()
-        if args.mode == "compute":
+        if args.mode == "transfer":
+            # heavy_communicate analog: saturate the host<->device link
+            # (the single-chip stand-in for contended ICI/NCCL bandwidth)
+            while time.time() - t0 < args.duty:
+                d = jax.device_put(host_buf)
+                np.asarray(d[:1, :1])   # round trip forces the copy back
+            time.sleep(max(0.0, 1.0 - args.duty))
+        elif args.mode == "compute":
             # occupy the device for `duty` of each second
             while time.time() - t0 < args.duty:
                 float(burn(x))

@@ -119,3 +119,33 @@ def test_profile_switch_byte_accounting():
     assert prof4.total_bytes == payload * n_dev
     assert prof4.moved_bytes == (payload - payload // n_dev) * n_dev
     assert prof4.total_bytes == prof4.moved_bytes + prof4.local_bytes
+
+
+@pytest.mark.slow
+def test_hot_switch_multibucket_plan_pools():
+    """The full (strategy, shape-plan) pool (define_and_run_graph.cc:1174):
+    each strategy's step is a PlanPool, each bucket length one plan inside
+    it; switching strategies and bucket lengths never recompiles a seen
+    (strategy, shape) pair."""
+    cfg = LlamaConfig.tiny(remat=False)
+    strategies = [
+        ParallelStrategy(mesh=MeshConfig(dp=4, tp=2), sequence_parallel=True),
+        ParallelStrategy(mesh=MeshConfig(dp=8)),
+    ]
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=1, seq_len=64,
+                        lr=1e-3, warmup_steps=2, total_steps=50,
+                        log_every=100)
+    tr = HotSwitchTrainer(lambda st: LlamaLMHeadModel(cfg, st), tc,
+                          strategies)
+    tr.build()
+    b64, b32 = _batch(seq=64), _batch(seq=32)
+    for _ in range(2):                        # repeat: everything cached
+        for sid in (0, 1):
+            tr.train_step(b64, strategy_id=sid)
+            tr.train_step(b32, strategy_id=sid)
+    pools = tr._steps
+    assert set(pools) == {0, 1}
+    for sid, pool in pools.items():
+        assert pool.num_plans == 2, (sid, pool.num_plans)
+    m = tr.train_step(b32, strategy_id=0)
+    assert np.isfinite(float(m["loss"]))

@@ -4,3 +4,7 @@ from hetu_tpu.data.bucket import (Bucket, pad_batch, pack_sequences,
 from hetu_tpu.data.dataset import JsonDataset, TokenizedDataset
 from hetu_tpu.data.dataloader import DataLoader, build_data_loader
 from hetu_tpu.data.data_collator import DataCollatorForLanguageModel
+from hetu_tpu.data.messages import (AlpacaTemplate, ChatFormat,
+                                    InputOutputTemplate, OpenAITemplate,
+                                    ShareGPTTemplate, build_sft_example,
+                                    render_messages)

@@ -1,0 +1,99 @@
+"""SFT message templates + chat rendering (reference: python/hetu/data/
+messages/ — sample->messages templates and span-tracked loss masking)."""
+import numpy as np
+
+from hetu_tpu.data.messages import (AlpacaTemplate, ChatFormat,
+                                    InputOutputTemplate, OpenAITemplate,
+                                    ShareGPTTemplate, build_sft_example,
+                                    render_messages)
+
+
+def _char_encode(s):
+    return [ord(c) % 256 for c in s]
+
+
+def test_input_output_template():
+    t = InputOutputTemplate(new_system_prompt="be brief")
+    msgs = t({"input": "2+2?", "output": "4"})
+    assert [m["role"] for m in msgs] == ["system", "user", "assistant"]
+    assert [m["masked"] for m in msgs] == [True, True, False]
+    # train_on_input unmasks the user turn
+    msgs2 = InputOutputTemplate(train_on_input=True)({"input": "a",
+                                                      "output": "b"})
+    assert msgs2[0]["masked"] is False
+    # partial column_map remaps only the named column
+    msgs3 = InputOutputTemplate(column_map={"input": "q"})(
+        {"q": "x", "output": "y"})
+    assert msgs3[0]["content"] == "x" and msgs3[1]["content"] == "y"
+
+
+def test_alpaca_template_both_prompts():
+    t = AlpacaTemplate()
+    with_inp = t({"instruction": "add", "input": "2 2", "output": "4"})
+    no_inp = t({"instruction": "say hi", "output": "hi"})
+    assert "### Input:" in with_inp[0]["content"]
+    assert "### Input:" not in no_inp[0]["content"]
+    assert with_inp[1] == {"role": "assistant", "content": "4",
+                           "masked": False}
+
+
+def test_sharegpt_and_openai_templates():
+    sg = ShareGPTTemplate()({"conversations": [
+        {"from": "system", "value": "s"},
+        {"from": "human", "value": "q"},
+        {"from": "gpt", "value": "a"}]})
+    assert [m["role"] for m in sg] == ["system", "user", "assistant"]
+    assert [m["masked"] for m in sg] == [True, True, False]
+    oa = OpenAITemplate()({"messages": [
+        {"role": "user", "content": "q"},
+        {"role": "assistant", "content": "a"}]})
+    assert [m["masked"] for m in oa] == [True, False]
+
+
+def test_render_messages_exact_mask():
+    msgs = [{"role": "user", "content": "ab", "masked": True},
+            {"role": "assistant", "content": "cd", "masked": False}]
+    fmt = ChatFormat(role_prefix={}, role_suffix={})   # raw content
+    ids, labels = render_messages(msgs, _char_encode, chat_format=fmt,
+                                  bos_id=1, eos_id=2)
+    assert ids.tolist() == [1, ord("a"), ord("b"), ord("c"), ord("d"), 2]
+    # masked span (bos + user) -> -100; assistant span + eos are targets
+    assert labels.tolist() == [-100, -100, -100, ord("c"), ord("d"), 2]
+    # truncation respects max_len
+    ids2, labels2 = render_messages(msgs, _char_encode, chat_format=fmt,
+                                    bos_id=1, eos_id=2, max_len=3)
+    assert len(ids2) == len(labels2) == 3
+
+
+def test_build_sft_example_with_real_tokenizer():
+    """End-to-end with the in-tree sentencepiece tokenizer (runtime-free
+    loader) — the actual SFT path a user runs."""
+    from hetu_tpu.data.tokenizers.sp_model import (SentencePieceTokenizer,
+                                                   write_model_proto)
+    pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3)]
+    pieces += [(f"<0x{b:02X}>", 0.0, 6) for b in range(256)]
+    pieces += [("▁", -2.0, 1), ("▁hi", -3.0, 1), ("▁there", -3.5, 1)]
+    tok = SentencePieceTokenizer(model_bytes=write_model_proto(
+        pieces, 1, byte_fallback=True))
+    ids, labels = build_sft_example(
+        {"input": "hi", "output": "there"}, InputOutputTemplate(),
+        tok.encode, chat_format=ChatFormat(role_prefix={}, role_suffix={}),
+        bos_id=tok.bos_id, eos_id=tok.eos_id)
+    assert ids[0] == tok.bos_id and ids[-1] == tok.eos_id
+    # only the assistant span + eos train
+    trained = labels[labels != -100]
+    assert tok.decode([t for t in trained]) == "there"
+    assert trained[-1] == tok.eos_id
+
+
+def test_render_multiturn_eos_per_assistant_turn():
+    msgs = [{"role": "user", "content": "q", "masked": True},
+            {"role": "assistant", "content": "a", "masked": False},
+            {"role": "user", "content": "r", "masked": True},
+            {"role": "assistant", "content": "b", "masked": False}]
+    fmt = ChatFormat(role_prefix={}, role_suffix={})
+    ids, labels = render_messages(msgs, _char_encode, chat_format=fmt,
+                                  eos_id=2)
+    # every assistant turn terminates with a TRAINED eos
+    assert ids.tolist() == [ord("q"), ord("a"), 2, ord("r"), ord("b"), 2]
+    assert labels.tolist() == [-100, ord("a"), 2, -100, ord("b"), 2]

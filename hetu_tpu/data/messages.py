@@ -96,11 +96,12 @@ class ShareGPTTemplate:
     def __init__(self, train_on_input: bool = False,
                  column_map: Optional[Dict[str, str]] = None):
         self.train_on_input = train_on_input
-        self.column_map = column_map or {"conversations": "conversations"}
+        self.column_map = column_map or {}
 
     def __call__(self, sample: Mapping[str, Any]) -> List[Message]:
+        key = self.column_map.get("conversations", "conversations")
         out = []
-        for turn in sample[self.column_map["conversations"]]:
+        for turn in sample[key]:
             role = self.ROLE_MAP.get(turn["from"], turn["from"])
             masked = (role != "assistant") and not self.train_on_input
             out.append(_msg(role, turn["value"], masked))
@@ -113,13 +114,14 @@ class OpenAITemplate:
     def __init__(self, train_on_input: bool = False,
                  column_map: Optional[Dict[str, str]] = None):
         self.train_on_input = train_on_input
-        self.column_map = column_map or {"messages": "messages"}
+        self.column_map = column_map or {}
 
     def __call__(self, sample: Mapping[str, Any]) -> List[Message]:
+        key = self.column_map.get("messages", "messages")
         return [
             _msg(m["role"], m["content"],
                  (m["role"] != "assistant") and not self.train_on_input)
-            for m in sample[self.column_map["messages"]]]
+            for m in sample[key]]
 
 
 @dataclasses.dataclass

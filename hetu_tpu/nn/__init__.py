@@ -2,7 +2,8 @@ from hetu_tpu.nn.module import Module, ModuleList, ModuleDict, Sequential, Param
 from hetu_tpu.nn import initializers
 from hetu_tpu.nn.layers import (
     Linear, Embedding, RMSNorm, LayerNorm, Dropout, Conv2d, MaxPool2d,
-    AvgPool2d, GELU, ReLU, SiLU,
+    AvgPool2d, GELU, ReLU, SiLU, BatchNorm, InstanceNorm, ConstantPad2d,
+    ZeroPad2d,
 )
 from hetu_tpu.nn.parallel import (
     ColumnParallelLinear, RowParallelLinear, VocabParallelEmbedding,

@@ -164,3 +164,100 @@ class ReLU(Module):
 class SiLU(Module):
     def forward(self, params, x):
         return ops.silu(x)
+
+
+class BatchNorm(Module):
+    """NHWC batch normalization with explicit running-stats state
+    (reference: nn/modules/batchnorm.py BatchNorm over CUDA kernels).
+
+    Functional-state design: running stats are DATA, not module state —
+    `init_state()` builds them, forward(training=True) returns
+    (y, new_state) so the caller threads them (jit-friendly; the
+    reference mutates saved_running_{mean,var} tensors in place)."""
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 momentum: float = 0.1, param_dtype=jnp.float32):
+        super().__init__()
+        self.num_features, self.eps, self.momentum = num_features, eps, momentum
+        self.param("weight", (num_features,), init.ones, dtype=param_dtype)
+        self.param("bias", (num_features,), init.zeros, dtype=param_dtype)
+
+    def init_state(self):
+        return {"mean": jnp.zeros((self.num_features,), jnp.float32),
+                "var": jnp.ones((self.num_features,), jnp.float32)}
+
+    def forward(self, params, x, state, *, training: bool = False):
+        axes = tuple(range(x.ndim - 1))          # all but channels
+        xf = x.astype(jnp.float32)
+        if training:
+            mean = jnp.mean(xf, axis=axes)
+            var = jnp.var(xf, axis=axes)
+            # running stats accumulate the UNBIASED variance (torch-style
+            # reference semantics: checkpoints interop at eval time);
+            # normalization itself uses the biased batch variance
+            n = x.size // x.shape[-1]
+            unbiased = var * (n / max(n - 1, 1))
+            m = self.momentum
+            state = {"mean": (1 - m) * state["mean"] + m * mean,
+                     "var": (1 - m) * state["var"] + m * unbiased}
+        else:
+            mean, var = state["mean"], state["var"]
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        y = y * params["weight"].astype(jnp.float32) \
+            + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype), state
+
+
+class InstanceNorm(Module):
+    """NHWC instance norm: per-(sample, channel) spatial statistics
+    (reference: nn/modules/instancenorm.py)."""
+
+    def __init__(self, num_features: int, eps: float = 1e-5,
+                 affine: bool = True, param_dtype=jnp.float32):
+        super().__init__()
+        self.eps, self.affine = eps, affine
+        if affine:
+            self.param("weight", (num_features,), init.ones,
+                       dtype=param_dtype)
+            self.param("bias", (num_features,), init.zeros,
+                       dtype=param_dtype)
+
+    def forward(self, params, x):
+        axes = tuple(range(1, x.ndim - 1))       # spatial dims
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=axes, keepdims=True)
+        var = jnp.var(xf, axis=axes, keepdims=True)
+        y = (xf - mean) * jax.lax.rsqrt(var + self.eps)
+        if self.affine:
+            y = y * params["weight"].astype(jnp.float32) \
+                + params["bias"].astype(jnp.float32)
+        return y.astype(x.dtype)
+
+
+class ConstantPad2d(Module):
+    """Pad the spatial dims of NHWC input (reference:
+    nn/modules/padding.py ConstantPad2d; ZeroPad2d = value 0)."""
+
+    def __init__(self, padding, value: float = 0.0):
+        super().__init__()
+        if isinstance(padding, int):
+            padding = (padding, padding, padding, padding)
+        self.padding = tuple(padding)   # (left, right, top, bottom)
+        self.value = value
+
+    def forward(self, params, x):
+        l, r, t, b = self.padding
+        # negative entries CROP (reference ConstantPad2d semantics)
+        def crop(v, lo, hi, axis):
+            sl = [slice(None)] * v.ndim
+            sl[axis] = slice(max(-lo, 0), v.shape[axis] - max(-hi, 0))
+            return v[tuple(sl)]
+        x = crop(crop(x, t, b, 1), l, r, 2)
+        pads = ((0, 0), (max(t, 0), max(b, 0)), (max(l, 0), max(r, 0)),
+                (0, 0))
+        return jnp.pad(x, pads, constant_values=self.value)
+
+
+class ZeroPad2d(ConstantPad2d):
+    def __init__(self, padding):
+        super().__init__(padding, 0.0)

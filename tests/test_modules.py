@@ -113,3 +113,57 @@ def test_cifar_style_cnn_smoke():
     last = [l for l in out.strip().splitlines() if l.startswith("step")][-1]
     acc = float(last.split("acc")[1])
     assert acc > 0.85, out
+
+
+def test_batchnorm_functional_state():
+    """BatchNorm with explicit running stats (reference:
+    nn/modules/batchnorm.py; functional state threads through jit)."""
+    import jax
+    from hetu_tpu.nn import BatchNorm
+    bn = BatchNorm(4, momentum=0.5)
+    params = bn.init(jax.random.key(0))
+    state = bn.init_state()
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(3.0, 2.0, (8, 5, 5, 4)), jnp.float32)
+    y, state2 = jax.jit(lambda p, x, s: bn(p, x, s, training=True))(
+        params, x, state)
+    # normalized over (N, H, W): per-channel ~N(0,1)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=(0, 1, 2))),
+                               np.zeros(4), atol=1e-4)
+    np.testing.assert_allclose(np.asarray(jnp.var(y, axis=(0, 1, 2))),
+                               np.ones(4), atol=1e-3)
+    # running stats moved toward the batch stats
+    assert float(jnp.max(jnp.abs(state2["mean"]))) > 1.0
+    # eval mode uses the running stats and returns them unchanged
+    y2, state3 = bn(params, x, state2, training=False)
+    assert jax.tree.all(jax.tree.map(
+        lambda a, b: bool(jnp.array_equal(a, b)), state2, state3))
+
+
+def test_instance_norm_and_padding():
+    import jax
+    from hetu_tpu.nn import ConstantPad2d, InstanceNorm, ZeroPad2d
+    inorm = InstanceNorm(3)
+    params = inorm.init(jax.random.key(1))
+    x = jnp.asarray(np.random.default_rng(1).normal(2, 3, (2, 6, 6, 3)),
+                    jnp.float32)
+    y = inorm(params, x)
+    # per-sample, per-channel spatial stats ~N(0,1)
+    np.testing.assert_allclose(np.asarray(jnp.mean(y, axis=(1, 2))),
+                               np.zeros((2, 3)), atol=1e-4)
+    pad = ZeroPad2d(1)
+    assert pad({}, x).shape == (2, 8, 8, 3)
+    cp = ConstantPad2d((1, 2, 0, 3), value=7.0)
+    out = cp({}, x)
+    assert out.shape == (2, 9, 9, 3)
+    assert float(out[0, -1, 0, 0]) == 7.0
+
+
+def test_constant_pad_negative_crops():
+    from hetu_tpu.nn import ConstantPad2d
+    x = jnp.arange(2 * 4 * 4 * 1, dtype=jnp.float32).reshape(2, 4, 4, 1)
+    out = ConstantPad2d((-1, 1, -2, 0), value=5.0)({}, x)
+    assert out.shape == (2, 2, 4, 1)       # H: 4-2; W: 4-1+1
+    assert float(out[0, 0, -1, 0]) == 5.0  # right pad value
+    np.testing.assert_array_equal(np.asarray(out[0, :, :-1, 0]),
+                                  np.asarray(x[0, 2:, 1:, 0]))

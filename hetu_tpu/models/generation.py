@@ -138,9 +138,11 @@ def decode_step(model, params, token, cache, pos):
 
 def generate(model, params, input_ids, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
+             top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
              eos_id: Optional[int] = None):
-    """Autoregressive generation (greedy when temperature == 0).
+    """Autoregressive generation (greedy when temperature == 0; top_k
+    and/or top_p (nucleus) filtering when sampling).
     input_ids: [b, plen] int32 -> [b, plen + max_new_tokens]."""
     b, plen = input_ids.shape
     max_len = plen + max_new_tokens
@@ -157,6 +159,18 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
         if top_k is not None:
             kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
+        if top_p is not None and top_p > 0.0:
+            # nucleus: keep the smallest prefix of the sorted distribution
+            # whose mass exceeds top_p; the max-prob token always survives
+            # (its preceding mass is 0 < top_p), so small top_p degenerates
+            # to greedy.  top_p in (None, 0.0) = filter disabled.
+            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
+            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            cum = jnp.cumsum(probs, axis=-1)
+            keep = cum - probs < top_p          # mass BEFORE this token
+            cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+                             axis=-1, keepdims=True)
+            logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)
 
     def step(carry, i):

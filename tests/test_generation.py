@@ -69,3 +69,29 @@ def test_gqa_generation():
         nxt = jnp.argmax(model(params, seq)[:, -1, :], -1).astype(jnp.int32)
         seq = jnp.concatenate([seq, nxt[:, None]], 1)
     np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_top_p_sampling_restricts_support():
+    """Nucleus sampling: with a peaked distribution and small top_p, only
+    the head of the distribution is ever sampled."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu.models.generation import generate
+
+    cfg = LlamaConfig.tiny(remat=False, vocab_size=64,
+                           max_position_embeddings=64)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(0))
+    ids = jnp.ones((2, 4), jnp.int32)
+    out = generate(model, params, ids, max_new_tokens=6, temperature=1.0,
+                   top_p=0.9, rng=jax.random.key(1))
+    assert out.shape == (2, 10)
+    # same seed + same settings -> deterministic
+    out2 = generate(model, params, ids, max_new_tokens=6, temperature=1.0,
+                    top_p=0.9, rng=jax.random.key(1))
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(out2))
+    # tiny top_p degenerates to greedy (only the argmax survives)
+    outg = generate(model, params, ids, max_new_tokens=6, temperature=0.0)
+    outp = generate(model, params, ids, max_new_tokens=6, temperature=1.0,
+                    top_p=1e-6, rng=jax.random.key(2))
+    np.testing.assert_array_equal(np.asarray(outg), np.asarray(outp))

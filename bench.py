@@ -16,6 +16,35 @@ import time
 import numpy as np
 
 
+def _last_verified():
+    """Newest BENCH_r*.json with a nonzero value (the driver-captured
+    records in the repo root).  The driver wraps the metric line in
+    {"cmd", "rc", "tail"}: the metric JSON is the last {"metric"...} line
+    of "tail"; raw metric records are accepted too."""
+    import glob
+    import os
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in sorted(glob.glob(os.path.join(here, "BENCH_r*.json")),
+                       reverse=True):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if "tail" in rec and "value" not in rec:
+                lines = [ln for ln in rec["tail"].splitlines()
+                         if ln.startswith('{"metric"')]
+                if not lines:
+                    continue
+                rec = json.loads(lines[-1])
+            if rec.get("value"):
+                return {"record": os.path.basename(path),
+                        "value": rec["value"],
+                        "vs_baseline": rec.get("vs_baseline"),
+                        "detail": rec.get("detail")}
+        except (OSError, ValueError):
+            continue
+    return None
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -50,11 +79,15 @@ def main():
             reason = (f"device init failed: {err!r}" if err is not None
                       else "tpu tunnel unresponsive (probe timed out); "
                            "last measured value in README.md Benchmarks")
+            detail = {"error": reason, "backend": "unreachable"}
+            lv = _last_verified()
+            if lv is not None:
+                # most recent driver-captured nonzero run, read from the
+                # BENCH_r*.json records so the number can't go stale
+                detail["last_verified"] = lv
             print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
                               "unit": "fraction_of_peak", "vs_baseline": 0.0,
-                              "detail": {"error": reason,
-                                         "backend": "unreachable"}}),
-                  flush=True)
+                              "detail": detail}), flush=True)
             return 0
 
     import hetu_tpu as ht

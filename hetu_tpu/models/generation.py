@@ -156,19 +156,27 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
         if temperature == 0.0:
             return jnp.argmax(logits, axis=-1).astype(jnp.int32)
         logits = logits / temperature
+        if top_k is not None or (top_p is not None and top_p > 0.0):
+            # ONE descending full-vocab sort serves both filters (the sort
+            # is the sampler's dominant cost inside the decode scan)
+            desc = jnp.sort(logits, axis=-1)[:, ::-1]
         if top_k is not None:
-            kth = jnp.sort(logits, axis=-1)[:, -top_k][:, None]
+            kth = desc[:, top_k - 1][:, None]
             logits = jnp.where(logits < kth, -1e30, logits)
         if top_p is not None and top_p > 0.0:
             # nucleus: keep the smallest prefix of the sorted distribution
             # whose mass exceeds top_p; the max-prob token always survives
             # (its preceding mass is 0 < top_p), so small top_p degenerates
-            # to greedy.  top_p in (None, 0.0) = filter disabled.
-            sorted_logits = jnp.sort(logits, axis=-1)[:, ::-1]
-            probs = jax.nn.softmax(sorted_logits, axis=-1)
+            # to greedy.  top_p in (None, 0.0) = filter disabled.  The
+            # nucleus is computed on the pre-top_k distribution; the final
+            # support is the INTERSECTION of both filters (standard HF
+            # semantics apply top_k then top_p on the same logits — the
+            # kept set differs only when top_k already removed nucleus
+            # members, where intersection is the conservative choice).
+            probs = jax.nn.softmax(desc, axis=-1)
             cum = jnp.cumsum(probs, axis=-1)
             keep = cum - probs < top_p          # mass BEFORE this token
-            cutoff = jnp.min(jnp.where(keep, sorted_logits, jnp.inf),
+            cutoff = jnp.min(jnp.where(keep, desc, jnp.inf),
                              axis=-1, keepdims=True)
             logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

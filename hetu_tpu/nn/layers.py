@@ -249,7 +249,7 @@ class ConstantPad2d(Module):
         l, r, t, b = self.padding
         # negative entries CROP (reference ConstantPad2d semantics)
         for lo, hi, axis in ((t, b, 1), (l, r, 2)):
-            if max(-lo, 0) + max(-hi, 0) >= x.shape[axis]:
+            if max(-lo, 0) + max(-hi, 0) > x.shape[axis]:
                 raise ValueError(
                     f"padding {self.padding} crops away the whole axis "
                     f"{axis} of input shape {x.shape}")

@@ -1,0 +1,97 @@
+"""Canaries for the two upstream XLA bugs this repo gates around.
+
+Each runs the MINIMAL crash repro in a SUBPROCESS (the failure mode is a
+CHECK-fail abort — rc 134 — which would kill pytest in-process) and
+asserts the crash still happens.  When a JAX/XLA upgrade fixes one, the
+canary FAILS on purpose with instructions to remove the workaround:
+
+* core.vma.pvary_missing's 16-bit->f32 widening on CPU
+  (AllReducePromotion CloneAllReduce CreateBinary(copy) check-fail)
+* pipeline_train_1f1b skip_dead_halves auto-gate to pp-only meshes
+  (SPMD partitioner ExpandDeviceGroupsWithIota check-fail on sharded
+  gathers inside partial-manual regions)
+"""
+import subprocess
+import sys
+
+import pytest
+
+_PSUM_REPRO = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+jax.config.update("jax_platforms", "cpu")
+mesh = jax.make_mesh((2, 2, 2), ("a", "b", "c"))
+f = jax.jit(jax.shard_map(lambda x: lax.psum(x * 2, "c"), mesh=mesh,
+                          in_specs=P("b", "c"), out_specs=P("b"),
+                          axis_names=frozenset({"b", "c"})))
+f(jnp.ones((8, 8), jnp.bfloat16))
+print("COMPILED-OK")
+"""
+
+_GATHER_REPRO = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax, jax.numpy as jnp
+jax.config.update("jax_platforms", "cpu")
+import hetu_tpu as ht
+import numpy as np
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel import ParallelStrategy
+
+# the ACTUAL gated construct: the cond-skipping shard_map round bodies
+# forced on with sharded dp/tp axes (tp-vocab embedding gather inside the
+# partial-manual region trips PartitionGather... / EvaluatePartitionCost)
+cfg = LlamaConfig.tiny(num_hidden_layers=2, remat=False,
+                       compute_dtype=jnp.float32)
+st = ParallelStrategy(mesh=MeshConfig(dp=2, tp=2, pp=2),
+                      sequence_parallel=True)
+ids = jnp.zeros((4, 32), jnp.int32)
+mesh = st.build_mesh()
+model = LlamaLMHeadModel(cfg, st)
+with ht.use_mesh(mesh):
+    params = model.init(jax.random.key(0), mesh=mesh)
+    jax.jit(lambda p: model.pipeline_train_grads(
+        p, ids, ids, n_micro=2, skip_dead_halves=True)
+    ).lower(params).compile()
+print("COMPILED-OK")
+"""
+
+
+def _run(src: str):
+    return subprocess.run([sys.executable, "-c", src],
+                          capture_output=True, text=True, timeout=420)
+
+
+def _assert_xla_check_fail(r):
+    """The signal must be the XLA abort, not an unrelated breakage (an API
+    rename would also be rc!=0 and would silently defeat the canary)."""
+    assert r.returncode in (-6, 134) or "Check failed" in r.stderr, (
+        f"repro failed for a DIFFERENT reason (rc={r.returncode}) — fix "
+        f"the repro:\n{r.stderr[-2000:]}")
+
+
+@pytest.mark.slow
+def test_canary_cpu_16bit_psum_partial_manual():
+    r = _run(_PSUM_REPRO)
+    if "COMPILED-OK" in r.stdout:
+        pytest.fail(
+            "XLA:CPU now compiles 16-bit psum from partial-manual regions "
+            "— remove the widening in hetu_tpu/core/vma.py pvary_missing "
+            "and hetu_tpu/parallel/hetero_pp.py _psum_wide")
+    _assert_xla_check_fail(r)
+
+
+@pytest.mark.slow
+def test_canary_sharded_gather_partial_manual():
+    r = _run(_GATHER_REPRO)
+    if "COMPILED-OK" in r.stdout:
+        pytest.fail(
+            "XLA's SPMD partitioner now handles sharded gathers inside "
+            "partial-manual regions — flip skip_dead_halves='auto' to "
+            "always-on in hetu_tpu/parallel/pipeline_1f1b.py and drop the "
+            "vmap fallback")
+    _assert_xla_check_fail(r)

@@ -167,16 +167,17 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
             # nucleus: keep the smallest prefix of the sorted distribution
             # whose mass exceeds top_p; the max-prob token always survives
             # (its preceding mass is 0 < top_p), so small top_p degenerates
-            # to greedy.  top_p in (None, 0.0) = filter disabled.  The
-            # nucleus is computed on the pre-top_k distribution; the final
-            # support is the INTERSECTION of both filters (standard HF
-            # semantics apply top_k then top_p on the same logits — the
-            # kept set differs only when top_k already removed nucleus
-            # members, where intersection is the conservative choice).
-            probs = jax.nn.softmax(desc, axis=-1)
+            # to greedy.  top_p in (None, 0.0) = filter disabled.  With
+            # top_k set, the nucleus is computed over the RENORMALIZED
+            # top-k distribution (HF semantics: top_k filters first); the
+            # filtered descending view is just the top-k prefix of `desc`,
+            # so no second sort is needed.
+            desc_f = desc if top_k is None else jnp.where(
+                jnp.arange(desc.shape[-1]) < top_k, desc, -1e30)
+            probs = jax.nn.softmax(desc_f, axis=-1)
             cum = jnp.cumsum(probs, axis=-1)
             keep = cum - probs < top_p          # mass BEFORE this token
-            cutoff = jnp.min(jnp.where(keep, desc, jnp.inf),
+            cutoff = jnp.min(jnp.where(keep, desc_f, jnp.inf),
                              axis=-1, keepdims=True)
             logits = jnp.where(logits < cutoff, -1e30, logits)
         return jax.random.categorical(key, logits, axis=-1).astype(jnp.int32)

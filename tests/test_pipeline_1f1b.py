@@ -270,3 +270,33 @@ def test_gpt_pipeline_dropout_smoke():
         l_drop = float(f(params, jax.random.key(0), False))
     assert np.isfinite(l_det) and np.isfinite(l_drop)
     assert abs(l_det - l_drop) > 1e-4   # masks actually applied
+
+
+def test_gpt_1f1b_hetero_stage_layers():
+    """GPT 1f1b with uneven (Malleus) stage layer counts — the padded
+    stage stacks + layer-mask path on the second model family."""
+    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+
+    cfg = GPTConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                         num_hidden_layers=3, pipeline_stage_layers=(2, 1))
+    st = ParallelStrategy(mesh=MeshConfig(pp=2))
+    ids = jnp.asarray(np.random.default_rng(9).integers(0, 256, (8, 32)),
+                      jnp.int32)
+    mesh = st.build_mesh()
+    model = GPTLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(9), mesh=mesh)
+        (glsum, _), ggrads = jax.jit(jax.value_and_grad(
+            lambda p: model(p, ids, labels=ids, n_micro=4,
+                            loss_reduction="sum"), has_aux=True))(params)
+        (lsum, _), grads = jax.jit(
+            lambda p: model.pipeline_train_grads(p, ids, ids,
+                                                 n_micro=4))(params)
+    assert abs(float(lsum) - float(glsum)) / abs(float(glsum)) < 1e-5
+    for (pa, a), (pb, b) in zip(sorted(jax.tree.leaves_with_path(ggrads),
+                                       key=lambda kv: str(kv[0])),
+                                sorted(jax.tree.leaves_with_path(grads),
+                                       key=lambda kv: str(kv[0]))):
+        rel = float(jnp.max(jnp.abs(a - b))) / (float(jnp.max(jnp.abs(a)))
+                                                + 1e-8)
+        assert rel < 2e-4, (pa, rel)

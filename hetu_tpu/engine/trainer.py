@@ -453,8 +453,16 @@ class Trainer:
                     deterministic=True, loss_reduction="sum",
                     include_aux_loss=False,
                     labels_shifted=self._labels_shifted)
-            with use_mesh(self.mesh):
-                self._eval_fn = jax.jit(eval_step)
+            from hetu_tpu.engine.plan_pool import PlanPool
+            from hetu_tpu.utils import flags
+            # eval over the bucket ladder gets the same plan-pool
+            # bookkeeping as training (one compile per shape, loud past
+            # the cap) instead of jit's silent retraces; compilation
+            # happens at call time inside the loop's mesh context
+            self._eval_fn = PlanPool(
+                eval_step,
+                max_plans=flags.int_flag("HETU_TPU_MAX_PLANS") or None,
+                name="eval_step", key_argnums=(1,))
         total, count = 0.0, 0.0
         for i, host_batch in enumerate(batches):
             if max_batches is not None and i >= max_batches:
@@ -471,7 +479,9 @@ class Trainer:
             host_batch = self._cp_reorder(host_batch)
             batch = {k: jax.device_put(v, sh) for k, v in host_batch.items()}
             with use_mesh(self.mesh), self._declared():
-                lsum, csum = self._eval_fn(self.params, batch)
+                lsum, csum = self._eval_fn(
+                    self.params, batch,
+                    strategy_id=self._plan_dispatch_key())
             total += float(lsum)
             count += float(csum)
         loss = total / max(count, 1.0)

@@ -242,3 +242,19 @@ def test_plan_pool_cap_errors_loudly(monkeypatch):
     with pytest.raises(RuntimeError, match="bucket ladder"):
         t.train_step({"input_ids": rng.integers(1, 250, size=(4, 32)).astype(np.int32),
                       "labels": rng.integers(1, 250, size=(4, 32)).astype(np.int32)})
+
+
+def test_evaluate_multibucket_plan_pool():
+    """evaluate() over two bucket lengths compiles exactly two eval plans
+    (the same no-silent-retrace contract train() has)."""
+    rng = np.random.default_rng(11)
+    t, _ = _make_trainer(dp=1, tp=1, gbs=4, mbs=4)
+    t.build()
+
+    def batch(seq):
+        ids = rng.integers(1, 250, size=(4, seq)).astype(np.int32)
+        return {"input_ids": ids, "labels": ids.copy()}
+
+    m = t.evaluate([batch(64), batch(32), batch(64), batch(32)])
+    assert np.isfinite(m["loss"]) and m["tokens"] > 0
+    assert t._eval_fn.num_plans == 2

@@ -111,6 +111,16 @@ class HotSwitchTrainer(Trainer):
                     old_step, dst.state_shardings["step"])
         else:
             self.opt_state = new_state
+        # eval pools are per strategy too: a plan compiled for the old
+        # mesh/model would otherwise be fetched for a same-shape batch
+        # (stash under the OLD id before active_id flips)
+        if not hasattr(self, "_evals"):
+            self._evals = {}
+        if hasattr(self, "_eval_fn"):
+            self._evals[self.active_id] = self._eval_fn
+            del self._eval_fn
+        if sid in self._evals:
+            self._eval_fn = self._evals[sid]
         self.active_id = sid
         self.model = dst.model
         self.strategy = dst.strategy

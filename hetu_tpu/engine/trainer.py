@@ -151,16 +151,22 @@ class Trainer:
         per batch, with the pool's retrace guard replacing jit's silent
         recompiles."""
         from hetu_tpu.engine.plan_pool import PlanPool
-        from hetu_tpu.utils import flags
         return PlanPool(
             self._train_step,
             jit_kwargs=dict(out_shardings=(pshard, sshard, None, None),
                             donate_argnums=(0, 1)),
-            max_plans=flags.int_flag("HETU_TPU_MAX_PLANS") or None,
+            max_plans=self._plan_cap(),
             name="train_step",
             # dispatch keys hash the BATCHES pytree only — params/opt_state
             # shapes never change within one pool
             key_argnums=(2,))
+
+    @staticmethod
+    def _plan_cap():
+        """HETU_TPU_MAX_PLANS resolution — one source of truth for the
+        train and eval pools."""
+        from hetu_tpu.utils import flags
+        return flags.int_flag("HETU_TPU_MAX_PLANS") or None
 
     def _plan_dispatch_key(self):
         """Traced-behavior inputs that are NOT visible in the batch shapes:
@@ -454,14 +460,15 @@ class Trainer:
                     include_aux_loss=False,
                     labels_shifted=self._labels_shifted)
             from hetu_tpu.engine.plan_pool import PlanPool
-            from hetu_tpu.utils import flags
             # eval over the bucket ladder gets the same plan-pool
             # bookkeeping as training (one compile per shape, loud past
             # the cap) instead of jit's silent retraces; compilation
-            # happens at call time inside the loop's mesh context
+            # happens at call time inside the loop's mesh context.
+            # (HotSwitchTrainer stashes/restores this per strategy —
+            # plans compiled for one mesh/model must not serve another.)
             self._eval_fn = PlanPool(
                 eval_step,
-                max_plans=flags.int_flag("HETU_TPU_MAX_PLANS") or None,
+                max_plans=self._plan_cap(),
                 name="eval_step", key_argnums=(1,))
         total, count = 0.0, 0.0
         for i, host_batch in enumerate(batches):

@@ -149,3 +149,33 @@ def test_hot_switch_multibucket_plan_pools():
         assert pool.num_plans == 2, (sid, pool.num_plans)
     m = tr.train_step(b32, strategy_id=0)
     assert np.isfinite(float(m["loss"]))
+
+
+@pytest.mark.slow
+def test_eval_pools_isolated_per_strategy():
+    """evaluate() after switch_to must not reuse a plan compiled for the
+    previous strategy's mesh/model (and switching back reuses the stash)."""
+    cfg = LlamaConfig.tiny(remat=False)
+    strategies = [
+        ParallelStrategy(mesh=MeshConfig(dp=4, tp=2), sequence_parallel=True),
+        ParallelStrategy(mesh=MeshConfig(dp=8)),
+    ]
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=1, seq_len=64,
+                        lr=1e-3, warmup_steps=2, total_steps=50,
+                        log_every=100)
+    tr = HotSwitchTrainer(lambda st: LlamaLMHeadModel(cfg, st), tc,
+                          strategies)
+    tr.build()
+    batch = _batch()
+    m0 = tr.evaluate([batch])
+    pool0 = tr._eval_fn
+    tr.switch_to(1)
+    assert not hasattr(tr, "_eval_fn") or tr._eval_fn is not pool0
+    m1 = tr.evaluate([batch])          # compiles strategy-1's own pool
+    assert np.isfinite(m1["loss"])
+    np.testing.assert_allclose(m0["loss"], m1["loss"], rtol=1e-4)
+    tr.switch_to(0)
+    assert tr._eval_fn is pool0        # stash restored, no recompile
+    m2 = tr.evaluate([batch])
+    np.testing.assert_allclose(m2["loss"], m0["loss"], rtol=1e-4)
+    assert pool0.num_plans == 1

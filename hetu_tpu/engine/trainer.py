@@ -205,21 +205,20 @@ class Trainer:
             # pipeline mode: micro-batching happens INSIDE the model's
             # circular pipeline (reference CrucialRun micro loop); feed the
             # whole global batch at once
-            if not c.dropout_deterministic and c.pp_schedule == "1f1b":
-                raise NotImplementedError(
-                    "dropout inside the 1f1b schedule (the manual-VJP "
-                    "recompute would need replayed masks); use gpipe")
             flat = {k: v.reshape((-1,) + v.shape[2:]) for k, v in batches.items()}
 
             if c.pp_schedule == "1f1b":
                 # PipeDream-flush manual-VJP schedule (reference:
-                # executable_graph.cc:836) — grads come back directly
+                # executable_graph.cc:836) — grads come back directly;
+                # dropout masks replay exactly in the backward visit (the
+                # rng rides the saved token stream)
                 (lsum, csum), grads = self.model.pipeline_train_grads(
                     params, flat["input_ids"], flat["labels"],
                     position_ids=flat.get("position_ids"),
                     segment_ids=flat.get("segment_ids"), n_micro=n_micro,
                     labels_shifted=self._labels_shifted,
-                    loss_scale=scale)
+                    loss_scale=scale,
+                    rng=None if c.dropout_deterministic else rng)
             else:
                 def pp_loss(p):
                     lsum_, csum_ = self.model(

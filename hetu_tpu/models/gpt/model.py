@@ -359,7 +359,8 @@ class GPTLMHeadModel(Module):
     def pipeline_train_grads(self, params, input_ids, labels, *,
                              position_ids=None, segment_ids=None,
                              n_micro: int, labels_shifted: bool = False,
-                             loss_scale=1.0, skip_dead_halves="auto"):
+                             loss_scale=1.0, skip_dead_halves="auto",
+                             rng=None):
         """1F1B (PipeDream-flush) training pass for the GPT family —
         ((loss_sum, count), grads); mirrors LlamaLMHeadModel
         .pipeline_train_grads (reference: executable_graph.cc:836).
@@ -391,21 +392,36 @@ class GPTLMHeadModel(Module):
         count = jnp.sum(((labels if labels_shifted else labels[:, 1:])
                          != -100).astype(jnp.float32))
 
-        def stage_scan(sp_slice, x0, pos, seg, mask_row):
+        use_drop = rng is not None and (c.hidden_dropout > 0.0
+                                        or c.attention_dropout > 0.0)
+
+        def stage_scan(sp_slice, x0, pos, seg, mask_row, drop_seed, offset):
             def body(carry, xs):
                 lp, mj = xs if mask_row is not None else (xs, None)
-                x_c = carry
+                x_c, gid = carry
+                layer_rng = None
+                if use_drop:
+                    # masks replay exactly in the backward visit: the seed
+                    # rides the saved token stream, the id is the stage
+                    # offset + local layer index (see llama counterpart)
+                    layer_rng = jax.random.fold_in(
+                        jax.random.key(drop_seed), gid)
                 out = self.model.block(lp, x_c, position_ids=pos,
-                                       segment_ids=seg)
+                                       segment_ids=seg, rng=layer_rng,
+                                       deterministic=not use_drop)
                 if mj is not None:
                     out = jnp.where(mj > 0, out, x_c)
-                return out, None
+                return (out, gid + 1), None
 
             fn = body
             if c.remat:
                 fn = jax.checkpoint(body, policy=remat_policy(c.remat_policy))
             xs = sp_slice if mask_row is None else (sp_slice, mask_row)
-            y, _ = lax.scan(fn, x0, xs)
+            from hetu_tpu.core.vma import cast_varying, vma_of
+            gid0 = (offset if offset is not None
+                    else cast_varying(jnp.zeros((), jnp.uint32),
+                                      tuple(vma_of(x0))))
+            (y, _), _ = lax.scan(fn, (x0, gid0), xs)
             return y
 
         def head_loss(ep_, y, lab):
@@ -431,8 +447,11 @@ class GPTLMHeadModel(Module):
                 + jnp.take(ep_["wpe"], pos_eff, axis=0)
             emb = st.constrain(emb.astype(c.compute_dtype), st.act_hidden())
             x0 = jnp.where(flg["is_first"] > 0, emb, x_in)
+            drop = feed_s.get("dropout_rng")
             y = stage_scan(sp_slice, x0, pos, feed_s.get("segment_ids"),
-                           flg.get("layer_mask"))
+                           flg.get("layer_mask"),
+                           drop[0, 0] if drop is not None else None,
+                           flg.get("stage_offset"))
             ce = head_loss(ep_, y, feed_b["labels"]) * flg["is_last"]
             return y, ce, jnp.zeros((), jnp.float32)
 
@@ -441,6 +460,14 @@ class GPTLMHeadModel(Module):
             ride["position_ids"] = position_ids
         if segment_ids is not None:
             ride["segment_ids"] = segment_ids
+        flags_extra = {}
+        if layer_mask is not None:
+            flags_extra["layer_mask"] = layer_mask
+        if use_drop:
+            from hetu_tpu.parallel.pipeline_1f1b import build_dropout_ride
+            ride["dropout_rng"], flags_extra["stage_offset"] = \
+                build_dropout_ride(rng, n_micro, input_ids.shape,
+                                   stage_layers)
 
         ce_sum, _aux, d_stage, d_edge = pipeline_train_1f1b(
             stage_fn, sp, ep, input_ids, labels, ride,
@@ -448,8 +475,7 @@ class GPTLMHeadModel(Module):
             compute_dtype=c.compute_dtype, aux_seed=0.0,
             state_spec=st.pipeline_state_spec(), loss_scale=loss_scale,
             skip_dead_halves=skip_dead_halves,
-            flags_extra=({"layer_mask": layer_mask}
-                         if layer_mask is not None else None))
+            flags_extra=flags_extra or None)
 
         d_blocks = unstack_stage_grads(
             d_stage, c.num_hidden_layers, st.pp, stage_layers)

@@ -437,7 +437,8 @@ class LlamaLMHeadModel(Module):
     def pipeline_train_grads(self, params, input_ids, labels, *,
                              position_ids=None, segment_ids=None,
                              n_micro: int, labels_shifted: bool = False,
-                             loss_scale=1.0, skip_dead_halves="auto"):
+                             loss_scale=1.0, skip_dead_halves="auto",
+                             rng=None):
         """1F1B (PipeDream-flush) training pass: returns
         ((loss_sum, count), grads) with grads matching `params` exactly
         (reference: executable_graph.cc:836 GeneratePipedreamFlushSchedule).
@@ -480,16 +481,28 @@ class LlamaLMHeadModel(Module):
             dtype=jnp.float32)
         block = self.model.layers.block
 
-        def stage_scan(sp_slice, x0, pos, seg, mask_row):
+        use_drop = rng is not None and (c.hidden_dropout > 0.0
+                                        or c.attention_dropout > 0.0)
+
+        def stage_scan(sp_slice, x0, pos, seg, mask_row, drop_seed, offset):
             def body(carry, xs):
                 lp, mj = xs if mask_row is not None else (xs, None)
-                x_c, aux_c = carry
+                x_c, aux_c, gid = carry
+                layer_rng = None
+                if use_drop:
+                    # (micro bits, global layer id) -> a mask the backward
+                    # visit REPRODUCES exactly: the seed rides the saved
+                    # token stream, the id comes from the stage offset
+                    layer_rng = jax.random.fold_in(
+                        jax.random.key(drop_seed), gid)
                 out, aux = block(lp, x_c, cos=cos, sin=sin,
-                                 position_ids=pos, segment_ids=seg)
+                                 position_ids=pos, segment_ids=seg,
+                                 rng=layer_rng,
+                                 deterministic=not use_drop)
                 if mj is not None:
                     out = jnp.where(mj > 0, out, x_c)
                     aux = aux * mj
-                return (out, aux_c + aux), None
+                return (out, aux_c + aux, gid + 1), None
 
             fn = body
             if c.remat:
@@ -501,7 +514,10 @@ class LlamaLMHeadModel(Module):
             from hetu_tpu.core.vma import cast_varying, vma_of
             init_aux = cast_varying(jnp.zeros((), jnp.float32),
                                     tuple(vma_of(x0)))
-            (y, aux), _ = lax.scan(fn, (x0, init_aux), xs)
+            gid0 = (offset if offset is not None
+                    else cast_varying(jnp.zeros((), jnp.uint32),
+                                      tuple(vma_of(x0))))
+            (y, aux, _), _ = lax.scan(fn, (x0, init_aux, gid0), xs)
             return y, aux
 
         def head_loss(ep_, y, lab):
@@ -521,10 +537,13 @@ class LlamaLMHeadModel(Module):
             emb = self.model.embed(ep_["embed"], feed_b["ids"])
             emb = st.constrain(emb.astype(c.compute_dtype), st.act_hidden())
             x0 = jnp.where(flg["is_first"] > 0, emb, x_in)
+            drop = feed_s.get("dropout_rng")
             y, aux = stage_scan(sp_slice, x0,
                                 feed_s.get("position_ids"),
                                 feed_s.get("segment_ids"),
-                                flg.get("layer_mask"))
+                                flg.get("layer_mask"),
+                                drop[0, 0] if drop is not None else None,
+                                flg.get("stage_offset"))
             ce = head_loss(ep_, y, feed_b["labels"]) * flg["is_last"]
             return y, ce, aux
 
@@ -533,6 +552,14 @@ class LlamaLMHeadModel(Module):
             ride["position_ids"] = position_ids
         if segment_ids is not None:
             ride["segment_ids"] = segment_ids
+        flags_extra = {}
+        if layer_mask is not None:
+            flags_extra["layer_mask"] = layer_mask
+        if use_drop:
+            from hetu_tpu.parallel.pipeline_1f1b import build_dropout_ride
+            ride["dropout_rng"], flags_extra["stage_offset"] = \
+                build_dropout_ride(rng, n_micro, input_ids.shape,
+                                   stage_layers)
         state_spec = st.pipeline_state_spec()
 
         ce_sum, aux_sum, d_stage, d_edge = pipeline_train_1f1b(
@@ -541,8 +568,7 @@ class LlamaLMHeadModel(Module):
             compute_dtype=c.compute_dtype, aux_seed=count,
             state_spec=state_spec, loss_scale=loss_scale,
             skip_dead_halves=skip_dead_halves,
-            flags_extra=({"layer_mask": layer_mask}
-                         if layer_mask is not None else None))
+            flags_extra=flags_extra or None)
 
         d_layers = unstack_stage_grads(
             d_stage, c.num_hidden_layers, st.pp, stage_layers)

@@ -145,6 +145,20 @@ def _shardmap_round_bodies(stage_fn: Callable, mesh, pp_axis: str):
     return vfwd, vbwd
 
 
+def build_dropout_ride(rng, n_micro: int, ids_shape, stage_layers):
+    """(dropout_rng rider [B, s], stage_offset row [pp]) for pipeline
+    dropout: per-micro uint32 seed bits ride the token stream (saved with
+    the stage inputs, so the backward visit replays the SAME masks), and
+    each stage's first global layer index seeds the per-layer fold_in.
+    One implementation for every model family."""
+    B, s = ids_shape
+    mb = B // n_micro
+    bits = jax.random.bits(rng, (n_micro,), dtype=jnp.uint32)
+    rider = jnp.broadcast_to(jnp.repeat(bits, mb)[:, None], (B, s))
+    offs = np.concatenate([[0], np.cumsum(stage_layers)[:-1]])
+    return rider, jnp.asarray(offs, jnp.uint32)
+
+
 def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
                         ids, labels, ride_data: Dict, *,
                         n_micro: int, mesh, hidden_size: int,
@@ -279,8 +293,18 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
             out = lax.with_sharding_constraint(out, bspec)
         return out
 
+    read_slots = jnp.asarray(2 * (pp - 1 - np.arange(pp)), jnp.int32)
+
     def read(buf):
-        # constant one-hot gather: slot index is static per stage
+        if jnp.issubdtype(buf.dtype, jnp.integer):
+            # the one-hot einsum promotes through f32, which rounds ints
+            # >= 2^24 — fatal for the uint32 dropout seeds (a corrupted
+            # seed makes the backward visit replay DIFFERENT masks);
+            # integer buffers take an exact per-stage gather instead
+            idx = read_slots.reshape((pp,) + (1,) * (buf.ndim - 1))
+            return jnp.take_along_axis(buf, idx, axis=1)[:, 0]
+        # constant one-hot gather: slot index is static per stage (exact
+        # for floats: x*1 + 0 sums reproduce the stored values bit-exactly)
         return jnp.einsum("pk,pk...->p...", read_oh, buf).astype(buf.dtype)
 
     # ---- init carries ------------------------------------------------------

@@ -90,3 +90,37 @@ def test_extents_rejects_impossible_totals():
         u.extents(2)  # 3 groups cannot all get a nonzero slice of 2
     with pytest.raises(ValueError):
         u.extents(0)
+
+
+def test_union_partition_fuzz():
+    """Randomized invariant check over shares/totals: extents partition the
+    total exactly, are share-monotone, offsets tile [0, total), and
+    split_host pieces reassemble to the original array."""
+    import random
+
+    rng = random.Random(3)
+    for _ in range(40):
+        g = rng.randint(1, 5)
+        shares = tuple(rng.randint(1, 7) for _ in range(g))
+        u = DSU(
+            tuple(DS.dup(2) for _ in range(g)), hetero_dim=0,
+            shares=shares).validate()
+        total_sh = sum(shares)
+        # non-multiples of sum(shares) exercise the largest-remainder
+        # rounding path (exact multiples only hit the trivial branch)
+        total = rng.randint(g, total_sh * 6)
+        ext = u.extents(total)
+        assert sum(ext) == total
+        assert all(e > 0 for e in ext)
+        # share-monotone: a strictly larger share never gets fewer rows
+        for i in range(g):
+            for j in range(g):
+                if shares[i] > shares[j]:
+                    assert ext[i] >= ext[j], (shares, ext)
+        offs = u.offsets(total)
+        assert offs[0][0] == 0 and offs[-1][1] == total
+        assert all(offs[k][1] == offs[k + 1][0] for k in range(g - 1))
+        arr = np.arange(total * 3).reshape(total, 3)
+        parts = u.split_host(arr)
+        np.testing.assert_array_equal(np.concatenate(parts, axis=0), arr)
+        assert [p.shape[0] for p in parts] == list(ext)

@@ -1,1 +1,2 @@
 from hetu_tpu.models.gpt.model import GPTConfig, GPTModel, GPTLMHeadModel
+from hetu_tpu.models.gpt import convert  # noqa: F401  (HF interop)

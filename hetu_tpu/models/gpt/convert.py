@@ -1,0 +1,140 @@
+"""HuggingFace <-> hetu_tpu weight conversion for the GPT family.
+
+Counterpart of models/llama/convert.py (reference: python/hetu/models/utils/
+model_utils.py HF interop).  HF GPT-2 uses Conv1D modules whose weights are
+stored [in, out] — already our orientation — so the mapping is mostly
+regrouping: c_attn's packed [h, 3h] splits into our per-head
+[h, heads, 3, hd] fused QKV, and per-layer tensors stack onto the leading
+scan dim.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict
+
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.models.gpt.model import GPTConfig
+
+
+def _t(x) -> np.ndarray:
+    if hasattr(x, "detach"):
+        x = x.detach().cpu().float().numpy()
+    return np.asarray(x, np.float32)
+
+
+def convert_hf_gpt2(state_dict: Dict[str, Any], config: GPTConfig,
+                    dtype=None) -> Dict[str, Any]:
+    """HF GPT2LMHeadModel state dict -> hetu_tpu params pytree
+    (use_scan layout: per-layer weights stacked on a leading dim)."""
+    c = config
+    h, hd, nh = c.hidden_size, c.head_dim, c.num_attention_heads
+    L = c.num_hidden_layers
+    dtype = dtype or c.param_dtype
+
+    def get(name):
+        return _t(state_dict[name])
+
+    cols = {k: [] for k in ("wqkv", "bqkv", "ow", "ob", "ln1w", "ln1b",
+                            "ln2w", "ln2b", "uw", "ub", "dw", "db")}
+    for i in range(L):
+        pre = f"transformer.h.{i}."
+        w = get(pre + "attn.c_attn.weight")          # [h, 3h], [q|k|v]
+        b = get(pre + "attn.c_attn.bias")            # [3h]
+        qkv_w = np.stack([w[:, j * h:(j + 1) * h].reshape(h, nh, hd)
+                          for j in range(3)], axis=2)   # [h, nh, 3, hd]
+        qkv_b = np.stack([b[j * h:(j + 1) * h].reshape(nh, hd)
+                          for j in range(3)], axis=1)   # [nh, 3, hd]
+        cols["wqkv"].append(qkv_w)
+        cols["bqkv"].append(qkv_b)
+        cols["ow"].append(get(pre + "attn.c_proj.weight"))   # [h, h] in,out
+        cols["ob"].append(get(pre + "attn.c_proj.bias"))
+        cols["ln1w"].append(get(pre + "ln_1.weight"))
+        cols["ln1b"].append(get(pre + "ln_1.bias"))
+        cols["ln2w"].append(get(pre + "ln_2.weight"))
+        cols["ln2b"].append(get(pre + "ln_2.bias"))
+        cols["uw"].append(get(pre + "mlp.c_fc.weight"))      # [h, 4h]
+        cols["ub"].append(get(pre + "mlp.c_fc.bias"))
+        cols["dw"].append(get(pre + "mlp.c_proj.weight"))    # [4h, h]
+        cols["db"].append(get(pre + "mlp.c_proj.bias"))
+
+    def stack(key):
+        return jnp.asarray(np.stack(cols[key]), dtype)
+
+    blocks = {
+        "ln1": {"weight": stack("ln1w"), "bias": stack("ln1b")},
+        "attn": {"wqkv": stack("wqkv"), "bqkv": stack("bqkv"),
+                 "o_proj": {"weight": stack("ow"), "bias": stack("ob")}},
+        "ln2": {"weight": stack("ln2w"), "bias": stack("ln2b")},
+        "mlp": {"w_up": stack("uw"), "b_up": stack("ub"),
+                "down": {"weight": stack("dw"), "bias": stack("db")}},
+    }
+    params: Dict[str, Any] = {
+        "model": {
+            "wte": {"weight": jnp.asarray(
+                get("transformer.wte.weight"), dtype)},
+            "wpe": jnp.asarray(get("transformer.wpe.weight"), dtype),
+            "blocks": blocks,
+            "final_ln": {"weight": jnp.asarray(
+                get("transformer.ln_f.weight"), dtype),
+                "bias": jnp.asarray(get("transformer.ln_f.bias"), dtype)},
+        }
+    }
+    if not c.tie_word_embeddings:
+        lm = state_dict.get("lm_head.weight",
+                            state_dict["transformer.wte.weight"])
+        params["lm_head"] = jnp.asarray(_t(lm).T, dtype)
+    return params
+
+
+def export_hf_gpt2(params: Dict[str, Any],
+                   config: GPTConfig) -> Dict[str, np.ndarray]:
+    """Inverse mapping: hetu_tpu params -> HF state dict (numpy)."""
+    c = config
+    h, hd, nh = c.hidden_size, c.head_dim, c.num_attention_heads
+    blocks = params["model"]["blocks"]
+    out: Dict[str, np.ndarray] = {
+        "transformer.wte.weight": np.asarray(
+            params["model"]["wte"]["weight"], np.float32),
+        "transformer.wpe.weight": np.asarray(
+            params["model"]["wpe"], np.float32),
+        "transformer.ln_f.weight": np.asarray(
+            params["model"]["final_ln"]["weight"], np.float32),
+        "transformer.ln_f.bias": np.asarray(
+            params["model"]["final_ln"]["bias"], np.float32),
+    }
+    # materialize each stacked tensor ONCE (one device-to-host transfer
+    # per tensor, not per layer — mirrors export_hf_llama)
+    wqkv = np.asarray(blocks["attn"]["wqkv"], np.float32)
+    bqkv = np.asarray(blocks["attn"]["bqkv"], np.float32)
+    ow = np.asarray(blocks["attn"]["o_proj"]["weight"], np.float32)
+    ob = np.asarray(blocks["attn"]["o_proj"]["bias"], np.float32)
+    ln1w = np.asarray(blocks["ln1"]["weight"], np.float32)
+    ln1b = np.asarray(blocks["ln1"]["bias"], np.float32)
+    ln2w = np.asarray(blocks["ln2"]["weight"], np.float32)
+    ln2b = np.asarray(blocks["ln2"]["bias"], np.float32)
+    uw = np.asarray(blocks["mlp"]["w_up"], np.float32)
+    ub = np.asarray(blocks["mlp"]["b_up"], np.float32)
+    dw = np.asarray(blocks["mlp"]["down"]["weight"], np.float32)
+    db = np.asarray(blocks["mlp"]["down"]["bias"], np.float32)
+    for i in range(c.num_hidden_layers):
+        pre = f"transformer.h.{i}."
+        out[pre + "attn.c_attn.weight"] = np.concatenate(
+            [wqkv[i][:, :, j, :].reshape(h, nh * hd) for j in range(3)],
+            axis=1)
+        out[pre + "attn.c_attn.bias"] = np.concatenate(
+            [bqkv[i][:, j, :].reshape(nh * hd) for j in range(3)])
+        out[pre + "attn.c_proj.weight"] = ow[i]
+        out[pre + "attn.c_proj.bias"] = ob[i]
+        out[pre + "ln_1.weight"] = ln1w[i]
+        out[pre + "ln_1.bias"] = ln1b[i]
+        out[pre + "ln_2.weight"] = ln2w[i]
+        out[pre + "ln_2.bias"] = ln2b[i]
+        out[pre + "mlp.c_fc.weight"] = uw[i]
+        out[pre + "mlp.c_fc.bias"] = ub[i]
+        out[pre + "mlp.c_proj.weight"] = dw[i]
+        out[pre + "mlp.c_proj.bias"] = db[i]
+    if "lm_head" in params:
+        out["lm_head.weight"] = np.asarray(params["lm_head"],
+                                           np.float32).T
+    return out

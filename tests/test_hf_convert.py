@@ -44,3 +44,42 @@ def test_roundtrip_export():
     for k, v in back.items():
         np.testing.assert_allclose(v, sd[k].float().numpy(), rtol=1e-6,
                                    atol=1e-6, err_msg=k)
+
+
+def _hf_gpt2():
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=256,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0,
+        layer_norm_epsilon=1e-5)
+    torch.manual_seed(1)
+    return transformers.GPT2LMHeadModel(hf_cfg)
+
+
+def test_hf_gpt2_logits_parity():
+    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.models.gpt.convert import convert_hf_gpt2
+
+    hf = _hf_gpt2().eval()
+    cfg = GPTConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    model = GPTLMHeadModel(cfg)
+    params = convert_hf_gpt2(hf.state_dict(), cfg)
+
+    ids = np.random.default_rng(1).integers(0, 256, size=(2, 32))
+    with torch.no_grad():
+        hf_logits = hf(torch.tensor(ids)).logits.numpy()
+    ours = np.asarray(model(params, jnp.asarray(ids, jnp.int32)))
+    np.testing.assert_allclose(ours, hf_logits, rtol=2e-3, atol=2e-3)
+
+
+def test_hf_gpt2_roundtrip_export():
+    from hetu_tpu.models.gpt import GPTConfig
+    from hetu_tpu.models.gpt.convert import convert_hf_gpt2, export_hf_gpt2
+
+    hf = _hf_gpt2()
+    cfg = GPTConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    params = convert_hf_gpt2(hf.state_dict(), cfg)
+    back = export_hf_gpt2(params, cfg)
+    sd = hf.state_dict()
+    for k, v in back.items():
+        np.testing.assert_allclose(v, sd[k].float().numpy(), rtol=1e-6,
+                                   atol=1e-6, err_msg=k)

@@ -6,9 +6,11 @@ reference is training-first and so are we — this is the functional decode
 loop for eval/demo, TPU-shaped: static max length, lax.scan decode, cache as
 a pytree carried through the scan).
 
-Works with the LLaMA family's stacked-scan parameter layout: the per-layer
-KV caches are stacked [L, b, max_len, n_kv, hd] and the decode step scans
-layers with the cache rows as per-layer xs/ys.
+Works with both model families' stacked-scan parameter layouts: the
+per-layer KV caches are stacked [L, b, max_len, n_kv, hd] and the decode
+step scans layers with the cache rows as per-layer xs/ys.  prefill and
+decode_step dispatch on the family (LLaMA: RMSNorm/rotary/fused-GQA QKV;
+GPT: LayerNorm/wpe/biased fused QKV).
 """
 from __future__ import annotations
 
@@ -39,12 +41,79 @@ def _attend_cached(q, ck, cv, pos, scale):
     return out.astype(q.dtype)
 
 
+def _is_gpt(model) -> bool:
+    return hasattr(model.model, "wte")
+
+
 def init_cache(model, batch: int, max_len: int):
-    """Empty KV cache [L, b, max_len, n_kv, hd] for the llama family."""
+    """Empty KV cache [L, b, max_len, n_kv, hd] (n_kv = heads for GPT)."""
     c = model.config
-    shape = (c.num_hidden_layers, batch, max_len, c.num_key_value_heads,
-             c.head_dim)
+    n_kv = getattr(c, "num_key_value_heads", c.num_attention_heads)
+    shape = (c.num_hidden_layers, batch, max_len, n_kv, c.head_dim)
     return (jnp.zeros(shape, c.compute_dtype), jnp.zeros(shape, c.compute_dtype))
+
+
+def _gpt_embed(model, mp, ids, pos_ids):
+    x = model.model.wte(mp["wte"], ids) \
+        + jnp.take(mp["wpe"], pos_ids, axis=0)
+    return x.astype(model.config.compute_dtype)
+
+
+def _prefill_gpt(model, params, input_ids, max_len: int):
+    mp = params["model"]
+    pos = jnp.arange(input_ids.shape[1], dtype=jnp.int32)
+    x = _gpt_embed(model, mp, input_ids, pos)
+    block = model.model.block
+
+    def body(h, lp):
+        out = block(lp, h)
+        hn = block.ln1(lp["ln1"], h)
+        # contract only the K/V planes for the cache (the block forward
+        # above already computed full QKV for its own attention)
+        kv = jnp.einsum("bsh,hngd->bsngd", hn,
+                        lp["attn"]["wqkv"][:, :, 1:3, :].astype(h.dtype)) \
+            + lp["attn"]["bqkv"][:, 1:3, :].astype(h.dtype)
+        return out, (kv[..., 0, :], kv[..., 1, :])
+
+    x, (ks, vs) = lax.scan(body, x, mp["blocks"])
+    hidden = model.model.final_ln(mp["final_ln"], x)
+    logits = model.logits(params, hidden)[:, -1, :]
+    pad = max_len - input_ids.shape[1]
+    cache_k = jnp.pad(ks, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    cache_v = jnp.pad(vs, ((0, 0), (0, 0), (0, pad), (0, 0), (0, 0)))
+    return logits, (cache_k, cache_v)
+
+
+def _decode_step_gpt(model, params, token, cache, pos):
+    c = model.config
+    mp = params["model"]
+    b = token.shape[0]
+    x = _gpt_embed(model, mp, token[:, None], jnp.full((1,), pos, jnp.int32))
+    block = model.model.block
+    att = block.attn
+    nh, hd = c.num_attention_heads, c.head_dim
+    scale = hd ** -0.5
+    cache_k, cache_v = cache
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        hn = block.ln1(lp["ln1"], h)
+        qkv = jnp.einsum("bsh,hngd->bsngd", hn,
+                         lp["attn"]["wqkv"].astype(h.dtype)) \
+            + lp["attn"]["bqkv"].astype(h.dtype)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
+        attn = _attend_cached(q, ck, cv, pos, scale)
+        h = h + att.o_proj(lp["attn"]["o_proj"],
+                           attn.reshape(b, 1, nh * hd))
+        h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (mp["blocks"], cache_k, cache_v))
+    hidden = model.model.final_ln(mp["final_ln"], x)
+    logits = model.logits(params, hidden)[:, 0, :]
+    return logits, (new_k, new_v)
 
 
 def prefill(model, params, input_ids, max_len: int):
@@ -55,6 +124,8 @@ def prefill(model, params, input_ids, max_len: int):
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
                          "params); rebuild the model with use_scan=True")
+    if _is_gpt(model):
+        return _prefill_gpt(model, params, input_ids, max_len)
     b, plen = input_ids.shape
     # extract per-layer k/v by re-running the projections layer by layer —
     # one pass via the scan collecting (k, v) as ys
@@ -95,6 +166,8 @@ def decode_step(model, params, token, cache, pos):
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
                          "params)")
+    if _is_gpt(model):
+        return _decode_step_gpt(model, params, token, cache, pos)
     mp = params["model"]
     b = token.shape[0]
     x = model.model.embed(mp["embed"], token[:, None]).astype(c.compute_dtype)

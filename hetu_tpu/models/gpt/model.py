@@ -320,6 +320,16 @@ class GPTLMHeadModel(Module):
                        init.normal(config.initializer_range),
                        dtype=config.param_dtype, ds=lm_ds)
 
+    def logits(self, params, hidden):
+        """hidden -> logits via the tied/untied head (one implementation
+        for the training forward AND the generation decode paths)."""
+        if self.config.tie_word_embeddings:
+            w = params["model"]["wte"]["weight"].astype(hidden.dtype).T
+        else:
+            w = params["lm_head"].astype(hidden.dtype)
+        return self.strategy.constrain(hidden @ w,
+                                       self.strategy.act_logits())
+
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, loss_reduction: str = "mean", rng=None,
                 deterministic=True, n_micro=None,
@@ -330,12 +340,7 @@ class GPTLMHeadModel(Module):
                             position_ids=position_ids,
                             segment_ids=segment_ids, rng=rng,
                             deterministic=deterministic, n_micro=n_micro)
-        if self.config.tie_word_embeddings:
-            w = params["model"]["wte"]["weight"].astype(hidden.dtype).T
-        else:
-            w = params["lm_head"].astype(hidden.dtype)
-        logits = hidden @ w
-        logits = self.strategy.constrain(logits, self.strategy.act_logits())
+        logits = self.logits(params, hidden)
         if labels is None:
             return logits
         # labels_shifted: host pre-shifted targets (CP seq reorder) — see

@@ -95,3 +95,35 @@ def test_top_p_sampling_restricts_support():
     outp = generate(model, params, ids, max_new_tokens=6, temperature=1.0,
                     top_p=1e-6, rng=jax.random.key(2))
     np.testing.assert_array_equal(np.asarray(outg), np.asarray(outp))
+
+
+def test_gpt_generate_matches_hf_greedy():
+    """GPT family through the KV-cache decode loop: greedy continuations
+    match HF transformers token-for-token under converted weights."""
+    import pytest
+    torch = pytest.importorskip("torch")
+    transformers = pytest.importorskip("transformers")
+    import jax
+    from hetu_tpu.models.generation import generate
+    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    from hetu_tpu.models.gpt.convert import convert_hf_gpt2
+
+    hf_cfg = transformers.GPT2Config(
+        vocab_size=256, n_embd=64, n_layer=2, n_head=4, n_positions=256,
+        resid_pdrop=0.0, embd_pdrop=0.0, attn_pdrop=0.0)
+    torch.manual_seed(3)
+    hf = transformers.GPT2LMHeadModel(hf_cfg).eval()
+    cfg = GPTConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    model = GPTLMHeadModel(cfg)
+    params = convert_hf_gpt2(hf.state_dict(), cfg)
+    ids = np.random.default_rng(3).integers(0, 256, size=(2, 8))
+    with torch.no_grad():
+        # explicit mask: otherwise HF infers one from pad_token_id and a
+        # random 0 in the prompt would mask a real token
+        hf_out = hf.generate(torch.tensor(ids), max_new_tokens=6,
+                             do_sample=False, pad_token_id=0,
+                             attention_mask=torch.ones_like(
+                                 torch.tensor(ids)))
+    ours = generate(model, params, jnp.asarray(ids, jnp.int32),
+                    max_new_tokens=6, temperature=0.0)
+    np.testing.assert_array_equal(np.asarray(ours), hf_out.numpy())

@@ -65,8 +65,12 @@ class TikTokenizer:
         self.pattern = pattern
         specials = list(special_tokens if special_tokens is not None
                         else ("<s>", "</s>", "<unk>"))
-        base = len(self.ranks)
+        # non-dense rank files exist (holes in the id space): special ids
+        # must start past the MAX rank, not len(ranks), or they collide
+        # with base ids and decode() silently prefers the base token
+        base = (max(self.ranks.values()) + 1) if self.ranks else 0
         self.special_tokens = {t: base + i for i, t in enumerate(specials)}
+        self._base = base
         self.bos_id = self.special_tokens.get("<s>")
         self.eos_id = self.special_tokens.get("</s>")
         self.pad_id = self.eos_id
@@ -120,11 +124,15 @@ class TikTokenizer:
     # -------------------------------------------------- vocab surface
     @property
     def vocab_size(self) -> int:
-        return len(self.ranks) + len(self.special_tokens)
+        # id-space size (embedding rows needed), not the token count —
+        # the two differ when the rank file is non-dense
+        return self._base + len(self.special_tokens)
 
     @property
     def base_vocab_size(self) -> int:
-        return len(self.ranks)
+        # id-space size below the special tokens (== first special id);
+        # > len(self.ranks) when the rank file is non-dense
+        return self._base
 
     def token_to_id(self, token: Union[str, bytes]) -> Optional[int]:
         if isinstance(token, str):

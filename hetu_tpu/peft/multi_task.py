@@ -228,10 +228,14 @@ class MultiTaskSFTEngine:
             return adapters, opt_state, loss
 
         from hetu_tpu.engine.plan_pool import PlanPool
+        from hetu_tpu.engine.trainer import Trainer
         # task adapters share shapes -> tasks share compiled plans; only
-        # distinct (rows, seq) shapes from the bucket ladder compile
+        # distinct (rows, seq) shapes from the bucket ladder compile —
+        # bounded by the same HETU_TPU_MAX_PLANS retrace guard as the
+        # train/eval pools
         self._step = PlanPool(step, jit_kwargs=dict(donate_argnums=(0, 1)),
-                              name="multitask_sft")
+                              name="multitask_sft",
+                              max_plans=Trainer._plan_cap())
 
     def train_micro(self, micro: MicroBatch) -> Dict[int, float]:
         """Run every task span in the micro; returns task -> mean loss."""

@@ -132,12 +132,17 @@ class CostModel:
                 # straggler savings to be worth picking.
                 m_max = max(c.tp // max(e, 1) for e in c.cp_tp_eff)
                 if m_max > 1:
-                    kv_bytes *= m_max
+                    # the one-time per-layer tp all-gather moves the
+                    # UNinflated local KV (the gather is what produces the
+                    # inflated buffer); only the ring hops pay m_max
                     ag = kv_bytes * (c.tp - 1) / max(c.tp, 1)
-                    t_comm += self.num_layers * ag / (
+                    kv_bytes *= m_max
+                    # per-device layer count: a pp stage hosts L/pp layers
+                    # (same accounting as the tp allreduce term above)
+                    t_comm += self.num_layers / max(c.pp, 1) * ag / (
                         self._allreduce_gbps("tp", c.tp) * 1e9)
-            t_comm += self.num_layers * (c.cp - 1) * kv_bytes / (
-                self.hw.ici_p2p_gbps * 1e9)
+            t_comm += (self.num_layers / max(c.pp, 1)) * (c.cp - 1) \
+                * kv_bytes / (self.hw.ici_p2p_gbps * 1e9)
 
         # comm/compute overlap (reference: overlap_coefficient.json:2): with
         # a measured coefficient k in [1, 2], per-layer collectives overlap

@@ -45,16 +45,20 @@ def _is_gpt(model) -> bool:
     return hasattr(model.model, "wte")
 
 
+def _check_context_length(config, max_len: int):
+    """Past the trained context, GPT's jnp.take on wpe (and LLaMA's RoPE
+    table lookup) would silently clamp to the last position — fail loudly
+    instead.  One guard shared by every cache-building entry point."""
+    if max_len > config.max_position_embeddings:
+        raise ValueError(
+            f"cache length {max_len} exceeds max_position_embeddings "
+            f"{config.max_position_embeddings}")
+
+
 def init_cache(model, batch: int, max_len: int):
     """Empty KV cache [L, b, max_len, n_kv, hd] (n_kv = heads for GPT)."""
     c = model.config
-    if max_len > c.max_position_embeddings:
-        # past the trained context, GPT's jnp.take on wpe (and LLaMA's RoPE
-        # table lookup) would silently clamp to the last position — fail
-        # loudly here so direct prefill/decode users hit it too
-        raise ValueError(
-            f"cache length {max_len} exceeds max_position_embeddings "
-            f"{c.max_position_embeddings}")
+    _check_context_length(c, max_len)
     n_kv = getattr(c, "num_key_value_heads", c.num_attention_heads)
     shape = (c.num_hidden_layers, batch, max_len, n_kv, c.head_dim)
     return (jnp.zeros(shape, c.compute_dtype), jnp.zeros(shape, c.compute_dtype))
@@ -131,12 +135,7 @@ def prefill(model, params, input_ids, max_len: int):
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
                          "params); rebuild the model with use_scan=True")
-    if max_len > c.max_position_embeddings:
-        # same guard as init_cache: position lookups past the trained
-        # context clamp silently, so direct prefill users must fail loudly
-        raise ValueError(
-            f"cache length {max_len} exceeds max_position_embeddings "
-            f"{c.max_position_embeddings}")
+    _check_context_length(c, max_len)
     if _is_gpt(model):
         return _prefill_gpt(model, params, input_ids, max_len)
     b, plen = input_ids.shape
@@ -232,9 +231,7 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
     input_ids: [b, plen] int32 -> [b, plen + max_new_tokens]."""
     b, plen = input_ids.shape
     max_len = plen + max_new_tokens
-    if max_len > model.config.max_position_embeddings:
-        raise ValueError(f"total length {max_len} exceeds "
-                         f"max_position_embeddings")
+    # context-length validation happens in prefill (_check_context_length)
     logits, cache = prefill(model, params, input_ids, max_len)
     rng = rng if rng is not None else jax.random.key(0)
 

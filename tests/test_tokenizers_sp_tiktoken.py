@@ -109,6 +109,22 @@ def test_tiktoken_pure_python_matches_package(tmp_path):
             text, disallowed_special=()), text
 
 
+def test_tiktoken_non_dense_ranks(tmp_path):
+    """Rank files with holes in the id space: special ids must start past
+    the MAX rank (not len(ranks)), or they collide with base ids and
+    decode() silently prefers the base token."""
+    ranks = {b"a": 0, b"b": 1, b"ab": 2, b"c": 5}  # holes at 3, 4
+    path = tmp_path / "holey.tiktoken"
+    save_tiktoken_ranks(ranks, str(path))
+    tok = TikTokenizer(str(path), pattern=r".")
+    assert min(tok.special_tokens.values()) == 6  # past max rank 5
+    assert tok.base_vocab_size == 6               # id-space size
+    assert tok.vocab_size == 9
+    # the special id decodes to the special token, never a base piece
+    assert tok.decode([tok.special_tokens["<s>"]]) == "<s>"
+    assert tok.decode(tok.encode("abc")) == "abc"
+
+
 def test_tiktoken_without_package(tmp_path, monkeypatch):
     """The slow path alone (as if tiktoken were absent) still round-trips."""
     path = tmp_path / "toy.tiktoken"

@@ -194,6 +194,9 @@ class AmpelosILP:
             raise ValueError(f"no feasible ILP plan for {n} devices, "
                              f"{self.num_layers} layers")
         score, tp, layers, members = best
+        # plan-time envelope check (same chokepoint as Trainer/searcher)
+        from hetu_tpu.parallel.strategy import validate_stage_plan
+        validate_stage_plan(self.num_layers, 1, tp, layers)
         cfg = generate_ds_parallel_config(
             num_layers=self.num_layers, dp=1, tp=tp, pp=len(layers),
             stage_layers=layers)

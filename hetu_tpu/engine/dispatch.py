@@ -29,29 +29,56 @@ class BatchStrategyDispatcher:
 
     cost: CostModel
     strategies: Sequence[ParallelStrategy]
+    # model config for the envelope chokepoint (None = mesh-only rules)
+    model_cfg: Optional[object] = None
+    # the run's ACTUAL schedule/micro/dropout settings (defaults match
+    # TrainingConfig) — validate must answer exactly like the Trainer's
+    # own chokepoint call or the dispatcher rejects runnable plans
+    pp_schedule: str = "gpipe"
+    n_micro: Optional[int] = None       # None = trainer-resolved, unchecked
+    deterministic: bool = True
+
+    def __post_init__(self):
+        # batch-independent envelope violations in the pool are a setup
+        # bug: reject them loudly at construction, not per-batch
+        for st in self.strategies:
+            st.validate(self.model_cfg, pp_schedule=self.pp_schedule,
+                        deterministic=self.deterministic)
 
     def _candidate(self, st: ParallelStrategy) -> StrategyCandidate:
         return StrategyCandidate(
             dp=st.dp, tp=st.tp, pp=st.pp, cp=st.cp,
             sequence_parallel=st.sequence_parallel, zero=st.zero,
-            remat=True, n_micro=max(2 * st.pp, 1) if st.pp > 1 else 1)
+            remat=True, n_micro=max(2 * st.pp, 1) if st.pp > 1 else 1,
+            cp_tp_eff=st.cp_tp_eff)
 
     def choose(self, seq_lens: Sequence[int],
                global_batch: Optional[int] = None) -> int:
         """Strategy id minimizing predicted time for this batch shape.
-        seq_lens: the batch's sequence lengths (max -> padded seq)."""
+        seq_lens: the batch's sequence lengths (max -> padded seq).
+        Pool entries whose envelope rejects THIS batch shape (e.g. CP
+        split divisibility) are skipped."""
+        from hetu_tpu.parallel.strategy import StrategyValidationError
         seq = int(max(seq_lens))
-        cost = dataclasses.replace(
-            self.cost, seq_len=seq,
-            global_batch=global_batch or len(seq_lens))
+        gb = global_batch or len(seq_lens)
+        cost = dataclasses.replace(self.cost, seq_len=seq, global_batch=gb)
         hbm = cost.hw.hbm_gbytes * 1e9 * 0.9
         best, best_t = None, float("inf")
         for i, st in enumerate(self.strategies):
             c = self._candidate(st)
+            try:
+                # c.n_micro is only a cost heuristic — the feasibility
+                # gate uses the run's actual n_micro (None = unchecked)
+                st.validate(self.model_cfg, pp_schedule=self.pp_schedule,
+                            n_micro=self.n_micro, global_batch=gb,
+                            seq_len=seq, deterministic=self.deterministic)
+            except StrategyValidationError:
+                continue
             t, m = cost.evaluate(c)
             if m <= hbm and t < best_t:
                 best, best_t = i, t
         if best is None:
             raise ValueError(
-                f"no strategy in the pool fits memory for seq={seq}")
+                f"no strategy in the pool fits memory (and the engine "
+                f"envelope) for seq={seq}")
         return best

@@ -84,6 +84,11 @@ class MalleusPlanner:
             stage_members.append(members)
             stage_speed.append(min(speeds[i] for i in members))
         stage_layers = balance_stages(self.num_layers, stage_speed)
+        # plan-time envelope check (the shared chokepoint): a degenerate
+        # balance (zero-layer stage, bad stage count) is rejected HERE,
+        # not when the pipeline engine traces
+        from hetu_tpu.parallel.strategy import validate_stage_plan
+        validate_stage_plan(self.num_layers, self.dp, self.tp, stage_layers)
         cfg = generate_ds_parallel_config(
             num_layers=self.num_layers, dp=self.dp, tp=self.tp, pp=pp,
             stage_layers=stage_layers)

@@ -87,6 +87,17 @@ class Trainer:
         if config.loss_scale not in ("auto", "dynamic", "none"):
             raise ValueError(f"loss_scale must be auto|dynamic|none, got "
                              f"{config.loss_scale!r}")
+
+        # the ONE plan-time envelope chokepoint (StrategyValidationError
+        # here, not a trace-time surprise later) — shared with the
+        # searcher, Malleus/Ampelos and the batch dispatcher
+        self.strategy.validate(
+            getattr(model, "config", None),
+            pp_schedule=config.pp_schedule,
+            n_micro=config.num_micro_batches(max(self.strategy.dp, 1)),
+            global_batch=config.global_batch_size,
+            seq_len=config.seq_len,
+            deterministic=config.dropout_deterministic)
         compute_dtype = getattr(getattr(model, "config", None),
                                 "compute_dtype", None)
         use_scaler = (config.loss_scale == "dynamic"

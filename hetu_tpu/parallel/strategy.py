@@ -17,6 +17,12 @@ from hetu_tpu.core.mesh import MeshConfig, create_mesh
 from hetu_tpu.dstates import DistributedStates as DS
 
 
+class StrategyValidationError(ValueError):
+    """A parallel plan outside the engines' envelope, rejected at PLAN time
+    (before any tracing) — the DeduceStates-rejects-at-graph-build analog
+    (reference: hetu/graph/operator.h:425-594)."""
+
+
 @dataclasses.dataclass(frozen=True)
 class ParallelStrategy:
     """Strategy = mesh shape + behavior flags.
@@ -181,6 +187,177 @@ class ParallelStrategy:
             return x
         return ds.constrain(x)
 
+    # -- plan-time validation -------------------------------------------
+    def validate(self, model_cfg=None, *, pp_schedule: str = "gpipe",
+                 n_micro: Optional[int] = None,
+                 global_batch: Optional[int] = None,
+                 seq_len: Optional[int] = None,
+                 stage_layers: Optional[Tuple[int, ...]] = None,
+                 deterministic: bool = False) -> "ParallelStrategy":
+        """The ONE chokepoint encoding the real engine envelope.
+
+        Every planner (Trainer, searcher, Malleus/Ampelos,
+        BatchStrategyDispatcher) calls this so no plan the engines would
+        reject — or silently degrade — survives past plan time.  Raises
+        StrategyValidationError with the rule that failed.
+
+        model_cfg: a model config (LlamaConfig/GPTConfig-shaped, duck-typed
+          via getattr) or None for mesh-only checks.
+        deterministic: True = an inference/eval plan (dropout never runs,
+          so dropout-composition rules are skipped).
+        """
+        def fail(msg):
+            raise StrategyValidationError(f"[{self.describe()}] {msg}")
+
+        m = self.mesh
+        for name, v in (("dp", m.dp), ("tp", m.tp), ("pp", m.pp),
+                        ("cp", m.cp), ("ep", m.ep)):
+            if v < 1:
+                fail(f"mesh axis {name}={v} must be >= 1")
+        if pp_schedule not in ("gpipe", "1f1b"):
+            fail(f"pp_schedule must be 'gpipe' or '1f1b', got {pp_schedule!r}")
+        if self.zero_stage not in (1, 2, 3):
+            fail(f"zero_stage must be 1, 2 or 3, got {self.zero_stage}")
+        if self.zero_stage >= 2 and not self.zero:
+            fail(f"zero_stage={self.zero_stage} requires zero=True")
+        if self.cp_split not in (None, "normal", "stripe", "sym"):
+            fail(f"cp_split must be normal|stripe|sym|None, got "
+                 f"{self.cp_split!r}")
+
+        # hetero CP ring: per-member effective TP (head-resplit ring)
+        if self.cp_tp_eff is not None:
+            if self.cp <= 1:
+                fail("cp_tp_eff requires cp > 1")
+            if len(self.cp_tp_eff) != self.cp:
+                fail(f"cp_tp_eff has {len(self.cp_tp_eff)} entries for "
+                     f"cp={self.cp}")
+            for e in self.cp_tp_eff:
+                if e < 1 or self.tp % e:
+                    fail(f"cp_tp_eff entry {e} must divide mesh tp={self.tp}")
+
+        # hetero-TP pipeline: per-STAGE effective TP in one program.
+        # Engine envelope (models/llama/model.py pp_tp_eff path +
+        # parallel/hetero_pp.py): GPipe only, dense blocks, no SP, cp=1.
+        if self.pp_tp_eff is not None:
+            if self.pp <= 1:
+                fail("pp_tp_eff requires pp > 1")
+            if len(self.pp_tp_eff) != self.pp:
+                fail(f"pp_tp_eff has {len(self.pp_tp_eff)} entries for "
+                     f"pp={self.pp}")
+            for e in self.pp_tp_eff:
+                if e < 1 or self.tp % e:
+                    fail(f"pp_tp_eff entry {e} must divide mesh tp={self.tp}")
+            if pp_schedule != "gpipe":
+                fail("pp_tp_eff is only implemented on the GPipe schedule "
+                     "(the 1f1b path would silently run all stages at "
+                     "homogeneous TP)")
+            if self.sequence_parallel:
+                fail("pp_tp_eff composes with dense blocks, no SP, cp=1 "
+                     "(sequence_parallel=True set)")
+            if self.cp > 1:
+                fail(f"pp_tp_eff composes with dense blocks, no SP, cp=1 "
+                     f"(cp={self.cp} set)")
+
+        # batch/micro divisibility (pipeline schedules and plain gradient
+        # accumulation both split the batch into n_micro equal microbatches)
+        if n_micro is not None and n_micro > 1:
+            if global_batch is not None and \
+                    global_batch % (self.dp * n_micro):
+                fail(f"global_batch={global_batch} must divide by "
+                     f"dp*n_micro={self.dp * n_micro}")
+        if global_batch is not None and global_batch % self.dp:
+            fail(f"global_batch={global_batch} must divide by dp={self.dp}")
+
+        # CP data-layout divisibility (data/bucket.py cp_split_batch —
+        # the ONE rule set shared with the ring's static step masks)
+        if seq_len is not None and self.cp > 1:
+            from hetu_tpu.utils import flags as _flags
+            split = self.cp_split or _flags.str_flag("HETU_TPU_CP_SPLIT")
+            if split == "sym" and seq_len % (2 * self.cp):
+                fail(f"seq_len={seq_len} must divide by 2*cp={2 * self.cp} "
+                     "for the 'sym' CP split")
+            if split == "normal" and seq_len % self.cp:
+                fail(f"seq_len={seq_len} must divide by cp={self.cp} for "
+                     "the 'normal' CP split")
+            if split == "stripe":
+                from hetu_tpu.data.bucket import stripe_granularity
+                if seq_len % self.cp or \
+                        stripe_granularity(seq_len, self.cp) is None:
+                    fail(f"seq_len={seq_len} needs a cp*m divisor (m >= 2) "
+                         f"for the 'stripe' CP split (cp={self.cp})")
+
+        if model_cfg is None:
+            return self
+
+        # ---- model-dependent rules (duck-typed config attributes) ----
+        heads = getattr(model_cfg, "num_attention_heads", None)
+        n_kv = getattr(model_cfg, "num_key_value_heads", heads)
+        n_layers = getattr(model_cfg, "num_hidden_layers", None)
+        n_experts = getattr(model_cfg, "num_experts", 0) or 0
+        use_scan = getattr(model_cfg, "use_scan", True)
+        stage_layers = (stage_layers if stage_layers is not None
+                        else getattr(model_cfg, "pipeline_stage_layers", None))
+        attn_drop = getattr(model_cfg, "attention_dropout", 0.0) or 0.0
+        hid_drop = getattr(model_cfg, "hidden_dropout", 0.0) or 0.0
+        dropout = (not deterministic) and (attn_drop > 0 or hid_drop > 0)
+
+        if heads is not None and self.tp > 1 and heads % self.tp:
+            fail(f"num_attention_heads={heads} must divide by tp={self.tp}")
+        if n_kv is not None and self.tp > 1 and n_kv % self.tp:
+            fail(f"num_key_value_heads={n_kv} must divide by tp={self.tp}")
+        if n_kv is not None:
+            for label, effs in (("cp_tp_eff", self.cp_tp_eff),
+                                ("pp_tp_eff", self.pp_tp_eff)):
+                for e in (effs or ()):
+                    if e > 1 and n_kv % e:
+                        fail(f"num_key_value_heads={n_kv} must divide by "
+                             f"every {label} entry (got {e})")
+
+        if self.ep > 1:
+            if n_experts <= 0:
+                fail(f"ep={self.ep} requires a MoE model (num_experts > 0)")
+            if n_experts % self.ep:
+                fail(f"num_experts={n_experts} must divide by ep={self.ep}")
+
+        if self.pp > 1:
+            if not use_scan:
+                fail("pipeline parallelism requires use_scan=True")
+            if stage_layers is not None:
+                if len(stage_layers) != self.pp:
+                    fail(f"stage_layers={list(stage_layers)} must have "
+                         f"len pp={self.pp}")
+                if any(k < 1 for k in stage_layers):
+                    fail(f"stage_layers={list(stage_layers)} entries must "
+                         "be >= 1")
+                if n_layers is not None and sum(stage_layers) != n_layers:
+                    fail(f"stage_layers={list(stage_layers)} must sum to "
+                         f"num_hidden_layers={n_layers}")
+            elif n_layers is not None and n_layers % self.pp:
+                fail(f"num_hidden_layers={n_layers} must divide by "
+                     f"pp={self.pp} (or pass stage_layers)")
+
+        if self.pp_tp_eff is not None:
+            if n_experts > 0:
+                fail("pp_tp_eff composes with dense blocks only "
+                     f"(num_experts={n_experts})")
+            if dropout:
+                fail("dropout inside the hetero-TP pipeline is not "
+                     "implemented (set dropouts to 0 or deterministic=True)")
+
+        if self.cp > 1 and not deterministic and attn_drop > 0:
+            fail(f"attention_dropout={attn_drop} inside ring attention "
+                 "(cp > 1) is not implemented")
+
+        if pp_schedule == "1f1b" and self.pp > 1:
+            if not use_scan:
+                fail("1f1b requires use_scan=True")
+            if n_experts > 0 and any(
+                    a > 1 for a in (self.dp, self.tp, self.cp, self.ep)):
+                fail("MoE aux-loss routing under the 1f1b schedule is only "
+                     "supported on pp-only meshes (use gpipe on mixed "
+                     "meshes)")
+        return self
+
     def describe(self) -> str:
         bits = [str(self.mesh)]
         if self.cp_tp_eff is not None:
@@ -190,6 +367,17 @@ class ParallelStrategy:
         if self.zero:
             bits.append(f"zero{max(self.zero_stage, 1)}")
         return "+".join(bits)
+
+
+def validate_stage_plan(num_layers: int, dp: int, tp: int,
+                        stage_layers) -> None:
+    """Envelope check for a planner-produced stage plan (Malleus/Ampelos):
+    one shared call instead of each planner synthesizing its own
+    strategy+config dance."""
+    from types import SimpleNamespace
+    ParallelStrategy(mesh=MeshConfig(dp=dp, tp=tp, pp=len(stage_layers))) \
+        .validate(SimpleNamespace(num_hidden_layers=num_layers),
+                  stage_layers=tuple(stage_layers))
 
 
 SINGLE = ParallelStrategy()

@@ -41,13 +41,35 @@ def _factorizations(n: int):
                 yield dp, tp, pp, cp
 
 
+def candidate_strategy(c: StrategyCandidate) -> "ParallelStrategy":
+    """StrategyCandidate -> the runtime ParallelStrategy it denotes (the
+    searcher's half of the mapping; BatchStrategyDispatcher._candidate is
+    the inverse direction)."""
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.parallel.strategy import ParallelStrategy
+    return ParallelStrategy(
+        mesh=MeshConfig(dp=c.dp, tp=c.tp, pp=c.pp, cp=c.cp),
+        sequence_parallel=c.sequence_parallel, zero=c.zero,
+        cp_tp_eff=c.cp_tp_eff)
+
+
 def search_strategy(cost: CostModel, num_devices: int,
                     max_tp: int = 8, max_pp: int = 8, max_cp: int = 8,
-                    topk: int = 5) -> List[Tuple[StrategyCandidate, float, float]]:
+                    topk: int = 5, model_cfg=None,
+                    pp_schedule: str = "gpipe",
+                    deterministic: bool = True,
+                    ) -> List[Tuple[StrategyCandidate, float, float]]:
     """Rank feasible candidates by predicted step time.
-    Returns [(candidate, time_s, mem_bytes)] best-first."""
+    Returns [(candidate, time_s, mem_bytes)] best-first.
+
+    Every candidate passes ParallelStrategy.validate (the engine-envelope
+    chokepoint) before costing, so the search can never emit a plan the
+    engines reject; pass model_cfg to also enforce the model-dependent
+    rules (head divisibility, MoE/ep, stage counts...)."""
+    from hetu_tpu.parallel.strategy import StrategyValidationError
     hbm = cost.hw.hbm_gbytes * 1e9 * 0.9  # headroom
     results = []
+    skipped = 0
     for dp, tp, pp, cp in _factorizations(num_devices):
         if tp > max_tp or pp > max_pp or cp > max_cp:
             continue
@@ -61,9 +83,22 @@ def search_strategy(cost: CostModel, num_devices: int,
                 c = StrategyCandidate(dp=dp, tp=tp, pp=pp, cp=cp,
                                       sequence_parallel=sp, zero=dp > 1,
                                       remat=remat, n_micro=n_micro)
+                try:
+                    candidate_strategy(c).validate(
+                        model_cfg, pp_schedule=pp_schedule, n_micro=n_micro,
+                        global_batch=cost.global_batch,
+                        seq_len=cost.seq_len, deterministic=deterministic)
+                except StrategyValidationError:
+                    skipped += 1
+                    continue
                 t, m = cost.evaluate(c)
                 if m <= hbm:
                     results.append((c, t, m))
+    if skipped:
+        from hetu_tpu.utils.logging import get_logger
+        get_logger("search").info(
+            f"search_strategy: {skipped} candidates outside the engine "
+            "envelope were skipped")
     results.sort(key=lambda r: r[1])
     return results[:topk]
 

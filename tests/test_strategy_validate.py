@@ -1,0 +1,175 @@
+"""ParallelStrategy.validate — the ONE plan-time envelope chokepoint.
+
+Every invalid combination must raise StrategyValidationError (a NAMED
+error) at plan time, from every planner entry point — never a trace-time
+surprise (reference bar: DeduceStates rejects invalid layouts at
+graph-build, hetu/graph/operator.h:425-594).
+"""
+import pytest
+
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.parallel.strategy import ParallelStrategy, StrategyValidationError
+from hetu_tpu.models.llama import LlamaConfig
+
+
+def _cfg(**kw):
+    return LlamaConfig.tiny(**kw)
+
+
+def _st(**kw):
+    mesh_kw = {k: kw.pop(k) for k in ("dp", "tp", "pp", "cp", "ep")
+               if k in kw}
+    return ParallelStrategy(mesh=MeshConfig(**mesh_kw), **kw)
+
+
+INVALID = [
+    # (strategy kwargs, validate kwargs, match fragment)
+    (dict(), dict(pp_schedule="bogus"), "pp_schedule"),
+    (dict(zero_stage=4), {}, "zero_stage"),
+    (dict(zero=False, zero_stage=2), {}, "requires zero=True"),
+    (dict(cp=2, cp_split="diagonal"), {}, "cp_split"),
+    # hetero CP ring shape rules
+    (dict(cp_tp_eff=(1,)), {}, "cp_tp_eff requires cp > 1"),
+    (dict(cp=2, tp=2, cp_tp_eff=(2,)), {}, "entries for cp"),
+    (dict(cp=2, tp=4, cp_tp_eff=(4, 3)), {}, "must divide mesh tp"),
+    # hetero-TP pipeline shape + composition rules
+    (dict(pp_tp_eff=(1,)), {}, "pp_tp_eff requires pp > 1"),
+    (dict(pp=2, tp=2, pp_tp_eff=(2,)), {}, "entries for pp"),
+    (dict(pp=2, tp=4, pp_tp_eff=(4, 3)), {}, "must divide mesh tp"),
+    (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(pp_schedule="1f1b"),
+     "GPipe schedule"),
+    (dict(pp=2, tp=2, pp_tp_eff=(2, 1), sequence_parallel=True), {},
+     "sequence_parallel"),
+    (dict(pp=2, tp=2, cp=2, pp_tp_eff=(2, 1)), {}, "cp=2 set"),
+    # batch divisibility
+    (dict(dp=2), dict(global_batch=7), "divide by dp"),
+    (dict(pp=2, dp=2), dict(n_micro=4, global_batch=12), "dp*n_micro"),
+    # CP data-layout divisibility
+    (dict(cp=2, cp_split="sym"), dict(seq_len=30), "2*cp"),
+    (dict(cp=4, cp_split="normal"), dict(seq_len=30), "'normal' CP split"),
+    (dict(cp=4, cp_split="stripe"), dict(seq_len=4), "stripe"),
+]
+
+MODEL_INVALID = [
+    # (strategy kwargs, model cfg kwargs, validate kwargs, match)
+    (dict(tp=4), {}, {}, "num_attention_heads"),   # tiny() has 4 q, 2 kv
+    (dict(tp=4, cp=1), dict(num_attention_heads=8), {},
+     "num_key_value_heads"),
+    (dict(cp=2, tp=2, cp_tp_eff=(2, 1)),
+     dict(num_attention_heads=9, num_key_value_heads=9), {},
+     "num_attention_heads=9"),
+    (dict(ep=2), {}, {}, "requires a MoE model"),
+    (dict(ep=4), dict(num_experts=6), {}, "divide by ep"),
+    (dict(pp=2), dict(use_scan=False), {}, "use_scan"),
+    (dict(pp=2), dict(num_hidden_layers=5), {}, "divide by"),
+    (dict(pp=2), {}, dict(stage_layers=(1, 2, 1)), "len pp"),
+    (dict(pp=2), {}, dict(stage_layers=(4, 0)), ">= 1"),
+    (dict(pp=2), {}, dict(stage_layers=(1, 2)), "sum to"),
+    (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(num_experts=4), {},
+     "dense blocks only"),
+    (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(hidden_dropout=0.1), {},
+     "dropout inside the hetero-TP pipeline"),
+    (dict(cp=2), dict(attention_dropout=0.1), {}, "ring attention"),
+    (dict(pp=2, tp=2), dict(num_experts=4), dict(pp_schedule="1f1b"),
+     "pp-only meshes"),
+]
+
+
+@pytest.mark.parametrize("st_kw,val_kw,match", INVALID)
+def test_mesh_rules_rejected(st_kw, val_kw, match):
+    with pytest.raises(StrategyValidationError) as ei:
+        _st(**st_kw).validate(None, **val_kw)
+    assert match in str(ei.value), (match, str(ei.value))
+
+
+@pytest.mark.parametrize("st_kw,cfg_kw,val_kw,match", MODEL_INVALID)
+def test_model_rules_rejected(st_kw, cfg_kw, val_kw, match):
+    cfg = _cfg(**cfg_kw)
+    with pytest.raises(StrategyValidationError):
+        _st(**st_kw).validate(cfg, **val_kw)
+
+
+def test_valid_plans_pass():
+    cfg = _cfg()
+    # the dryrun topologies' shapes all validate
+    _st(dp=2, tp=2, pp=2, sequence_parallel=True).validate(
+        cfg, n_micro=4, global_batch=16, seq_len=64)
+    _st(dp=2, tp=2, cp=2, sequence_parallel=True).validate(
+        cfg, seq_len=128)
+    _st(dp=2, tp=2, ep=2).validate(_cfg(num_experts=4))
+    _st(pp=2, tp=2, pp_tp_eff=(2, 1)).validate(cfg, n_micro=2)
+    _st(pp=2).validate(cfg, pp_schedule="1f1b", n_micro=4)
+    _st(pp=2).validate(_cfg(num_experts=2), pp_schedule="1f1b", n_micro=4)
+    # dropout rules relax for inference plans
+    _st(cp=2).validate(_cfg(attention_dropout=0.1), deterministic=True)
+    # validate returns self for chaining
+    st = _st(dp=2)
+    assert st.validate(cfg) is st
+
+
+def test_trainer_rejects_at_plan_time():
+    """The Trainer constructor (plan time) raises the named error — no
+    model init, no tracing."""
+    from hetu_tpu.engine.trainer import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaLMHeadModel
+    st = _st(pp=2, tp=2, pp_tp_eff=(2, 1))
+    model = LlamaLMHeadModel(_cfg(num_experts=4), st)
+    with pytest.raises(StrategyValidationError, match="dense blocks only"):
+        Trainer(model, TrainingConfig(global_batch_size=8,
+                                      micro_batch_size=1, seq_len=64),
+                strategy=st)
+
+
+def test_searcher_filters_envelope():
+    """Candidates outside the model envelope never surface from search."""
+    from hetu_tpu.search.cost_model import CostModel
+    from hetu_tpu.search.profiler import HardwareProfile
+    from hetu_tpu.search.searcher import search_strategy
+    hw = HardwareProfile()
+    cost = CostModel(hw, num_layers=4, hidden=64, intermediate=176,
+                     vocab=256, num_params=4_000_000, global_batch=32,
+                     seq_len=64)
+    # kv heads = 2: tp=4/8 plans are invalid for this model
+    res = search_strategy(cost, 8, model_cfg=_cfg(), topk=100)
+    assert res, "search returned no candidates"
+    assert all(c.tp <= 2 for c, _, _ in res)
+    # without the model config, tp=4 candidates appear (mesh-only rules)
+    res_any = search_strategy(cost, 8, topk=100)
+    assert any(c.tp > 2 for c, _, _ in res_any)
+
+
+def test_dispatcher_respects_envelope():
+    from hetu_tpu.engine.dispatch import BatchStrategyDispatcher
+    from hetu_tpu.search.cost_model import CostModel
+    from hetu_tpu.search.profiler import HardwareProfile
+    hw = HardwareProfile()
+    cost = CostModel(hw, num_layers=4, hidden=64, intermediate=176,
+                     vocab=256, num_params=4_000_000, global_batch=32,
+                     seq_len=64)
+    with pytest.raises(StrategyValidationError):
+        BatchStrategyDispatcher(cost, [_st(tp=4)], model_cfg=_cfg())
+    # a cp pool entry is skipped for a seq its split can't divide
+    disp = BatchStrategyDispatcher(cost, [_st(cp=4, cp_split="sym"),
+                                          _st(dp=1)], model_cfg=_cfg())
+    assert disp.choose([28] * 8) == 1   # 28 % 8 != 0 -> cp entry skipped
+    # the heuristic cost n_micro (2*pp) must NOT gate feasibility: a pp=2
+    # pool entry stays choosable for a batch of 6 (trainer runs n_micro=6)
+    disp_pp = BatchStrategyDispatcher(cost, [_st(pp=2)], model_cfg=_cfg())
+    assert disp_pp.choose([32] * 6) == 0
+    # deterministic default matches TrainingConfig: a dropout model config
+    # with a cp entry is a RUNNABLE pool under dropout_deterministic=True
+    BatchStrategyDispatcher(cost, [_st(cp=2)],
+                            model_cfg=_cfg(attention_dropout=0.1))
+    with pytest.raises(StrategyValidationError):
+        BatchStrategyDispatcher(cost, [_st(cp=2)],
+                                model_cfg=_cfg(attention_dropout=0.1),
+                                deterministic=False)
+
+
+def test_malleus_rejects_degenerate_balance():
+    """More stages than layers -> the chokepoint names the failure."""
+    from hetu_tpu.engine.malleus import MalleusPlanner, StragglerProfile
+    planner = MalleusPlanner(num_layers=2, tp=1, dp=1)
+    prof = StragglerProfile(speeds=[1.0, 1.0, 1.0, 1.0])
+    with pytest.raises((StrategyValidationError, ValueError)):
+        planner.plan(prof)

@@ -200,6 +200,12 @@ class GPTModel(Module):
                  strategy: Optional[ParallelStrategy] = None):
         super().__init__()
         strategy = strategy or ParallelStrategy()
+        if strategy.pp_tp_eff is not None:
+            # defense in depth behind ParallelStrategy.validate: no GPT
+            # hetero-TP block maker exists, and ignoring the request would
+            # silently run every stage at homogeneous TP
+            raise NotImplementedError(
+                "pp_tp_eff is implemented for the LLaMA family only")
         self.config, self.strategy = config, strategy
         c = config
         self.wte = VocabParallelEmbedding(

@@ -19,6 +19,10 @@ class LlamaConfig:
     max_position_embeddings: int = 4096
     rms_norm_eps: float = 1e-5
     rope_theta: float = 10000.0
+    # the LLaMA family has the hetero-TP pipeline block maker
+    # (parallel/hetero_pp.py llama_block_maker); ParallelStrategy.validate
+    # rejects pp_tp_eff for families without one
+    supports_hetero_tp: bool = True
     tie_word_embeddings: bool = False
     initializer_range: float = 0.02
     attention_dropout: float = 0.0

@@ -455,11 +455,12 @@ class LlamaLMHeadModel(Module):
         c, st = self.config, self.strategy
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
-        if st.pp_tp_eff is not None:
+        if st.pp_tp_eff is not None and (
+                c.num_experts > 0 or st.sequence_parallel or st.cp > 1
+                or rng is not None):
             raise NotImplementedError(
-                "per-stage hetero TP (pp_tp_eff) is only implemented on the "
-                "GPipe path (pp_schedule='gpipe'); the 1f1b schedule would "
-                "silently run all stages at homogeneous TP")
+                "pp_tp_eff under 1f1b composes with dense blocks, no SP, "
+                "cp=1, no dropout (same envelope as the GPipe hetero path)")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()
@@ -562,13 +563,34 @@ class LlamaLMHeadModel(Module):
                                    stage_layers)
         state_spec = st.pipeline_state_spec()
 
+        custom = None
+        if st.pp_tp_eff is not None:
+            # per-stage hetero TP: manual-(pp, tp) switch round bodies with
+            # the edges (vocab embedding, loss head) composed in auto mode
+            # (parallel/hetero_pp.py hetero_tp_1f1b_rounds)
+            from hetu_tpu.parallel.hetero_pp import (
+                hetero_tp_1f1b_rounds, llama_block_maker)
+
+            def embed_fn(ep_, ids_):
+                emb = self.model.embed(ep_["embed"], ids_)
+                return st.constrain(emb.astype(c.compute_dtype),
+                                    st.act_hidden())
+
+            custom = hetero_tp_1f1b_rounds(
+                llama_block_maker(c, cos, sin, tp=st.tp),
+                block.param_specs(), embed_fn, head_loss,
+                mesh=mesh, pp=st.pp, tp=st.tp, tp_eff=st.pp_tp_eff,
+                stage_layers=stage_layers, remat=c.remat,
+                remat_policy=c.remat_policy, compute_dtype=c.compute_dtype,
+                token_keys=tuple(ride.keys()))
+
         ce_sum, aux_sum, d_stage, d_edge = pipeline_train_1f1b(
             stage_fn, sp, ep, input_ids, labels, ride,
             n_micro=n_micro, mesh=mesh, hidden_size=c.hidden_size,
             compute_dtype=c.compute_dtype, aux_seed=count,
             state_spec=state_spec, loss_scale=loss_scale,
             skip_dead_halves=skip_dead_halves,
-            flags_extra=flags_extra or None)
+            flags_extra=flags_extra or None, custom_rounds=custom)
 
         d_layers = unstack_stage_grads(
             d_stage, c.num_hidden_layers, st.pp, stage_layers)

@@ -162,6 +162,127 @@ def _manual_specs(param_spec_tree, keep=("pp", "tp"), lead=("pp", None)):
                         is_leaf=lambda x: isinstance(x, ParamSpec))
 
 
+def _hetero_switch_stack(block_maker: Callable, param_ds_tree, mesh, *,
+                         pp: int, tp: int, tp_eff: Sequence[int],
+                         stage_layers: Sequence[int], remat: bool,
+                         remat_policy: str, token_keys=(),
+                         pp_axis: str = "pp", tp_axis: str = "tp"):
+    """shard_map'ed (stage_params, x_buf [pp, mb, s, h], tok_buf) ->
+    (y_buf, aux_row [pp]): manual over (pp, tp) with a `lax.switch` on the
+    stage index choosing that stage's static (tp_eff, layer-count) branch.
+    ONE builder shared by the GPipe hetero pipeline and the 1F1B hetero
+    round bodies."""
+
+    def stage_branch(stage_i: int):
+        e = tp_eff[stage_i]
+        m = tp // e
+        k_s = stage_layers[stage_i]
+        block = block_maker(e, m)
+
+        def run(sp1, x_mb, tok1):
+            def body(carry, lp):
+                x_c, aux_c = carry
+                out, aux = block(lp, x_c, tok1.get("position_ids"),
+                                 tok1.get("segment_ids"))
+                return (out, aux_c + aux), None
+
+            fn = body
+            if remat:
+                from hetu_tpu.nn.remat import remat_policy as _policy
+                fn = jax.checkpoint(body, policy=_policy(remat_policy))
+            sliced = jax.tree.map(lambda a: a[:k_s], sp1)
+            (y, aux), _ = lax.scan(
+                fn, (x_mb, jnp.zeros((), jnp.float32)), sliced)
+            return y, aux
+
+        return run
+
+    pspecs = _manual_specs(param_ds_tree, keep=(pp_axis, tp_axis),
+                           lead=(pp_axis, None))
+
+    def manual(sp, x_b, tok_b):
+        # local views: stage dim extent 1, weights local tp shards
+        sp1 = jax.tree.map(lambda a: a[0], sp)
+        tok1 = {k: v[0] for k, v in tok_b.items()}
+        p = lax.axis_index(pp_axis)
+        branches = [stage_branch(i) for i in range(pp)]
+        y, aux = lax.switch(p, branches, sp1, x_b[0], tok1)
+        return y[None], jnp.reshape(aux, (1,)).astype(jnp.float32)
+
+    Ppp = P(pp_axis)
+    return jax.shard_map(
+        manual, mesh=mesh,
+        in_specs=(pspecs, Ppp, {k: Ppp for k in token_keys}),
+        out_specs=(Ppp, Ppp),
+        axis_names=frozenset({pp_axis, tp_axis}), check_vma=True)
+
+
+def hetero_tp_1f1b_rounds(block_maker: Callable, param_ds_tree, embed_fn,
+                          head_fn, *, mesh, pp: int, tp: int,
+                          tp_eff: Sequence[int], stage_layers: Sequence[int],
+                          remat: bool, remat_policy: str, compute_dtype,
+                          token_keys=(), pp_axis: str = "pp",
+                          tp_axis: str = "tp"):
+    """(vfwd, vbwd) round bodies for `pipeline_train_1f1b(custom_rounds=...)`
+    running each stage at effective TP degree tp_eff[s].
+
+    Design: the decoder stack runs under the manual-(pp, tp) switch body
+    (_hetero_switch_stack), while the EDGES — the tp-sharded vocab embedding
+    and the loss head — run in auto (GSPMD) mode outside the manual region,
+    composed per round:
+
+        y = switch_stack(where(stage==0, embed(ids), x_in))
+        ce = head(y[last], labels)
+
+    That keeps the known partitioner crash (a sharded gather partitioned
+    inside a partial-manual region, see pipeline_1f1b.py skip_dead_halves)
+    out of the program: the embedding gather is a plain auto-mode op, and
+    the manual region contains only the block math the GPipe hetero path
+    already differentiates (topology-8 dryrun).  The backward round is a
+    `jax.vjp` of the composed round function, seeded with the engine's
+    per-stage cotangent rows — exact 1F1B semantics because the round
+    function is row-wise independent across stages.
+
+    embed_fn(edge_params, ids [mb, s]) -> [mb, s, h] hidden (auto mode);
+    head_fn(edge_params, y [mb, s, h], labels) -> summed CE scalar.
+    """
+    import numpy as np
+
+    vstack = _hetero_switch_stack(
+        block_maker, param_ds_tree, mesh, pp=pp, tp=tp, tp_eff=tp_eff,
+        stage_layers=stage_layers, remat=remat, remat_policy=remat_policy,
+        token_keys=token_keys, pp_axis=pp_axis, tp_axis=tp_axis)
+
+    first = jnp.asarray(np.arange(pp) == 0)
+    last_idx = pp - 1
+
+    def round_fn(sp, ep, x_in, feed_b, feed_s):
+        emb = embed_fn(ep, feed_b["ids"]).astype(compute_dtype)
+        x0 = jnp.where(first[:, None, None, None], emb[None], x_in)
+        y, aux_row = vstack(sp, x0, feed_s)
+        ce = head_fn(ep, y[last_idx], feed_b["labels"])
+        ce_row = jnp.zeros((pp,), jnp.float32).at[last_idx].set(
+            jnp.asarray(ce, jnp.float32))
+        return y, ce_row, aux_row
+
+    def vfwd(sp, ep, x, fb, fs, fl, fv):
+        return round_fn(sp, ep, x, fb, fs)
+
+    def vbwd(sp, ep, x, fb, fs, fl, dy, dce, daux, bv):
+        fn = lambda sp_, ep_, x_: round_fn(sp_, ep_, x_, fb, fs)
+        _, vjp = jax.vjp(fn, sp, ep, x)
+        dsp, dep, dx = vjp((dy, dce, daux))
+        # the engine accumulates edge grads with a leading pp dim (one row
+        # per stage); the composed round used the edges once — record the
+        # whole contribution on row 0
+        dep = jax.tree.map(
+            lambda g: jnp.zeros((pp,) + g.shape, jnp.float32)
+            .at[0].set(g.astype(jnp.float32)), dep)
+        return dsp, dep, dx
+
+    return vfwd, vbwd
+
+
 def staged_stack_forward_hetero_tp(
         block_maker: Callable, param_ds_tree, stack_params, x, *,
         num_layers: int, pp: int, tp: int, tp_eff: Sequence[int], mesh,
@@ -203,48 +324,10 @@ def staged_stack_forward_hetero_tp(
     xm = x.reshape(n_micro, mb, s, h)
     tok = {k: v.reshape(n_micro, mb, s) for k, v in token_data.items()}
 
-    pspecs = _manual_specs(param_ds_tree, keep=(pp_axis, tp_axis),
-                           lead=(pp_axis, None))
-
-    def stage_branch(stage_i: int):
-        e = tp_eff[stage_i]
-        m = tp // e
-        k_s = stage_layers[stage_i]
-        block = block_maker(e, m)
-
-        def run(sp1, x_mb, tok1):
-            def body(carry, lp):
-                x_c, aux_c = carry
-                out, aux = block(lp, x_c, tok1.get("position_ids"),
-                                 tok1.get("segment_ids"))
-                return (out, aux_c + aux), None
-
-            fn = body
-            if remat:
-                from hetu_tpu.nn.remat import remat_policy as _policy
-                fn = jax.checkpoint(body, policy=_policy(remat_policy))
-            sliced = jax.tree.map(lambda a: a[:k_s], sp1)
-            (y, aux), _ = lax.scan(
-                fn, (x_mb, jnp.zeros((), jnp.float32)), sliced)
-            return y, aux
-
-        return run
-
-    def manual(sp, x_b, tok_b):
-        # local views: stage dim extent 1, weights local tp shards
-        sp1 = jax.tree.map(lambda a: a[0], sp)
-        tok1 = {k: v[0] for k, v in tok_b.items()}
-        p = lax.axis_index(pp_axis)
-        branches = [stage_branch(i) for i in range(pp)]
-        y, aux = lax.switch(p, branches, sp1, x_b[0], tok1)
-        return y[None], jnp.reshape(aux, (1,)).astype(jnp.float32)
-
-    Ppp = P(pp_axis)
-    vbody = jax.shard_map(
-        manual, mesh=mesh,
-        in_specs=(pspecs, Ppp, {k: Ppp for k in token_data}),
-        out_specs=(Ppp, Ppp),
-        axis_names=frozenset({pp_axis, tp_axis}), check_vma=True)
+    vbody = _hetero_switch_stack(
+        block_maker, param_ds_tree, mesh, pp=pp, tp=tp, tp_eff=tp_eff,
+        stage_layers=stage_layers, remat=remat, remat_policy=remat_policy,
+        token_keys=tuple(token_data), pp_axis=pp_axis, tp_axis=tp_axis)
 
     def shift_in(new, state, sp=None):
         out = jnp.concatenate([new[None], state[:-1]], axis=0)

@@ -165,7 +165,8 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
                         compute_dtype, pp_axis: str = "pp",
                         aux_seed=1.0, state_spec: Optional[P] = None,
                         flags_extra: Optional[Dict] = None,
-                        loss_scale=1.0, skip_dead_halves="auto"):
+                        loss_scale=1.0, skip_dead_halves="auto",
+                        custom_rounds=None):
     """Run the 1F1B schedule and return loss pieces + gradients.
 
     stage_fn(stage_params_slice, edge_params, x_in, feed_bcast, feed_stage,
@@ -182,6 +183,13 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
       with each micro (positions/segments).
     aux_seed: d(total_loss)/d(aux) — the token count when the model folds
       aux losses as `aux * count` (must be computed from labels up front).
+    custom_rounds: optional (vfwd, vbwd) replacing the built-in round-body
+      realizations (vmap / shard_map) — used by the hetero-TP pipeline
+      (hetero_pp.hetero_tp_1f1b_rounds), whose stages need manual-(pp, tp)
+      switch bodies.  Signatures:
+        vfwd(sp, ep, x, feed_b, feed_s, flags, fv) -> (y, ce_row, aux_row)
+        vbwd(sp, ep, x, feed_b, feed_s, flags, dy, dce, daux, bv)
+          -> (d_stage, d_edge [pp-leading], dx)
 
     Returns (ce_sum, aux_sum, d_stage_params, d_edge_params).
     """
@@ -244,7 +252,9 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
         _, vjp = jax.vjp(fn, sp, ep, x_in)
         return vjp((dy, dce, daux))            # (d_stage, d_edge, dx)
 
-    if skip_dead_halves == "auto":
+    if custom_rounds is not None:
+        skip_dead_halves = False   # masked execution; bodies are external
+    elif skip_dead_halves == "auto":
         # the shard_map bodies trip an XLA SPMD-partitioner check-fail
         # (ExpandDeviceGroupsWithIota inside PartitionGather...) when a
         # SHARDED gather — the tp-vocab embedding — is partitioned inside
@@ -253,7 +263,9 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
         # realization until the upstream partitioner handles it
         skip_dead_halves = all(int(mesh.shape[a]) == 1
                                for a in mesh.axis_names if a != pp_axis)
-    if skip_dead_halves:
+    if custom_rounds is not None:
+        vfwd, vbwd = custom_rounds
+    elif skip_dead_halves:
         # shard_map manual over ONLY pp: each stage's dead schedule half
         # (warmup rounds have no backward work, cooldown rounds no forward)
         # is an UNTAKEN lax.cond branch, so the 2(pp-1) fill/drain rounds

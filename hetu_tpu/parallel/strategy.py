@@ -235,9 +235,10 @@ class ParallelStrategy:
                 if e < 1 or self.tp % e:
                     fail(f"cp_tp_eff entry {e} must divide mesh tp={self.tp}")
 
-        # hetero-TP pipeline: per-STAGE effective TP in one program.
+        # hetero-TP pipeline: per-STAGE effective TP in one program, on
+        # both schedules (GPipe switch bodies + 1f1b hetero round bodies).
         # Engine envelope (models/llama/model.py pp_tp_eff path +
-        # parallel/hetero_pp.py): GPipe only, dense blocks, no SP, cp=1.
+        # parallel/hetero_pp.py): dense blocks, no SP, cp=1, no dropout.
         if self.pp_tp_eff is not None:
             if self.pp <= 1:
                 fail("pp_tp_eff requires pp > 1")
@@ -247,10 +248,6 @@ class ParallelStrategy:
             for e in self.pp_tp_eff:
                 if e < 1 or self.tp % e:
                     fail(f"pp_tp_eff entry {e} must divide mesh tp={self.tp}")
-            if pp_schedule != "gpipe":
-                fail("pp_tp_eff is only implemented on the GPipe schedule "
-                     "(the 1f1b path would silently run all stages at "
-                     "homogeneous TP)")
             if self.sequence_parallel:
                 fail("pp_tp_eff composes with dense blocks, no SP, cp=1 "
                      "(sequence_parallel=True set)")
@@ -337,6 +334,10 @@ class ParallelStrategy:
                      f"pp={self.pp} (or pass stage_layers)")
 
         if self.pp_tp_eff is not None:
+            if not getattr(model_cfg, "supports_hetero_tp", False):
+                fail("pp_tp_eff needs a model family with a hetero-TP "
+                     "block maker (LLaMA); this one would silently run "
+                     "all stages at homogeneous TP")
             if n_experts > 0:
                 fail("pp_tp_eff composes with dense blocks only "
                      f"(num_experts={n_experts})")
@@ -348,14 +349,8 @@ class ParallelStrategy:
             fail(f"attention_dropout={attn_drop} inside ring attention "
                  "(cp > 1) is not implemented")
 
-        if pp_schedule == "1f1b" and self.pp > 1:
-            if not use_scan:
-                fail("1f1b requires use_scan=True")
-            if n_experts > 0 and any(
-                    a > 1 for a in (self.dp, self.tp, self.cp, self.ep)):
-                fail("MoE aux-loss routing under the 1f1b schedule is only "
-                     "supported on pp-only meshes (use gpipe on mixed "
-                     "meshes)")
+        if pp_schedule == "1f1b" and self.pp > 1 and not use_scan:
+            fail("1f1b requires use_scan=True")
         return self
 
     def describe(self) -> str:

@@ -29,6 +29,13 @@ class StrategyCandidate:
     # Carries the bandwidth price parallel/ring_attention.py documents:
     # the rotating KV buffer is padded to the widest member.
     cp_tp_eff: Optional[tuple] = None
+    # pipeline schedule (parallel/pipeline.py GPipe scan vs
+    # pipeline_1f1b.py PipeDream-flush).  The trade the model captures:
+    # 1f1b stores O(pp) stage inputs instead of O(n_micro), but on MIXED
+    # meshes its vmap realization pays (pp-1) extra full rounds (the
+    # cond-skipping shard_map bodies are pp-only — see pipeline_1f1b.py
+    # skip_dead_halves)
+    pp_schedule: str = "gpipe"
 
     @property
     def num_devices(self):
@@ -46,7 +53,14 @@ class StrategyCandidate:
             bits.append("zero1")
         if self.remat:
             bits.append("rc")
+        if self.pp > 1 and self.pp_schedule != "gpipe":
+            bits.append(self.pp_schedule)
         return "x".join(bits) or "single"
+
+    @property
+    def pp_only(self) -> bool:
+        """pp is the sole >1 mesh axis (the dead-half-skipping envelope)."""
+        return self.pp > 1 and self.dp == 1 and self.tp == 1 and self.cp == 1
 
 
 @dataclasses.dataclass
@@ -159,7 +173,15 @@ class CostModel:
             busy = compute + t_comm + t_dp
         if c.pp > 1:
             m = max(c.n_micro, c.pp)
-            busy *= (m + c.pp - 1) / m
+            if c.pp_schedule == "1f1b" and not c.pp_only:
+                # vmap realization on mixed meshes: every one of the
+                # m + 2(pp-1) lockstep rounds runs BOTH halves (masked),
+                # so fill/drain rounds cost full F+B instead of one half
+                busy *= (m + 2 * (c.pp - 1)) / m
+            else:
+                # GPipe scan / 1f1b with dead-half skipping (pp-only):
+                # the true PipeDream-flush makespan (m + pp - 1)(F + B)
+                busy *= (m + c.pp - 1) / m
         return busy
 
     # ---------------- memory ----------------
@@ -181,7 +203,16 @@ class CostModel:
         else:
             acts = act_per_layer * layers_local * self.act_full_units
         if c.pp > 1:
-            acts *= min(c.n_micro, c.pp)  # in-flight micros
+            m = max(c.n_micro, c.pp)
+            if c.pp_schedule == "1f1b":
+                # O(pp), independent of n_micro (pipeline_1f1b.py ring
+                # buffer): 2pp-1 saved stage INPUTS (one micro's boundary
+                # each) + one micro's live layer activations inside the
+                # round's recompute-vjp
+                mb_boundary = act_per_layer / m
+                acts = mb_boundary * (2 * c.pp - 1) + acts / m
+            else:
+                acts *= min(c.n_micro, c.pp)  # in-flight micros
         logits = b_local * seq_local * self.vocab * 4 / max(c.tp, 1)
         return params + opt + grads + acts + logits
 

@@ -56,8 +56,9 @@ def candidate_strategy(c: StrategyCandidate) -> "ParallelStrategy":
 def search_strategy(cost: CostModel, num_devices: int,
                     max_tp: int = 8, max_pp: int = 8, max_cp: int = 8,
                     topk: int = 5, model_cfg=None,
-                    pp_schedule: str = "gpipe",
+                    pp_schedule: str = "auto",
                     deterministic: bool = True,
+                    n_micro: Optional[int] = None,
                     ) -> List[Tuple[StrategyCandidate, float, float]]:
     """Rank feasible candidates by predicted step time.
     Returns [(candidate, time_s, mem_bytes)] best-first.
@@ -65,7 +66,12 @@ def search_strategy(cost: CostModel, num_devices: int,
     Every candidate passes ParallelStrategy.validate (the engine-envelope
     chokepoint) before costing, so the search can never emit a plan the
     engines reject; pass model_cfg to also enforce the model-dependent
-    rules (head divisibility, MoE/ep, stage counts...)."""
+    rules (head divisibility, MoE/ep, stage counts...).
+
+    pp_schedule: "auto" scores BOTH schedules per pipeline candidate and
+    lets the cost model pick on merit (gpipe's O(n_micro) memory vs
+    1f1b's O(pp) memory and mixed-mesh round penalty); or pin "gpipe" /
+    "1f1b".  n_micro: pin the micro count (None = the 2*pp heuristic)."""
     from hetu_tpu.parallel.strategy import StrategyValidationError
     hbm = cost.hw.hbm_gbytes * 1e9 * 0.9  # headroom
     results = []
@@ -77,29 +83,37 @@ def search_strategy(cost: CostModel, num_devices: int,
             continue
         if cost.global_batch % max(dp * cp, 1):
             continue
+        schedules = (("gpipe", "1f1b") if pp > 1 and pp_schedule == "auto"
+                     else (pp_schedule if pp > 1 else "gpipe",))
         for sp in ((True, False) if tp > 1 else (False,)):
             for remat in (True, False):
-                n_micro = max(2 * pp, 1) if pp > 1 else 1
-                c = StrategyCandidate(dp=dp, tp=tp, pp=pp, cp=cp,
-                                      sequence_parallel=sp, zero=dp > 1,
-                                      remat=remat, n_micro=n_micro)
-                try:
-                    candidate_strategy(c).validate(
-                        model_cfg, pp_schedule=pp_schedule, n_micro=n_micro,
-                        global_batch=cost.global_batch,
-                        seq_len=cost.seq_len, deterministic=deterministic)
-                except StrategyValidationError:
-                    skipped += 1
-                    continue
-                t, m = cost.evaluate(c)
-                if m <= hbm:
-                    results.append((c, t, m))
+                for sched in schedules:
+                    nm = n_micro if n_micro is not None else \
+                        (max(2 * pp, 1) if pp > 1 else 1)
+                    c = StrategyCandidate(dp=dp, tp=tp, pp=pp, cp=cp,
+                                          sequence_parallel=sp, zero=dp > 1,
+                                          remat=remat, n_micro=nm,
+                                          pp_schedule=sched)
+                    try:
+                        candidate_strategy(c).validate(
+                            model_cfg, pp_schedule=sched, n_micro=nm,
+                            global_batch=cost.global_batch,
+                            seq_len=cost.seq_len,
+                            deterministic=deterministic)
+                    except StrategyValidationError:
+                        skipped += 1
+                        continue
+                    t, m = cost.evaluate(c)
+                    if m <= hbm:
+                        results.append((c, t, m))
     if skipped:
         from hetu_tpu.utils.logging import get_logger
         get_logger("search").info(
             f"search_strategy: {skipped} candidates outside the engine "
             "envelope were skipped")
-    results.sort(key=lambda r: r[1])
+    # memory breaks time ties (e.g. gpipe vs 1f1b on a pp-only mesh run
+    # the same (m+pp-1) makespan — prefer the O(pp)-memory schedule)
+    results.sort(key=lambda r: (r[1], r[2]))
     return results[:topk]
 
 

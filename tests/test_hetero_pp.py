@@ -134,10 +134,11 @@ def test_full_train_step_driver_envelope():
         assert bool(jnp.isfinite(loss))
 
 
-def test_1f1b_rejects_pp_tp_eff():
-    """pp_tp_eff is a GPipe-path feature; the 1f1b schedule must refuse it
-    loudly instead of silently running homogeneous TP."""
-    cfg = _cfg()
+def test_1f1b_pp_tp_eff_envelope():
+    """pp_tp_eff under 1f1b runs (test_pipeline_1f1b.test_1f1b_hetero_tp
+    is the parity test) but keeps the hetero envelope: SP/cp/MoE/dropout
+    compositions must refuse loudly."""
+    cfg = _cfg(num_experts=2)
     st = ParallelStrategy(mesh=MeshConfig(pp=2, tp=2), pp_tp_eff=(2, 1))
     model = LlamaLMHeadModel(cfg, st)
     ids = _ids()

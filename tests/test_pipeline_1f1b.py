@@ -219,6 +219,51 @@ def test_1f1b_moe_aux_on_pp_only_mesh():
             ParallelStrategy(mesh=MeshConfig(pp=2)), n_micro=4)
 
 
+@pytest.mark.slow
+def test_1f1b_cp_ring():
+    """1f1b + CP ring attention: the ring's shard_map nests inside the
+    vmap(spmd_axis_name='pp') round bodies exactly as in the GPipe path
+    (pipeline.py:316), so cp>1 composes with the PipeDream-flush schedule."""
+    _parity(LlamaConfig.tiny(num_hidden_layers=4, **_BASE),
+            ParallelStrategy(mesh=MeshConfig(pp=2, cp=2)), n_micro=4, s=64)
+
+
+@pytest.mark.slow
+def test_1f1b_moe_mixed_mesh():
+    """MoE router aux under 1f1b on a MIXED mesh (pp x tp) — the aux
+    accumulation and expert dispatch must survive the vmap realization,
+    not just the pp-only shard_map bodies."""
+    _parity(LlamaConfig.tiny(num_experts=4, **_BASE),
+            ParallelStrategy(mesh=MeshConfig(pp=2, tp=2)), n_micro=4)
+
+
+@pytest.mark.slow
+def test_1f1b_moe_cp_dp_mixed_mesh():
+    """The widest 1f1b composition: MoE + CP ring + DP on one mesh."""
+    _parity(LlamaConfig.tiny(num_experts=2, num_hidden_layers=4, **_BASE),
+            ParallelStrategy(mesh=MeshConfig(dp=2, pp=2, cp=2)),
+            n_micro=2, s=64)
+
+
+@pytest.mark.slow
+def test_1f1b_hetero_tp():
+    """pp_tp_eff under 1f1b: stage 0 at tp=2, stage 1 at effective tp=1,
+    on a dp2 x pp2 x tp2 mesh — parity against the GPipe hetero path
+    (which is itself golden-parity tested)."""
+    _parity(LlamaConfig.tiny(**_BASE),
+            ParallelStrategy(mesh=MeshConfig(dp=2, pp=2, tp=2),
+                             pp_tp_eff=(2, 1)), n_micro=4)
+
+
+@pytest.mark.slow
+def test_1f1b_hetero_tp_uneven_stages():
+    """pp_tp_eff + uneven Malleus stage layers under 1f1b in one program."""
+    _parity(LlamaConfig.tiny(num_hidden_layers=4,
+                             pipeline_stage_layers=(3, 1), **_BASE),
+            ParallelStrategy(mesh=MeshConfig(pp=2, tp=2),
+                             pp_tp_eff=(2, 1)), n_micro=4)
+
+
 def test_gpt_1f1b_grads_match_gpipe():
     """GPT-family 1f1b parity with the GPipe autodiff path (tied head,
     wpe positions inside stage 0)."""

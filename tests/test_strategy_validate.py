@@ -36,8 +36,6 @@ INVALID = [
     (dict(pp_tp_eff=(1,)), {}, "pp_tp_eff requires pp > 1"),
     (dict(pp=2, tp=2, pp_tp_eff=(2,)), {}, "entries for pp"),
     (dict(pp=2, tp=4, pp_tp_eff=(4, 3)), {}, "must divide mesh tp"),
-    (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(pp_schedule="1f1b"),
-     "GPipe schedule"),
     (dict(pp=2, tp=2, pp_tp_eff=(2, 1), sequence_parallel=True), {},
      "sequence_parallel"),
     (dict(pp=2, tp=2, cp=2, pp_tp_eff=(2, 1)), {}, "cp=2 set"),
@@ -70,9 +68,19 @@ MODEL_INVALID = [
     (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(hidden_dropout=0.1), {},
      "dropout inside the hetero-TP pipeline"),
     (dict(cp=2), dict(attention_dropout=0.1), {}, "ring attention"),
-    (dict(pp=2, tp=2), dict(num_experts=4), dict(pp_schedule="1f1b"),
-     "pp-only meshes"),
 ]
+
+
+def test_pp_tp_eff_needs_hetero_capable_family():
+    """GPT has no hetero-TP block maker: the chokepoint (and the model
+    constructor, defense in depth) must refuse pp_tp_eff instead of
+    silently running homogeneous TP."""
+    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    st = _st(pp=2, tp=2, pp_tp_eff=(2, 1))
+    with pytest.raises(StrategyValidationError, match="hetero-TP"):
+        st.validate(GPTConfig.tiny())
+    with pytest.raises(NotImplementedError, match="LLaMA"):
+        GPTLMHeadModel(GPTConfig.tiny(), st)
 
 
 @pytest.mark.parametrize("st_kw,val_kw,match", INVALID)
@@ -98,8 +106,17 @@ def test_valid_plans_pass():
         cfg, seq_len=128)
     _st(dp=2, tp=2, ep=2).validate(_cfg(num_experts=4))
     _st(pp=2, tp=2, pp_tp_eff=(2, 1)).validate(cfg, n_micro=2)
+    # hetero-TP now runs under BOTH schedules (hetero_tp_1f1b_rounds)
+    _st(pp=2, tp=2, pp_tp_eff=(2, 1)).validate(cfg, pp_schedule="1f1b",
+                                               n_micro=2)
     _st(pp=2).validate(cfg, pp_schedule="1f1b", n_micro=4)
     _st(pp=2).validate(_cfg(num_experts=2), pp_schedule="1f1b", n_micro=4)
+    # 1f1b composes with CP rings and with MoE on mixed meshes (the vmap
+    # realization; test_pipeline_1f1b golden-parity tests)
+    _st(pp=2, cp=2).validate(cfg, pp_schedule="1f1b", n_micro=4,
+                             seq_len=128)
+    _st(pp=2, tp=2).validate(_cfg(num_experts=4), pp_schedule="1f1b",
+                             n_micro=4)
     # dropout rules relax for inference plans
     _st(cp=2).validate(_cfg(attention_dropout=0.1), deterministic=True)
     # validate returns self for chaining

@@ -385,7 +385,6 @@ class Trainer:
         GetCUDAProfiler).  AOT lower().compile() does NOT share jit's
         dispatch cache, so the first call per batch shape pays one full XLA
         compile; results are memoized per shape here."""
-        batches = self.prepare_batch(host_batch)
         key = tuple(sorted((k, tuple(v.shape))
                            for k, v in host_batch.items()))
         cache = getattr(self, "_memory_reports", None)
@@ -393,12 +392,7 @@ class Trainer:
             cache = self._memory_reports = {}
         if key in cache:
             return cache[key]
-        rng = jax.random.key(0)
-        with use_mesh(self.mesh), self._declared():
-            compiled = self._step_fn.lower(
-                self.params, self.opt_state, batches, rng,
-                self.scaler_state).compile()
-        mem = compiled.memory_analysis()
+        mem = self._compiled_for_shape(host_batch, key).memory_analysis()
         out = {}
         for k in ("argument_size_in_bytes", "output_size_in_bytes",
                   "temp_size_in_bytes", "alias_size_in_bytes",
@@ -411,6 +405,38 @@ class Trainer:
                                 + out.get("temp_size", 0))
         cache[key] = out
         return out
+
+    def _compiled_for_shape(self, host_batch, key):
+        """AOT lower().compile() of the step for this batch shape — ONE
+        compile shared by memory_report and phase_report (it does not
+        share jit's dispatch cache, so it costs a full XLA compile)."""
+        cache = getattr(self, "_compiled_steps", None)
+        if cache is None:
+            cache = self._compiled_steps = {}
+        if key not in cache:
+            batches = self.prepare_batch(host_batch)
+            rng = jax.random.key(0)
+            with use_mesh(self.mesh), self._declared():
+                cache[key] = self._step_fn.lower(
+                    self.params, self.opt_state, batches, rng,
+                    self.scaler_state).compile()
+        return cache[key]
+
+    def phase_report(self, host_batch: Dict[str, np.ndarray]):
+        """Per-phase (embed/attn/moe/mlp/lm_head) attribution of the
+        compiled train step from the named-scope HLO metadata — the
+        reference's per-op cost records (profiler.h:25), hardware-free.
+        Pairs with memory_report (shares its one AOT compile per shape)."""
+        from hetu_tpu.utils.profiling import phase_breakdown
+        key = tuple(sorted((k, tuple(v.shape))
+                           for k, v in host_batch.items()))
+        cache = getattr(self, "_phase_reports", None)
+        if cache is None:
+            cache = self._phase_reports = {}
+        if key not in cache:
+            cache[key] = phase_breakdown(
+                self._compiled_for_shape(host_batch, key))
+        return cache[key]
 
     def train_step(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         batches = self.prepare_batch(host_batch)

@@ -179,14 +179,17 @@ class GPTBlock(Module):
     def forward(self, params, x, *, position_ids=None, segment_ids=None,
                 rng=None, deterministic=True):
         c = self.config
-        h = self.attn(params["attn"], self.ln1(params["ln1"], x),
-                      position_ids=position_ids, segment_ids=segment_ids,
-                      rng=rng, deterministic=deterministic)
+        # phase scopes for HLO/trace attribution (see LlamaBlock.forward)
+        with jax.named_scope("attn"):
+            h = self.attn(params["attn"], self.ln1(params["ln1"], x),
+                          position_ids=position_ids, segment_ids=segment_ids,
+                          rng=rng, deterministic=deterministic)
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 2),
                             deterministic)
         x = x + h
-        h = self.mlp(params["mlp"], self.ln2(params["ln2"], x))
+        with jax.named_scope("mlp"):
+            h = self.mlp(params["mlp"], self.ln2(params["ln2"], x))
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 3),
                             deterministic)
@@ -240,10 +243,11 @@ class GPTModel(Module):
         b, s = input_ids.shape
         pos = position_ids if position_ids is not None else \
             jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32), (b, s))
-        x = self.wte(params["wte"], input_ids)
-        x = x + jnp.take(params["wpe"], pos, axis=0)
-        x = x.astype(c.compute_dtype)
-        x = st.constrain(x, st.act_hidden())
+        with jax.named_scope("embed"):
+            x = self.wte(params["wte"], input_ids)
+            x = x + jnp.take(params["wpe"], pos, axis=0)
+            x = x.astype(c.compute_dtype)
+            x = st.constrain(x, st.act_hidden())
 
         use_drop = not deterministic and rng is not None
         if st.pp > 1:
@@ -329,12 +333,13 @@ class GPTLMHeadModel(Module):
     def logits(self, params, hidden):
         """hidden -> logits via the tied/untied head (one implementation
         for the training forward AND the generation decode paths)."""
-        if self.config.tie_word_embeddings:
-            w = params["model"]["wte"]["weight"].astype(hidden.dtype).T
-        else:
-            w = params["lm_head"].astype(hidden.dtype)
-        return self.strategy.constrain(hidden @ w,
-                                       self.strategy.act_logits())
+        with jax.named_scope("lm_head"):
+            if self.config.tie_word_embeddings:
+                w = params["model"]["wte"]["weight"].astype(hidden.dtype).T
+            else:
+                w = params["lm_head"].astype(hidden.dtype)
+            return self.strategy.constrain(hidden @ w,
+                                           self.strategy.act_logits())
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, loss_reduction: str = "mean", rng=None,

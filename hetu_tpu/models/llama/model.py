@@ -169,22 +169,29 @@ class LlamaBlock(Module):
                 segment_ids=None, rng=None, deterministic=True,
                 token_ids=None):
         c = self.config
-        h = self.attn(params["attn"],
-                      self.input_norm(params["input_norm"], x),
-                      cos=cos, sin=sin, position_ids=position_ids,
-                      segment_ids=segment_ids, rng=rng,
-                      deterministic=deterministic)
+        # named phase scopes survive into the optimized HLO metadata and
+        # profiler traces (utils/profiling.py phase_breakdown reads them;
+        # reference: impl/profiler/profiler.h:25 per-op cost attribution)
+        with jax.named_scope("attn"):
+            h = self.attn(params["attn"],
+                          self.input_norm(params["input_norm"], x),
+                          cos=cos, sin=sin, position_ids=position_ids,
+                          segment_ids=segment_ids, rng=rng,
+                          deterministic=deterministic)
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 2),
                             deterministic)
         x = x + h
         aux = jnp.zeros((), jnp.float32)
         if c.num_experts > 0:
-            h, aux = self.mlp(params["mlp"],
-                              self.post_norm(params["post_norm"], x),
-                              token_ids=token_ids)
+            with jax.named_scope("moe"):
+                h, aux = self.mlp(params["mlp"],
+                                  self.post_norm(params["post_norm"], x),
+                                  token_ids=token_ids)
         else:
-            h = self.mlp(params["mlp"], self.post_norm(params["post_norm"], x))
+            with jax.named_scope("mlp"):
+                h = self.mlp(params["mlp"],
+                             self.post_norm(params["post_norm"], x))
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 3),
                             deterministic)
@@ -343,8 +350,9 @@ class LlamaModel(Module):
                 segment_ids=None, rng=None, deterministic=True,
                 n_micro=None):
         c, st = self.config, self.strategy
-        x = self.embed(params["embed"], input_ids).astype(c.compute_dtype)
-        x = st.constrain(x, st.act_hidden())
+        with jax.named_scope("embed"):
+            x = self.embed(params["embed"], input_ids).astype(c.compute_dtype)
+            x = st.constrain(x, st.act_hidden())
         cos, sin = ops.build_rope_cache(
             c.max_position_embeddings, c.head_dim, c.rope_theta,
             dtype=jnp.float32)
@@ -382,12 +390,14 @@ class LlamaLMHeadModel(Module):
 
     def logits(self, params, hidden):
         c = self.config
-        if c.tie_word_embeddings:
-            w = params["model"]["embed"]["weight"].astype(hidden.dtype).T
-        else:
-            w = params["lm_head"].astype(hidden.dtype)
-        logits = hidden @ w
-        return self.strategy.constrain(logits, self.strategy.act_logits())
+        with jax.named_scope("lm_head"):
+            if c.tie_word_embeddings:
+                w = params["model"]["embed"]["weight"].astype(hidden.dtype).T
+            else:
+                w = params["lm_head"].astype(hidden.dtype)
+            logits = hidden @ w
+            return self.strategy.constrain(logits,
+                                           self.strategy.act_logits())
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, rng=None, deterministic=True,

@@ -47,9 +47,15 @@ def _dense_bytes(vocab: int, dim: int, dtype_bytes: int = 4) -> int:
 class QuantizedEmbedding:
     """int8/int4 storage with one absmax scale per row block.
 
-    `compress(table)` -> params; `lookup(params, ids)` dequantizes only the
-    gathered rows (memory stays compressed end to end).  `fake_quant`
-    builds the straight-through estimator for quantization-aware training:
+    `compress(table)` -> params; `lookup(params, ids)` GATHERS the rows'
+    quantized blocks + scales first and dequantizes only the gathered
+    slice, so compiled temporaries stay O(batch*dim) — never the dense
+    (vocab, dim) table (the point of the method for multi-GB tables;
+    reference: EmbeddingMemoryCompression/methods/layers/quantize.py
+    dequantizes gathered rows).  Blocks are row-aligned: the effective
+    block size is the largest divisor of embedding_dim <= block_size, so
+    every row owns whole blocks and gathers cleanly.  `fake_quant` builds
+    the straight-through estimator for quantization-aware training:
     fwd quantize->dequantize, bwd identity (ALPT's learned-scale variant
     degenerates to absmax here)."""
     num_embeddings: int
@@ -57,17 +63,38 @@ class QuantizedEmbedding:
     bits: int = 8
     block_size: int = 64
 
+    def __post_init__(self):
+        if self.bits == 4 and self.embedding_dim % 2:
+            raise ValueError(
+                f"int4 packs two nibbles per byte: embedding_dim="
+                f"{self.embedding_dim} must be even")
+        bs = min(self.block_size, self.embedding_dim)
+        while self.embedding_dim % bs or (self.bits == 4 and bs % 2):
+            bs -= 1
+        self._bs = bs
+
     def compress(self, table: jnp.ndarray):
         assert table.shape == (self.num_embeddings, self.embedding_dim)
+        v, d, bs = self.num_embeddings, self.embedding_dim, self._bs
+        nb = d // bs
         qfn = quantize_int8 if self.bits == 8 else quantize_int4
-        q, scale = qfn(table, self.block_size)
-        return {"q": q, "scale": scale}
+        q, scale = qfn(table, bs)       # row-aligned: flat blocks = v*nb
+        # store per-row block structure so lookup can gather rows
+        q = q.reshape((v, nb) + q.shape[1:])
+        return {"q": q, "scale": scale.reshape(v, nb)}
 
     def lookup(self, params, ids: jnp.ndarray) -> jnp.ndarray:
-        shape = (self.num_embeddings, self.embedding_dim)
-        dq = (dequantize_int8 if self.bits == 8 else dequantize_int4)(
-            params["q"], params["scale"], shape)
-        return jnp.take(dq, ids, axis=0)
+        qr = jnp.take(params["q"], ids, axis=0)        # [..., nb, bs(/2)]
+        sr = jnp.take(params["scale"], ids, axis=0)    # [..., nb]
+        if self.bits == 8:
+            vals = qr.astype(jnp.float32) * sr[..., None]
+        else:
+            lo = (qr & 0xF).astype(jnp.int32) - 8
+            hi = ((qr >> 4) & 0xF).astype(jnp.int32) - 8
+            nib = jnp.stack([lo, hi], axis=-1).reshape(qr.shape[:-1]
+                                                       + (self._bs,))
+            vals = nib.astype(jnp.float32) * sr[..., None]
+        return vals.reshape(ids.shape + (self.embedding_dim,))
 
     def fake_quant(self, table: jnp.ndarray) -> jnp.ndarray:
         qfn = quantize_int8 if self.bits == 8 else quantize_int4
@@ -75,7 +102,7 @@ class QuantizedEmbedding:
 
         @jax.custom_vjp
         def ste(t):
-            q, s = qfn(t, self.block_size)
+            q, s = qfn(t, self._bs)
             return dqfn(q, s, t.shape)
 
         ste.defvjp(lambda t: (ste(t), None), lambda _, g: (g,))
@@ -83,7 +110,7 @@ class QuantizedEmbedding:
 
     def memory(self) -> int:
         n = self.num_embeddings * self.embedding_dim
-        blocks = -(-n // self.block_size)
+        blocks = n // self._bs
         return n * self.bits // 8 + blocks * 4
 
     def compression(self) -> float:

@@ -97,3 +97,64 @@ class StepProfiler:
         ts = sorted(self._times)
         return {"steps": len(ts), "min_s": ts[0],
                 "median_s": ts[len(ts) // 2], "max_s": ts[-1]}
+
+
+# ---------------------------------------------------------------------------
+# per-phase HLO attribution (reference: hetu/impl/profiler/profiler.h:25
+# per-op cost records + HETU_EVENT_TIMING executable_graph.cc:1303)
+# ---------------------------------------------------------------------------
+
+PHASES = ("embed", "attn", "moe", "mlp", "lm_head", "ring")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1,
+                "f8e5m2": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4,
+                "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1, "c64": 8,
+                "c128": 16}
+
+
+def phase_breakdown(compiled_or_text, phases=PHASES):
+    """Attribute the optimized HLO's instructions to the model's
+    jax.named_scope phases (models annotate embed/attn/moe/mlp/lm_head).
+
+    The scopes survive into instruction metadata (op_name="jit(f)/.../attn/
+    dot_general"), INCLUDING the autodiff transpose ops, so forward and
+    backward both attribute.  Returns {phase: {"instructions", "dots",
+    "out_bytes"}} plus an "other" bucket — a hardware-free compute-split
+    estimate (dots ~ MXU work, out_bytes ~ HBM traffic) that calibrates the
+    cost model's per-phase terms; a jax.profiler trace over the same step
+    shows the identical scope names on the timeline for wall-clock truth."""
+    import re
+
+    txt = (compiled_or_text if isinstance(compiled_or_text, str)
+           else compiled_or_text.as_text())
+    op_pat = re.compile(r'op_name="([^"]+)"')
+    shape_pat = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
+    # a scope segment may be wrapped by transform names — "attn",
+    # "jvp(embed)", "transpose(jvp(mlp))" — so match the phase bounded by
+    # path separators or transform parens
+    seg_pats = {p: re.compile(r'(?:^|[/(])' + re.escape(p) + r'(?:[)/]|$)')
+                for p in phases}
+    out = {p: {"instructions": 0, "dots": 0, "out_bytes": 0}
+           for p in (*phases, "other")}
+    for line in txt.splitlines():
+        m = op_pat.search(line)
+        if m is None:
+            continue
+        opname = m.group(1)
+        seg = next((p for p in phases if seg_pats[p].search(opname)),
+                   "other")
+        rec = out[seg]
+        rec["instructions"] += 1
+        if " dot(" in line or " convolution(" in line:
+            rec["dots"] += 1
+        # output shape(s): scalar `= f32[8,16]{...}` or tuple-shaped
+        # multi-output fusions `= (f32[8,128]{...}, f32[8]{...})` — HLO
+        # text carries shapes only on the output side, so summing every
+        # shape token on the line attributes all components
+        for dt, dims in shape_pat.findall(line):
+            numel = 1
+            for d in dims.split(","):
+                if d:
+                    numel *= int(d)
+            rec["out_bytes"] += numel * _DTYPE_BYTES.get(dt, 4)
+    return out

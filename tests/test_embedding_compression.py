@@ -96,3 +96,39 @@ def test_dedup_embedding_groups_duplicates():
     ref = jnp.take(jnp.asarray(table), _ids(), axis=0)
     assert float(jnp.max(jnp.abs(out - ref))) < 1e-3
     assert emb.compression_of(params) > 5
+
+
+@pytest.mark.parametrize("bits", [8, 4])
+def test_quantized_lookup_memory_stays_compressed(bits):
+    """The lookup gathers quantized blocks THEN dequantizes: compiled
+    temporaries must stay O(batch*dim), never the dense (vocab, dim)
+    table (the docstring's promise; reference quantize.py dequantizes
+    gathered rows)."""
+    V2, D2 = 16384, 64
+    emb = QuantizedEmbedding(V2, D2, bits=bits, block_size=32)
+    table = jnp.asarray(np.random.default_rng(0).normal(
+        0, 0.05, size=(V2, D2)), jnp.float32)
+    params = emb.compress(table)
+    ids = _ids(n=64) % V2
+    out = emb.lookup(params, ids)
+    ref = jnp.take(table, ids, axis=0)
+    tol = 5e-3 if bits == 8 else 5e-2
+    assert float(jnp.max(jnp.abs(out - ref))) < tol
+    dense_bytes = V2 * D2 * 4
+    ma = jax.jit(emb.lookup).lower(params, ids).compile().memory_analysis()
+    assert ma.temp_size_in_bytes < dense_bytes / 8, (
+        f"lookup materializes {ma.temp_size_in_bytes} temp bytes "
+        f"(dense table = {dense_bytes})")
+
+
+def test_quantized_odd_dim_block_alignment():
+    """embedding_dim not divisible by block_size: the effective block size
+    falls back to a divisor so rows still own whole blocks."""
+    emb = QuantizedEmbedding(100, 48, bits=8, block_size=32)
+    assert 48 % emb._bs == 0
+    table = jnp.asarray(np.random.default_rng(2).normal(
+        0, 0.05, size=(100, 48)), jnp.float32)
+    params = emb.compress(table)
+    ids = jnp.asarray([0, 7, 99], jnp.int32)
+    out = emb.lookup(params, ids)
+    assert float(jnp.max(jnp.abs(out - jnp.take(table, ids, axis=0)))) < 5e-3

@@ -258,3 +258,30 @@ def test_evaluate_multibucket_plan_pool():
     m = t.evaluate([batch(64), batch(32), batch(64), batch(32)])
     assert np.isfinite(m["loss"]) and m["tokens"] > 0
     assert t._eval_fn.num_plans == 2
+
+
+def test_phase_report_attribution():
+    """phase_report attributes the compiled step's HLO to the model's
+    named scopes (embed/attn/mlp/lm_head): every phase must carry
+    instructions, attn+mlp must carry the dot work (fwd AND transpose/bwd
+    ops keep the scope in op_name)."""
+    import numpy as np
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.data import pad_batch
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+
+    st = ParallelStrategy(mesh=MeshConfig(dp=2, tp=2))
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=2, seq_len=64,
+                        lr=1e-3, warmup_steps=1, total_steps=10,
+                        log_every=100)
+    tr = Trainer(LlamaLMHeadModel(LlamaConfig.tiny(), st), tc, st).build()
+    rng = np.random.default_rng(0)
+    b = pad_batch([rng.integers(1, 250, size=60) for _ in range(4)], 64)
+    rep = tr.phase_report(b)
+    for phase in ("embed", "attn", "mlp", "lm_head"):
+        assert rep[phase]["instructions"] > 0, (phase, rep)
+    assert rep["attn"]["dots"] > 0 and rep["mlp"]["dots"] > 0
+    assert rep["lm_head"]["out_bytes"] > 0
+    assert rep["moe"]["instructions"] == 0   # dense model

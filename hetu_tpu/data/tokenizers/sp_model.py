@@ -73,7 +73,11 @@ def parse_model_proto(data: bytes):
     """ModelProto -> (pieces [(text, score, type)], trainer {..}, norm {..})."""
     pieces: List[Tuple[str, float, int]] = []
     trainer: Dict[str, int] = {}
-    norm = {"add_dummy_prefix": True, "escape_whitespaces": True}
+    # proto defaults (sentencepiece_model.proto NormalizerSpec):
+    # remove_extra_whitespaces defaults TRUE — models trained with
+    # defaults omit the field entirely
+    norm = {"add_dummy_prefix": True, "escape_whitespaces": True,
+            "remove_extra_whitespaces": True}
     for fno, _, v in _fields(data):
         if fno == 1:  # repeated SentencePiece
             text, score, typ = "", 0.0, _NORMAL
@@ -101,8 +105,12 @@ def parse_model_proto(data: bytes):
                     trainer["pad_id"] = _signed(tv)
         elif fno == 3:  # NormalizerSpec
             for nfno, nwt, nv in _fields(v):
-                if nfno == 3:
+                if nfno == 1:
+                    norm["name"] = nv.decode("utf-8")
+                elif nfno == 3:
                     norm["add_dummy_prefix"] = bool(nv)
+                elif nfno == 4:
+                    norm["remove_extra_whitespaces"] = bool(nv)
                 elif nfno == 5:
                     norm["escape_whitespaces"] = bool(nv)
     return pieces, trainer, norm
@@ -134,7 +142,9 @@ def write_model_proto(pieces: Sequence[Tuple[str, float, int]],
                       model_type: int = 1, *,
                       unk_id: int = 0, bos_id: int = 1, eos_id: int = 2,
                       pad_id: int = -1, add_dummy_prefix: bool = True,
-                      byte_fallback: bool = False) -> bytes:
+                      byte_fallback: bool = False,
+                      normalizer_name: str = "identity",
+                      remove_extra_whitespaces: bool = False) -> bytes:
     out = b""
     for text, score, typ in pieces:
         p = _ld(1, text.encode("utf-8"))
@@ -146,10 +156,60 @@ def write_model_proto(pieces: Sequence[Tuple[str, float, int]],
     for fno, vid in ((40, unk_id), (41, bos_id), (42, eos_id), (43, pad_id)):
         tr += _varint((fno << 3) | 0) + _varint(vid)
     out += _ld(2, tr)
-    nm = _varint((3 << 3) | 0) + _varint(int(add_dummy_prefix))
+    nm = _ld(1, normalizer_name.encode("utf-8"))
+    nm += _varint((3 << 3) | 0) + _varint(int(add_dummy_prefix))
+    nm += _varint((4 << 3) | 0) + _varint(int(remove_extra_whitespaces))
     nm += _varint((5 << 3) | 0) + _varint(1)
     out += _ld(3, nm)
     return out
+
+
+# ---------------------------------------------------------------------------
+# rule-name normalization (NormalizerSpec.name driven)
+# ---------------------------------------------------------------------------
+
+# The real sentencepiece runtime normalizes through the model's PRECOMPILED
+# charsmap (a serialized double-array trie baked at training time from the
+# named rule — builder.cc BuildNmtNfkcMap).  This module implements the
+# NAMED rules directly with unicodedata instead of decoding the trie:
+# identical for NFKC-representable mappings (the overwhelming majority —
+# fullwidth forms, compatibility ligatures, composed accents), approximate
+# for the handful of hand-curated NMT entries.  Documented divergence, not
+# silent: models whose name is unknown raise.
+_NMT_SPACE = {0x0009, 0x000A, 0x000D, 0x000B, 0x000C, 0x00A0, 0x1680,
+              0x2028, 0x2029, 0x202F, 0x205F, 0x3000} | \
+             set(range(0x2000, 0x200B))
+_NMT_REMOVE = (set(range(0x0000, 0x0009)) | set(range(0x000E, 0x0020))
+               | {0x007F, 0x008F, 0x009F, 0x00AD, 0xFEFF}
+               | set(range(0x200B, 0x2010)) | set(range(0x202A, 0x202F))
+               | set(range(0x2060, 0x2065)))
+
+
+def _nmt_premap(text: str) -> str:
+    out = []
+    for ch in text:
+        cp = ord(ch)
+        if cp in _NMT_REMOVE:
+            continue
+        out.append(" " if cp in _NMT_SPACE else ch)
+    return "".join(out)
+
+
+def rule_normalize(name: str, text: str) -> str:
+    """Apply the NormalizerSpec rule `name` (reference: the sentencepiece
+    normalization_rule_name the library bakes into the charsmap)."""
+    import unicodedata
+    if name in ("identity", ""):
+        return text
+    if name in ("nfkc", "nfkc_cf", "nmt_nfkc", "nmt_nfkc_cf"):
+        if name.startswith("nmt_"):
+            text = _nmt_premap(text)
+        text = unicodedata.normalize("NFKC", text)
+        if name.endswith("_cf"):
+            text = text.casefold()
+        return text
+    raise ValueError(f"unknown normalization rule {name!r} "
+                     "(identity|nfkc|nfkc_cf|nmt_nfkc|nmt_nfkc_cf)")
 
 
 # ---------------------------------------------------------------------------
@@ -170,6 +230,8 @@ class SentencePieceTokenizer:
         self.pieces = pieces
         self.model_type = trainer.get("model_type", 1)
         self.add_dummy_prefix = norm["add_dummy_prefix"]
+        self.normalizer_name = norm.get("name", "identity")
+        self.remove_extra_whitespaces = norm["remove_extra_whitespaces"]
         self.unk_id = trainer.get("unk_id", 0)
         self.bos_id = trainer.get("bos_id", 1)
         self.eos_id = trainer.get("eos_id", 2)
@@ -183,9 +245,20 @@ class SentencePieceTokenizer:
             elif typ == _BYTE:
                 self._byte_ids[int(text[1:-1], 16)] = pid  # "<0xAB>"
         self._max_len = max((len(t) for t in self._vocab), default=1)
+        # no vocab piece carries ▁ past position 0 -> no merge can cross
+        # a word boundary -> the BPE arena chunks exactly at each ▁
+        # (LLaMA-style vocabs qualify; interior-▁ pieces fall back to the
+        # whole-text arena)
+        self._bpe_chunkable = not any(_WS in t[1:] for t in self._vocab)
 
     # -------------------------------------------------- helpers
     def _normalize(self, text: str) -> str:
+        """NormalizerSpec order (normalizer.cc): charsmap rule ->
+        whitespace squeeze -> ▁ escaping -> dummy prefix."""
+        text = rule_normalize(self.normalizer_name, text)
+        if self.remove_extra_whitespaces:
+            import re
+            text = re.sub(r" +", " ", text).strip(" ")
         text = text.replace(" ", _WS)
         if self.add_dummy_prefix and text and not text.startswith(_WS):
             text = _WS + text
@@ -237,17 +310,79 @@ class SentencePieceTokenizer:
 
     # -------------------------------------------------- bpe (score merges)
     def _encode_bpe(self, text: str) -> List[int]:
+        """Best-score-first adjacent merges (ties leftmost — the greedy
+        reference semantics; the sentencepiece library's symbol-pair
+        agenda is the same scheme, bpe_model.cc).  Word-chunked when the
+        vocab allows (corpus-speed path), heap-based lazy-invalidation
+        merges within an arena — against the O(n^2) rescan the first
+        version did."""
+        if self._bpe_chunkable and len(text) > 64:
+            ids: List[int] = []
+            start = 0
+            for k in range(1, len(text) + 1):
+                if k == len(text) or text[k] == _WS:
+                    ids.extend(self._merge_arena(text[start:k]))
+                    start = k
+            return ids
+        return self._merge_arena(text)
+
+    def _merge_arena(self, text: str) -> List[int]:
+        import heapq
+
         units = list(text)
-        while len(units) > 1:
-            best_k, best_score = -1, None
-            for k in range(len(units) - 1):
-                hit = self._vocab.get(units[k] + units[k + 1])
-                if hit is not None and (best_score is None
-                                        or hit[1] > best_score):
-                    best_k, best_score = k, hit[1]
-            if best_k < 0:
-                break
-            units[best_k:best_k + 2] = [units[best_k] + units[best_k + 1]]
+        n = len(units)
+        if n <= 1:
+            return self._bpe_emit(units)
+        if n <= 16:
+            # small arenas (typical ▁-chunked words): the plain greedy
+            # rescan beats the heap's setup cost
+            get = self._vocab.get
+            while len(units) > 1:
+                best_k, best_score = -1, None
+                for k in range(len(units) - 1):
+                    hit = get(units[k] + units[k + 1])
+                    if hit is not None and (best_score is None
+                                            or hit[1] > best_score):
+                        best_k, best_score = k, hit[1]
+                if best_k < 0:
+                    break
+                units[best_k:best_k + 2] = [units[best_k]
+                                            + units[best_k + 1]]
+            return self._bpe_emit(units)
+        nxt = list(range(1, n)) + [-1]
+        prv = [-1] + list(range(0, n - 1))
+        alive = [True] * n
+        heap: List[Tuple[float, int, str, str]] = []
+
+        def push(k: int):
+            j = nxt[k]
+            if j < 0:
+                return
+            hit = self._vocab.get(units[k] + units[j])
+            if hit is not None:
+                # (-score, k): leftmost wins ties like the greedy scan
+                heapq.heappush(heap, (-hit[1], k, units[k], units[j]))
+
+        for k in range(n - 1):
+            push(k)
+        while heap:
+            _, k, left, right = heapq.heappop(heap)
+            if not alive[k] or units[k] != left:
+                continue              # stale: k was merged away/changed
+            j = nxt[k]
+            if j < 0 or units[j] != right:
+                continue              # stale: the right neighbor changed
+            units[k] = left + right
+            alive[j] = False
+            nxt[k] = nxt[j]
+            if nxt[j] >= 0:
+                prv[nxt[j]] = k
+            if prv[k] >= 0:
+                push(prv[k])
+            push(k)
+        return self._bpe_emit([units[k] for k in range(n) if alive[k]])
+
+    def _bpe_emit(self, units: Sequence[str]) -> List[int]:
         ids: List[int] = []
         for u in units:
             hit = self._vocab.get(u)

@@ -47,6 +47,7 @@ class ElasticController:
         self.rendezvous_timeout = rendezvous_timeout
         self.generation = 0
         self.trainer = None
+        self._consumed_epoch = 0   # newest plan round this worker took
 
     def _startup_rendezvous(self):
         """Wait for the full expected membership before the FIRST plan —
@@ -64,22 +65,93 @@ class ElasticController:
                     f"{self.rendezvous_timeout}s")
             time.sleep(0.2)
 
+    def _current_epoch(self) -> int:
+        try:
+            return int(self.client.get("__elastic_epoch__"))
+        except KeyError:
+            return 0
+
     # ------------------------------------------------------------------
     def _replan(self) -> Dict:
-        """Agree on a new plan for the survivors (rank order decides the
-        proposer; everyone votes on the result's fingerprint)."""
-        alive = self.client.membership()
-        leader = min(alive)
-        key = f"__elastic_plan_gen{self.generation}__"
-        if self.client.rank == leader:
-            plan = self.planner_fn(alive)
-            self.client.put(key, plan)
-        plan = self.client.get(key, block=True, timeout=120)
-        # consistency vote on the plan fingerprint (reference: Consistent)
-        fingerprint = str(sorted(plan.get("strategy", {}).items()))
-        self.client.consistent(f"plan_gen{self.generation}", fingerprint,
-                               count=len(alive))
-        return plan
+        """Agree on a new plan for the current membership (rank order
+        decides the proposer; everyone votes on the result's fingerprint).
+
+        The round id is a cluster-wide EPOCH in the KV store, not a local
+        counter: a worker that (re)joins mid-run (launcher restart,
+        orchestrator slot respawn) has no idea how many re-plans happened
+        before it — it adopts the round the leader publishes, so joiners
+        and survivors always read/vote the SAME keys.
+
+        No barrier: everyone POLLS the epoch key.  Barrier names derived
+        from per-worker membership snapshots deadlock when two deaths are
+        detected in different monitor sweeps (survivors end up in
+        different barriers), so the loop instead re-reads membership
+        every tick — the leader (min alive) publishes a round for its
+        view, consumers take any round that INCLUDES them, and a worker
+        excluded from a round keeps waiting (exclusion means the server
+        declared it dead; its resume() is rejected anyway).  A joiner
+        nobody plans in asks for a re-mesh itself (worker_stop broadcast)
+        after a grace period — that is what integrates relaunched workers
+        without an orchestrator."""
+        deadline = time.time() + self.rendezvous_timeout
+        ask_at = time.time() + 10.0
+        while True:
+            alive = self.client.membership()
+            if self.client.rank not in alive:
+                # the server declared this worker dead (heartbeat false-
+                # positive, e.g. a long XLA compile): fail FAST — resume()
+                # would be rejected anyway, and broadcasting re-mesh
+                # requests from a dead-marked rank would thrash the
+                # survivors with needless checkpoint+rebuild cycles
+                raise RuntimeError(
+                    f"rank {self.client.rank} was declared dead by the "
+                    "coordination server; reconnect with a fresh client "
+                    "for a new rank (split-brain guard)")
+            epoch = self._current_epoch()
+            if epoch > self._consumed_epoch:
+                members = self.client.get(f"__elastic_members_e{epoch}__",
+                                          block=True, timeout=60)
+                if self.client.rank in members:
+                    plan = self.client.get(f"__elastic_plan_e{epoch}__",
+                                           block=True, timeout=60)
+                    self._consumed_epoch = epoch
+                    fingerprint = str(sorted(
+                        plan.get("strategy", {}).items()))
+                    try:
+                        self.client.consistent(f"plan_e{epoch}",
+                                               fingerprint,
+                                               count=len(members))
+                    except TimeoutError:
+                        # a round member died mid-vote; a newer round is
+                        # coming — keep looping
+                        continue
+                    if self._current_epoch() == epoch:
+                        return plan
+                    continue   # superseded while voting: take the newer
+                else:
+                    # a round that predates/excludes this worker
+                    self._consumed_epoch = epoch
+            elif alive and self.client.rank == min(alive):
+                new_epoch = epoch + 1
+                plan = self.planner_fn(alive)
+                self.client.put(f"__elastic_plan_e{new_epoch}__", plan)
+                # membership of the round, for consumers and outside
+                # observers (the orchestrator's convergence check)
+                self.client.put(f"__elastic_members_e{new_epoch}__", alive)
+                self.client.put("__elastic_epoch__", new_epoch)
+                continue
+            elif time.time() > ask_at:
+                # joined a cluster that is NOT re-planning: request a
+                # re-mesh so the leader publishes a round including us
+                logger.info("no plan round includes this worker; "
+                            "requesting a re-mesh")
+                self.client.worker_stop()
+                ask_at = time.time() + 15.0
+            if time.time() > deadline:
+                raise TimeoutError(
+                    f"_replan: no usable plan round after "
+                    f"{self.rendezvous_timeout}s (alive={alive})")
+            time.sleep(0.1)
 
     def _rebuild(self):
         plan = self._replan()
@@ -104,9 +176,12 @@ class ElasticController:
         self.generation += 1
 
     # ------------------------------------------------------------------
-    def run(self, batches, num_steps: int) -> object:
+    def run(self, batches, num_steps: int,
+            step_callback: Optional[Callable] = None) -> object:
         """The elastic loop (reference: workers re-entering Trainer after
-        WorkerStop).  Returns the final trainer."""
+        WorkerStop).  Returns the final trainer.
+        step_callback(trainer, metrics): per-step hook (loss-curve
+        logging in the elastic demos/tests)."""
         self._startup_rendezvous()
         self._rebuild()
         it = iter(batches)
@@ -125,7 +200,9 @@ class ElasticController:
                 batch = next(it)
             except StopIteration:
                 break
-            self.trainer.train_step(batch)
+            metrics = self.trainer.train_step(batch)
+            if step_callback is not None:
+                step_callback(self.trainer, metrics)
             steps_done = self.trainer.global_step
         if getattr(self.trainer, "_ckpt", None) is not None:
             self.trainer.save(wait=True)

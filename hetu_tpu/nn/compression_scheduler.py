@@ -1,0 +1,274 @@
+"""Budgeted embedding-compression scheduler.
+
+Rebuild of the reference suite's scheduler layer (reference: tools/
+EmbeddingMemoryCompression/methods/scheduler/ — per-method trainers driven
+by a target compress_rate, multistage.py's stage-wise method switching,
+compressor.py's compress/decompress contract).  The reference fixes ONE
+method per run from the CLI; this scheduler closes the loop the suite
+implies: given a SET of tables, a byte budget, and per-table access
+frequencies (the LFU/LRU cache stats, data/embedding_cache.py stats()),
+choose a per-table method mix and MIGRATE tables between methods at a
+checkpoint boundary.
+
+Planning: every table gets a quality ladder (dense -> int8 -> int4 -> QR
+-> hash -> TT) with measured bytes (module.memory()) and a quality-loss
+proxy per step.  Starting all-dense, the planner greedily takes the
+downgrade step with the best bytes-saved per access-weighted quality-loss
+until the mix fits the budget — hot tables keep richer methods, cold
+tables absorb the compression.
+
+Migration: quantized/dedup compress directly from the dense table; the
+learned structures (hash/QR/TT) are fitted to reproduce the old table's
+rows (a short Adam regression on sampled ids — the distillation analog of
+the reference's stage-wise retraining).  Optimizer state for a migrated
+table restarts, which is why migrations belong at checkpoint boundaries.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.nn.embedding_compression import (HashEmbedding, QREmbedding,
+                                               QuantizedEmbedding,
+                                               TTEmbedding)
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("compress_sched")
+
+
+@dataclasses.dataclass(frozen=True)
+class TableSpec:
+    name: str
+    num_embeddings: int
+    embedding_dim: int
+    # relative access frequency (e.g. hits/accesses share from the
+    # LFU/LRU cache stats); hotter tables resist compression
+    access_freq: float = 1.0
+
+
+@dataclasses.dataclass(frozen=True)
+class MethodChoice:
+    method: str                 # dense|quantized8|quantized4|qr|hash|tt
+    bytes: int
+    quality_loss: float         # proxy in [0, 1): 0 = exact
+    module: Optional[object]    # the compression module (None for dense)
+
+
+def _factor3(n: int) -> Tuple[int, int, int]:
+    """n = a*b*c with factors as close as possible (TT dim factors)."""
+    best = (1, 1, n)
+    for a in range(1, int(round(n ** (1 / 3))) + 2):
+        if n % a:
+            continue
+        m = n // a
+        for b in range(a, int(np.sqrt(m)) + 1):
+            if m % b == 0:
+                best = (a, b, m // b)
+    return best
+
+
+def method_ladder(t: TableSpec) -> List[MethodChoice]:
+    """This table's quality ladder, best-first, strictly shrinking bytes.
+    Quality-loss proxies are coarse by design (the reference calibrates
+    per-method AUC drops experimentally; these only need the right ORDER
+    for the greedy trade to be sensible)."""
+    v, d = t.num_embeddings, t.embedding_dim
+    out = [MethodChoice("dense", v * d * 4, 0.0, None)]
+
+    q8 = QuantizedEmbedding(v, d, bits=8, block_size=min(64, d))
+    out.append(MethodChoice("quantized8", q8.memory(), 0.01, q8))
+    if d % 2 == 0:
+        q4 = QuantizedEmbedding(v, d, bits=4, block_size=min(64, d))
+        out.append(MethodChoice("quantized4", q4.memory(), 0.05, q4))
+    qr = QREmbedding(v, d)
+    out.append(MethodChoice("qr", qr.memory(), 0.25, qr))
+    h = HashEmbedding(v, d, compressed_rows=max(v // 16, 8))
+    out.append(MethodChoice("hash", h.memory(), 0.35, h))
+    d3 = _factor3(d)
+    if d3[0] > 1 or d > 8:
+        v3 = int(np.ceil(v ** (1 / 3)))
+        tt = TTEmbedding(v, d, vocab_factors=(v3, v3, v3), dim_factors=d3,
+                         rank=4)
+        out.append(MethodChoice("tt", tt.memory(), 0.45, tt))
+    # keep only strictly-shrinking steps (a "compressed" method larger
+    # than its predecessor is useless for this table's shape)
+    ladder = [out[0]]
+    for c in out[1:]:
+        if c.bytes < ladder[-1].bytes:
+            ladder.append(c)
+    return ladder
+
+
+def plan_methods(tables: Sequence[TableSpec],
+                 budget_bytes: float) -> Dict[str, MethodChoice]:
+    """Greedy budgeted assignment: downgrade the (table, step) with the
+    best bytes-saved per access-weighted quality-loss until under
+    budget.  Raises if even the smallest mix exceeds the budget."""
+    ladders = {t.name: method_ladder(t) for t in tables}
+    freq = {t.name: max(t.access_freq, 1e-9) for t in tables}
+    level = {t.name: 0 for t in tables}
+    total = sum(ladders[n][0].bytes for n in level)
+    while total > budget_bytes:
+        best_name, best_ratio = None, -1.0
+        for n, lv in level.items():
+            if lv + 1 >= len(ladders[n]):
+                continue
+            cur, nxt = ladders[n][lv], ladders[n][lv + 1]
+            saved = cur.bytes - nxt.bytes
+            cost = (nxt.quality_loss - cur.quality_loss) * freq[n]
+            ratio = saved / max(cost, 1e-12)
+            if ratio > best_ratio:
+                best_name, best_ratio = n, ratio
+        if best_name is None:
+            raise ValueError(
+                f"budget {budget_bytes / 1e6:.2f}MB infeasible: the most "
+                f"compressed mix still needs {total / 1e6:.2f}MB")
+        total -= (ladders[best_name][level[best_name]].bytes
+                  - ladders[best_name][level[best_name] + 1].bytes)
+        level[best_name] += 1
+    return {n: ladders[n][lv] for n, lv in level.items()}
+
+
+def freqs_from_cache_stats(stats: Dict[str, Dict]) -> Dict[str, float]:
+    """Per-table access share out of LFU/LRU cache stats()
+    ({table: {"accesses": N, ...}}) — the planner's access_freq input."""
+    tot = sum(max(s.get("accesses", 0), 0) for s in stats.values()) or 1
+    return {n: max(s.get("accesses", 0), 1e-9) / tot
+            for n, s in stats.items()}
+
+
+# ---------------------------------------------------------------------------
+# migration
+# ---------------------------------------------------------------------------
+
+def _fit_structure(module, target: jnp.ndarray, key,
+                   steps: int = 300, lr: float = 0.05,
+                   sample: int = 4096):
+    """Fit a learned structure (hash/QR/TT) to reproduce `target` rows —
+    the migration analog of the reference's stage-wise retraining."""
+    import optax
+
+    v = target.shape[0]
+    params = module.init(key)
+    opt = optax.adam(lr)
+    state = opt.init(params)
+
+    def loss_fn(p, ids):
+        return jnp.mean((module.lookup(p, ids) - target[ids]) ** 2)
+
+    @jax.jit
+    def step(p, s, ids):
+        g = jax.grad(loss_fn)(p, ids)
+        up, s = opt.update(g, s)
+        return jax.tree.map(lambda a, u: a + u, p, up), s
+
+    rng = np.random.default_rng(0)
+    for _ in range(steps):
+        ids = jnp.asarray(rng.integers(0, v, size=min(sample, v)))
+        params, state = step(params, state, ids)
+    return params
+
+
+def compress_table(choice: MethodChoice, dense: jnp.ndarray, key):
+    """dense [v, d] -> params for the chosen method."""
+    if choice.method == "dense":
+        return dense
+    if choice.method.startswith("quantized"):
+        return choice.module.compress(dense)
+    return _fit_structure(choice.module, dense, key)
+
+
+def reconstruct_table(choice: MethodChoice, params,
+                      num_embeddings: int) -> jnp.ndarray:
+    """params -> approximate dense [v, d] (migration source)."""
+    if choice.method == "dense":
+        return params
+    ids = jnp.arange(num_embeddings)
+    return choice.module.lookup(params, ids)
+
+
+class ScheduledEmbeddings:
+    """A set of embedding tables living under a byte budget.
+
+    init(key) builds per-table params for the planned mix; lookup(name,
+    params, ids) dispatches to the table's method; replan(...) re-runs the
+    planner (new budget and/or fresh cache stats) and MIGRATES any table
+    whose method changed — reconstruct from the old method, compress into
+    the new — returning the migration list.  Learned-structure params
+    (dense/hash/QR/TT) take gradients; quantized storage is frozen
+    (stop_gradient) — requantization training is the fake_quant STE path.
+    """
+
+    def __init__(self, tables: Sequence[TableSpec], budget_bytes: float):
+        self.tables = {t.name: t for t in tables}
+        self.budget_bytes = budget_bytes
+        self.plan = plan_methods(tables, budget_bytes)
+
+    def init(self, key) -> Dict[str, object]:
+        params = {}
+        for i, (name, t) in enumerate(sorted(self.tables.items())):
+            k = jax.random.fold_in(key, i)
+            choice = self.plan[name]
+            if choice.method == "dense":
+                params[name] = jax.random.normal(
+                    k, (t.num_embeddings, t.embedding_dim)) * 0.02
+            elif choice.method.startswith("quantized"):
+                dense = jax.random.normal(
+                    k, (t.num_embeddings, t.embedding_dim)) * 0.02
+                params[name] = choice.module.compress(dense)
+            else:
+                params[name] = choice.module.init(k)
+        return params
+
+    def lookup(self, name: str, params, ids: jnp.ndarray) -> jnp.ndarray:
+        choice = self.plan[name]
+        if choice.method == "dense":
+            return jnp.take(params[name], ids, axis=0)
+        if choice.method.startswith("quantized"):
+            return choice.module.lookup(
+                jax.tree.map(jax.lax.stop_gradient, params[name]), ids)
+        return choice.module.lookup(params[name], ids)
+
+    def memory(self) -> int:
+        return sum(c.bytes for c in self.plan.values())
+
+    def describe(self) -> Dict[str, str]:
+        return {n: c.method for n, c in self.plan.items()}
+
+    def replan(self, params: Dict[str, object],
+               budget_bytes: Optional[float] = None,
+               access_freqs: Optional[Dict[str, float]] = None,
+               key=None) -> Tuple[Dict[str, object], List[Dict]]:
+        """Checkpoint-boundary re-plan + migration.  Returns (new_params,
+        migrations); a migrated table's optimizer state must restart."""
+        if budget_bytes is not None:
+            self.budget_bytes = budget_bytes
+        if access_freqs:
+            self.tables = {
+                n: dataclasses.replace(t, access_freq=access_freqs.get(
+                    n, t.access_freq))
+                for n, t in self.tables.items()}
+        key = key if key is not None else jax.random.key(0)
+        new_plan = plan_methods(list(self.tables.values()),
+                                self.budget_bytes)
+        migrations: List[Dict] = []
+        new_params = dict(params)
+        for i, (name, t) in enumerate(sorted(self.tables.items())):
+            old, new = self.plan[name], new_plan[name]
+            if old.method == new.method:
+                continue
+            dense = reconstruct_table(old, params[name], t.num_embeddings)
+            new_params[name] = compress_table(
+                new, dense, jax.random.fold_in(key, 1000 + i))
+            migrations.append({"table": name, "from": old.method,
+                               "to": new.method,
+                               "bytes": (old.bytes, new.bytes)})
+            logger.info(f"migrated {name}: {old.method} -> {new.method} "
+                        f"({old.bytes / 1e6:.2f}MB -> "
+                        f"{new.bytes / 1e6:.2f}MB)")
+        self.plan = new_plan
+        return new_params, migrations

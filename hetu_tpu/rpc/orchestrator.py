@@ -1,0 +1,238 @@
+"""Multi-host orchestration: spawn per-host launchers, detect HOST loss,
+respawn lost slots on the survivors.
+
+Rebuild of the reference's cross-host elastic tooling (reference:
+python/hetu/rpc/pssh_start.py — per-node worker launch over parallel-ssh;
+pssh_start_elastic.py — the relaunch loop; heturpc_elastic_server.py:497
+`detect_node_info` — survivor re-detection and strategy-arg rewrite for the
+remaining nodes).  TPU realization: a "host" is a launcher subprocess
+(`python -m hetu_tpu.rpc.launcher --coord-address ...`) started in its own
+process group, so killing the group is a whole-host crash; on a real pod
+each spawn line would go through `ssh <host> ...` instead — the ssh
+transport is the ONLY thing this module leaves to the platform.
+
+The division of labor (all automatic, no operator action):
+  * the coordination server (owned here) detects WORKER loss by heartbeat
+    and stop-flags everyone; survivors re-plan in place and resume from
+    checkpoint (engine/elastic.py ElasticController) — the reference
+    instead restarts workers with rewritten args, which costs a full
+    process restart per re-mesh;
+  * THIS orchestrator detects HOST loss (the launcher process group died),
+    and — when `respawn_lost_slots` — respawns the lost worker slots on a
+    surviving host with fresh cluster-unique slot ids, then broadcasts a
+    stop so the grown membership re-meshes (the joiners adopt the cluster
+    epoch from the KV store — ElasticController._replan).
+"""
+from __future__ import annotations
+
+import os
+import signal
+import subprocess
+import sys
+import time
+from typing import Dict, List, Optional, Sequence
+
+from hetu_tpu.rpc.server import CoordinationServer
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("orchestrator")
+
+
+class HostProc:
+    """One 'host': a launcher subprocess in its own process group."""
+
+    def __init__(self, name: str, popen: subprocess.Popen,
+                 slots: Sequence[int]):
+        self.name = name
+        self.popen = popen
+        self.slots = list(slots)
+        self.lost = False
+        self.killed_by_orchestrator = False
+
+
+class MultiHostOrchestrator:
+    """pssh_start_elastic analog, one level above ElasticLauncher."""
+
+    def __init__(self, worker_cmd: Sequence[str], hosts: Dict[str, int],
+                 env: Optional[Dict[str, str]] = None,
+                 heartbeat_timeout: float = 10.0,
+                 log_dir: Optional[str] = None,
+                 respawn_lost_slots: bool = False,
+                 max_respawns: int = 1):
+        """hosts: name -> worker count on that host.  Slot ids are assigned
+        contiguously in dict order (cluster-unique; the reference rewrites
+        per-host rank offsets in its pssh args)."""
+        self.worker_cmd = list(worker_cmd)
+        self.hosts_spec = dict(hosts)
+        self.extra_env = dict(env or {})
+        self.log_dir = log_dir
+        self.respawn_lost_slots = respawn_lost_slots
+        self.max_respawns = max_respawns
+        self.world_size = sum(hosts.values())
+        self.server = CoordinationServer(heartbeat_timeout=heartbeat_timeout)
+        self.hosts: Dict[str, HostProc] = {}
+        self._next_slot = self.world_size
+        self._respawns = 0
+        self.events: List[Dict] = []
+
+    # ------------------------------------------------------------------
+    @property
+    def coord_address(self) -> str:
+        return f"{self.server.host}:{self.server.port}"
+
+    def _spawn_host(self, name: str, slots: Sequence[int]) -> HostProc:
+        """One launcher subprocess == one host (ssh-equivalent line in
+        `HostProc.popen.args` for a real deployment)."""
+        cmd = [sys.executable, "-m", "hetu_tpu.rpc.launcher",
+               "-n", str(len(slots)),
+               "--coord-address", self.coord_address,
+               "--world-size", str(self.world_size),
+               "--worker-id-base", str(min(slots))]
+        if self.log_dir:
+            cmd += ["--log-dir", os.path.join(self.log_dir, f"host_{name}")]
+        cmd += ["--"] + self.worker_cmd
+        env = dict(os.environ)
+        env.update(self.extra_env)
+        popen = subprocess.Popen(cmd, env=env, start_new_session=True)
+        hp = HostProc(name, popen, slots)
+        logger.info(f"host {name}: launcher pid={popen.pid} slots={slots}")
+        self.events.append({"event": "host_spawn", "host": name,
+                            "slots": list(slots)})
+        return hp
+
+    def start(self) -> "MultiHostOrchestrator":
+        base = 0
+        for name, n in self.hosts_spec.items():
+            slots = list(range(base, base + n))
+            self.hosts[name] = self._spawn_host(name, slots)
+            base += n
+        return self
+
+    # ------------------------------------------------------------------
+    def kill_host(self, name: str):
+        """Failure injection: crash the WHOLE host (launcher + workers, the
+        process group) — the reference's node-loss experiment."""
+        hp = self.hosts[name]
+        hp.killed_by_orchestrator = True
+        try:
+            os.killpg(os.getpgid(hp.popen.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+
+    def membership(self) -> List[int]:
+        return self.server.alive_ranks()
+
+    # ------------------------------------------------------------------
+    def poll(self) -> Dict[str, Optional[int]]:
+        """Reap host exits; on an UNEXPECTED host loss, optionally respawn
+        its slots on a surviving host (fresh cluster-unique slot ids) and
+        broadcast a stop so the grown membership re-meshes."""
+        out: Dict[str, Optional[int]] = {}
+        for name, hp in list(self.hosts.items()):
+            rc = hp.popen.poll()
+            out[name] = rc
+            if rc is None or hp.lost:
+                continue
+            hp.lost = True
+            logger.warning(f"host {name} gone (rc={rc}); "
+                           f"slots {hp.slots} lost")
+            self.events.append({"event": "host_loss", "host": name,
+                                "slots": list(hp.slots), "rc": rc})
+            clean_exit = rc == 0 and not hp.killed_by_orchestrator
+            if (self.respawn_lost_slots and not clean_exit
+                    and self._respawns < self.max_respawns):
+                survivor = next((n for n, h in self.hosts.items()
+                                 if not h.lost and h.popen.poll() is None),
+                                None)
+                if survivor is None:
+                    logger.error("no surviving host to respawn on")
+                    continue
+                self._respawns += 1
+                slots = list(range(self._next_slot,
+                                   self._next_slot + len(hp.slots)))
+                self._next_slot += len(hp.slots)
+                newname = f"{survivor}+{name}"
+                # in a real deployment this spawn line runs over
+                # `ssh <survivor>` — detect_node_info + relaunch analog
+                self.hosts[newname] = self._spawn_host(newname, slots)
+                self.events.append({"event": "respawn", "host": newname,
+                                    "on": survivor, "slots": slots})
+                # the joined-worker target is re-derived each tick from
+                # the SLOT layout (live hosts' slot counts): membership
+                # sampled here can still count the just-killed host's
+                # workers whose socket-close the server hasn't processed
+                self._pending_remesh = {
+                    "deadline": time.time() + 180.0,
+                    "next_cast": 0.0, "casts": 0}
+        self._drive_pending_remesh()
+        return out
+
+    def _remesh_converged(self) -> bool:
+        """True when the LATEST re-plan epoch covered every alive rank —
+        the ElasticController publishes each round's membership."""
+        epoch = int(self.server.kv_get("__elastic_epoch__", 0))
+        members = self.server.kv_get(f"__elastic_members_e{epoch}__", [])
+        alive = self.server.alive_ranks()
+        return bool(alive) and set(alive) <= set(members)
+
+    def _drive_pending_remesh(self):
+        """Non-blocking remesh driver, stepped from poll(): once the
+        replacement workers have connected, stop-flag everyone until a
+        re-plan epoch covers the grown membership.  Growth does not trip
+        the server's loss monitor, and a single broadcast can race a
+        survivor's in-flight rebuild (its resume() clears the flag) — so
+        this RE-broadcasts until the published epoch membership shows
+        convergence.  Runs as a state machine so poll() keeps reaping
+        other hosts' exits meanwhile."""
+        pr = getattr(self, "_pending_remesh", None)
+        if pr is None:
+            return
+        now = time.time()
+        # live slot count by layout, not by a frozen membership sample
+        want = sum(len(hp.slots) for hp in self.hosts.values()
+                   if not hp.lost and hp.popen.poll() is None)
+        joined = len(self.membership()) >= want > 0
+        done = joined and self._remesh_converged()
+        if done or now > pr["deadline"]:
+            self._pending_remesh = None
+            self.events.append({"event": "remesh_broadcast",
+                                "alive": self.membership(),
+                                "broadcasts": pr["casts"],
+                                "converged": done})
+            return
+        if joined and now >= pr["next_cast"]:
+            self.server.broadcast_stop()
+            pr["casts"] += 1
+            pr["next_cast"] = now + 3.0
+
+    # ------------------------------------------------------------------
+    def monitor(self, poll_interval: float = 0.5,
+                until: Optional[float] = None):
+        """Poll until every host's launcher has exited (or `until`)."""
+        deadline = time.time() + until if until else None
+        while True:
+            codes = self.poll()
+            if all(c is not None for c in codes.values()):
+                return codes
+            if deadline and time.time() > deadline:
+                return codes
+            time.sleep(poll_interval)
+
+    def shutdown(self):
+        for hp in self.hosts.values():
+            if hp.popen.poll() is None:
+                hp.killed_by_orchestrator = True
+                try:
+                    os.killpg(os.getpgid(hp.popen.pid), signal.SIGTERM)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        deadline = time.time() + 5
+        for hp in self.hosts.values():
+            while hp.popen.poll() is None and time.time() < deadline:
+                time.sleep(0.05)
+            if hp.popen.poll() is None:
+                try:
+                    os.killpg(os.getpgid(hp.popen.pid), signal.SIGKILL)
+                except (ProcessLookupError, PermissionError):
+                    pass
+        self.server.close()

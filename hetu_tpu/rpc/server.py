@@ -114,6 +114,13 @@ class CoordinationServer:
                     st = self._votes[vname]
                     if st.get("done_at") and now - st["done_at"] > 60.0:
                         del self._votes[vname]
+                    elif st.get("done_at") is None and \
+                            now - st.get("started_at", now) > 300.0:
+                        # abandoned mid-vote (a member died before count
+                        # was reached; clients timed out and moved to a
+                        # newer round) — without this the elastic retry
+                        # path leaks one entry per interrupted vote
+                        del self._votes[vname]
                 for rank, info in list(self._workers.items()):
                     if info.get("alive") and \
                             now - info["last_beat"] > self.heartbeat_timeout:
@@ -159,6 +166,26 @@ class CoordinationServer:
     def _mark_lost(self, rank: int, why: str):
         with self._lock:
             self._mark_lost_locked(rank, why)
+
+    def broadcast_stop(self):
+        """Stop-flag every alive worker (the WorkerStop broadcast, from
+        the server side).  The orchestrator uses this to force a re-mesh
+        when membership GROWS — replacement slots joining after a host
+        loss — since growth alone does not trip the loss monitor."""
+        with self._lock:
+            for r, w in self._workers.items():
+                if w.get("alive"):
+                    self._stop_flags.add(r)
+            self._kv["__membership_change__"] = time.time()
+
+    def alive_ranks(self):
+        with self._lock:
+            return sorted(r for r, w in self._workers.items()
+                          if w.get("alive"))
+
+    def kv_get(self, key, default=None):
+        with self._lock:
+            return self._kv.get(key, default)
 
     def _mark_lost_locked(self, rank: int, why: str):
         info = self._workers.get(rank)
@@ -226,7 +253,7 @@ class CoordinationServer:
                                             req["value"], req["count"])
                 st = self._votes.setdefault(
                     name, {"votes": {}, "result": None, "collected": set(),
-                           "done_at": None})
+                           "done_at": None, "started_at": time.time()})
                 if st["result"] is not None:
                     # a completed round: hand out the result; clear the round
                     # once every participant has collected it, so the name is

@@ -68,12 +68,15 @@ def main():
         client, trainer_factory, planner_fn,
         expected_world=int(os.environ.get("HETU_TPU_NUM_WORKERS", "0")))
 
+    # paced steps so kills (and respawned joiners) land mid-training
+    pace = float(os.environ.get("HETU_TPU_TEST_PACE", "0.05"))
+
     class Batches:
         def __iter__(self):
             return self
 
         def __next__(self):
-            time.sleep(0.05)      # pace steps so kills land mid-training
+            time.sleep(pace)
             return batch
 
     gen_log = []
@@ -89,6 +92,12 @@ def main():
 
     ctl._rebuild = rebuild_logged
 
+    def log_loss(trainer, metrics):
+        if trainer.global_step % 10 == 0:
+            log_status(status_path, {
+                "event": "loss", "step": trainer.global_step,
+                "loss": float(metrics["loss"])})
+
     if len(sys.argv) > 3 and int(sys.argv[3]) == worker_id:
         # self-terminating straggler variant (when the test asks for it)
         steps_before_death = int(sys.argv[4])
@@ -102,9 +111,9 @@ def main():
                     os._exit(17)
                 return super().__next__()
 
-        trainer = ctl.run(DyingBatches(), num_steps)
+        trainer = ctl.run(DyingBatches(), num_steps, step_callback=log_loss)
     else:
-        trainer = ctl.run(Batches(), num_steps)
+        trainer = ctl.run(Batches(), num_steps, step_callback=log_loss)
 
     log_status(status_path, {
         "event": "done", "rank": client.rank,

@@ -1,0 +1,121 @@
+"""Budgeted embedding-compression scheduler (reference: tools/
+EmbeddingMemoryCompression/methods/scheduler/ — stage-wise method
+switching under a target compress rate)."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from hetu_tpu.nn.compression_scheduler import (ScheduledEmbeddings,
+                                               TableSpec,
+                                               freqs_from_cache_stats,
+                                               method_ladder, plan_methods)
+
+
+def _tables():
+    return [
+        TableSpec("hot", 2000, 32, access_freq=0.8),
+        TableSpec("warm", 2000, 32, access_freq=0.15),
+        TableSpec("cold", 2000, 32, access_freq=0.05),
+    ]
+
+
+def test_ladder_shrinks_strictly():
+    lad = method_ladder(_tables()[0])
+    assert lad[0].method == "dense"
+    assert all(a.bytes > b.bytes for a, b in zip(lad, lad[1:]))
+    assert all(a.quality_loss < b.quality_loss for a, b in zip(lad, lad[1:]))
+
+
+def test_budget_sweep_changes_mix():
+    """Ample budget -> all dense; shrinking budgets compress the COLD
+    tables first (access-weighted greedy); infeasible raises."""
+    tabs = _tables()
+    dense_total = sum(t.num_embeddings * t.embedding_dim * 4 for t in tabs)
+    full = plan_methods(tabs, dense_total)
+    assert all(c.method == "dense" for c in full.values())
+
+    mid = plan_methods(tabs, dense_total * 0.5)
+    assert any(c.method != "dense" for c in mid.values())
+    order = {"dense": 0, "quantized8": 1, "quantized4": 2, "qr": 3,
+             "hash": 4, "tt": 5}
+    assert order[mid["cold"].method] >= order[mid["hot"].method]
+
+    tight = plan_methods(tabs, dense_total * 0.05)
+    assert sum(c.bytes for c in tight.values()) <= dense_total * 0.05
+    assert order[tight["cold"].method] >= order[tight["hot"].method]
+
+    with pytest.raises(ValueError, match="infeasible"):
+        plan_methods(tabs, 64)
+
+
+def test_freqs_from_cache_stats():
+    freqs = freqs_from_cache_stats({
+        "a": {"accesses": 900}, "b": {"accesses": 100}})
+    assert freqs["a"] == pytest.approx(0.9)
+    assert freqs["b"] == pytest.approx(0.1)
+
+
+def test_training_continues_across_migration():
+    """End-to-end: train, replan to a smaller budget (tables MIGRATE),
+    keep training — the loss stays finite and keeps improving, and the
+    migrated storage obeys the new budget."""
+    tabs = [TableSpec("user", 600, 16, 0.7), TableSpec("item", 600, 16, 0.3)]
+    dense_total = sum(t.num_embeddings * t.embedding_dim * 4 for t in tabs)
+    sched = ScheduledEmbeddings(tabs, dense_total)
+    assert set(sched.describe().values()) == {"dense"}
+
+    key = jax.random.key(0)
+    params = sched.init(key)
+    w = jax.random.normal(jax.random.fold_in(key, 9), (32, 1)) * 0.1
+    rng = np.random.default_rng(0)
+    uids = jnp.asarray(rng.integers(0, 600, 256))
+    iids = jnp.asarray(rng.integers(0, 600, 256))
+    y = jnp.asarray(rng.normal(size=(256, 1)), jnp.float32)
+
+    def loss_fn(params, w):
+        f = jnp.concatenate([sched.lookup("user", params, uids),
+                             sched.lookup("item", params, iids)], axis=-1)
+        return jnp.mean((f @ w - y) ** 2)
+
+    @jax.jit
+    def step(params, w):
+        l, g = jax.value_and_grad(loss_fn, argnums=(0, 1),
+                                  allow_int=True)(params, w)
+        # integer leaves (quantized storage) are frozen — skip the update
+        params = jax.tree.map(
+            lambda p, gr: p - 0.1 * gr.astype(p.dtype)
+            if jnp.issubdtype(p.dtype, jnp.floating) else p, params, g[0])
+        return params, w - 0.1 * g[1], l
+
+    losses = []
+    for _ in range(40):
+        params, w, l = step(params, w)
+        losses.append(float(l))
+    assert losses[-1] < losses[0]
+
+    # checkpoint boundary: halve the budget -> at least one migration
+    params, migrations = sched.replan(params, budget_bytes=dense_total / 3,
+                                      key=jax.random.fold_in(key, 1))
+    assert migrations, sched.describe()
+    assert sched.memory() <= dense_total / 3
+    post = []
+    for _ in range(40):
+        params, w, l = step(params, w)   # jit retraces for the new pytree
+        post.append(float(l))
+    assert np.isfinite(post).all()
+    assert post[-1] < post[0]
+
+
+def test_replan_with_fresh_cache_stats_flips_hot_table():
+    """New access stats change WHICH table keeps the richer method."""
+    tabs = [TableSpec("a", 1000, 32, 0.9), TableSpec("b", 1000, 32, 0.1)]
+    dense_total = sum(t.num_embeddings * t.embedding_dim * 4 for t in tabs)
+    sched = ScheduledEmbeddings(tabs, dense_total * 0.5)
+    order = {"dense": 0, "quantized8": 1, "quantized4": 2, "qr": 3,
+             "hash": 4, "tt": 5}
+    assert order[sched.plan["b"].method] >= order[sched.plan["a"].method]
+    params = sched.init(jax.random.key(0))
+    # traffic flipped: b is hot now
+    _, migs = sched.replan(params, access_freqs={"a": 0.1, "b": 0.9})
+    assert order[sched.plan["a"].method] >= order[sched.plan["b"].method]

@@ -1,0 +1,159 @@
+"""Automated multi-host elastic demo (reference: python/hetu/rpc/
+pssh_start.py per-node launch, pssh_start_elastic.py relaunch loop,
+heturpc_elastic_server.py:497 detect_node_info).
+
+The orchestrator — NOT an operator — spawns two per-host launcher
+subprocesses against one coordination server, a whole "host" (its process
+group) is killed mid-training, the server's heartbeat monitor detects the
+loss, the survivors re-plan for the shrunken membership and resume from
+checkpoint, and (respawn mode) the lost slots come back on the surviving
+host and the grown membership re-meshes via the cluster-epoch protocol."""
+import json
+import os
+import sys
+import time
+
+import pytest
+
+from hetu_tpu.rpc.orchestrator import MultiHostOrchestrator
+
+WORKER = os.path.join(os.path.dirname(__file__), "elastic_worker_main.py")
+
+
+def _read_status(workdir, wid):
+    path = os.path.join(workdir, f"status_w{wid}.jsonl")
+    if not os.path.exists(path):
+        return []
+    with open(path) as f:
+        return [json.loads(line) for line in f if line.strip()]
+
+
+def _env():
+    env = dict(PYTHONPATH=os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    env["JAX_PLATFORMS"] = "cpu"
+    env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=1"
+    return env
+
+
+def _wait_first_generation(workdir, slots, timeout=240):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        if all(any(r["event"] == "generation"
+                   for r in _read_status(workdir, w)) for w in slots):
+            return
+        time.sleep(0.5)
+    pytest.fail("cluster never reached generation 1: " + repr(
+        {w: _read_status(workdir, w) for w in slots}))
+
+
+@pytest.mark.slow
+def test_host_loss_survivors_replan_and_resume(tmp_path):
+    """Kill host B's whole process group: the orchestrator observes the
+    host loss, the survivors on host A re-plan to world=2 WITHOUT any
+    operator action and resume from checkpoint, and the loss curve
+    continues falling past the pre-kill steps."""
+    workdir = str(tmp_path)
+    num_steps = 150
+    orch = MultiHostOrchestrator(
+        [sys.executable, WORKER, workdir, str(num_steps)],
+        hosts={"A": 2, "B": 2}, env=_env(), heartbeat_timeout=30.0,
+        log_dir=os.path.join(workdir, "logs")).start()
+    try:
+        _wait_first_generation(workdir, range(4))
+        time.sleep(3.0)   # let train steps + a checkpoint-able state land
+        # ensure the global leader (min rank, checkpoint owner) is on A
+        slot_rank = {w: _read_status(workdir, w)[0]["rank"]
+                     for w in range(4)}
+        if min(slot_rank, key=slot_rank.get) in (2, 3):
+            victim_host, survivor_slots = "A", [2, 3]
+        else:
+            victim_host, survivor_slots = "B", [0, 1]
+        orch.kill_host(victim_host)
+        codes = orch.monitor(until=420)
+    finally:
+        orch.shutdown()
+
+    # the orchestrator recorded the host loss on its own
+    losses = [e for e in orch.events if e["event"] == "host_loss"
+              and e["host"] == victim_host]
+    assert losses, orch.events
+    assert codes[victim_host] != 0
+
+    for w in survivor_slots:
+        recs = _read_status(workdir, w)
+        builds = [r for r in recs if r["event"] == "build"]
+        assert len(builds[-1]["alive"]) == 2, (w, builds[-1])
+        assert builds[-1]["plan"]["dp"] == 2, (w, builds[-1])
+        done = [r for r in recs if r["event"] == "done"]
+        assert done and done[0]["final_step"] >= num_steps, (w, recs)
+
+    # checkpoint continuity + the loss curve CONTINUES: the leader's
+    # post-loss generation resumed past step 0 and its post-resume losses
+    # end below the first recorded loss
+    leader_slot = min(survivor_slots, key=lambda w: slot_rank[w])
+    recs_l = _read_status(workdir, leader_slot)
+    gen2 = [r for r in recs_l if r["event"] == "generation"][-1]
+    assert gen2["resumed_step"] > 0, recs_l
+    curve = [(r["step"], r["loss"]) for r in recs_l if r["event"] == "loss"]
+    post = [l for s, l in curve if s > gen2["resumed_step"]]
+    assert post, curve
+    assert post[-1] < curve[0][1], curve
+
+
+@pytest.mark.slow
+def test_host_loss_respawns_slots_on_survivor(tmp_path):
+    """respawn_lost_slots: after host B dies, the orchestrator respawns
+    B's two slots on host A (fresh cluster-unique ids 4,5 — the
+    detect_node_info relaunch analog), broadcasts a re-mesh, and the
+    grown membership (old + joiners, via the cluster-epoch re-plan
+    protocol) agrees on a world=4 plan again."""
+    workdir = str(tmp_path)
+    num_steps = 600
+    env = _env()
+    # slow pace: the joiners (fresh python + jax import + trainer build)
+    # must come up while the survivors are still training
+    env["HETU_TPU_TEST_PACE"] = "0.15"
+    orch = MultiHostOrchestrator(
+        [sys.executable, WORKER, workdir, str(num_steps)],
+        hosts={"A": 2, "B": 2}, env=env, heartbeat_timeout=30.0,
+        respawn_lost_slots=True,
+        log_dir=os.path.join(workdir, "logs")).start()
+    try:
+        _wait_first_generation(workdir, range(4))
+        time.sleep(2.0)
+        slot_rank = {w: _read_status(workdir, w)[0]["rank"]
+                     for w in range(4)}
+        victim_host = "B" if min(slot_rank, key=slot_rank.get) in (0, 1) \
+            else "A"
+        survivor_slots = [0, 1] if victim_host == "B" else [2, 3]
+        orch.kill_host(victim_host)
+        codes = orch.monitor(until=420)
+    finally:
+        orch.shutdown()
+
+    respawns = [e for e in orch.events if e["event"] == "respawn"]
+    assert respawns and respawns[0]["slots"] == [4, 5], orch.events
+    assert any(e["event"] == "remesh_broadcast" for e in orch.events)
+    assert codes[respawns[0]["host"]] == 0, codes
+
+    # survivors re-meshed TWICE (loss -> dp=2, respawn -> dp=4 again)
+    for w in survivor_slots:
+        recs = _read_status(workdir, w)
+        builds = [r for r in recs if r["event"] == "build"]
+        assert len(builds) >= 3, (w, builds)
+        assert len(builds[-1]["alive"]) == 4, (w, builds[-1])
+        assert builds[-1]["plan"]["dp"] == 4, (w, builds[-1])
+        done = [r for r in recs if r["event"] == "done"]
+        assert done and done[0]["final_step"] >= num_steps, (w, recs)
+    # the joiners adopted the cluster epoch and finished too
+    for w in (4, 5):
+        recs = _read_status(workdir, w)
+        builds = [r for r in recs if r["event"] == "build"]
+        assert builds and len(builds[-1]["alive"]) == 4, (w, recs)
+        done = [r for r in recs if r["event"] == "done"]
+        assert done, (w, recs)
+
+
+if __name__ == "__main__":
+    sys.exit(pytest.main([__file__, "-v", "-m", "slow"]))

@@ -135,3 +135,139 @@ def test_tiktoken_without_package(tmp_path, monkeypatch):
     assert tok.decode(ids) == "hello world"
     tok2 = build_tokenizer("tiktoken", str(path))
     assert tok2.encode("hello world") == ids
+
+
+def _nfkc_pieces():
+    pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3)]
+    pieces += [(f"<0x{b:02X}>", 0.0, 6) for b in range(256)]
+    for i, text in enumerate(["abc", "café"[:4], "café", "x",
+                              "a", "b", "c", WS]):
+        pieces.append((text, -2.0 - 0.1 * i, 1))
+    return pieces
+
+
+def test_sp_nmt_nfkc_normalizer():
+    """A synthesized nmt_nfkc model: fullwidth/compatibility forms and
+    decomposed accents normalize exactly like the spec's NFKC step, NBSP
+    becomes a plain space, and extra whitespace squeezes away."""
+    import unicodedata
+    blob = write_model_proto(_nfkc_pieces(), model_type=1,
+                             byte_fallback=True,
+                             normalizer_name="nmt_nfkc",
+                             remove_extra_whitespaces=True)
+    tok = SentencePieceTokenizer(model_bytes=blob)
+    assert tok.normalizer_name == "nmt_nfkc"
+    # fullwidth ａｂｃ -> abc (NFKC compatibility mapping)
+    assert tok.decode(tok.encode("ａｂｃ")) == "abc"
+    # decomposed e + combining acute -> composed é
+    assert tok.decode(tok.encode("café")) == "café"
+    # NBSP -> space; runs of whitespace squeeze to one; edges strip
+    ids = tok.encode("  abc  x  ")
+    assert tok.decode(ids) == "abc x"
+    # the normalized form matches applying unicodedata NFKC directly
+    assert tok.encode("ａｂｃ") == tok.encode(
+        unicodedata.normalize("NFKC", "ａｂｃ"))
+
+
+def test_sp_nfkc_cf_casefolds():
+    blob = write_model_proto(_nfkc_pieces(), model_type=1,
+                             byte_fallback=True,
+                             normalizer_name="nmt_nfkc_cf")
+    tok = SentencePieceTokenizer(model_bytes=blob)
+    assert tok.decode(tok.encode("ABC")) == "abc"
+
+
+def test_sp_unknown_normalizer_raises():
+    blob = write_model_proto(_nfkc_pieces(), model_type=1,
+                             normalizer_name="martian")
+    tok = SentencePieceTokenizer(model_bytes=blob)
+    with pytest.raises(ValueError, match="martian"):
+        tok.encode("abc")
+
+
+def test_sp_identity_default_unchanged():
+    """LLaMA models carry the identity normalizer: behavior must be
+    byte-identical to the pre-normalizer implementation."""
+    blob = write_model_proto(_llama_style_pieces(), model_type=1,
+                             byte_fallback=True)
+    tok = SentencePieceTokenizer(model_bytes=blob)
+    assert tok.normalizer_name == "identity"
+    assert tok.decode(tok.encode("the quick")) == "the quick"
+
+
+def test_sp_bpe_heap_matches_quadratic_reference():
+    """The heap-based merge loop must reproduce the greedy
+    best-score-first (leftmost on ties) reference exactly."""
+    import random
+
+    pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+              (WS, -1.0, 1)]
+    rng = random.Random(7)
+    alphabet = "abcd"
+    for ch in alphabet:
+        pieces.append((ch, -9.0, 1))
+    seen = {p[0] for p in pieces}
+    for _ in range(40):
+        ln = rng.randint(2, 5)
+        t = "".join(rng.choice(alphabet) for _ in range(ln))
+        if t not in seen:
+            seen.add(t)
+            pieces.append((t, round(rng.uniform(-8.0, -1.0), 3), 1))
+    blob = write_model_proto(pieces, model_type=2, byte_fallback=True)
+    tok = SentencePieceTokenizer(model_bytes=blob)
+
+    def quadratic(text):
+        units = list(text)
+        while len(units) > 1:
+            best_k, best_score = -1, None
+            for k in range(len(units) - 1):
+                hit = tok._vocab.get(units[k] + units[k + 1])
+                if hit is not None and (best_score is None
+                                        or hit[1] > best_score):
+                    best_k, best_score = k, hit[1]
+            if best_k < 0:
+                break
+            units[best_k:best_k + 2] = [units[best_k] + units[best_k + 1]]
+        return tok._bpe_emit(units)
+
+    for _ in range(200):
+        text = "".join(rng.choice(alphabet + " ")
+                       for _ in range(rng.randint(0, 40)))
+        norm = tok._normalize(text)
+        assert tok._encode_bpe(norm) == quadratic(norm), text
+
+
+@pytest.mark.slow
+def test_sp_bpe_megabyte_under_a_second():
+    """Corpus-tokenization speed: 1MB of text through the BPE path in
+    sub-second time (the O(n^2) rescan took minutes)."""
+    import random
+    import time
+
+    pieces = [("<unk>", 0.0, 2), ("<s>", 0.0, 3), ("</s>", 0.0, 3),
+              (WS, -1.0, 1)]
+    rng = random.Random(3)
+    alphabet = "abcdefgh"
+    for ch in alphabet:
+        pieces.append((ch, -9.0, 1))
+    seen = {p[0] for p in pieces}
+    for _ in range(500):
+        ln = rng.randint(2, 6)
+        t = "".join(rng.choice(alphabet) for _ in range(ln))
+        if t not in seen:
+            seen.add(t)
+            pieces.append((t, round(rng.uniform(-8.0, -1.0), 3), 1))
+    blob = write_model_proto(pieces, model_type=2, byte_fallback=True)
+    tok = SentencePieceTokenizer(model_bytes=blob)
+    words = ["".join(rng.choice(alphabet)
+                     for _ in range(rng.randint(2, 8)))
+             for _ in range(170_000)]
+    text = tok._normalize(" ".join(words))[:1_000_001]
+    t0 = time.perf_counter()
+    ids = tok._encode_bpe(text)
+    dt = time.perf_counter() - t0
+    assert ids
+    assert dt < 1.0, f"1MB BPE encode took {dt:.2f}s"
+    # the ▁-chunked fast path is EXACT vs the whole-text arena
+    small = tok._normalize(" ".join(words[:300]))
+    assert tok._encode_bpe(small) == tok._merge_arena(small)

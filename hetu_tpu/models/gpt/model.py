@@ -32,6 +32,9 @@ class GPTConfig:
     num_attention_heads: int = 12
     max_position_embeddings: int = 1024
     layer_norm_eps: float = 1e-5
+    # the GPT family has a hetero-TP pipeline block maker too
+    # (parallel/hetero_pp.py gpt_block_maker)
+    supports_hetero_tp: bool = True
     tie_word_embeddings: bool = True
     initializer_range: float = 0.02
     attention_dropout: float = 0.0
@@ -203,12 +206,6 @@ class GPTModel(Module):
                  strategy: Optional[ParallelStrategy] = None):
         super().__init__()
         strategy = strategy or ParallelStrategy()
-        if strategy.pp_tp_eff is not None:
-            # defense in depth behind ParallelStrategy.validate: no GPT
-            # hetero-TP block maker exists, and ignoring the request would
-            # silently run every stage at homogeneous TP
-            raise NotImplementedError(
-                "pp_tp_eff is implemented for the LLaMA family only")
         self.config, self.strategy = config, strategy
         c = config
         self.wte = VocabParallelEmbedding(
@@ -258,6 +255,24 @@ class GPTModel(Module):
             mesh = current_mesh()
             if mesh is None:
                 raise ValueError("pipeline needs a mesh (use hetu_tpu.use_mesh)")
+
+            if st.pp_tp_eff is not None:
+                # per-stage hetero TP (see LlamaModel counterpart)
+                from hetu_tpu.parallel.hetero_pp import (
+                    gpt_block_maker, staged_stack_forward_hetero_tp)
+                if st.sequence_parallel or st.cp > 1 or use_drop:
+                    raise NotImplementedError(
+                        "pp_tp_eff composes with no SP, cp=1, no dropout")
+                x, _aux = staged_stack_forward_hetero_tp(
+                    gpt_block_maker(c, tp=st.tp),
+                    self.block.param_specs(), params["blocks"], x,
+                    num_layers=c.num_hidden_layers, pp=st.pp, tp=st.tp,
+                    tp_eff=st.pp_tp_eff, mesh=mesh,
+                    position_ids=position_ids, segment_ids=segment_ids,
+                    stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
+                    remat=c.remat, remat_policy=c.remat_policy,
+                    state_spec=st.pipeline_state_spec())
+                return self.final_ln(params["final_ln"], x)
 
             def block_fn(layer_params, x_mb, pos_mb, seg_mb, rng=None):
                 out = self.block(layer_params, x_mb, position_ids=pos_mb,
@@ -391,6 +406,11 @@ class GPTLMHeadModel(Module):
         c, st = self.config, self.strategy
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
+        if st.pp_tp_eff is not None and (
+                st.sequence_parallel or st.cp > 1 or rng is not None):
+            raise NotImplementedError(
+                "pp_tp_eff under 1f1b composes with no SP, cp=1, "
+                "no dropout (same envelope as the GPipe hetero path)")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()
@@ -454,14 +474,21 @@ class GPTLMHeadModel(Module):
             return ops.softmax_cross_entropy_sparse(
                 lg, tgt, ignore_index=-100, reduction="sum")
 
-        def stage_fn(sp_slice, ep_, x_in, feed_b, feed_s, flg):
-            ids = feed_b["ids"]
-            pos = feed_s.get("position_ids")
-            pos_eff = pos if pos is not None else jnp.broadcast_to(
+        def embed_micro(ep_, ids, pos_row):
+            """wte + wpe + cast + constrain for one [mb, s] micro — ONE
+            implementation for the homogeneous stage_fn AND the hetero-TP
+            round bodies (which differ only in position-row indexing)."""
+            pos_eff = pos_row if pos_row is not None else jnp.broadcast_to(
                 jnp.arange(ids.shape[1], dtype=jnp.int32), ids.shape)
             emb = self.model.wte(ep_["wte"], ids) \
                 + jnp.take(ep_["wpe"], pos_eff, axis=0)
-            emb = st.constrain(emb.astype(c.compute_dtype), st.act_hidden())
+            return st.constrain(emb.astype(c.compute_dtype),
+                                st.act_hidden())
+
+        def stage_fn(sp_slice, ep_, x_in, feed_b, feed_s, flg):
+            ids = feed_b["ids"]
+            pos = feed_s.get("position_ids")
+            emb = embed_micro(ep_, ids, pos)
             x0 = jnp.where(flg["is_first"] > 0, emb, x_in)
             drop = feed_s.get("dropout_rng")
             y = stage_scan(sp_slice, x0, pos, feed_s.get("segment_ids"),
@@ -485,13 +512,33 @@ class GPTLMHeadModel(Module):
                 build_dropout_ride(rng, n_micro, input_ids.shape,
                                    stage_layers)
 
+        custom = None
+        if st.pp_tp_eff is not None:
+            # per-stage hetero TP round bodies (see llama counterpart)
+            from hetu_tpu.parallel.hetero_pp import (
+                gpt_block_maker, hetero_tp_1f1b_rounds)
+
+            def embed_fn(ep_, feed_b, feed_s):
+                pos = feed_s.get("position_ids")
+                # riders carry a leading pp dim here: stage 0's row
+                return embed_micro(ep_, feed_b["ids"],
+                                   pos[0] if pos is not None else None)
+
+            custom = hetero_tp_1f1b_rounds(
+                gpt_block_maker(c, tp=st.tp),
+                self.model.block.param_specs(), embed_fn, head_loss,
+                mesh=mesh, pp=st.pp, tp=st.tp, tp_eff=st.pp_tp_eff,
+                stage_layers=stage_layers, remat=c.remat,
+                remat_policy=c.remat_policy, compute_dtype=c.compute_dtype,
+                token_keys=tuple(ride.keys()))
+
         ce_sum, _aux, d_stage, d_edge = pipeline_train_1f1b(
             stage_fn, sp, ep, input_ids, labels, ride,
             n_micro=n_micro, mesh=mesh, hidden_size=c.hidden_size,
             compute_dtype=c.compute_dtype, aux_seed=0.0,
             state_spec=st.pipeline_state_spec(), loss_scale=loss_scale,
             skip_dead_halves=skip_dead_halves,
-            flags_extra=flags_extra or None)
+            flags_extra=flags_extra or None, custom_rounds=custom)
 
         d_blocks = unstack_stage_grads(
             d_stage, c.num_hidden_layers, st.pp, stage_layers)

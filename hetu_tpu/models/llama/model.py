@@ -581,8 +581,8 @@ class LlamaLMHeadModel(Module):
             from hetu_tpu.parallel.hetero_pp import (
                 hetero_tp_1f1b_rounds, llama_block_maker)
 
-            def embed_fn(ep_, ids_):
-                emb = self.model.embed(ep_["embed"], ids_)
+            def embed_fn(ep_, feed_b, feed_s):
+                emb = self.model.embed(ep_["embed"], feed_b["ids"])
                 return st.constrain(emb.astype(c.compute_dtype),
                                     st.act_hidden())
 

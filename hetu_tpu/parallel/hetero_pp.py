@@ -143,6 +143,75 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
     return maker
 
 
+def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp"):
+    """block_maker(e, m) -> block_fn(layer_params, x, pos, seg) -> (x, 0)
+    running the GPT block manual-over-tp at effective degree e.
+
+    Mirrors models/gpt/model.py GPTBlock exactly (pre-LN, fused qkv
+    [h, n, 3, hd] + bias, flash attention, row o_proj + bias, GELU MLP
+    with biases) — golden-parity tested against it.  Dense, no dropout
+    (the hetero envelope ParallelStrategy.validate enforces)."""
+    from hetu_tpu import ops
+    from jax.ad_checkpoint import checkpoint_name
+
+    hd = cfg.head_dim
+    n_heads = cfg.num_attention_heads
+
+    def maker(e: int, m: int) -> Callable:
+        if n_heads % e:
+            raise ValueError(f"num_attention_heads={n_heads} must divide "
+                             f"by effective tp degree {e}")
+        n_e = n_heads // e
+
+        def block(lp, x, pos, seg):
+            t = lax.axis_index(tp_axis)
+            b, s, h = x.shape
+            ln1w, ln1b, ln2w, ln2b = _al(
+                lp["ln1"]["weight"], lp["ln1"]["bias"],
+                lp["ln2"]["weight"], lp["ln2"]["bias"], x)[:4]
+            xin = ops.layer_norm(x, ln1w, ln1b, cfg.layer_norm_eps)
+            wqkv = _blk(lp["attn"]["wqkv"], 1, t, e, m, tp_axis)
+            bqkv = _blk(lp["attn"]["bqkv"], 0, t, e, m, tp_axis)
+            xin_t, wqkv, bqkv = _al(xin, wqkv, bqkv)
+            qkv = jnp.einsum("bsh,hngd->bsngd", xin_t,
+                             wqkv.astype(x.dtype)) + bqkv.astype(x.dtype)
+            q = qkv[..., 0, :]
+            k = qkv[..., 1, :]
+            v = qkv[..., 2, :]
+            if seg is not None:
+                q, k, v, seg = _al(q, k, v, seg)
+            else:
+                q, k, v = _al(q, k, v)
+            attn = ops.flash_attention(
+                q, k, v, causal=True, segment_ids=seg,
+                use_pallas=None if cfg.use_flash_attention else False)
+            attn = checkpoint_name(attn, "attn_out")
+            wo = _blk(lp["attn"]["o_proj"]["weight"], 0, t, e, m, tp_axis)
+            attn2, wo = _al(attn.reshape(b, s, n_e * hd), wo)
+            h1 = attn2 @ wo.astype(x.dtype)
+            # row-parallel bias adds ONCE, after the reduction
+            h1, ob, x = _al(_psum_wide(h1, tp_axis) / m,
+                            lp["attn"]["o_proj"]["bias"], x)
+            x = x + h1 + ob.astype(x.dtype)
+            xin2 = ops.layer_norm(x, ln2w, ln2b, cfg.layer_norm_eps)
+            w_up = _blk(lp["mlp"]["w_up"], 1, t, e, m, tp_axis)
+            b_up = _blk(lp["mlp"]["b_up"], 0, t, e, m, tp_axis)
+            xin2_t, w_up, b_up = _al(xin2, w_up, b_up)
+            y = xin2_t @ w_up.astype(x.dtype) + b_up.astype(x.dtype)
+            y = ops.gelu(y)
+            wd = _blk(lp["mlp"]["down"]["weight"], 0, t, e, m, tp_axis)
+            y, wd = _al(y, wd)
+            h2 = y @ wd.astype(x.dtype)
+            h2, db, x = _al(_psum_wide(h2, tp_axis) / m,
+                            lp["mlp"]["down"]["bias"], x)
+            x = x + h2 + db.astype(x.dtype)
+            return x, jnp.zeros((), jnp.float32)
+
+        return block
+
+    return maker
+
+
 def _manual_specs(param_spec_tree, keep=("pp", "tp"), lead=("pp", None)):
     """Model ParamSpec tree (one layer) -> PartitionSpecs naming ONLY the
     manual axes (auto axes like dp must stay unmentioned), with the stacked
@@ -243,7 +312,9 @@ def hetero_tp_1f1b_rounds(block_maker: Callable, param_ds_tree, embed_fn,
     per-stage cotangent rows — exact 1F1B semantics because the round
     function is row-wise independent across stages.
 
-    embed_fn(edge_params, ids [mb, s]) -> [mb, s, h] hidden (auto mode);
+    embed_fn(edge_params, feed_b, feed_s) -> [mb, s, h] hidden (auto mode;
+      feed_b carries "ids"/"labels", feed_s the token riders — GPT's wpe
+      needs the positions);
     head_fn(edge_params, y [mb, s, h], labels) -> summed CE scalar.
     """
     import numpy as np
@@ -257,7 +328,7 @@ def hetero_tp_1f1b_rounds(block_maker: Callable, param_ds_tree, embed_fn,
     last_idx = pp - 1
 
     def round_fn(sp, ep, x_in, feed_b, feed_s):
-        emb = embed_fn(ep, feed_b["ids"]).astype(compute_dtype)
+        emb = embed_fn(ep, feed_b, feed_s).astype(compute_dtype)
         x0 = jnp.where(first[:, None, None, None], emb[None], x_in)
         y, aux_row = vstack(sp, x0, feed_s)
         ce = head_fn(ep, y[last_idx], feed_b["labels"])

@@ -336,8 +336,9 @@ class ParallelStrategy:
         if self.pp_tp_eff is not None:
             if not getattr(model_cfg, "supports_hetero_tp", False):
                 fail("pp_tp_eff needs a model family with a hetero-TP "
-                     "block maker (LLaMA); this one would silently run "
-                     "all stages at homogeneous TP")
+                     "block maker (LLaMA and GPT have one — see "
+                     "parallel/hetero_pp.py); this one would silently "
+                     "run all stages at homogeneous TP")
             if n_experts > 0:
                 fail("pp_tp_eff composes with dense blocks only "
                      f"(num_experts={n_experts})")

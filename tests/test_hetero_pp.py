@@ -144,3 +144,24 @@ def test_1f1b_pp_tp_eff_envelope():
     ids = _ids()
     with pytest.raises(NotImplementedError, match="pp_tp_eff"):
         model.pipeline_train_grads({}, ids, ids, n_micro=2)
+
+
+def test_gpt_hetero_tp_pipeline_matches_single_device():
+    """GPT family through the hetero-TP pipeline (gpt_block_maker):
+    logits parity with the single-device model."""
+    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    cfg = GPTConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                         use_flash_attention=False, use_scan=True)
+    ids = _ids(vocab=cfg.vocab_size)
+    gmodel = GPTLMHeadModel(cfg, ParallelStrategy())
+    gp = gmodel.init(jax.random.key(1))
+    golden = gmodel(gp, ids)
+
+    st = ParallelStrategy(mesh=MeshConfig(pp=2, tp=2), pp_tp_eff=(2, 1))
+    mesh = st.build_mesh(devices=jax.devices()[:4])
+    model = GPTLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(1), mesh=mesh)
+        out = jax.jit(lambda p, x: model(p, x, n_micro=2))(params, ids)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(golden),
+                               rtol=2e-3, atol=2e-3)

@@ -439,3 +439,29 @@ def test_1f1b_dropout_grads_match_reference():
         rel = float(jnp.max(jnp.abs(a - flat[path]))) / (
             float(jnp.max(jnp.abs(a))) + 1e-8)
         assert rel < 2e-4, (path, rel)
+
+
+@pytest.mark.slow
+def test_gpt_1f1b_hetero_tp():
+    """GPT pp_tp_eff under 1f1b (gpt_block_maker round bodies) — parity
+    with the GPT GPipe hetero path."""
+    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    cfg = GPTConfig.tiny(remat=False, compute_dtype=jnp.float32)
+    st = ParallelStrategy(mesh=MeshConfig(pp=2, tp=2), pp_tp_eff=(2, 1))
+    ids = jnp.asarray(np.random.default_rng(5).integers(
+        0, cfg.vocab_size, (8, 32)), jnp.int32)
+    mesh = st.build_mesh()
+    model = GPTLMHeadModel(cfg, st)
+    with ht.use_mesh(mesh):
+        params = model.init(jax.random.key(5), mesh=mesh)
+        (glsum, _), ggrads = jax.jit(jax.value_and_grad(
+            lambda p: model(p, ids, labels=ids, n_micro=4,
+                            loss_reduction="sum"), has_aux=True))(params)
+        (lsum, _), grads = jax.jit(
+            lambda p: model.pipeline_train_grads(p, ids, ids,
+                                                 n_micro=4))(params)
+    assert abs(float(lsum) - float(glsum)) / abs(float(glsum)) < 1e-5
+    for a, g in zip(jax.tree.leaves(ggrads), jax.tree.leaves(grads)):
+        rel = float(jnp.max(jnp.abs(a - g))) / (float(jnp.max(jnp.abs(a)))
+                                                + 1e-8)
+        assert rel < 2e-4, rel

@@ -72,15 +72,19 @@ MODEL_INVALID = [
 
 
 def test_pp_tp_eff_needs_hetero_capable_family():
-    """GPT has no hetero-TP block maker: the chokepoint (and the model
-    constructor, defense in depth) must refuse pp_tp_eff instead of
-    silently running homogeneous TP."""
-    from hetu_tpu.models.gpt import GPTConfig, GPTLMHeadModel
+    """A model family without a hetero-TP block maker (no
+    supports_hetero_tp flag) must be refused at plan time instead of
+    silently running homogeneous TP.  Both in-tree families (LLaMA, GPT)
+    carry the flag and pass."""
+    from types import SimpleNamespace
+    from hetu_tpu.models.gpt import GPTConfig
     st = _st(pp=2, tp=2, pp_tp_eff=(2, 1))
+    alien = SimpleNamespace(num_attention_heads=4, num_key_value_heads=4,
+                            num_hidden_layers=2, use_scan=True)
     with pytest.raises(StrategyValidationError, match="hetero-TP"):
-        st.validate(GPTConfig.tiny())
-    with pytest.raises(NotImplementedError, match="LLaMA"):
-        GPTLMHeadModel(GPTConfig.tiny(), st)
+        st.validate(alien)
+    st.validate(GPTConfig.tiny())
+    st.validate(_cfg())
 
 
 @pytest.mark.parametrize("st_kw,val_kw,match", INVALID)

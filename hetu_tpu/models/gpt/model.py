@@ -260,14 +260,16 @@ class GPTModel(Module):
                 # per-stage hetero TP (see LlamaModel counterpart)
                 from hetu_tpu.parallel.hetero_pp import (
                     gpt_block_maker, staged_stack_forward_hetero_tp)
-                if st.sequence_parallel or st.cp > 1 or use_drop:
+                if st.cp > 1 or use_drop:
                     raise NotImplementedError(
-                        "pp_tp_eff composes with no SP, cp=1, no dropout")
+                        "pp_tp_eff composes with cp=1, no dropout")
                 x, _aux = staged_stack_forward_hetero_tp(
-                    gpt_block_maker(c, tp=st.tp),
+                    gpt_block_maker(c, tp=st.tp,
+                                    sequence_parallel=st.sequence_parallel),
                     self.block.param_specs(), params["blocks"], x,
                     num_layers=c.num_hidden_layers, pp=st.pp, tp=st.tp,
                     tp_eff=st.pp_tp_eff, mesh=mesh,
+                    sequence_parallel=st.sequence_parallel,
                     position_ids=position_ids, segment_ids=segment_ids,
                     stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
                     remat=c.remat, remat_policy=c.remat_policy,
@@ -406,11 +408,10 @@ class GPTLMHeadModel(Module):
         c, st = self.config, self.strategy
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
-        if st.pp_tp_eff is not None and (
-                st.sequence_parallel or st.cp > 1 or rng is not None):
+        if st.pp_tp_eff is not None and (st.cp > 1 or rng is not None):
             raise NotImplementedError(
-                "pp_tp_eff under 1f1b composes with no SP, cp=1, "
-                "no dropout (same envelope as the GPipe hetero path)")
+                "pp_tp_eff under 1f1b composes with cp=1, no dropout "
+                "(same envelope as the GPipe hetero path)")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()
@@ -525,12 +526,14 @@ class GPTLMHeadModel(Module):
                                    pos[0] if pos is not None else None)
 
             custom = hetero_tp_1f1b_rounds(
-                gpt_block_maker(c, tp=st.tp),
+                gpt_block_maker(c, tp=st.tp,
+                                sequence_parallel=st.sequence_parallel),
                 self.model.block.param_specs(), embed_fn, head_loss,
                 mesh=mesh, pp=st.pp, tp=st.tp, tp_eff=st.pp_tp_eff,
                 stage_layers=stage_layers, remat=c.remat,
                 remat_policy=c.remat_policy, compute_dtype=c.compute_dtype,
-                token_keys=tuple(ride.keys()))
+                token_keys=tuple(ride.keys()),
+                sequence_parallel=st.sequence_parallel)
 
         ce_sum, _aux, d_stage, d_edge = pipeline_train_1f1b(
             stage_fn, sp, ep, input_ids, labels, ride,

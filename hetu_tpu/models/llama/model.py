@@ -291,17 +291,19 @@ class LlamaDecoderStack(Module):
             # distributed_states.h:158 unions over unequal stage groups)
             from hetu_tpu.parallel.hetero_pp import (
                 llama_block_maker, staged_stack_forward_hetero_tp)
-            if c.num_experts > 0 or st.sequence_parallel or st.cp > 1:
+            if c.num_experts > 0 or st.cp > 1:
                 raise NotImplementedError(
-                    "pp_tp_eff composes with dense blocks, no SP, cp=1")
+                    "pp_tp_eff composes with dense blocks, cp=1")
             if rng is not None:
                 raise NotImplementedError(
                     "dropout inside the hetero-TP pipeline")
             return staged_stack_forward_hetero_tp(
-                llama_block_maker(c, cos, sin, tp=st.tp),
+                llama_block_maker(c, cos, sin, tp=st.tp,
+                                  sequence_parallel=st.sequence_parallel),
                 self.block.param_specs(), params["layers"], x,
                 num_layers=self.num_layers, pp=st.pp, tp=st.tp,
                 tp_eff=st.pp_tp_eff, mesh=mesh,
+                sequence_parallel=st.sequence_parallel,
                 position_ids=position_ids, segment_ids=segment_ids,
                 stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
                 remat=c.remat, remat_policy=c.remat_policy,
@@ -466,11 +468,10 @@ class LlamaLMHeadModel(Module):
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
         if st.pp_tp_eff is not None and (
-                c.num_experts > 0 or st.sequence_parallel or st.cp > 1
-                or rng is not None):
+                c.num_experts > 0 or st.cp > 1 or rng is not None):
             raise NotImplementedError(
-                "pp_tp_eff under 1f1b composes with dense blocks, no SP, "
-                "cp=1, no dropout (same envelope as the GPipe hetero path)")
+                "pp_tp_eff under 1f1b composes with dense blocks, cp=1, "
+                "no dropout (same envelope as the GPipe hetero path)")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()
@@ -587,12 +588,14 @@ class LlamaLMHeadModel(Module):
                                     st.act_hidden())
 
             custom = hetero_tp_1f1b_rounds(
-                llama_block_maker(c, cos, sin, tp=st.tp),
+                llama_block_maker(c, cos, sin, tp=st.tp,
+                                  sequence_parallel=st.sequence_parallel),
                 block.param_specs(), embed_fn, head_loss,
                 mesh=mesh, pp=st.pp, tp=st.tp, tp_eff=st.pp_tp_eff,
                 stage_layers=stage_layers, remat=c.remat,
                 remat_policy=c.remat_policy, compute_dtype=c.compute_dtype,
-                token_keys=tuple(ride.keys()))
+                token_keys=tuple(ride.keys()),
+                sequence_parallel=st.sequence_parallel)
 
         ce_sum, aux_sum, d_stage, d_edge = pipeline_train_1f1b(
             stage_fn, sp, ep, input_ids, labels, ride,

@@ -63,6 +63,35 @@ def _psum_wide(x, axis):
     return lax.psum(x, axis)
 
 
+def _reduce_out(x, axis, *, sp: bool, seq_dim: int = 1):
+    """The row-parallel output reduction: all-reduce (plain TP) or
+    reduce-scatter onto the seq dim (Megatron-SP) — same 16-bit widening
+    guard as _psum_wide."""
+    if not sp:
+        return _psum_wide(x, axis)
+    if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
+        return lax.psum_scatter(
+            x.astype(jnp.float32), axis, scatter_dimension=seq_dim,
+            tiled=True).astype(x.dtype)
+    return lax.psum_scatter(x, axis, scatter_dimension=seq_dim, tiled=True)
+
+
+def _gather_seq(x, axis, *, sp: bool, seq_dim: int = 1):
+    """SP regions enter the projections through a seq all-gather.
+
+    On the cpu backend 16-bit inputs gather in f32: the TRANSPOSE of a
+    tiled all-gather is a psum_scatter of the cotangent, and a 16-bit
+    reduce-scatter from a partial-manual region hits the same XLA:CPU
+    AllReducePromotion check-fail as 16-bit psums (see _psum_wide) —
+    widening around the gather keeps that transpose f32."""
+    if not sp:
+        return x
+    if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
+        return lax.all_gather(x.astype(jnp.float32), axis, axis=seq_dim,
+                              tiled=True).astype(x.dtype)
+    return lax.all_gather(x, axis, axis=seq_dim, tiled=True)
+
+
 def _blk(w, dim: int, t, e: int, m: int, tp_axis: str):
     """Block-major effective-degree weight slice: the [dim]-sharded weight's
     block t//m of e, as a LOCAL slice of the tp all-gather (m==1: the local
@@ -75,19 +104,25 @@ def _blk(w, dim: int, t, e: int, m: int, tp_axis: str):
     return lax.dynamic_slice_in_dim(full, idx, size_e, axis=dim)
 
 
-def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
+def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp",
+                      sequence_parallel: bool = False):
     """block_maker(e, m) -> block_fn(layer_params, x, pos, seg) -> (x, aux)
     running the LLaMA block manual-over-tp at effective degree e.
 
     Mirrors models/llama/model.py LlamaBlock exactly (pre-norm, fused qkv
     [h, n_kv, group+2, hd], RoPE, flash attention, row o_proj, SwiGLU MLP)
-    — golden-parity tested against it. Dense only (no MoE/dropout here)."""
+    — golden-parity tested against it. Dense only (no MoE/dropout here).
+    sequence_parallel: between-block activations arrive seq-sharded over
+    the FULL tp axis (Megatron-SP in manual form — all-gather into the
+    projections, reduce-scatter out of the row-parallel matmuls; weight
+    blocks still replicate m-fold at effective degree e)."""
     from hetu_tpu import ops
     from jax.ad_checkpoint import checkpoint_name
 
     hd = cfg.head_dim
     n_q, n_kv = cfg.num_attention_heads, cfg.num_key_value_heads
     group = n_q // n_kv
+    sp = sequence_parallel
 
     def maker(e: int, m: int) -> Callable:
         if n_kv % e:
@@ -97,10 +132,11 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
 
         def block(lp, x, pos, seg):
             t = lax.axis_index(tp_axis)
-            b, s, h = x.shape
             nw, nw2 = _al(lp["input_norm"]["weight"], lp["post_norm"]["weight"],
                           x)[:2]
-            xin = ops.rms_norm(x, nw, cfg.rms_norm_eps)
+            xin = _gather_seq(ops.rms_norm(x, nw, cfg.rms_norm_eps),
+                              tp_axis, sp=sp)
+            b, s, h = xin.shape
             wqkv = _blk(lp["attn"]["wqkv"], 1, t, e, m, tp_axis)
             xin_t, wqkv = _al(xin, wqkv)
             qkv = jnp.einsum("bsh,hkgd->bskgd", xin_t,
@@ -125,9 +161,11 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
             wo = _blk(lp["attn"]["o_proj"]["weight"], 0, t, e, m, tp_axis)
             attn2, wo = _al(attn.reshape(b, s, kv_e * group * hd), wo)
             h1 = attn2 @ wo.astype(x.dtype)
-            h1, x = _al(_psum_wide(h1, tp_axis) / m, x)
+            h1, x = _al(_reduce_out(h1, tp_axis, sp=sp) / m, x)
             x = x + h1
-            xin2 = ops.rms_norm(x, _al(nw2, x)[0], cfg.rms_norm_eps)
+            xin2 = _gather_seq(
+                ops.rms_norm(x, _al(nw2, x)[0], cfg.rms_norm_eps),
+                tp_axis, sp=sp)
             wgu = _blk(lp["mlp"]["w_gate_up"], 2, t, e, m, tp_axis)
             xin2_t, wgu = _al(xin2, wgu)
             gu = jnp.einsum("bsh,hci->bsci", xin2_t, wgu.astype(x.dtype))
@@ -135,7 +173,7 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
             wd = _blk(lp["mlp"]["down_proj"]["weight"], 0, t, e, m, tp_axis)
             hidden, wd = _al(hidden, wd)
             h2 = hidden @ wd.astype(x.dtype)
-            h2, x = _al(_psum_wide(h2, tp_axis) / m, x)
+            h2, x = _al(_reduce_out(h2, tp_axis, sp=sp) / m, x)
             return x + h2, jnp.zeros((), jnp.float32)
 
         return block
@@ -143,19 +181,22 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp"):
     return maker
 
 
-def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp"):
+def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp",
+                    sequence_parallel: bool = False):
     """block_maker(e, m) -> block_fn(layer_params, x, pos, seg) -> (x, 0)
     running the GPT block manual-over-tp at effective degree e.
 
     Mirrors models/gpt/model.py GPTBlock exactly (pre-LN, fused qkv
     [h, n, 3, hd] + bias, flash attention, row o_proj + bias, GELU MLP
     with biases) — golden-parity tested against it.  Dense, no dropout
-    (the hetero envelope ParallelStrategy.validate enforces)."""
+    (the hetero envelope ParallelStrategy.validate enforces).
+    sequence_parallel: see llama_block_maker."""
     from hetu_tpu import ops
     from jax.ad_checkpoint import checkpoint_name
 
     hd = cfg.head_dim
     n_heads = cfg.num_attention_heads
+    sp = sequence_parallel
 
     def maker(e: int, m: int) -> Callable:
         if n_heads % e:
@@ -165,11 +206,13 @@ def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp"):
 
         def block(lp, x, pos, seg):
             t = lax.axis_index(tp_axis)
-            b, s, h = x.shape
             ln1w, ln1b, ln2w, ln2b = _al(
                 lp["ln1"]["weight"], lp["ln1"]["bias"],
                 lp["ln2"]["weight"], lp["ln2"]["bias"], x)[:4]
-            xin = ops.layer_norm(x, ln1w, ln1b, cfg.layer_norm_eps)
+            xin = _gather_seq(
+                ops.layer_norm(x, ln1w, ln1b, cfg.layer_norm_eps),
+                tp_axis, sp=sp)
+            b, s, h = xin.shape
             wqkv = _blk(lp["attn"]["wqkv"], 1, t, e, m, tp_axis)
             bqkv = _blk(lp["attn"]["bqkv"], 0, t, e, m, tp_axis)
             xin_t, wqkv, bqkv = _al(xin, wqkv, bqkv)
@@ -190,10 +233,12 @@ def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp"):
             attn2, wo = _al(attn.reshape(b, s, n_e * hd), wo)
             h1 = attn2 @ wo.astype(x.dtype)
             # row-parallel bias adds ONCE, after the reduction
-            h1, ob, x = _al(_psum_wide(h1, tp_axis) / m,
+            h1, ob, x = _al(_reduce_out(h1, tp_axis, sp=sp) / m,
                             lp["attn"]["o_proj"]["bias"], x)
             x = x + h1 + ob.astype(x.dtype)
-            xin2 = ops.layer_norm(x, ln2w, ln2b, cfg.layer_norm_eps)
+            xin2 = _gather_seq(
+                ops.layer_norm(x, ln2w, ln2b, cfg.layer_norm_eps),
+                tp_axis, sp=sp)
             w_up = _blk(lp["mlp"]["w_up"], 1, t, e, m, tp_axis)
             b_up = _blk(lp["mlp"]["b_up"], 0, t, e, m, tp_axis)
             xin2_t, w_up, b_up = _al(xin2, w_up, b_up)
@@ -202,7 +247,7 @@ def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp"):
             wd = _blk(lp["mlp"]["down"]["weight"], 0, t, e, m, tp_axis)
             y, wd = _al(y, wd)
             h2 = y @ wd.astype(x.dtype)
-            h2, db, x = _al(_psum_wide(h2, tp_axis) / m,
+            h2, db, x = _al(_reduce_out(h2, tp_axis, sp=sp) / m,
                             lp["mlp"]["down"]["bias"], x)
             x = x + h2 + db.astype(x.dtype)
             return x, jnp.zeros((), jnp.float32)
@@ -235,12 +280,14 @@ def _hetero_switch_stack(block_maker: Callable, param_ds_tree, mesh, *,
                          pp: int, tp: int, tp_eff: Sequence[int],
                          stage_layers: Sequence[int], remat: bool,
                          remat_policy: str, token_keys=(),
-                         pp_axis: str = "pp", tp_axis: str = "tp"):
+                         pp_axis: str = "pp", tp_axis: str = "tp",
+                         sequence_parallel: bool = False):
     """shard_map'ed (stage_params, x_buf [pp, mb, s, h], tok_buf) ->
     (y_buf, aux_row [pp]): manual over (pp, tp) with a `lax.switch` on the
     stage index choosing that stage's static (tp_eff, layer-count) branch.
     ONE builder shared by the GPipe hetero pipeline and the 1F1B hetero
-    round bodies."""
+    round bodies.  Under SP the x buffer enters/leaves seq-sharded over
+    the tp axis (the block maker must be built sequence_parallel too)."""
 
     def stage_branch(stage_i: int):
         e = tp_eff[stage_i]
@@ -279,10 +326,12 @@ def _hetero_switch_stack(block_maker: Callable, param_ds_tree, mesh, *,
         return y[None], jnp.reshape(aux, (1,)).astype(jnp.float32)
 
     Ppp = P(pp_axis)
+    # [pp, mb, s, h] buffers: seq dim manual-sharded over tp under SP
+    Px = P(pp_axis, None, tp_axis) if sequence_parallel else Ppp
     return jax.shard_map(
         manual, mesh=mesh,
-        in_specs=(pspecs, Ppp, {k: Ppp for k in token_keys}),
-        out_specs=(Ppp, Ppp),
+        in_specs=(pspecs, Px, {k: Ppp for k in token_keys}),
+        out_specs=(Px, Ppp),
         axis_names=frozenset({pp_axis, tp_axis}), check_vma=True)
 
 
@@ -291,7 +340,8 @@ def hetero_tp_1f1b_rounds(block_maker: Callable, param_ds_tree, embed_fn,
                           tp_eff: Sequence[int], stage_layers: Sequence[int],
                           remat: bool, remat_policy: str, compute_dtype,
                           token_keys=(), pp_axis: str = "pp",
-                          tp_axis: str = "tp"):
+                          tp_axis: str = "tp",
+                          sequence_parallel: bool = False):
     """(vfwd, vbwd) round bodies for `pipeline_train_1f1b(custom_rounds=...)`
     running each stage at effective TP degree tp_eff[s].
 
@@ -322,7 +372,8 @@ def hetero_tp_1f1b_rounds(block_maker: Callable, param_ds_tree, embed_fn,
     vstack = _hetero_switch_stack(
         block_maker, param_ds_tree, mesh, pp=pp, tp=tp, tp_eff=tp_eff,
         stage_layers=stage_layers, remat=remat, remat_policy=remat_policy,
-        token_keys=token_keys, pp_axis=pp_axis, tp_axis=tp_axis)
+        token_keys=token_keys, pp_axis=pp_axis, tp_axis=tp_axis,
+        sequence_parallel=sequence_parallel)
 
     first = jnp.asarray(np.arange(pp) == 0)
     last_idx = pp - 1
@@ -360,7 +411,8 @@ def staged_stack_forward_hetero_tp(
         position_ids=None, segment_ids=None, stage_layers=None,
         n_micro: Optional[int] = None, remat: bool = True,
         remat_policy: str = "nothing", state_spec=None,
-        pp_axis: str = "pp", tp_axis: str = "tp"):
+        pp_axis: str = "pp", tp_axis: str = "tp",
+        sequence_parallel: bool = False):
     """GPipe pipeline where stage s runs at effective TP degree tp_eff[s].
 
     block_maker(e, m) -> block_fn(local_layer_params, x_mb, pos, seg);
@@ -398,7 +450,8 @@ def staged_stack_forward_hetero_tp(
     vbody = _hetero_switch_stack(
         block_maker, param_ds_tree, mesh, pp=pp, tp=tp, tp_eff=tp_eff,
         stage_layers=stage_layers, remat=remat, remat_policy=remat_policy,
-        token_keys=tuple(token_data), pp_axis=pp_axis, tp_axis=tp_axis)
+        token_keys=tuple(token_data), pp_axis=pp_axis, tp_axis=tp_axis,
+        sequence_parallel=sequence_parallel)
 
     def shift_in(new, state, sp=None):
         out = jnp.concatenate([new[None], state[:-1]], axis=0)

@@ -236,9 +236,9 @@ class ParallelStrategy:
                     fail(f"cp_tp_eff entry {e} must divide mesh tp={self.tp}")
 
         # hetero-TP pipeline: per-STAGE effective TP in one program, on
-        # both schedules (GPipe switch bodies + 1f1b hetero round bodies).
-        # Engine envelope (models/llama/model.py pp_tp_eff path +
-        # parallel/hetero_pp.py): dense blocks, no SP, cp=1, no dropout.
+        # both schedules (GPipe switch bodies + 1f1b hetero round bodies),
+        # with or without SP.  Engine envelope (models pp_tp_eff paths +
+        # parallel/hetero_pp.py): dense blocks, cp=1, no dropout.
         if self.pp_tp_eff is not None:
             if self.pp <= 1:
                 fail("pp_tp_eff requires pp > 1")
@@ -248,12 +248,13 @@ class ParallelStrategy:
             for e in self.pp_tp_eff:
                 if e < 1 or self.tp % e:
                     fail(f"pp_tp_eff entry {e} must divide mesh tp={self.tp}")
-            if self.sequence_parallel:
-                fail("pp_tp_eff composes with dense blocks, no SP, cp=1 "
-                     "(sequence_parallel=True set)")
             if self.cp > 1:
-                fail(f"pp_tp_eff composes with dense blocks, no SP, cp=1 "
+                fail(f"pp_tp_eff composes with dense blocks, cp=1 "
                      f"(cp={self.cp} set)")
+            if self.sequence_parallel and seq_len is not None \
+                    and seq_len % self.tp:
+                fail(f"pp_tp_eff+SP reduce-scatters the seq dim: "
+                     f"seq_len={seq_len} must divide by tp={self.tp}")
 
         # batch/micro divisibility (pipeline schedules and plain gradient
         # accumulation both split the batch into n_micro equal microbatches)

@@ -465,3 +465,12 @@ def test_gpt_1f1b_hetero_tp():
         rel = float(jnp.max(jnp.abs(a - g))) / (float(jnp.max(jnp.abs(a)))
                                                 + 1e-8)
         assert rel < 2e-4, rel
+
+
+@pytest.mark.slow
+def test_1f1b_hetero_tp_sequence_parallel():
+    """pp_tp_eff + SP under 1f1b: seq-sharded hetero round bodies."""
+    _parity(LlamaConfig.tiny(**_BASE),
+            ParallelStrategy(mesh=MeshConfig(dp=2, pp=2, tp=2),
+                             pp_tp_eff=(2, 1), sequence_parallel=True),
+            n_micro=4)

@@ -36,8 +36,9 @@ INVALID = [
     (dict(pp_tp_eff=(1,)), {}, "pp_tp_eff requires pp > 1"),
     (dict(pp=2, tp=2, pp_tp_eff=(2,)), {}, "entries for pp"),
     (dict(pp=2, tp=4, pp_tp_eff=(4, 3)), {}, "must divide mesh tp"),
-    (dict(pp=2, tp=2, pp_tp_eff=(2, 1), sequence_parallel=True), {},
-     "sequence_parallel"),
+    # pp_tp_eff + SP is SUPPORTED; its seq dim must reduce-scatter evenly
+    (dict(pp=2, tp=2, pp_tp_eff=(2, 1), sequence_parallel=True),
+     dict(seq_len=33), "must divide by tp"),
     (dict(pp=2, tp=2, cp=2, pp_tp_eff=(2, 1)), {}, "cp=2 set"),
     # batch divisibility
     (dict(dp=2), dict(global_batch=7), "divide by dp"),
@@ -113,6 +114,9 @@ def test_valid_plans_pass():
     # hetero-TP now runs under BOTH schedules (hetero_tp_1f1b_rounds)
     _st(pp=2, tp=2, pp_tp_eff=(2, 1)).validate(cfg, pp_schedule="1f1b",
                                                n_micro=2)
+    # ... and WITH sequence parallelism (SP block makers)
+    _st(pp=2, tp=2, pp_tp_eff=(2, 1), sequence_parallel=True).validate(
+        cfg, seq_len=64)
     _st(pp=2).validate(cfg, pp_schedule="1f1b", n_micro=4)
     _st(pp=2).validate(_cfg(num_experts=2), pp_schedule="1f1b", n_micro=4)
     # 1f1b composes with CP rings and with MoE on mixed meshes (the vmap

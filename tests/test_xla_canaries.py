@@ -61,6 +61,23 @@ print("COMPILED-OK")
 """
 
 
+_RS_REPRO = """
+import os
+os.environ["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
+import jax, jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+jax.config.update("jax_platforms", "cpu")
+mesh = jax.make_mesh((2, 2, 2), ("a", "b", "c"))
+f = jax.jit(jax.shard_map(
+    lambda x: lax.psum_scatter(x * 2, "c", scatter_dimension=0, tiled=True),
+    mesh=mesh, in_specs=P("b", None), out_specs=P(("b", "c")),
+    axis_names=frozenset({"b", "c"})))
+f(jnp.ones((8, 8), jnp.bfloat16))
+print("COMPILED-OK")
+"""
+
+
 def _run(src: str):
     return subprocess.run([sys.executable, "-c", src],
                           capture_output=True, text=True, timeout=420)
@@ -82,6 +99,20 @@ def test_canary_cpu_16bit_psum_partial_manual():
             "XLA:CPU now compiles 16-bit psum from partial-manual regions "
             "— remove the widening in hetu_tpu/core/vma.py pvary_missing "
             "and hetu_tpu/parallel/hetero_pp.py _psum_wide")
+    _assert_xla_check_fail(r)
+
+
+@pytest.mark.slow
+def test_canary_cpu_16bit_reduce_scatter_partial_manual():
+    """Third instance of the AllReducePromotion family: a 16-bit
+    psum_scatter from a partial-manual region (the TRANSPOSE of the SP
+    hetero pipeline's seq all-gather emits exactly this)."""
+    r = _run(_RS_REPRO)
+    if "COMPILED-OK" in r.stdout:
+        pytest.fail(
+            "XLA:CPU now compiles 16-bit reduce-scatter from "
+            "partial-manual regions — remove the widening in "
+            "hetu_tpu/parallel/hetero_pp.py _reduce_out/_gather_seq")
     _assert_xla_check_fail(r)
 
 

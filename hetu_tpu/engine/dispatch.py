@@ -51,7 +51,8 @@ class BatchStrategyDispatcher:
             sequence_parallel=st.sequence_parallel, zero=st.zero,
             remat=True,
             n_micro=self.n_micro or (max(2 * st.pp, 1) if st.pp > 1 else 1),
-            cp_tp_eff=st.cp_tp_eff, pp_schedule=self.pp_schedule)
+            cp_tp_eff=st.cp_tp_eff, pp_tp_eff=st.pp_tp_eff,
+            pp_schedule=self.pp_schedule)
 
     def choose(self, seq_lens: Sequence[int],
                global_batch: Optional[int] = None) -> int:

@@ -29,6 +29,11 @@ class StrategyCandidate:
     # Carries the bandwidth price parallel/ring_attention.py documents:
     # the rotating KV buffer is padded to the widest member.
     cp_tp_eff: Optional[tuple] = None
+    # hetero-TP pipeline: per-STAGE effective TP degree (None = uniform).
+    # Carries parallel/hetero_pp.py's documented price: stages at degree
+    # e < tp replicate block compute m = tp/e-fold and all-gather their
+    # weight blocks once per layer per micro.
+    pp_tp_eff: Optional[tuple] = None
     # pipeline schedule (parallel/pipeline.py GPipe scan vs
     # pipeline_1f1b.py PipeDream-flush).  The trade the model captures:
     # 1f1b stores O(pp) stage inputs instead of O(n_micro), but on MIXED
@@ -114,6 +119,28 @@ class CostModel:
                                    self.hw.bf16_tflops * self.mxu_efficiency)
         eff = min(eff, self.hw.bf16_tflops * 0.85)
         compute = flops / (c.num_devices * eff * 1e12)
+        if c.pp_tp_eff:
+            # hetero-TP pipeline price (parallel/hetero_pp.py module doc):
+            # a stage at effective degree e computes each block m = tp/e
+            # times (block-major replication), and the ONE-program
+            # lockstep realization paces every round at the SLOWEST
+            # stage — so compute scales by max(m), not the mean
+            ms = [max(c.tp // max(e, 1), 1) for e in c.pp_tp_eff]
+            compute *= max(ms)
+            if any(m > 1 for m in ms):
+                # a replicated stage all-gathers its FULL stage weights
+                # (2 bytes bf16, num_params/pp per stage; each device
+                # receives (tp-1)/tp of the gather output) once per
+                # micro pass of the schedule (m = max(n_micro, pp) — the
+                # same micro count the bubble term models)
+                ag = 2.0 * self.num_params / max(c.pp, 1) \
+                    * (c.tp - 1) / max(c.tp, 1)
+                t_hetero_ag = max(c.n_micro, c.pp) * ag / (
+                    self._allreduce_gbps("tp", c.tp) * 1e9)
+            else:
+                t_hetero_ag = 0.0
+        else:
+            t_hetero_ag = 0.0
 
         # TP comm: 4 allreduces of [b_local, s, h] bf16 per layer (2 fwd+2 bwd),
         # halved arithmetic but same bytes under SP (reduce-scatter+allgather)
@@ -165,6 +192,7 @@ class CostModel:
         # (M=0 -> C; full overlap M=C -> k*C; k=2 == fully serial).  The DP
         # grad-sync tail stays serial — it fires after the backward.
         # Without a measurement, keep the conservative serial sum.
+        t_comm += t_hetero_ag
         k = self.hw.measured.get("overlap_coef")
         if k:
             busy = (max(compute, t_comm) + (k - 1.0) * min(compute, t_comm)
@@ -214,7 +242,13 @@ class CostModel:
             else:
                 acts *= min(c.n_micro, c.pp)  # in-flight micros
         logits = b_local * seq_local * self.vocab * 4 / max(c.tp, 1)
-        return params + opt + grads + acts + logits
+        transient = 0.0
+        if c.pp_tp_eff and any(c.tp // max(e, 1) > 1 for e in c.pp_tp_eff):
+            # hetero replicated stages hold ONE transiently-gathered
+            # layer's full weights (persistent storage stays the 1/tp
+            # block-major shard — _blk gathers, slices, discards)
+            transient = 2.0 * self.num_params / max(self.num_layers, 1)
+        return params + opt + grads + acts + logits + transient
 
     def evaluate(self, c: StrategyCandidate):
         return self.step_time(c), self.per_device_memory(c)

@@ -50,7 +50,7 @@ def candidate_strategy(c: StrategyCandidate) -> "ParallelStrategy":
     return ParallelStrategy(
         mesh=MeshConfig(dp=c.dp, tp=c.tp, pp=c.pp, cp=c.cp),
         sequence_parallel=c.sequence_parallel, zero=c.zero,
-        cp_tp_eff=c.cp_tp_eff)
+        cp_tp_eff=c.cp_tp_eff, pp_tp_eff=c.pp_tp_eff)
 
 
 def search_strategy(cost: CostModel, num_devices: int,

@@ -324,3 +324,27 @@ def test_searcher_schedule_choice_flips_with_n_micro():
     big = best_schedule(64, cap)
     assert big == "1f1b", (small, big)
     assert small != "1f1b" or small is None, (small, big)
+
+
+def test_cost_model_hetero_pp_price():
+    """pp_tp_eff plans carry parallel/hetero_pp.py's documented price:
+    m-fold replicated compute on low-degree stages + per-layer weight
+    all-gathers, and the worst stage holds 1/min(e) of the weights."""
+    hw = HardwareProfile.preset("v5e")
+    cost = CostModel(hw=hw, num_layers=8, hidden=1024, intermediate=2816,
+                     vocab=32000, num_params=300_000_000,
+                     global_batch=32, seq_len=1024)
+    homo = StrategyCandidate(pp=2, tp=2, n_micro=4)
+    het = StrategyCandidate(pp=2, tp=2, pp_tp_eff=(2, 1), n_micro=4)
+    # lockstep rounds pace at the slowest (most-replicated) stage: the
+    # compute portion doubles at m_max=2 (comm terms stay homogeneous)
+    assert cost.step_time(het) > cost.step_time(homo) * 1.8
+    # persistent storage stays the 1/tp shard; only the transiently
+    # gathered layer buffer adds memory
+    assert cost.per_device_memory(het) > cost.per_device_memory(homo)
+    assert cost.per_device_memory(het) < cost.per_device_memory(homo) * 1.5
+    # degenerate hetero (all stages at full degree) = homogeneous
+    full = StrategyCandidate(pp=2, tp=2, pp_tp_eff=(2, 2), n_micro=4)
+    assert cost.step_time(full) == pytest.approx(cost.step_time(homo))
+    assert cost.per_device_memory(full) == pytest.approx(
+        cost.per_device_memory(homo))

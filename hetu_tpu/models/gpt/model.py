@@ -260,15 +260,16 @@ class GPTModel(Module):
                 # per-stage hetero TP (see LlamaModel counterpart)
                 from hetu_tpu.parallel.hetero_pp import (
                     gpt_block_maker, staged_stack_forward_hetero_tp)
-                if st.cp > 1 or use_drop:
+                if st.cp > 1 or (use_drop and c.attention_dropout > 0.0):
                     raise NotImplementedError(
-                        "pp_tp_eff composes with cp=1, no dropout")
+                        "pp_tp_eff composes with cp=1, hidden dropout only")
                 x, _aux = staged_stack_forward_hetero_tp(
                     gpt_block_maker(c, tp=st.tp,
                                     sequence_parallel=st.sequence_parallel),
                     self.block.param_specs(), params["blocks"], x,
                     num_layers=c.num_hidden_layers, pp=st.pp, tp=st.tp,
                     tp_eff=st.pp_tp_eff, mesh=mesh,
+                    rng=rng if use_drop else None,
                     sequence_parallel=st.sequence_parallel,
                     position_ids=position_ids, segment_ids=segment_ids,
                     stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
@@ -408,10 +409,11 @@ class GPTLMHeadModel(Module):
         c, st = self.config, self.strategy
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
-        if st.pp_tp_eff is not None and (st.cp > 1 or rng is not None):
+        if st.pp_tp_eff is not None and (
+                st.cp > 1 or (rng is not None and c.attention_dropout > 0.0)):
             raise NotImplementedError(
-                "pp_tp_eff under 1f1b composes with cp=1, no dropout "
-                "(same envelope as the GPipe hetero path)")
+                "pp_tp_eff under 1f1b composes with cp=1, hidden dropout "
+                "only (same envelope as the GPipe hetero path)")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()

@@ -294,15 +294,16 @@ class LlamaDecoderStack(Module):
             if c.num_experts > 0 or st.cp > 1:
                 raise NotImplementedError(
                     "pp_tp_eff composes with dense blocks, cp=1")
-            if rng is not None:
+            if rng is not None and c.attention_dropout > 0.0:
                 raise NotImplementedError(
-                    "dropout inside the hetero-TP pipeline")
+                    "attention_dropout inside the hetero-TP pipeline "
+                    "(hidden_dropout is supported)")
             return staged_stack_forward_hetero_tp(
                 llama_block_maker(c, cos, sin, tp=st.tp,
                                   sequence_parallel=st.sequence_parallel),
                 self.block.param_specs(), params["layers"], x,
                 num_layers=self.num_layers, pp=st.pp, tp=st.tp,
-                tp_eff=st.pp_tp_eff, mesh=mesh,
+                tp_eff=st.pp_tp_eff, mesh=mesh, rng=rng,
                 sequence_parallel=st.sequence_parallel,
                 position_ids=position_ids, segment_ids=segment_ids,
                 stage_layers=c.pipeline_stage_layers, n_micro=n_micro,
@@ -468,10 +469,12 @@ class LlamaLMHeadModel(Module):
         if st.pp <= 1:
             raise ValueError("pipeline_train_grads requires pp > 1")
         if st.pp_tp_eff is not None and (
-                c.num_experts > 0 or st.cp > 1 or rng is not None):
+                c.num_experts > 0 or st.cp > 1
+                or (rng is not None and c.attention_dropout > 0.0)):
             raise NotImplementedError(
                 "pp_tp_eff under 1f1b composes with dense blocks, cp=1, "
-                "no dropout (same envelope as the GPipe hetero path)")
+                "hidden dropout only (same envelope as the GPipe hetero "
+                "path)")
         if not c.use_scan:
             raise ValueError("1f1b requires use_scan")
         mesh = current_mesh()

@@ -130,7 +130,7 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp",
                              f"effective tp degree {e}")
         kv_e = n_kv // e
 
-        def block(lp, x, pos, seg):
+        def block(lp, x, pos, seg, rng=None):
             t = lax.axis_index(tp_axis)
             nw, nw2 = _al(lp["input_norm"]["weight"], lp["post_norm"]["weight"],
                           x)[:2]
@@ -160,8 +160,18 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp",
             attn = checkpoint_name(attn, "attn_out")
             wo = _blk(lp["attn"]["o_proj"]["weight"], 0, t, e, m, tp_axis)
             attn2, wo = _al(attn.reshape(b, s, kv_e * group * hd), wo)
+            if rng is not None and sp:
+                # SP: each tp rank holds a DISTINCT seq chunk — fold the
+                # rank in so masks are independent per token (non-SP keeps
+                # the shared key: replicated activations need identical
+                # masks across the m-fold block replicas)
+                rng = jax.random.fold_in(rng, t)
             h1 = attn2 @ wo.astype(x.dtype)
             h1, x = _al(_reduce_out(h1, tp_axis, sp=sp) / m, x)
+            if rng is not None and cfg.hidden_dropout > 0.0:
+                # same (micro, layer)-keyed folds as LlamaBlock.forward
+                h1 = ops.dropout(h1, cfg.hidden_dropout,
+                                 jax.random.fold_in(rng, 2), False)
             x = x + h1
             xin2 = _gather_seq(
                 ops.rms_norm(x, _al(nw2, x)[0], cfg.rms_norm_eps),
@@ -174,6 +184,9 @@ def llama_block_maker(cfg, cos, sin, *, tp: int, tp_axis: str = "tp",
             hidden, wd = _al(hidden, wd)
             h2 = hidden @ wd.astype(x.dtype)
             h2, x = _al(_reduce_out(h2, tp_axis, sp=sp) / m, x)
+            if rng is not None and cfg.hidden_dropout > 0.0:
+                h2 = ops.dropout(h2, cfg.hidden_dropout,
+                                 jax.random.fold_in(rng, 3), False)
             return x + h2, jnp.zeros((), jnp.float32)
 
         return block
@@ -204,7 +217,7 @@ def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp",
                              f"by effective tp degree {e}")
         n_e = n_heads // e
 
-        def block(lp, x, pos, seg):
+        def block(lp, x, pos, seg, rng=None):
             t = lax.axis_index(tp_axis)
             ln1w, ln1b, ln2w, ln2b = _al(
                 lp["ln1"]["weight"], lp["ln1"]["bias"],
@@ -233,9 +246,18 @@ def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp",
             attn2, wo = _al(attn.reshape(b, s, n_e * hd), wo)
             h1 = attn2 @ wo.astype(x.dtype)
             # row-parallel bias adds ONCE, after the reduction
+            if rng is not None and sp:
+                # per-rank fold under SP (see llama counterpart)
+                rng = jax.random.fold_in(rng, t)
             h1, ob, x = _al(_reduce_out(h1, tp_axis, sp=sp) / m,
                             lp["attn"]["o_proj"]["bias"], x)
-            x = x + h1 + ob.astype(x.dtype)
+            h1 = h1 + ob.astype(x.dtype)
+            if rng is not None and cfg.hidden_dropout > 0.0:
+                # same folds as GPTBlock.forward (bias included, like the
+                # homogeneous RowParallelLinear output)
+                h1 = ops.dropout(h1, cfg.hidden_dropout,
+                                 jax.random.fold_in(rng, 2), False)
+            x = x + h1
             xin2 = _gather_seq(
                 ops.layer_norm(x, ln2w, ln2b, cfg.layer_norm_eps),
                 tp_axis, sp=sp)
@@ -249,7 +271,11 @@ def gpt_block_maker(cfg, *, tp: int, tp_axis: str = "tp",
             h2 = y @ wd.astype(x.dtype)
             h2, db, x = _al(_reduce_out(h2, tp_axis, sp=sp) / m,
                             lp["mlp"]["down"]["bias"], x)
-            x = x + h2 + db.astype(x.dtype)
+            h2 = h2 + db.astype(x.dtype)
+            if rng is not None and cfg.hidden_dropout > 0.0:
+                h2 = ops.dropout(h2, cfg.hidden_dropout,
+                                 jax.random.fold_in(rng, 3), False)
+            x = x + h2
             return x, jnp.zeros((), jnp.float32)
 
         return block
@@ -287,19 +313,39 @@ def _hetero_switch_stack(block_maker: Callable, param_ds_tree, mesh, *,
     stage index choosing that stage's static (tp_eff, layer-count) branch.
     ONE builder shared by the GPipe hetero pipeline and the 1F1B hetero
     round bodies.  Under SP the x buffer enters/leaves seq-sharded over
-    the tp axis (the block maker must be built sequence_parallel too)."""
+    the tp axis (the block maker must be built sequence_parallel too).
+
+    Dropout: when a "dropout_rng" rider is present (the build_dropout_ride
+    scheme — per-micro uint32 bits on the token stream), each layer's key
+    is fold_in(key(bits), global_layer_id) with the stage's STATIC layer
+    offset, and the block is called with rng=key.  The rider is replicated
+    over tp, so tp replicas draw identical masks (consistency under
+    block-major replication); the 1F1B backward visit replays exactly
+    because the saved rider re-derives the same keys inside the vjp."""
+    import numpy as np
+
+    offs = np.concatenate([[0], np.cumsum(list(stage_layers))[:-1]])
+    has_rng = "dropout_rng" in token_keys
 
     def stage_branch(stage_i: int):
         e = tp_eff[stage_i]
         m = tp // e
         k_s = stage_layers[stage_i]
         block = block_maker(e, m)
+        off = int(offs[stage_i])
 
         def run(sp1, x_mb, tok1):
-            def body(carry, lp):
+            micro_key = (jax.random.key(tok1["dropout_rng"][0, 0])
+                         if has_rng else None)
+
+            def body(carry, xs):
+                lp, gid = xs
                 x_c, aux_c = carry
+                kw = {}
+                if has_rng:
+                    kw["rng"] = jax.random.fold_in(micro_key, gid)
                 out, aux = block(lp, x_c, tok1.get("position_ids"),
-                                 tok1.get("segment_ids"))
+                                 tok1.get("segment_ids"), **kw)
                 return (out, aux_c + aux), None
 
             fn = body
@@ -307,8 +353,9 @@ def _hetero_switch_stack(block_maker: Callable, param_ds_tree, mesh, *,
                 from hetu_tpu.nn.remat import remat_policy as _policy
                 fn = jax.checkpoint(body, policy=_policy(remat_policy))
             sliced = jax.tree.map(lambda a: a[:k_s], sp1)
+            gids = jnp.arange(off, off + k_s, dtype=jnp.uint32)
             (y, aux), _ = lax.scan(
-                fn, (x_mb, jnp.zeros((), jnp.float32)), sliced)
+                fn, (x_mb, jnp.zeros((), jnp.float32)), (sliced, gids))
             return y, aux
 
         return run
@@ -412,11 +459,13 @@ def staged_stack_forward_hetero_tp(
         n_micro: Optional[int] = None, remat: bool = True,
         remat_policy: str = "nothing", state_spec=None,
         pp_axis: str = "pp", tp_axis: str = "tp",
-        sequence_parallel: bool = False):
+        sequence_parallel: bool = False, rng=None):
     """GPipe pipeline where stage s runs at effective TP degree tp_eff[s].
 
-    block_maker(e, m) -> block_fn(local_layer_params, x_mb, pos, seg);
+    block_maker(e, m) -> block_fn(local_layer_params, x_mb, pos, seg[, rng]);
     param_ds_tree: the model's per-layer DS tree (for the manual in_specs).
+    rng enables hidden dropout inside the hetero pipeline (the
+    build_dropout_ride per-micro-bits scheme; see _hetero_switch_stack).
     Everything else mirrors pipeline.staged_stack_forward."""
     tp_eff = tuple(int(e) for e in tp_eff)
     if len(tp_eff) != pp:
@@ -443,6 +492,10 @@ def staged_stack_forward_hetero_tp(
         token_data["position_ids"] = position_ids
     if segment_ids is not None:
         token_data["segment_ids"] = segment_ids
+    if rng is not None:
+        from hetu_tpu.parallel.pipeline_1f1b import build_dropout_ride
+        token_data["dropout_rng"], _ = build_dropout_ride(
+            rng, n_micro, (B, s), stage_layers)
 
     xm = x.reshape(n_micro, mb, s, h)
     tok = {k: v.reshape(n_micro, mb, s) for k, v in token_data.items()}

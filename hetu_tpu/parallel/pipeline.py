@@ -289,16 +289,13 @@ def staged_stack_forward(block_fn, stack_params, x, *, num_layers: int,
     stage_const = None
     if rng is not None:
         hetero_exec = False
-        B = x.shape[0]
-        mb = B // n_micro
-        bits = jax.vmap(lambda k: jax.random.bits(k, dtype=jnp.uint32))(
-            jax.random.split(rng, n_micro))                  # [n_micro]
-        rider = jnp.broadcast_to(
-            jnp.repeat(bits, mb)[:, None], (B, x.shape[1]))
+        # ONE rider scheme shared with the 1F1B and hetero-TP paths
+        # (build_dropout_ride), so the same rng draws the same masks in
+        # every pipeline engine
+        from hetu_tpu.parallel.pipeline_1f1b import build_dropout_ride
+        rider, stage_const = build_dropout_ride(
+            rng, n_micro, (x.shape[0], x.shape[1]), stage_layers)
         token_data = dict(token_data, dropout_rng=rider)
-        # exclusive prefix sum: stage s's first global layer index
-        offs = np.concatenate([[0], np.cumsum(stage_layers)[:-1]])
-        stage_const = jnp.asarray(offs, jnp.uint32)
 
     has_mask = layer_mask is not None
     has_rng = rng is not None

@@ -238,7 +238,7 @@ class ParallelStrategy:
         # hetero-TP pipeline: per-STAGE effective TP in one program, on
         # both schedules (GPipe switch bodies + 1f1b hetero round bodies),
         # with or without SP.  Engine envelope (models pp_tp_eff paths +
-        # parallel/hetero_pp.py): dense blocks, cp=1, no dropout.
+        # parallel/hetero_pp.py): dense blocks, cp=1, hidden dropout only.
         if self.pp_tp_eff is not None:
             if self.pp <= 1:
                 fail("pp_tp_eff requires pp > 1")
@@ -295,9 +295,9 @@ class ParallelStrategy:
         use_scan = getattr(model_cfg, "use_scan", True)
         stage_layers = (stage_layers if stage_layers is not None
                         else getattr(model_cfg, "pipeline_stage_layers", None))
+        # hidden dropout composes everywhere the engines run; only
+        # attention_dropout has composition limits
         attn_drop = getattr(model_cfg, "attention_dropout", 0.0) or 0.0
-        hid_drop = getattr(model_cfg, "hidden_dropout", 0.0) or 0.0
-        dropout = (not deterministic) and (attn_drop > 0 or hid_drop > 0)
 
         if heads is not None and self.tp > 1 and heads % self.tp:
             fail(f"num_attention_heads={heads} must divide by tp={self.tp}")
@@ -343,9 +343,9 @@ class ParallelStrategy:
             if n_experts > 0:
                 fail("pp_tp_eff composes with dense blocks only "
                      f"(num_experts={n_experts})")
-            if dropout:
-                fail("dropout inside the hetero-TP pipeline is not "
-                     "implemented (set dropouts to 0 or deterministic=True)")
+            if (not deterministic) and attn_drop > 0:
+                fail("attention_dropout inside the hetero-TP pipeline is "
+                     "not implemented (hidden_dropout is supported)")
 
         if self.cp > 1 and not deterministic and attn_drop > 0:
             fail(f"attention_dropout={attn_drop} inside ring attention "

@@ -66,8 +66,8 @@ MODEL_INVALID = [
     (dict(pp=2), {}, dict(stage_layers=(1, 2)), "sum to"),
     (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(num_experts=4), {},
      "dense blocks only"),
-    (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(hidden_dropout=0.1), {},
-     "dropout inside the hetero-TP pipeline"),
+    (dict(pp=2, tp=2, pp_tp_eff=(2, 1)), dict(attention_dropout=0.1), {},
+     "attention_dropout inside the hetero-TP pipeline"),
     (dict(cp=2), dict(attention_dropout=0.1), {}, "ring attention"),
 ]
 

@@ -359,6 +359,8 @@ class ParallelStrategy:
         bits = [str(self.mesh)]
         if self.cp_tp_eff is not None:
             bits.append(f"cptp{list(self.cp_tp_eff)}")
+        if self.pp_tp_eff is not None:
+            bits.append(f"pptp{list(self.pp_tp_eff)}")
         if self.sequence_parallel:
             bits.append("sp")
         if self.zero:

@@ -1,0 +1,91 @@
+"""validate() <-> engine agreement fuzz: the plan-time chokepoint must
+match what the engines actually do, in BOTH directions — a plan validate
+accepts must build and run, and a plan validate rejects must raise a
+named error from the engine too (never run silently degraded).
+
+(reference bar: DeduceStates at graph-build IS the engine's own check,
+hetu/graph/operator.h:425-594 — here the chokepoint is separate code, so
+drift is possible and this test is the tripwire.)"""
+import random
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+import hetu_tpu as ht
+from hetu_tpu.core.mesh import MeshConfig
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.parallel import ParallelStrategy
+from hetu_tpu.parallel.strategy import StrategyValidationError
+
+
+def _sample(rng):
+    """Random tiny strategy+config in the 8-device space, biased toward
+    the tricky hetero/composition corners."""
+    pp = rng.choice([1, 2])
+    tp = rng.choice([1, 2])
+    cp = rng.choice([1, 2]) if pp * tp <= 4 else 1
+    dp = rng.choice([1, 2]) if pp * tp * cp <= 4 else 1
+    kw = {}
+    if pp > 1 and tp > 1 and rng.random() < 0.5:
+        kw["pp_tp_eff"] = tuple(rng.choice([1, tp]) for _ in range(pp))
+    if rng.random() < 0.4 and tp > 1:
+        kw["sequence_parallel"] = True
+    st = ParallelStrategy(mesh=MeshConfig(dp=dp, tp=tp, pp=pp, cp=cp), **kw)
+    cfg_kw = {}
+    if rng.random() < 0.3:
+        cfg_kw["num_experts"] = 2
+    if rng.random() < 0.3:
+        cfg_kw["attention_dropout"] = 0.1
+    if rng.random() < 0.3:
+        cfg_kw["hidden_dropout"] = 0.1
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False, **cfg_kw)
+    return st, cfg
+
+
+@pytest.mark.slow
+def test_validate_matches_engine_verdicts():
+    rng = random.Random(0)
+    seq = 64
+    checked_ok = checked_rej = 0
+    for trial in range(14):
+        st, cfg = _sample(rng)
+        deterministic = not (cfg.attention_dropout or cfg.hidden_dropout)
+        try:
+            st.validate(cfg, n_micro=2 if st.pp > 1 else None,
+                        global_batch=8, seq_len=seq,
+                        deterministic=deterministic)
+            accepted = True
+        except StrategyValidationError:
+            accepted = False
+
+        ids = jnp.asarray(np.random.default_rng(trial).integers(
+            0, cfg.vocab_size, (8, seq)), jnp.int32)
+        mesh = st.build_mesh(devices=jax.devices()[:st.mesh.num_devices])
+        key = jax.random.key(trial)
+
+        def run():
+            model = LlamaLMHeadModel(cfg, st)
+            with ht.use_mesh(mesh):
+                p = model.init(jax.random.key(0), mesh=mesh)
+                drop_rng = None if deterministic else key
+                loss = jax.jit(lambda q: model(
+                    q, ids, labels=ids, n_micro=2 if st.pp > 1 else None,
+                    rng=drop_rng, deterministic=deterministic))(p)
+                return float(loss)
+
+        if accepted:
+            loss = run()   # must BUILD AND RUN, finite
+            assert np.isfinite(loss), (st.describe(), cfg)
+            checked_ok += 1
+        else:
+            # must raise a NAMED error from the engine too — silent
+            # degraded execution is the failure mode validate() exists
+            # to prevent
+            with pytest.raises((NotImplementedError, ValueError)):
+                run()
+            checked_rej += 1
+    # the sample must exercise both directions to mean anything
+    assert checked_ok >= 3 and checked_rej >= 2, (checked_ok, checked_rej)

@@ -1,0 +1,134 @@
+"""MultiHostOrchestrator state-machine units (fast, no subprocesses —
+the end-to-end flows live in test_elastic_multihost.py)."""
+import time
+import types
+
+import pytest
+
+from hetu_tpu.rpc.orchestrator import HostProc, MultiHostOrchestrator
+
+
+class FakeServer:
+    def __init__(self, alive=(), kv=None):
+        self.alive = list(alive)
+        self.kv = dict(kv or {})
+        self.stops = 0
+        self.host, self.port = "127.0.0.1", 1
+
+    def alive_ranks(self):
+        return sorted(self.alive)
+
+    def kv_get(self, key, default=None):
+        return self.kv.get(key, default)
+
+    def broadcast_stop(self):
+        self.stops += 1
+
+    def close(self):
+        pass
+
+
+class FakeProc:
+    def __init__(self, rc=None):
+        self.rc = rc
+        self.pid = 0
+
+    def poll(self):
+        return self.rc
+
+
+def _orch(server, hosts):
+    """Orchestrator with the server/hosts injected (no process spawns)."""
+    o = MultiHostOrchestrator.__new__(MultiHostOrchestrator)
+    o.server = server
+    o.hosts = hosts
+    o.events = []
+    return o
+
+
+def _host(name, slots, rc=None, lost=False):
+    hp = HostProc(name, FakeProc(rc), slots)
+    hp.lost = lost
+    return hp
+
+
+def test_remesh_converged_requires_epoch_covering_alive():
+    srv = FakeServer(alive=[0, 1, 4, 5],
+                     kv={"__elastic_epoch__": 2,
+                         "__elastic_members_e2__": [0, 1]})
+    o = _orch(srv, {})
+    assert not o._remesh_converged()        # epoch 2 misses ranks 4, 5
+    srv.kv["__elastic_epoch__"] = 3
+    srv.kv["__elastic_members_e3__"] = [0, 1, 4, 5]
+    assert o._remesh_converged()
+    srv.alive = []                          # empty membership never converges
+    assert not o._remesh_converged()
+
+
+def test_drive_pending_remesh_waits_for_joiners_then_casts():
+    """want derives from the live SLOT layout each tick (a frozen
+    membership sample can still count just-killed workers); no stop is
+    broadcast until the joiners actually connect."""
+    srv = FakeServer(alive=[0, 1], kv={"__elastic_epoch__": 1,
+                                       "__elastic_members_e1__": [0, 1]})
+    hosts = {"A": _host("A", [0, 1]),
+             "B": _host("B", [2, 3], rc=1, lost=True),       # dead host
+             "A+B": _host("A+B", [4, 5])}                    # respawned
+    o = _orch(srv, hosts)
+    o._pending_remesh = {"deadline": time.time() + 60,
+                         "next_cast": 0.0, "casts": 0}
+    o._drive_pending_remesh()
+    assert srv.stops == 0                   # joiners not connected yet
+    srv.alive = [0, 1, 4, 5]                # joiners connect
+    o._drive_pending_remesh()
+    assert srv.stops == 1                   # cast fired
+    o._drive_pending_remesh()
+    assert srv.stops == 1                   # rate-limited (3s spacing)
+    # a covering epoch lands -> converged, state cleared, event recorded
+    srv.kv["__elastic_epoch__"] = 2
+    srv.kv["__elastic_members_e2__"] = [0, 1, 4, 5]
+    o._drive_pending_remesh()
+    assert o._pending_remesh is None
+    ev = [e for e in o.events if e["event"] == "remesh_broadcast"]
+    assert ev and ev[0]["converged"] and ev[0]["broadcasts"] == 1
+
+
+def test_drive_pending_remesh_deadline_gives_up():
+    srv = FakeServer(alive=[0, 1], kv={})
+    o = _orch(srv, {"A": _host("A", [0, 1])})
+    o._pending_remesh = {"deadline": time.time() - 1,
+                         "next_cast": 0.0, "casts": 0}
+    o._drive_pending_remesh()
+    assert o._pending_remesh is None
+    ev = [e for e in o.events if e["event"] == "remesh_broadcast"]
+    assert ev and not ev[0]["converged"]
+
+
+def test_poll_records_host_loss_without_respawn():
+    srv = FakeServer(alive=[0, 1])
+    hosts = {"A": _host("A", [0, 1]),
+             "B": _host("B", [2, 3], rc=-9)}
+    o = _orch(srv, hosts)
+    o.respawn_lost_slots = False
+    codes = o.poll()
+    assert codes == {"A": None, "B": -9}
+    losses = [e for e in o.events if e["event"] == "host_loss"]
+    assert losses == [{"event": "host_loss", "host": "B",
+                       "slots": [2, 3], "rc": -9}]
+    # a second poll does not double-report
+    o.poll()
+    assert len([e for e in o.events if e["event"] == "host_loss"]) == 1
+
+
+def test_poll_clean_exit_is_not_a_loss_to_respawn():
+    """rc=0 (training finished) must not trigger slot respawn."""
+    srv = FakeServer(alive=[])
+    hosts = {"A": _host("A", [0, 1], rc=0)}
+    o = _orch(srv, hosts)
+    o.respawn_lost_slots = True
+    o.max_respawns = 1
+    o._respawns = 0
+    o._next_slot = 2
+    o.poll()
+    assert len(o.hosts) == 1          # nothing respawned
+    assert [e["event"] for e in o.events] == ["host_loss"]

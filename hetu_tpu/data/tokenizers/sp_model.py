@@ -195,6 +195,13 @@ def _nmt_premap(text: str) -> str:
     return "".join(out)
 
 
+#: NormalizerSpec rule names this module implements; anything else (e.g.
+#: 'user_defined' custom-charsmap models, which sentencepiece supports)
+#: falls back to identity AT LOAD TIME with a logged warning — a model
+#: that loads must not start raising on its first encode().
+KNOWN_RULES = ("identity", "", "nfkc", "nfkc_cf", "nmt_nfkc", "nmt_nfkc_cf")
+
+
 def rule_normalize(name: str, text: str) -> str:
     """Apply the NormalizerSpec rule `name` (reference: the sentencepiece
     normalization_rule_name the library bakes into the charsmap)."""
@@ -231,6 +238,18 @@ class SentencePieceTokenizer:
         self.model_type = trainer.get("model_type", 1)
         self.add_dummy_prefix = norm["add_dummy_prefix"]
         self.normalizer_name = norm.get("name", "identity")
+        if self.normalizer_name not in KNOWN_RULES:
+            # validate ONCE at load: unknown rules (custom precompiled
+            # charsmaps) degrade to identity with a visible warning instead
+            # of raising mid-encode()
+            from hetu_tpu.utils.logging import get_logger
+            get_logger("tokenizers.sp").warning(
+                f"unknown normalization rule "
+                f"{self.normalizer_name!r}; known rules are "
+                f"{[r for r in KNOWN_RULES if r]} — falling back to "
+                "identity (the model's precompiled charsmap is not "
+                "applied)")
+            self.normalizer_name = "identity"
         self.remove_extra_whitespaces = norm["remove_extra_whitespaces"]
         self.unk_id = trainer.get("unk_id", 0)
         self.bos_id = trainer.get("bos_id", 1)

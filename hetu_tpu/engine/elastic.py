@@ -19,7 +19,8 @@ from __future__ import annotations
 import time
 from typing import Callable, Dict, Optional
 
-from hetu_tpu.rpc.client import CoordinationClient
+from hetu_tpu.obs.metrics import get_registry
+from hetu_tpu.rpc.client import CoordinationClient, VoteDisagreement
 from hetu_tpu.utils.logging import get_logger
 
 logger = get_logger("elastic")
@@ -124,6 +125,22 @@ class ElasticController:
                     except TimeoutError:
                         # a round member died mid-vote; a newer round is
                         # coming — keep looping
+                        get_registry().inc("elastic.vote_timeouts")
+                        continue
+                    except VoteDisagreement:
+                        # dual-leader race: two workers with divergent
+                        # membership snapshots published the SAME epoch,
+                        # interleaving the plan/members writes — a consumer
+                        # can read one leader's members with the other's
+                        # plan and the fingerprint vote disagrees.  The
+                        # disagreement is survivable: a newer round
+                        # supersedes, so keep polling instead of crashing
+                        # the surviving worker.
+                        get_registry().inc("elastic.vote_conflicts")
+                        logger.warning(
+                            f"plan vote for epoch {epoch} disagreed "
+                            "(dual-leader race); waiting for a "
+                            "superseding round")
                         continue
                     if self._current_epoch() == epoch:
                         return plan
@@ -154,9 +171,25 @@ class ElasticController:
             time.sleep(0.1)
 
     def _rebuild(self):
-        plan = self._replan()
+        reg = get_registry()
+        with reg.timer("elastic.replan_s"):
+            plan = self._replan()
+        reg.inc("elastic.replans")
+        reg.set_gauge("elastic.epoch", self._consumed_epoch)
+        reg.set_gauge("elastic.generation", self.generation)
         logger.info(f"[gen {self.generation}] rebuilding with strategy "
                     f"{plan.get('strategy')}")
+        # release the OLD trainer's telemetry sinks before replacing it:
+        # the PlanPool on_compile hook is a bound method, so the trainer
+        # sits in a reference cycle refcounting can't reclaim — without an
+        # explicit close() every re-mesh would leak an open runlog fd and
+        # drop the generation's final summary record
+        old_close = getattr(self.trainer, "close", None)
+        if callable(old_close):
+            try:
+                old_close()
+            except Exception as e:
+                logger.warning(f"closing previous trainer failed: {e!r}")
         self.trainer = self.trainer_factory(plan)
         if getattr(self.trainer, "params", None) is None and \
                 hasattr(self.trainer, "build"):
@@ -172,6 +205,14 @@ class ElasticController:
         else:
             logger.info(f"[gen {self.generation}] no ckpt_dir configured — "
                         "state will NOT survive re-meshing")
+        # elastic re-mesh epochs leave a run-event record (the trainer owns
+        # the RunLog; a factory-built trainer without one logs nothing)
+        run_log = getattr(self.trainer, "run_log", None)
+        if run_log is not None:
+            run_log.log("elastic_epoch", epoch=self._consumed_epoch,
+                        generation=self.generation,
+                        alive=self.client.membership(),
+                        strategy=plan.get("strategy"))
         self.client.resume()   # clear the server-side stop flag too
         self.generation += 1
 

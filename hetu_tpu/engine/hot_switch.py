@@ -80,6 +80,7 @@ class HotSwitchTrainer(Trainer):
             raise RuntimeError("HotSwitchTrainer.build() must run before "
                                "switching strategies")
         t0 = time.perf_counter()
+        from_id = self.active_id
         dst = self._handle(sid)
         # byte accounting BEFORE the move (needs the live src shardings) —
         # the reference's ProfileRunningDetails (switch_exec_graph.cc:1904)
@@ -140,8 +141,18 @@ class HotSwitchTrainer(Trainer):
             prof.wall_s = time.perf_counter() - t0
             self.last_switch_profile = prof
             detail = f"; params {prof.describe()}"
+        wall_s = time.perf_counter() - t0
+        self._registry.inc("switch.count")
+        self._registry.observe("switch.wall_s", wall_s)
+        if self.run_log is not None:
+            # switch phases become timeline spans via obs.trace_from_runlog
+            self.run_log.log(
+                "switch", from_id=from_id, to_id=sid, wall_s=wall_s,
+                mode=mode.value,
+                moved_bytes=(prof.moved_bytes if prof else None),
+                total_bytes=(prof.total_bytes if prof else None))
         logger.info(f"hot-switch -> strategy {sid} ({dst.strategy.describe()}) "
-                    f"in {time.perf_counter() - t0:.3f}s{detail}")
+                    f"in {wall_s:.3f}s{detail}")
         return self
 
     def build(self, rng=None):

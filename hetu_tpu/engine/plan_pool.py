@@ -52,6 +52,11 @@ class PlanPool:
     # invariant per pool, and flattening a million-leaf param tree every
     # step is hot-path host work jit's own cache never paid.
     key_argnums: Optional[Tuple[int, ...]] = None
+    # observability hook: on_compile(pool_name, key, compiled_plan,
+    # compile_seconds) after every NEW plan compile — the trainer feeds
+    # compile events (+ estimated MFU) into its RunLog from here.  Hook
+    # failures are logged, never fatal: telemetry must not kill a step.
+    on_compile: Optional[Callable[[str, Tuple, Any, float], None]] = None
 
     def __post_init__(self):
         self._plans: Dict[Tuple, Any] = {}
@@ -79,11 +84,16 @@ class PlanPool:
             t0 = time.perf_counter()
             plan = self._jitted.lower(*args).compile()
             self._plans[key] = plan
+            dt = time.perf_counter() - t0
             msg = (f"plan pool '{self.name}': compiled plan #{n + 1} "
-                   f"(strategy {strategy_id}) in "
-                   f"{time.perf_counter() - t0:.1f}s")
+                   f"(strategy {strategy_id}) in {dt:.1f}s")
             # plan #1 is expected; growth beyond it deserves visibility
             (logger.info if n == 0 else logger.warning)(msg)
+            if self.on_compile is not None:
+                try:
+                    self.on_compile(self.name, key, plan, dt)
+                except Exception as e:
+                    logger.warning(f"on_compile hook failed: {e!r}")
         return plan
 
     def __call__(self, *args, strategy_id=0):

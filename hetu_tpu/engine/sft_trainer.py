@@ -63,6 +63,21 @@ class SFTTrainer(Trainer):
             model = LoRAWrappedModel(model, base_params, lora)
         super().__init__(model, config, strategy, **kw)
 
+    def train_step(self, host_batch: Dict[str, np.ndarray]):
+        """SFT batches are mostly prompt+padding: track how many label
+        slots actually carry loss, so a run whose response fraction
+        collapses (bad masking, over-padding) is visible in the metrics
+        registry without stepping through data by hand.  Counted here —
+        not in prepare_batch — so report paths (memory/phase/mfu) that
+        prepare a batch without training don't skew the ratio."""
+        labels = host_batch.get("labels")
+        if labels is not None:
+            lab = np.asarray(labels)
+            masked = int((lab == -100).sum())
+            self._registry.inc("sft.masked_tokens", masked)
+            self._registry.inc("sft.loss_tokens", int(lab.size - masked))
+        return super().train_step(host_batch)
+
     def _make_shardings(self):
         if self.lora_cfg is None:
             return super()._make_shardings()

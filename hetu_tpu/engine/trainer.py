@@ -36,6 +36,16 @@ from hetu_tpu.utils.logging import get_logger
 logger = get_logger("trainer")
 
 
+def _device_mem_bytes():
+    """bytes_in_use on device 0, or None where the backend hides it (CPU)."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        v = stats.get("bytes_in_use")
+        return int(v) if v is not None else None
+    except Exception:
+        return None
+
+
 class Trainer:
     def __init__(self, model, config: TrainingConfig,
                  strategy: Optional[ParallelStrategy] = None,
@@ -109,6 +119,21 @@ class Trainer:
 
         from hetu_tpu.utils.profiling import StepProfiler
         self.profiler = StepProfiler()
+        # -- telemetry (hetu_tpu.obs): the metrics registry is process-
+        # global (rpc/elastic write into the same one); the RunLog lives
+        # next to the checkpoints so every run leaves a machine-readable
+        # trace (docs/observability.md)
+        from hetu_tpu.obs.metrics import get_registry
+        from hetu_tpu.obs.runlog import RunLog, default_runlog_path
+        self._registry = get_registry()
+        rl_path = default_runlog_path(config.ckpt_dir)
+        # one writer per run: in multi-process runs only process 0 logs
+        # (the same gate the checkpoint writer uses) — N appenders to one
+        # JSONL would duplicate every record Nx and can tear lines on
+        # shared filesystems
+        if rl_path and jax.process_index() != 0:
+            rl_path = None
+        self.run_log = RunLog(rl_path) if rl_path else None
         c = config
         self.optimizer = optim.AdamW(
             lr=optim.cosine_schedule(c.lr, c.warmup_steps, c.total_steps,
@@ -153,6 +178,25 @@ class Trainer:
                 self.scaler_state = jax.device_put(
                     self._scaler.init(), NamedSharding(mesh, P()))
             self._step_fn = self._make_step_pool(self._pshard, self._sshard)
+        from hetu_tpu.utils import flags
+        sched_path = flags.str_flag("HETU_TPU_TRACE_SCHEDULE")
+        if sched_path and self.strategy.pp > 1:
+            # render THIS run's micro-batch schedule (per-stage fwd/bwd/
+            # bubble lanes) for Perfetto — hardware-free, from the same
+            # validity masks the pipeline engines scan over
+            from hetu_tpu.obs.trace import pipeline_schedule_trace
+            n_micro = c.num_micro_batches(max(self.strategy.dp, 1))
+            try:
+                pipeline_schedule_trace(
+                    self.strategy.pp, n_micro,
+                    schedule=c.pp_schedule).save(sched_path)
+                logger.info(
+                    f"pipeline schedule trace written to {sched_path}")
+            except OSError as e:
+                # telemetry must not be fatal: a bad trace path costs the
+                # render, never the run
+                logger.warning(f"schedule trace to {sched_path} "
+                               f"failed: {e!r}")
         return self
 
     def _make_step_pool(self, pshard, sshard):
@@ -170,7 +214,8 @@ class Trainer:
             name="train_step",
             # dispatch keys hash the BATCHES pytree only — params/opt_state
             # shapes never change within one pool
-            key_argnums=(2,))
+            key_argnums=(2,),
+            on_compile=self._on_plan_compile)
 
     @staticmethod
     def _plan_cap():
@@ -184,6 +229,30 @@ class Trainer:
         the CP data layout declared around the trace (it changes the ring's
         static tile masks and the label convention)."""
         return (self._cp_split, self._labels_shifted)
+
+    def _on_plan_compile(self, pool_name, key, plan, compile_s):
+        """PlanPool hook: every fresh XLA compile leaves a run-event record
+        with XLA's FLOP count and a hardware-free estimated MFU (the
+        roofline over cost_analysis — obs.mfu), so BENCH tooling can
+        attribute cost even when the step never executes on hardware."""
+        self._registry.inc("trainer.compiles", pool=pool_name)
+        self._registry.observe("trainer.compile_s", compile_s,
+                               pool=pool_name)
+        if self.run_log is None:
+            return
+        from hetu_tpu.obs.mfu import estimate_from_compiled
+        try:
+            # phase attribution parses the full HLO text — too heavy for a
+            # per-compile hook on big programs; mfu_report() does the
+            # phase-resolved version on demand
+            est = estimate_from_compiled(plan, with_phases=False)
+        except Exception:
+            est = {}
+        self.run_log.log(
+            "compile", name=pool_name, plan=str(key)[:500],
+            compile_s=compile_s, flops=est.get("flops_per_step"),
+            estimated_mfu=est.get("estimated_mfu"),
+            estimated_step_s=est.get("estimated_step_s"))
 
     # ------------------------------------------------------------------
     def _loss_fn(self, params, batch, rng):
@@ -378,6 +447,17 @@ class Trainer:
             out[k] = jax.device_put(v, self._batch_sharding(v.ndim))
         return out
 
+    def _memo_by_shape(self, attr: str, host_batch, compute):
+        """Per-batch-shape memo shared by the report surfaces (memory/
+        phase/mfu): ONE key construction so the three caches can never
+        diverge.  `compute(key)` runs on miss."""
+        key = tuple(sorted((k, tuple(v.shape))
+                           for k, v in host_batch.items()))
+        cache = self.__dict__.setdefault(attr, {})
+        if key not in cache:
+            cache[key] = compute(key)
+        return cache[key]
+
     def memory_report(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """XLA's compiled-memory breakdown of the train step for this batch
         shape — the per-plan analog of the reference's micro-batch memory
@@ -385,26 +465,20 @@ class Trainer:
         GetCUDAProfiler).  AOT lower().compile() does NOT share jit's
         dispatch cache, so the first call per batch shape pays one full XLA
         compile; results are memoized per shape here."""
-        key = tuple(sorted((k, tuple(v.shape))
-                           for k, v in host_batch.items()))
-        cache = getattr(self, "_memory_reports", None)
-        if cache is None:
-            cache = self._memory_reports = {}
-        if key in cache:
-            return cache[key]
-        mem = self._compiled_for_shape(host_batch, key).memory_analysis()
-        out = {}
-        for k in ("argument_size_in_bytes", "output_size_in_bytes",
-                  "temp_size_in_bytes", "alias_size_in_bytes",
-                  "generated_code_size_in_bytes"):
-            v = getattr(mem, k, None)
-            if v is not None:
-                out[k.replace("_in_bytes", "")] = int(v)
-        # donated params/opt aliasing means live peak ~ args + temp
-        out["peak_estimate"] = (out.get("argument_size", 0)
-                                + out.get("temp_size", 0))
-        cache[key] = out
-        return out
+        def compute(key):
+            mem = self._compiled_for_shape(host_batch, key).memory_analysis()
+            out = {}
+            for k in ("argument_size_in_bytes", "output_size_in_bytes",
+                      "temp_size_in_bytes", "alias_size_in_bytes",
+                      "generated_code_size_in_bytes"):
+                v = getattr(mem, k, None)
+                if v is not None:
+                    out[k.replace("_in_bytes", "")] = int(v)
+            # donated params/opt aliasing means live peak ~ args + temp
+            out["peak_estimate"] = (out.get("argument_size", 0)
+                                    + out.get("temp_size", 0))
+            return out
+        return self._memo_by_shape("_memory_reports", host_batch, compute)
 
     def _compiled_for_shape(self, host_batch, key):
         """AOT lower().compile() of the step for this batch shape — ONE
@@ -428,15 +502,21 @@ class Trainer:
         reference's per-op cost records (profiler.h:25), hardware-free.
         Pairs with memory_report (shares its one AOT compile per shape)."""
         from hetu_tpu.utils.profiling import phase_breakdown
-        key = tuple(sorted((k, tuple(v.shape))
-                           for k, v in host_batch.items()))
-        cache = getattr(self, "_phase_reports", None)
-        if cache is None:
-            cache = self._phase_reports = {}
-        if key not in cache:
-            cache[key] = phase_breakdown(
-                self._compiled_for_shape(host_batch, key))
-        return cache[key]
+        return self._memo_by_shape(
+            "_phase_reports", host_batch,
+            lambda key: phase_breakdown(
+                self._compiled_for_shape(host_batch, key)))
+
+    def mfu_report(self, host_batch: Dict[str, np.ndarray]):
+        """Hardware-free estimated MFU + per-phase roofline bound for the
+        compiled train step at this batch shape (obs.mfu: cost_analysis
+        FLOPs x hardware-profile peaks x phase_breakdown traffic).  Shares
+        the one AOT compile per shape with memory_report/phase_report."""
+        from hetu_tpu.obs.mfu import estimate_from_compiled
+        return self._memo_by_shape(
+            "_mfu_reports", host_batch,
+            lambda key: estimate_from_compiled(
+                self._compiled_for_shape(host_batch, key)))
 
     def train_step(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         batches = self.prepare_batch(host_batch)
@@ -464,7 +544,13 @@ class Trainer:
                 break
             with self.profiler.step(self.global_step):
                 metrics = self.train_step(host_batch)
-            tokens += int(np.prod(host_batch["input_ids"].shape))
+            step_s = self.profiler.last_step_s
+            batch_tokens = int(np.prod(host_batch["input_ids"].shape))
+            tokens += batch_tokens
+            self._registry.inc("trainer.steps")
+            self._registry.inc("trainer.tokens", batch_tokens)
+            self._registry.observe("trainer.step_time_s", step_s)
+            loss = None
             if (self.global_step % c.log_every) == 0:
                 loss = float(metrics["loss"])  # forces device sync
                 dt = time.perf_counter() - t0
@@ -474,10 +560,54 @@ class Trainer:
                     f"grad_norm {float(metrics['grad_norm']):.3f} "
                     f"tokens/s {tokens / max(dt, 1e-9):,.0f}")
                 t0, tokens = time.perf_counter(), 0
+            if self.run_log is not None:
+                # loss AND the device memory probe ride only on
+                # log-boundary steps — float(loss) is a device sync and
+                # memory_stats() a runtime query (a host round-trip on the
+                # remote-TPU backend) the hot path must not pay per step
+                self.run_log.step(
+                    self.global_step, step_s, loss=loss,
+                    tokens_per_s=batch_tokens / max(step_s, 1e-9),
+                    device_mem_bytes=(_device_mem_bytes()
+                                      if loss is not None else None),
+                    plan=self._plan_fingerprint(host_batch))
             if self._ckpt and (self.global_step % c.ckpt_every) == 0:
                 self.save()
         self.profiler.close()
+        self._obs_summary()
         return metrics
+
+    def _plan_fingerprint(self, host_batch) -> str:
+        """Stable id of (strategy, batch shapes) — which compiled plan a
+        step dispatched to, readable across runs."""
+        shapes = ",".join(f"{k}:{'x'.join(map(str, v.shape))}"
+                          for k, v in sorted(host_batch.items()))
+        return f"{self.strategy.describe()}|{shapes}"
+
+    def _obs_summary(self):
+        """Flush telemetry at a loop boundary: one 'summary' run-event
+        (registry snapshot + step-time summary) and the optional
+        HETU_TPU_METRICS_EXPORT registry dump.  Idempotent — a later
+        close() appends another snapshot, never corrupts."""
+        from hetu_tpu.utils import flags
+        if self.run_log is not None:
+            self.run_log.log("summary", profiler=self.profiler.summary(),
+                             metrics=self._registry.snapshot())
+        path = flags.str_flag("HETU_TPU_METRICS_EXPORT")
+        if path:
+            try:
+                self._registry.export_jsonl(path)
+            except OSError as e:
+                logger.warning(f"metrics export to {path} failed: {e!r}")
+
+    def close(self):
+        """Release observability sinks (flush + close the RunLog).  Safe to
+        call more than once; training after close() still runs, it just
+        stops leaving run events."""
+        self.profiler.close()
+        self._obs_summary()
+        if self.run_log is not None:
+            self.run_log.close()
 
     # ------------------------------------------------------------------
     def evaluate(self, batches: Iterable[Dict[str, np.ndarray]],

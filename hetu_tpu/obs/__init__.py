@@ -1,0 +1,34 @@
+"""Unified telemetry: metrics registry, structured run events, Chrome-trace
+timelines, and the hardware-free MFU/roofline reporter.
+
+Four pieces, one import surface:
+
+    from hetu_tpu import obs
+    obs.get_registry().inc("elastic.replans")
+    log = obs.RunLog("/ckpts/runlog.jsonl"); log.step(1, 0.42, loss=2.3)
+    obs.pipeline_schedule_trace(4, 8, schedule="1f1b").save("sched.json")
+    obs.estimate_from_compiled(compiled)["estimated_mfu"]
+
+See docs/observability.md for the env flags, the RunLog schema, and how
+the estimated MFU is derived.
+"""
+from hetu_tpu.obs.metrics import (Histogram, MetricsRegistry,  # noqa: F401
+                                  get_registry)
+from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
+                              estimate_from_compiled, estimate_mfu,
+                              flops_of_compiled, load_hardware_profile)
+from hetu_tpu.obs.runlog import (SCHEMA_VERSION, RunLog,  # noqa: F401
+                                 default_runlog_path)
+from hetu_tpu.obs.trace import (ChromeTrace,  # noqa: F401
+                                pipeline_schedule_trace,
+                                schedule_bubble_fraction,
+                                trace_from_runlog)
+
+__all__ = [
+    "MetricsRegistry", "Histogram", "get_registry",
+    "RunLog", "SCHEMA_VERSION", "default_runlog_path",
+    "ChromeTrace", "pipeline_schedule_trace", "schedule_bubble_fraction",
+    "trace_from_runlog",
+    "estimate_mfu", "estimate_from_compiled", "flops_of_compiled",
+    "analytic_transformer_estimate", "load_hardware_profile",
+]

@@ -1,0 +1,172 @@
+"""Process-local metrics registry.
+
+Rebuild of the reference's profiler cost records as an always-on surface
+(reference: hetu/impl/profiler/profiler.h:25 per-op cost records,
+SURVEY §5.1 HETU_EVENT_TIMING) — but instead of env-gated log lines, a
+typed registry the whole runtime writes into and any exit point (trainer
+close, bench, tools_obs_report) can snapshot:
+
+    reg = get_registry()
+    reg.inc("elastic.replans")
+    reg.set_gauge("rpc.worker_last_seen_s", 0.0, rank=3)
+    reg.observe("trainer.step_time_s", 0.412)
+
+Counters are monotonic, gauges are last-write-wins, histograms keep a
+bounded reservoir and report count/sum/min/max/percentiles.  Every series
+is keyed by (name, sorted label items) so per-rank / per-strategy series
+coexist under one name.  All operations are thread-safe: the rpc server's
+connection threads and the trainer loop write concurrently.
+"""
+from __future__ import annotations
+
+import json
+import threading
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_LabelKey = Tuple[Tuple[str, str], ...]
+
+
+def _label_key(labels: Dict[str, Any]) -> _LabelKey:
+    return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
+
+
+class Histogram:
+    """Bounded-reservoir timing histogram.
+
+    Keeps the first `cap` observations verbatim plus running count/sum/
+    min/max for everything; past the cap, new values overwrite reservoir
+    slots round-robin so long runs keep a recent-ish sample while the
+    aggregate stats stay exact."""
+
+    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_sample", "_next")
+
+    def __init__(self, cap: int = 2048):
+        self.cap = cap
+        self.count = 0
+        self.total = 0.0
+        self.vmin: Optional[float] = None
+        self.vmax: Optional[float] = None
+        self._sample: List[float] = []
+        self._next = 0
+
+    def observe(self, value: float):
+        v = float(value)
+        self.count += 1
+        self.total += v
+        self.vmin = v if self.vmin is None else min(self.vmin, v)
+        self.vmax = v if self.vmax is None else max(self.vmax, v)
+        if len(self._sample) < self.cap:
+            self._sample.append(v)
+        else:
+            self._sample[self._next] = v
+            self._next = (self._next + 1) % self.cap
+
+    def percentile(self, p: float) -> Optional[float]:
+        """p in [0, 100] over the reservoir (exact until `cap` samples)."""
+        if not self._sample:
+            return None
+        s = sorted(self._sample)
+        idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
+        return s[idx]
+
+    def summary(self) -> Dict[str, Any]:
+        out = {"count": self.count, "sum": self.total,
+               "min": self.vmin, "max": self.vmax,
+               "mean": (self.total / self.count) if self.count else None}
+        for p in (50, 95, 99):
+            out[f"p{p}"] = self.percentile(p)
+        return out
+
+
+class MetricsRegistry:
+    """One process-local registry of counters/gauges/histograms."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._counters: Dict[Tuple[str, _LabelKey], float] = {}
+        self._gauges: Dict[Tuple[str, _LabelKey], float] = {}
+        self._hists: Dict[Tuple[str, _LabelKey], Histogram] = {}
+
+    # ------------------------------------------------------------- write
+    def inc(self, name: str, value: float = 1.0, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._counters[key] = self._counters.get(key, 0.0) + value
+
+    def set_gauge(self, name: str, value: float, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            self._gauges[key] = float(value)
+
+    def observe(self, name: str, value: float, **labels):
+        key = (name, _label_key(labels))
+        with self._lock:
+            h = self._hists.get(key)
+            if h is None:
+                h = self._hists[key] = Histogram()
+            h.observe(value)
+
+    def timer(self, name: str, **labels):
+        """Context manager observing wall seconds into histogram `name`."""
+        return _Timer(self, name, labels)
+
+    # -------------------------------------------------------------- read
+    def counter_value(self, name: str, **labels) -> float:
+        return self._counters.get((name, _label_key(labels)), 0.0)
+
+    def gauge_value(self, name: str, **labels) -> Optional[float]:
+        return self._gauges.get((name, _label_key(labels)))
+
+    def histogram(self, name: str, **labels) -> Optional[Histogram]:
+        return self._hists.get((name, _label_key(labels)))
+
+    def snapshot(self) -> Dict[str, Any]:
+        """{'counters': [...], 'gauges': [...], 'histograms': [...]} with
+        each series as {'name', 'labels', ...} — JSON-serializable."""
+        with self._lock:
+            counters = [{"name": n, "labels": dict(lk), "value": v}
+                        for (n, lk), v in sorted(self._counters.items())]
+            gauges = [{"name": n, "labels": dict(lk), "value": v}
+                      for (n, lk), v in sorted(self._gauges.items())]
+            hists = [dict({"name": n, "labels": dict(lk)}, **h.summary())
+                     for (n, lk), h in sorted(self._hists.items())]
+        return {"counters": counters, "gauges": gauges, "histograms": hists}
+
+    def export_jsonl(self, path: str):
+        """One JSONL line per series (kind-tagged) — greppable, appendable."""
+        snap = self.snapshot()
+        with open(path, "w") as f:
+            for kind in ("counters", "gauges", "histograms"):
+                for rec in snap[kind]:
+                    f.write(json.dumps(dict(rec, kind=kind[:-1])) + "\n")
+
+    def reset(self):
+        with self._lock:
+            self._counters.clear()
+            self._gauges.clear()
+            self._hists.clear()
+
+
+class _Timer:
+    def __init__(self, reg: MetricsRegistry, name: str, labels: Dict):
+        self.reg, self.name, self.labels = reg, name, labels
+        self.elapsed: Optional[float] = None
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        self.elapsed = time.perf_counter() - self._t0
+        self.reg.observe(self.name, self.elapsed, **self.labels)
+        return False
+
+
+_default = MetricsRegistry()
+
+
+def get_registry() -> MetricsRegistry:
+    """The process-global default registry (what the trainer/rpc/elastic
+    layers write into unless handed an explicit one)."""
+    return _default

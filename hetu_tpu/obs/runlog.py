@@ -1,0 +1,152 @@
+"""Structured run-event log.
+
+Every training run leaves a machine-readable trace: one JSONL record per
+step / compile / switch / elastic epoch, written next to the checkpoints
+(reference: the profiler cost records persisted per run — hetu/impl/
+profiler/; here the schema is stable and versioned so BENCH tooling and
+tools_obs_report.py can read logs across repo revisions).
+
+Record shape (all kinds):
+
+    {"schema": 1, "kind": "step", "t": <unix wall time>, ...kind fields}
+
+Kind fields:
+    step          step, step_time_s, loss, tokens_per_s, device_mem_bytes,
+                  plan (fingerprint of the dispatched plan)
+    compile       name, plan, compile_s, flops, estimated_mfu
+    switch        from_id, to_id, wall_s, moved_bytes, total_bytes
+    elastic_epoch epoch, alive, strategy
+    summary       metrics (a MetricsRegistry snapshot), profiler summary
+
+The writer is append-only and flushes per record by default: a preempted
+TPU worker's log is valid up to its last completed step.
+"""
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from typing import Any, Dict, Iterator, List, Optional
+
+SCHEMA_VERSION = 1
+
+#: field names every record carries — the stability contract tested by
+#: tests/test_obs.py (extend with new OPTIONAL fields; never rename these)
+REQUIRED_FIELDS = ("schema", "kind", "t")
+
+
+class RunLog:
+    """Append-only JSONL run-event writer."""
+
+    def __init__(self, path: str, flush_every: int = 1):
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._f = open(path, "a")
+        self._lock = threading.Lock()
+        self._flush_every = max(1, flush_every)
+        self._since_flush = 0
+        self.records_written = 0
+
+    # ------------------------------------------------------------------
+    def log(self, kind: str, **fields) -> Dict[str, Any]:
+        rec = {"schema": SCHEMA_VERSION, "kind": kind, "t": time.time()}
+        rec.update(fields)
+        line = json.dumps(rec, default=_jsonable)
+        with self._lock:
+            if self._f.closed:
+                return rec   # post-close stragglers (daemon threads) drop
+            try:
+                self._f.write(line + "\n")
+                self._since_flush += 1
+                self.records_written += 1
+                if self._since_flush >= self._flush_every:
+                    self._f.flush()
+                    self._since_flush = 0
+            except OSError as e:
+                # telemetry must not kill a step: a full disk / dead mount
+                # under the runlog disables the writer (warn once) while
+                # the training loop — and its checkpoints, possibly on a
+                # different path — carry on
+                try:
+                    self._f.close()
+                except OSError:
+                    pass
+                from hetu_tpu.utils.logging import get_logger
+                get_logger("obs.runlog").warning(
+                    f"run log write to {self.path} failed ({e!r}); "
+                    "disabling run-event logging for this run")
+        return rec
+
+    def step(self, step: int, step_time_s: float, *,
+             loss: Optional[float] = None,
+             tokens_per_s: Optional[float] = None,
+             device_mem_bytes: Optional[int] = None,
+             plan: Optional[str] = None, **extra) -> Dict[str, Any]:
+        return self.log("step", step=step, step_time_s=step_time_s,
+                        loss=loss, tokens_per_s=tokens_per_s,
+                        device_mem_bytes=device_mem_bytes, plan=plan,
+                        **extra)
+
+    def close(self):
+        with self._lock:
+            if not self._f.closed:
+                self._f.flush()
+                self._f.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def read(path: str) -> List[Dict[str, Any]]:
+        return list(RunLog.iter_records(path))
+
+    @staticmethod
+    def iter_records(path: str) -> Iterator[Dict[str, Any]]:
+        """Yields records, skipping torn trailing lines (a preempted
+        writer's final partial write must not poison the whole log)."""
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rec = json.loads(line)
+                except ValueError:
+                    continue
+                if isinstance(rec, dict) and rec.get("kind"):
+                    yield rec
+
+
+def _jsonable(obj):
+    """Fallback encoder: numpy / jax scalars -> python numbers."""
+    for attr in ("item", "tolist"):
+        fn = getattr(obj, attr, None)
+        if callable(fn):
+            try:
+                return fn()
+            except Exception:
+                pass
+    return str(obj)
+
+
+def default_runlog_path(ckpt_dir: Optional[str]) -> Optional[str]:
+    """Resolve where a trainer's run log goes: the HETU_TPU_RUNLOG flag
+    wins; else next to the checkpoints; else no log."""
+    from hetu_tpu.utils import flags
+    explicit = flags.str_flag("HETU_TPU_RUNLOG")
+    if explicit:
+        return explicit
+    if ckpt_dir:
+        # keep local-path semantics only — remote URIs (gs://) are the
+        # checkpointer's business, not a line-buffered JSONL writer's
+        if "://" not in ckpt_dir:
+            return os.path.join(ckpt_dir, "runlog.jsonl")
+    return None

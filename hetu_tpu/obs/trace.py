@@ -1,0 +1,225 @@
+"""Chrome-trace (chrome://tracing / Perfetto JSON) exporter.
+
+Two sources feed one timeline format:
+
+1. **Schedule renders** — the pipeline engines' own schedule structures
+   (`parallel.pipeline_1f1b.schedule_validity`,
+   `parallel.pipeline.gpipe_schedule_validity`) drawn as per-stage lanes
+   with fwd/bwd/bubble events, so "what does my 1F1B schedule look like"
+   is answerable without hardware (the reference draws the same picture
+   from its per-op event records, SURVEY §5.1).
+2. **Run events** — RunLog records (steps, hot-switch phases, elastic
+   re-mesh epochs) converted into wall-clock spans.
+
+Open the saved JSON at https://ui.perfetto.dev or chrome://tracing.
+
+Format: the Trace Event JSON array form — each event carries at least
+`name`, `ph`, `ts` (microseconds), `pid`; complete events ("ph": "X") add
+`dur`; instant events use "ph": "i".
+"""
+from __future__ import annotations
+
+import contextlib
+import json
+import time
+from typing import Any, Dict, Iterable, List, Optional
+
+
+class ChromeTrace:
+    """Accumulates trace events; `save()`/`to_json()` emit the JSON array
+    form that chrome://tracing and Perfetto accept directly."""
+
+    def __init__(self):
+        self.events: List[Dict[str, Any]] = []
+        self._t0 = time.perf_counter()
+
+    # ------------------------------------------------------------------
+    def add_complete(self, name: str, ts_us: float, dur_us: float, *,
+                     pid: Any = 0, tid: Any = 0, cat: str = "",
+                     args: Optional[Dict] = None):
+        ev = {"name": name, "ph": "X", "ts": float(ts_us),
+              "dur": float(dur_us), "pid": pid, "tid": tid}
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def add_instant(self, name: str, ts_us: float, *, pid: Any = 0,
+                    tid: Any = 0, cat: str = "",
+                    args: Optional[Dict] = None):
+        ev = {"name": name, "ph": "i", "ts": float(ts_us), "pid": pid,
+              "tid": tid, "s": "p"}
+        if cat:
+            ev["cat"] = cat
+        if args:
+            ev["args"] = args
+        self.events.append(ev)
+
+    def name_thread(self, pid: Any, tid: Any, name: str):
+        self.events.append({"name": "thread_name", "ph": "M", "pid": pid,
+                            "tid": tid, "ts": 0,
+                            "args": {"name": name}})
+
+    def name_process(self, pid: Any, name: str):
+        self.events.append({"name": "process_name", "ph": "M", "pid": pid,
+                            "tid": 0, "ts": 0, "args": {"name": name}})
+
+    @contextlib.contextmanager
+    def span(self, name: str, *, pid: Any = 0, tid: Any = 0, cat: str = "",
+             args: Optional[Dict] = None):
+        """Wall-clock complete event over the with-block (ts relative to
+        trace construction)."""
+        t0 = time.perf_counter()
+        try:
+            yield self
+        finally:
+            t1 = time.perf_counter()
+            self.add_complete(name, (t0 - self._t0) * 1e6,
+                              (t1 - t0) * 1e6, pid=pid, tid=tid, cat=cat,
+                              args=args)
+
+    # ------------------------------------------------------------------
+    def to_json(self) -> str:
+        return json.dumps(self.events)
+
+    def save(self, path: str) -> str:
+        with open(path, "w") as f:
+            f.write(self.to_json())
+        return path
+
+
+# ---------------------------------------------------------------------------
+# schedule renders
+# ---------------------------------------------------------------------------
+
+def pipeline_schedule_trace(pp: int, n_micro: int, *,
+                            schedule: str = "1f1b",
+                            fwd_us: float = 1000.0,
+                            bwd_us: float = 2000.0) -> ChromeTrace:
+    """Render a micro-batch pipeline schedule as per-stage timeline lanes.
+
+    Lanes come from the engines' OWN schedule structures, so the picture is
+    the executed schedule, not a diagram: 1F1B uses
+    pipeline_1f1b.schedule_validity (lockstep rounds, fwd half + bwd half),
+    GPipe uses pipeline.gpipe_schedule_validity (fill/steady forwards, then
+    the autodiff-reversed backwards).  `fwd_us`/`bwd_us` are per-micro
+    nominal durations (B ~ 2F by default); feed measured values for a
+    to-scale render.
+    """
+    if schedule not in ("1f1b", "gpipe"):
+        raise ValueError(f"schedule must be '1f1b' or 'gpipe', "
+                         f"got {schedule!r}")
+    tr = ChromeTrace()
+    pid = f"pipeline/{schedule}"
+    tr.name_process(pid, f"{schedule} pp={pp} n_micro={n_micro}")
+    for s in range(pp):
+        tr.name_thread(pid, s, f"stage {s}")
+
+    def lane(stage, t0, dur, kind, micro=None):
+        if kind == "bubble":
+            tr.add_complete("bubble", t0, dur, pid=pid, tid=stage,
+                            cat="bubble")
+        else:
+            tr.add_complete(f"{'F' if kind == 'fwd' else 'B'}{micro}",
+                            t0, dur, pid=pid, tid=stage, cat=kind,
+                            args={"micro": int(micro), "stage": int(stage)})
+
+    if schedule == "1f1b":
+        from hetu_tpu.parallel.pipeline_1f1b import schedule_validity
+        fwd, bwd = schedule_validity(pp, n_micro)
+        round_us = fwd_us + bwd_us
+        for r in range(fwd.shape[0]):
+            t0 = r * round_us
+            for s in range(pp):
+                if fwd[r, s]:
+                    lane(s, t0, fwd_us, "fwd", r - s)
+                else:
+                    lane(s, t0, fwd_us, "bubble")
+                if bwd[r, s]:
+                    lane(s, t0 + fwd_us, bwd_us, "bwd",
+                         r - 2 * (pp - 1) + s)
+                else:
+                    lane(s, t0 + fwd_us, bwd_us, "bubble")
+    else:
+        from hetu_tpu.parallel.pipeline import gpipe_schedule_validity
+        valid = gpipe_schedule_validity(pp, n_micro)
+        T = valid.shape[0]
+        for t in range(T):
+            for s in range(pp):
+                if valid[t, s]:
+                    lane(s, t * fwd_us, fwd_us, "fwd", t - s)
+                else:
+                    lane(s, t * fwd_us, fwd_us, "bubble")
+        # the GPipe backward is scan autodiff: ticks replay in REVERSE
+        bwd_base = T * fwd_us
+        for k, t in enumerate(reversed(range(T))):
+            for s in range(pp):
+                if valid[t, s]:
+                    lane(s, bwd_base + k * bwd_us, bwd_us, "bwd", t - s)
+                else:
+                    lane(s, bwd_base + k * bwd_us, bwd_us, "bubble")
+    return tr
+
+
+def schedule_bubble_fraction(pp: int, n_micro: int,
+                             schedule: str = "1f1b",
+                             fwd_us: float = 1.0,
+                             bwd_us: float = 2.0) -> float:
+    """Fraction of lane time spent idle in the rendered schedule — the
+    analytic pipeline-bubble overhead ((pp-1)/(n_micro+pp-1) for GPipe)."""
+    tr = pipeline_schedule_trace(pp, n_micro, schedule=schedule,
+                                 fwd_us=fwd_us, bwd_us=bwd_us)
+    busy = sum(e["dur"] for e in tr.events
+               if e.get("ph") == "X" and e.get("cat") in ("fwd", "bwd"))
+    idle = sum(e["dur"] for e in tr.events
+               if e.get("ph") == "X" and e.get("cat") == "bubble")
+    total = busy + idle
+    return idle / total if total else 0.0
+
+
+# ---------------------------------------------------------------------------
+# run-event conversion (RunLog -> timeline)
+# ---------------------------------------------------------------------------
+
+def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
+    """Convert RunLog records into a wall-clock timeline: step spans on a
+    'train' lane, hot-switch phases on a 'switch' lane, elastic epochs as
+    instants on an 'elastic' lane."""
+    recs = [r for r in records if isinstance(r, dict) and "t" in r]
+    tr = ChromeTrace()
+    if not recs:
+        return tr
+    t0 = min(float(r["t"]) for r in recs)
+    pid = "run"
+    tr.name_process(pid, "training run")
+    tr.name_thread(pid, "train", "train steps")
+    tr.name_thread(pid, "switch", "hot switches")
+    tr.name_thread(pid, "elastic", "elastic epochs")
+    for r in recs:
+        ts = (float(r["t"]) - t0) * 1e6
+        kind = r.get("kind")
+        if kind == "step":
+            dur = float(r.get("step_time_s") or 0.0) * 1e6
+            # RunLog stamps t at record time (step END); draw from start
+            tr.add_complete(f"step {r.get('step')}", ts - dur, dur,
+                            pid=pid, tid="train", cat="step",
+                            args={k: r[k] for k in
+                                  ("loss", "tokens_per_s", "plan")
+                                  if r.get(k) is not None})
+        elif kind == "switch":
+            dur = float(r.get("wall_s") or 0.0) * 1e6
+            tr.add_complete(
+                f"switch {r.get('from_id')}->{r.get('to_id')}", ts - dur,
+                dur, pid=pid, tid="switch", cat="switch",
+                args={k: r[k] for k in ("moved_bytes", "total_bytes")
+                      if r.get(k) is not None})
+        elif kind == "elastic_epoch":
+            tr.add_instant(f"epoch {r.get('epoch')}", ts, pid=pid,
+                           tid="elastic", cat="elastic",
+                           args={"alive": r.get("alive")})
+        elif kind == "compile":
+            dur = float(r.get("compile_s") or 0.0) * 1e6
+            tr.add_complete(f"compile {r.get('name')}", ts - dur, dur,
+                            pid=pid, tid="train", cat="compile")
+    return tr

@@ -39,6 +39,17 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def gpipe_schedule_validity(pp: int, n_micro: int):
+    """The GPipe fill/steady/drain structure as a [T, pp] numpy mask:
+    stage s is live at tick t iff 0 <= t-s < n_micro (its micro index).
+    This is the mask pipeline_apply scans over (aux masking) — factored
+    out so the Chrome-trace exporter (hetu_tpu.obs.trace) renders the
+    schedule the engine actually executes."""
+    T = n_micro + pp - 1
+    micro_idx = np.arange(T)[:, None] - np.arange(pp)[None, :]   # [T, pp]
+    return (micro_idx >= 0) & (micro_idx < n_micro)
+
+
 def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
                    *, n_micro: int, mesh, pp_axis: str = "pp",
                    remat: bool = True, remat_policy: str = "nothing",
@@ -121,10 +132,7 @@ def pipeline_apply(stage_body: Callable, stage_params, x, token_data: Dict,
     # stage s processes micro t-s at tick t; anything else is fill/drain
     # garbage whose aux (e.g. MoE router loss on zero activations) must NOT
     # reach the training loss
-    ticks = jnp.arange(T)
-    stages = jnp.arange(pp)
-    micro_idx = ticks[:, None] - stages[None, :]          # [T, pp]
-    aux_mask = ((micro_idx >= 0) & (micro_idx < n_micro)).astype(jnp.float32)
+    aux_mask = jnp.asarray(gpipe_schedule_validity(pp, n_micro), jnp.float32)
 
     def step(carry, xs_t):
         state_x, state_tok = carry

@@ -60,6 +60,25 @@ from jax import lax
 from jax.sharding import PartitionSpec as P
 
 
+def schedule_validity(pp: int, n_micro: int):
+    """The 1F1B lockstep-round structure as [R, pp] numpy masks.
+
+    Round r's forward half runs micro r-s on stage s where fwd[r, s];
+    its backward half runs micro r-2(pp-1)+s where bwd[r, s].  This is
+    exactly what pipeline_train_1f1b scans over (fwd_valid/bwd_valid) —
+    factored out so the Chrome-trace exporter (hetu_tpu.obs.trace) renders
+    the schedule the engine actually executes.  Under skip_dead_halves the
+    invalid halves cost ~nothing; they render as bubble lanes either way.
+    """
+    R = n_micro + 2 * (pp - 1)
+    r_ = np.arange(R)[:, None]
+    s_ = np.arange(pp)[None, :]
+    fwd = (r_ - s_ >= 0) & (r_ - s_ < n_micro)
+    m_b = r_ - 2 * (pp - 1) + s_
+    bwd = (m_b >= 0) & (m_b < n_micro)
+    return fwd, bwd
+
+
 def _shardmap_round_bodies(stage_fn: Callable, mesh, pp_axis: str):
     """(vfwd, vbwd) with per-stage dead-half skipping.
 
@@ -223,11 +242,9 @@ def pipeline_train_1f1b(stage_fn: Callable, stage_params, edge_params,
     xs_ride = {k: stream(micros(v), 0) for k, v in ride_data.items()}
 
     # ---- validity masks [R, pp] -------------------------------------------
-    r_ = np.arange(R)[:, None]
-    s_ = np.arange(pp)[None, :]
-    fwd_valid = jnp.asarray(((r_ - s_ >= 0) & (r_ - s_ < n)), jnp.float32)
-    m_b = r_ - 2 * (pp - 1) + s_
-    bwd_valid = jnp.asarray(((m_b >= 0) & (m_b < n)), jnp.float32)
+    fwd_np, bwd_np = schedule_validity(pp, n)
+    fwd_valid = jnp.asarray(fwd_np, jnp.float32)
+    bwd_valid = jnp.asarray(bwd_np, jnp.float32)
 
     is_first = jnp.asarray(np.arange(pp) == 0, jnp.float32)
     is_last = jnp.asarray(np.arange(pp) == pp - 1, jnp.float32)

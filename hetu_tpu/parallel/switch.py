@@ -159,11 +159,19 @@ class StrategySwitcher:
     def switch(self, params, opt_state, to_id: int,
                mode: SwitchMode = SwitchMode.PARAM_AND_OPTIMIZER,
                donate: bool = True):
+        # the two switch phases (param move, opt-state move) are timed
+        # separately into the metrics registry — the reference profiles
+        # its ParamSlice program per phase the same way
+        from hetu_tpu.obs.metrics import get_registry
+        reg = get_registry()
         dst = self.handles[to_id]
-        new_params = switch_tree(params, dst.param_shardings, donate=donate)
+        with reg.timer("switch.params_s", to_id=to_id):
+            new_params = switch_tree(params, dst.param_shardings,
+                                     donate=donate)
         if mode is SwitchMode.PARAM_AND_OPTIMIZER and opt_state is not None:
-            new_state = switch_tree(opt_state, dst.state_shardings,
-                                    donate=donate)
+            with reg.timer("switch.opt_state_s", to_id=to_id):
+                new_state = switch_tree(opt_state, dst.state_shardings,
+                                        donate=donate)
         else:
             new_state = None
         return new_params, new_state

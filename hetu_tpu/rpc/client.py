@@ -16,6 +16,14 @@ from typing import Any, Dict, Optional
 from hetu_tpu.rpc.server import _recv, _send
 
 
+class VoteDisagreement(RuntimeError):
+    """A `consistent` vote completed and the participants DISAGREED — a
+    real consensus conflict (e.g. the elastic dual-leader race), distinct
+    from the generic RuntimeError `_call` raises for any rpc failure.
+    Catchers recovering from vote conflicts must match this type, not
+    bare RuntimeError, or they misclassify transport/server errors."""
+
+
 class CoordinationClient:
     def __init__(self, host: str, port: int, info: Optional[Dict] = None,
                  heartbeat_interval: float = 2.0, auto_heartbeat: bool = True):
@@ -46,9 +54,17 @@ class CoordinationClient:
         return resp
 
     def _heartbeat_loop(self):
+        from hetu_tpu.obs.metrics import get_registry
+        reg = get_registry()
         while not self._shutdown:
             try:
+                t0 = time.perf_counter()
                 resp = self._call({"op": "heartbeat", "rank": self.rank})
+                # heartbeat RTT is the cheapest coordination-health probe
+                # each worker has: a climbing p95 here means the control
+                # plane (not the compute) is the straggler
+                reg.observe("rpc.heartbeat_rtt_s",
+                            time.perf_counter() - t0, rank=self.rank)
                 if resp.get("stop"):
                     self.should_stop = True
             except (ConnectionError, OSError, RuntimeError):
@@ -104,7 +120,8 @@ class CoordinationClient:
                                "count": count})
             if resp["done"]:
                 if not resp["agreed"]:
-                    raise RuntimeError(f"consistency vote {name!r} failed")
+                    raise VoteDisagreement(
+                        f"consistency vote {name!r} failed")
                 return resp["value"]
             if time.time() > deadline:
                 raise TimeoutError(f"consistent {name!r} timed out")

@@ -24,6 +24,7 @@ from typing import Any, Dict, Optional, Set
 
 import numpy as np
 
+from hetu_tpu.obs.metrics import get_registry
 from hetu_tpu.rpc.wire import decode_rows, encode_rows
 from hetu_tpu.utils.logging import get_logger
 
@@ -192,6 +193,10 @@ class CoordinationServer:
         if info is None or not info.get("alive"):
             return
         info["alive"] = False
+        reg = get_registry()
+        reg.inc("rpc.workers_lost", reason=why)
+        reg.set_gauge("rpc.alive_workers", sum(
+            1 for w in self._workers.values() if w.get("alive")))
         logger.warning(f"worker {rank} lost ({why}); signaling stop "
                        "to survivors")
         self._kv["__membership_change__"] = time.time()
@@ -212,6 +217,10 @@ class CoordinationServer:
                 self._workers[rank] = {
                     "info": req.get("info", {}), "alive": True,
                     "last_beat": time.time()}
+                reg = get_registry()
+                reg.inc("rpc.connects")
+                reg.set_gauge("rpc.alive_workers", sum(
+                    1 for w in self._workers.values() if w.get("alive")))
                 if conn_state is not None:
                     conn_state["rank"] = rank
                 return {"ok": True, "rank": rank,
@@ -220,7 +229,17 @@ class CoordinationServer:
                 rank = req["rank"]
                 stop = rank in self._stop_flags
                 if rank in self._workers:
-                    self._workers[rank]["last_beat"] = time.time()
+                    now = time.time()
+                    prev = self._workers[rank]["last_beat"]
+                    self._workers[rank]["last_beat"] = now
+                    # straggler visibility: per-worker inter-beat gap
+                    # histogram + last-seen gauge (a worker whose gap
+                    # creeps toward heartbeat_timeout is about to be
+                    # declared dead — see tools_straggler.py)
+                    reg = get_registry()
+                    reg.observe("rpc.heartbeat_gap_s", now - prev,
+                                rank=rank)
+                    reg.set_gauge("rpc.worker_last_beat_t", now, rank=rank)
                     # a stop-flagged worker is NOT resurrected by a late
                     # heartbeat — it must re-connect for a fresh rank
                     if not stop:

@@ -44,6 +44,16 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "off by default — the tree walk costs host time per switch"),
     Flag("HETU_TPU_LOG_LEVEL", "str", "INFO",
          "root log level for hetu_tpu loggers"),
+    Flag("HETU_TPU_RUNLOG", "str", "",
+         "write the structured run-event JSONL (obs.RunLog) to this path; "
+         "default: <ckpt_dir>/runlog.jsonl when checkpointing, else off"),
+    Flag("HETU_TPU_METRICS_EXPORT", "str", "",
+         "export the metrics-registry snapshot as JSONL to this path when "
+         "the trainer loop ends"),
+    Flag("HETU_TPU_TRACE_SCHEDULE", "str", "",
+         "write a Chrome-trace render of the pipeline micro-batch schedule "
+         "(obs.pipeline_schedule_trace) to this path at build time when "
+         "pp > 1; open in Perfetto / chrome://tracing"),
     Flag("HETU_TPU_MAX_PLANS", "int", 8,
          "max compiled train-step plans per strategy (one per batch-shape "
          "bucket); a new shape past the cap is a loud error instead of a "

@@ -86,6 +86,12 @@ class StepProfiler:
             if self._trace_active and rel >= trace_steps[1]:
                 self._stop_trace()
 
+    @property
+    def last_step_s(self) -> float:
+        """Wall seconds of the most recent profiled step (0.0 before the
+        first) — the trainer's RunLog step records read it."""
+        return self._times[-1] if self._times else 0.0
+
     def close(self):
         """Flush an in-flight trace (called by the trainer when the loop
         ends before the trace window closes)."""
@@ -129,6 +135,14 @@ def phase_breakdown(compiled_or_text, phases=PHASES):
            else compiled_or_text.as_text())
     op_pat = re.compile(r'op_name="([^"]+)"')
     shape_pat = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
+    # the OUTPUT-shape section of `%name = <shapes> opcode(...)`: the
+    # non-greedy group is everything between the assignment and the first
+    # lowercase opcode token followed by '(' (operand shapes live INSIDE
+    # the parens and must not count — summing them overcounts traffic by
+    # the instruction fan-in).  Tuple outputs `(f32[..]{..}, f32[..]{..})`
+    # and tiled layouts `{1,0:T(8,128)}` stay in the group: `T(` starts
+    # uppercase, dtype tokens are followed by `[` not `(`.
+    out_pat = re.compile(r'=\s*(.*?)\s*[a-z][a-z0-9_.-]*\(')
     # a scope segment may be wrapped by transform names — "attn",
     # "jvp(embed)", "transpose(jvp(mlp))" — so match the phase bounded by
     # path separators or transform parens
@@ -148,10 +162,13 @@ def phase_breakdown(compiled_or_text, phases=PHASES):
         if " dot(" in line or " convolution(" in line:
             rec["dots"] += 1
         # output shape(s): scalar `= f32[8,16]{...}` or tuple-shaped
-        # multi-output fusions `= (f32[8,128]{...}, f32[8]{...})` — HLO
-        # text carries shapes only on the output side, so summing every
-        # shape token on the line attributes all components
-        for dt, dims in shape_pat.findall(line):
+        # multi-output fusions `= (f32[8,128]{...}, f32[8]{...})`.  HLO
+        # text ALSO prints operand shapes inside the call parens, so the
+        # scan is anchored to the output section only (out_pat) — every
+        # component of a tuple output counts, no operand double-counts.
+        om = out_pat.search(line)
+        out_section = om.group(1) if om is not None else ""
+        for dt, dims in shape_pat.findall(out_section):
             numel = 1
             for d in dims.split(","):
                 if d:

@@ -177,12 +177,17 @@ def test_sp_nfkc_cf_casefolds():
     assert tok.decode(tok.encode("ABC")) == "abc"
 
 
-def test_sp_unknown_normalizer_raises():
+def test_sp_unknown_normalizer_falls_back_at_load():
+    """A model carrying an unimplemented NormalizerSpec rule (e.g. a
+    custom precompiled charsmap) must degrade to identity at LOAD time
+    with a logged warning — a model that loads must not start raising on
+    its first encode()."""
     blob = write_model_proto(_nfkc_pieces(), model_type=1,
                              normalizer_name="martian")
     tok = SentencePieceTokenizer(model_bytes=blob)
-    with pytest.raises(ValueError, match="martian"):
-        tok.encode("abc")
+    assert tok.normalizer_name == "identity"
+    # encodes as identity, no mid-encode raise; NFKC is NOT applied
+    assert tok.decode(tok.encode("abc")) == "abc"
 
 
 def test_sp_identity_default_unchanged():

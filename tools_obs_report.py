@@ -1,0 +1,108 @@
+"""Summarize a RunLog JSONL for BENCH records.
+
+Reads the structured run-event log a training run leaves next to its
+checkpoints (hetu_tpu.obs.RunLog, see docs/observability.md) and prints
+one JSON summary: step count, median/p95 step time, aggregate tokens/s,
+compile stats, hot-switch/elastic counts, and the hardware-free
+estimated MFU recorded at compile time — the numbers a BENCH record
+wants, without re-running anything.
+
+    python tools_obs_report.py /ckpts/runlog.jsonl
+    python tools_obs_report.py runlog.jsonl --trace timeline.json
+
+--trace additionally renders the run as a Chrome-trace timeline
+(open at https://ui.perfetto.dev).  Pure host-side file munging: no jax,
+no device contact, safe when the TPU tunnel is down.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _percentile(sorted_vals, p):
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
+def summarize(records) -> dict:
+    """Aggregate RunLog records (any iterable of dicts) into the BENCH
+    summary shape.  Tolerates partial logs: a preempted run still reports
+    everything up to its last completed step."""
+    steps = [r for r in records if r.get("kind") == "step"]
+    compiles = [r for r in records if r.get("kind") == "compile"]
+    switches = [r for r in records if r.get("kind") == "switch"]
+    epochs = [r for r in records if r.get("kind") == "elastic_epoch"]
+
+    out: dict = {"steps": len(steps), "compiles": len(compiles),
+                 "switches": len(switches), "elastic_epochs": len(epochs)}
+
+    times = sorted(float(r["step_time_s"]) for r in steps
+                   if r.get("step_time_s"))
+    if times:
+        out["step_time_s"] = {
+            "median": _percentile(times, 50),
+            "p95": _percentile(times, 95),
+            "min": times[0], "max": times[-1],
+        }
+    tps = [float(r["tokens_per_s"]) for r in steps if r.get("tokens_per_s")]
+    if tps:
+        out["tokens_per_s_median"] = _percentile(sorted(tps), 50)
+    losses = [float(r["loss"]) for r in steps if r.get("loss") is not None]
+    if losses:
+        out["loss_first"], out["loss_last"] = losses[0], losses[-1]
+    mems = [int(r["device_mem_bytes"]) for r in steps
+            if r.get("device_mem_bytes")]
+    if mems:
+        out["device_mem_bytes_max"] = max(mems)
+
+    # the hardware-free perf signal: estimated MFU stamped per compile
+    # (obs.mfu roofline) — report the latest, which matches the plan the
+    # run actually stepped with
+    est = [r for r in compiles if r.get("estimated_mfu")]
+    if est:
+        last = est[-1]
+        out["estimated_mfu"] = float(last["estimated_mfu"])
+        if last.get("flops"):
+            out["flops_per_step"] = float(last["flops"])
+    compile_s = sorted(float(r["compile_s"]) for r in compiles
+                       if r.get("compile_s"))
+    if compile_s:
+        out["compile_s_total"] = sum(compile_s)
+
+    plans = {r.get("plan") for r in steps if r.get("plan")}
+    if plans:
+        out["plans"] = sorted(plans)
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Summarize a RunLog JSONL (steps, step-time "
+                    "percentiles, tokens/s, estimated MFU) for BENCH.")
+    ap.add_argument("runlog", help="path to a runlog.jsonl")
+    ap.add_argument("--trace", metavar="OUT.json", default=None,
+                    help="also render the run as Chrome-trace JSON "
+                         "(open in Perfetto / chrome://tracing)")
+    args = ap.parse_args(argv)
+
+    from hetu_tpu.obs.runlog import RunLog
+    records = RunLog.read(args.runlog)
+    if not records:
+        print(f"no records in {args.runlog}", file=sys.stderr)
+        return 1
+    print(json.dumps(summarize(records), indent=2))
+
+    if args.trace:
+        from hetu_tpu.obs.trace import trace_from_runlog
+        trace_from_runlog(records).save(args.trace)
+        print(f"# timeline written to {args.trace}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

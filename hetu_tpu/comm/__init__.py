@@ -1,0 +1,34 @@
+"""Compressed gradient collectives (HETU_TPU_GRAD_COMPRESS).
+
+Four pieces, one import surface (docs/comm_compression.md):
+
+    comm.wire       — the bytes-on-wire model (pure python; shared with
+                      obs.comm, search/cost_model.py and bench.py)
+    comm.compress   — blockwise int8 quantize/dequantize (+ stochastic
+                      rounding, + error-feedback quantize)
+    comm.bucketer   — BucketPlan: fuse small grads into flat buffers
+    comm.grad_sync  — the quantized DP sync (shard_map-internal) and the
+                      hetero-DP bridge compress/accumulate pair
+"""
+from hetu_tpu.comm.bucketer import BucketPlan  # noqa: F401
+from hetu_tpu.comm.compress import (dequantize_blockwise,  # noqa: F401
+                                    ef_quantize, quantize_blockwise)
+from hetu_tpu.comm.grad_sync import (MODES, bridge_accumulate,  # noqa: F401
+                                     bridge_compress, bridge_residual_init,
+                                     ef_init, ef_shardings, ef_specs,
+                                     quantized_grad_sync,
+                                     uses_error_feedback)
+from hetu_tpu.comm.wire import (COMPRESSED_MODES, DEFAULT_BLOCK,  # noqa: F401
+                                analytic_dp_sync, dp_sync_wire_bytes,
+                                wire_bytes_per_element, wire_factor)
+
+__all__ = [
+    "BucketPlan",
+    "quantize_blockwise", "dequantize_blockwise", "ef_quantize",
+    "MODES", "COMPRESSED_MODES", "DEFAULT_BLOCK",
+    "quantized_grad_sync", "ef_init", "ef_specs", "ef_shardings",
+    "uses_error_feedback",
+    "bridge_compress", "bridge_accumulate", "bridge_residual_init",
+    "wire_bytes_per_element", "wire_factor", "dp_sync_wire_bytes",
+    "analytic_dp_sync",
+]

@@ -1,0 +1,115 @@
+"""Gradient bucketer: fuse per-parameter grads into flat f32 buffers.
+
+A transformer's grad pytree is dominated by a few big matrices plus a long
+tail of small tensors (norm gains, biases).  Launching one collective per
+leaf pays per-op latency and per-block scale overhead on every tiny tensor;
+fusing the tail into shared flat buckets amortizes both — the classic DDP
+gradient-bucketing move, here feeding the quantized sync
+(comm/grad_sync.py) whose chunking wants lengths divisible by
+dp * block_size anyway.
+
+`BucketPlan` is built ONCE from abstract grads (shapes/dtypes) at trainer
+build time; `pack`/`unpack` are pure jnp reshape/concat/slice, traced into
+the train step.  Leaves are assigned in tree-flatten order: leaves at
+least `bucket_elems` large get a bucket of their own, smaller ones fuse
+greedily.  Every bucket is zero-padded to a multiple of `multiple`
+(pad contributes zero gradient, quantizes to zero, and is dropped by
+`unpack`)."""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+@dataclasses.dataclass(frozen=True)
+class _Slot:
+    """One leaf's home: bucket index, offset into it, and its shape."""
+    bucket: int
+    offset: int
+    size: int
+    shape: Tuple[int, ...]
+    dtype: Any
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    slots: Tuple[_Slot, ...]          # one per leaf, tree-flatten order
+    sizes: Tuple[int, ...]            # padded bucket lengths
+    treedef: Any
+
+    @staticmethod
+    def build(abstract_tree, *, bucket_elems: int = 1 << 22,
+              multiple: int = 2048) -> "BucketPlan":
+        """abstract_tree: grads-shaped pytree of arrays or
+        ShapeDtypeStructs.  bucket_elems: fuse-target bucket size in
+        elements; multiple: every padded bucket length divides by this
+        (callers pass dp * block_size)."""
+        leaves, treedef = jax.tree.flatten(abstract_tree)
+        slots: List[_Slot] = []
+        sizes: List[int] = []
+        cur = -1          # open bucket index, -1 = none
+        fill = 0
+        for leaf in leaves:
+            size = 1
+            for d in leaf.shape:
+                size *= int(d)
+            if size >= bucket_elems:
+                # big leaf: its own bucket, nothing else fuses in
+                sizes.append(size)
+                slots.append(_Slot(len(sizes) - 1, 0, size,
+                                   tuple(leaf.shape), leaf.dtype))
+                continue
+            if cur < 0 or fill + size > bucket_elems:
+                sizes.append(0)
+                cur, fill = len(sizes) - 1, 0
+            slots.append(_Slot(cur, fill, size, tuple(leaf.shape),
+                               leaf.dtype))
+            fill += size
+            sizes[cur] = fill
+        padded = tuple(-(-s // multiple) * multiple for s in sizes)
+        return BucketPlan(tuple(slots), padded, treedef)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.sizes)
+
+    @property
+    def total_elements(self) -> int:
+        """Padded flat elements across all buckets (what goes on the
+        wire per sync)."""
+        return sum(self.sizes)
+
+    def pack(self, tree) -> List[jnp.ndarray]:
+        """Grads pytree -> list of flat f32 buckets (zero-padded)."""
+        leaves = jax.tree.leaves(tree)
+        if len(leaves) != len(self.slots):
+            raise ValueError(f"tree has {len(leaves)} leaves, plan has "
+                             f"{len(self.slots)}")
+        parts: List[List[jnp.ndarray]] = [[] for _ in self.sizes]
+        fills = [0] * len(self.sizes)
+        for leaf, slot in zip(leaves, self.slots):
+            parts[slot.bucket].append(leaf.reshape(-1).astype(jnp.float32))
+            fills[slot.bucket] += slot.size
+        out = []
+        for bi, chunks in enumerate(parts):
+            pad = self.sizes[bi] - fills[bi]
+            if pad:
+                chunks = chunks + [jnp.zeros((pad,), jnp.float32)]
+            out.append(chunks[0] if len(chunks) == 1
+                       else jnp.concatenate(chunks))
+        return out
+
+    def unpack(self, flats: Sequence[jnp.ndarray]):
+        """List of flat buckets -> grads pytree (original shapes/dtypes)."""
+        if len(flats) != len(self.sizes):
+            raise ValueError(f"got {len(flats)} buckets, plan has "
+                             f"{len(self.sizes)}")
+        leaves = []
+        for slot in self.slots:
+            flat = jax.lax.slice(flats[slot.bucket], (slot.offset,),
+                                 (slot.offset + slot.size,))
+            leaves.append(flat.reshape(slot.shape).astype(slot.dtype))
+        return jax.tree.unflatten(self.treedef, leaves)

@@ -1,0 +1,79 @@
+"""Blockwise int8 quantize/dequantize primitives for gradient collectives.
+
+The payload format is the one `comm/wire.py` prices: flat f32 buffers cut
+into blocks of `block_size`, each block carried as int8 values plus one
+f32 absmax scale.  Unlike `ops/quantization.py` (weight-only storage
+quantization, arbitrary nd-shapes), these primitives are collective-facing:
+they keep the block axis outermost so chunks of whole blocks can ride
+all-to-all / all-gather rows, and they offer
+
+  * stochastic rounding — unbiased E[deq(q)] = x, the standard variance-
+    for-bias trade for gradient compression (EQuARX, PAPERS.md), and
+  * error feedback — `ef_quantize` folds the previous round's
+    quantization residual into the buffer before quantizing and returns
+    the new residual, the SGD-with-memory correction that restores
+    convergence when the same buffer is compressed every step.
+
+All functions are jit-safe and shard_map-safe (elementwise + block
+reductions only, no collectives here).
+"""
+from __future__ import annotations
+
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu.comm.wire import DEFAULT_BLOCK
+
+
+def quantize_blockwise(x, block_size: int = DEFAULT_BLOCK, *,
+                       stochastic: bool = False,
+                       rng: Optional[jax.Array] = None
+                       ) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Flat f32 [n] (n % block_size == 0) -> (q int8 [n//bs, bs],
+    scales f32 [n//bs]).  Deterministic round-to-nearest by default;
+    stochastic=True rounds up with probability equal to the fractional
+    part (needs `rng`), making the dequantized value unbiased."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    n = flat.shape[0]
+    if n % block_size:
+        raise ValueError(f"buffer of {n} elements is not a multiple of "
+                         f"block_size={block_size}; pad first "
+                         f"(comm.bucketer does)")
+    blocks = flat.reshape(-1, block_size)
+    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    scale = jnp.maximum(scale, 1e-12)
+    y = blocks / scale[:, None]
+    if stochastic:
+        if rng is None:
+            raise ValueError("stochastic rounding needs an rng key")
+        floor = jnp.floor(y)
+        frac = y - floor
+        up = jax.random.uniform(rng, y.shape) < frac
+        y = floor + up.astype(jnp.float32)
+    else:
+        y = jnp.round(y)
+    q = jnp.clip(y, -127.0, 127.0).astype(jnp.int8)
+    return q, scale
+
+
+def dequantize_blockwise(q, scale) -> jnp.ndarray:
+    """(q int8 [nb, bs], scales f32 [nb]) -> flat f32 [nb*bs]."""
+    return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
+
+
+def ef_quantize(x, residual, block_size: int = DEFAULT_BLOCK, *,
+                stochastic: bool = False,
+                rng: Optional[jax.Array] = None
+                ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Error-feedback quantize: compress c = x + residual and return
+    (q, scales, new_residual = c - dequantize(q)).  With residual=None
+    behaves like plain quantize (new_residual still returned, for a
+    uniform calling convention)."""
+    flat = x.reshape(-1).astype(jnp.float32)
+    c = flat if residual is None else flat + residual.reshape(-1)
+    q, scale = quantize_blockwise(c, block_size, stochastic=stochastic,
+                                  rng=rng)
+    new_residual = c - dequantize_blockwise(q, scale)
+    return q, scale, new_residual

@@ -1,0 +1,75 @@
+"""Wire-format accounting for compressed gradient collectives.
+
+Pure python (no jax): the SAME byte model is consumed by the HLO analyzer
+(`hetu_tpu.obs.comm`), the strategy-search cost model
+(`search/cost_model.py` DP grad-sync term) and `bench.py`'s
+unreachable-backend fallback, so "how many bytes does a sync move" has
+exactly one definition in the repo.
+
+The compressed DP sync (comm/grad_sync.py) is the EQuARX-shaped pattern
+(PAPERS.md): quantize -> all-to-all (the ring reduce-scatter step, each
+peer receives int8 chunks + f32 block scales) -> local dequant+sum ->
+re-quantize the reduced shard -> all-gather.  Per ring participant of
+n devices and a flat f32 buffer of N elements:
+
+    fp32 all-reduce       2 (n-1)/n * 4N          bytes on wire
+    int8 a2a + all-gather 2 (n-1)/n * N*(1 + 4/B) bytes on wire
+
+with B the quantization block size (one f32 absmax scale per B int8
+payload bytes).  The ratio is 4 / (1 + 4/B) ~ 3.94x at B=256,
+independent of n — the "~4x fewer DP-sync bytes" the flag buys.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+#: default quantization block (one f32 scale per 256 int8 values)
+DEFAULT_BLOCK = 256
+
+#: the HETU_TPU_GRAD_COMPRESS modes that actually compress
+COMPRESSED_MODES = ("int8", "int8-ef")
+
+
+def wire_bytes_per_element(mode: str, block_size: int = DEFAULT_BLOCK) -> float:
+    """Bytes on wire per f32 gradient element under `mode` (scales
+    included)."""
+    if mode in COMPRESSED_MODES:
+        return 1.0 + 4.0 / float(block_size)
+    return 4.0
+
+
+def wire_factor(mode: str, block_size: int = DEFAULT_BLOCK) -> float:
+    """Multiplier on the fp32 DP grad-sync wire bytes under `mode`
+    (1.0 for "none"; ~0.254 for int8 at the default block)."""
+    return wire_bytes_per_element(mode, block_size) / 4.0
+
+
+def dp_sync_wire_bytes(n_elements: float, dp: int, mode: str = "none",
+                       block_size: int = DEFAULT_BLOCK) -> float:
+    """Per-chip bytes on wire for one DP grad sync of `n_elements` f32
+    gradient values over a ring of `dp` devices."""
+    if dp <= 1:
+        return 0.0
+    ring = 2.0 * (dp - 1) / dp
+    return ring * n_elements * wire_bytes_per_element(mode, block_size)
+
+
+def analytic_dp_sync(n_params: float, dp: int, *,
+                     block_size: int = DEFAULT_BLOCK,
+                     ici_gbps: Optional[float] = None) -> Dict[str, Any]:
+    """The fp32-vs-int8 sync comparison for a model of `n_params` grads —
+    the hardware-free record bench.py emits when no step can even lower
+    (analytic twin of obs.comm.collective_report on a compiled step)."""
+    fp32 = dp_sync_wire_bytes(n_params, dp, "none", block_size)
+    int8 = dp_sync_wire_bytes(n_params, dp, "int8", block_size)
+    out: Dict[str, Any] = {
+        "dp": dp, "grad_elements": float(n_params),
+        "fp32_wire_bytes": fp32, "int8_wire_bytes": int8,
+        "ratio": (fp32 / int8) if int8 else None,
+        "block_size": block_size, "analytic": True,
+    }
+    if ici_gbps:
+        bw = float(ici_gbps) * 1e9
+        out["fp32_comm_s"] = fp32 / bw
+        out["int8_comm_s"] = int8 / bw
+    return out

@@ -25,13 +25,34 @@ Two execution contexts consume this algebra:
 """
 from __future__ import annotations
 
+import contextlib
 import dataclasses
 import enum
+import threading
 from typing import Dict, FrozenSet, Optional, Sequence, Tuple, Union
 
 import jax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+_constrain_local = threading.local()
+
+
+@contextlib.contextmanager
+def suppress_constraints():
+    """Trace-scope context: `DS.constrain` becomes the identity.
+
+    GSPMD sharding constraints are illegal inside a fully-manual shard_map
+    region (every mesh axis is already manual), and semantically vacuous
+    there — per-device values are local by construction.  The compressed
+    grad-sync path (engine/trainer.py _compressed_grads) traces the model
+    inside such a region and wraps the trace in this context."""
+    prev = getattr(_constrain_local, "off", False)
+    _constrain_local.off = True
+    try:
+        yield
+    finally:
+        _constrain_local.off = prev
 
 AxisName = str
 DimSpec = Tuple[AxisName, ...]  # mesh axes sharding one tensor dim (outer→inner)
@@ -171,6 +192,8 @@ class DistributedStates:
             raise ValueError(f"cannot constrain to partial layout {self}")
         if not self.sharded_axes():
             return x
+        if getattr(_constrain_local, "off", False):
+            return x  # inside a fully-manual region (suppress_constraints)
         if mesh is not None:
             return lax.with_sharding_constraint(x, self.named_sharding(mesh))
         return lax.with_sharding_constraint(x, self.partition_spec())

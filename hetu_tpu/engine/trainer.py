@@ -117,6 +117,31 @@ class Trainer:
         self._scaler = GradScaler() if use_scaler else None
         self.scaler_state = None
 
+        # -- compressed DP grad sync (hetu_tpu/comm, HETU_TPU_GRAD_COMPRESS;
+        # docs/comm_compression.md).  "none" is the byte-identical default:
+        # the branch below is python-level, so no traced program changes.
+        from hetu_tpu.utils import flags as _flags
+        self._grad_compress = _flags.str_flag("HETU_TPU_GRAD_COMPRESS")
+        self._bucket_plan = None
+        if self._grad_compress != "none":
+            st = self.strategy
+            if (st.tp > 1 or st.cp > 1 or st.pp > 1 or st.ep > 1
+                    or st.zero_stage >= 3):
+                # the quantized sync runs the per-replica grad computation
+                # inside a shard_map over dp with replicated params — only
+                # homogeneous DP/ZeRO-1/2 fits that envelope (the hetero-DP
+                # BRIDGE compresses independently in parallel/hetero_dp.py)
+                raise ValueError(
+                    f"HETU_TPU_GRAD_COMPRESS={self._grad_compress!r} "
+                    f"supports homogeneous DP/ZeRO-1/2 only (dp>1, "
+                    f"tp=cp=pp=ep=1, zero_stage<3); got "
+                    f"{self.strategy.describe()}")
+            if st.dp <= 1:
+                logger.info(
+                    f"HETU_TPU_GRAD_COMPRESS={self._grad_compress} ignored: "
+                    f"dp=1 has no grad sync to compress")
+                self._grad_compress = "none"
+
         from hetu_tpu.utils.profiling import StepProfiler
         self.profiler = StepProfiler()
         # -- telemetry (hetu_tpu.obs): the metrics registry is process-
@@ -174,6 +199,25 @@ class Trainer:
             self._pshard, self._sshard = self._make_shardings()
             self.opt_state = jax.jit(
                 self.optimizer.init, out_shardings=self._sshard)(self.params)
+            if self._grad_compress != "none":
+                # bucket layout is a compile-time constant: one plan from
+                # the abstract grad shapes, padded so every bucket chunks
+                # cleanly into dp rows of whole quantization blocks
+                from hetu_tpu.comm import DEFAULT_BLOCK, BucketPlan
+                dp = self.strategy.dp
+                self._bucket_plan = BucketPlan.build(
+                    jax.tree.map(
+                        lambda p: jax.ShapeDtypeStruct(p.shape, jnp.float32),
+                        self.model.abstract_params()),
+                    multiple=dp * DEFAULT_BLOCK)
+                from hetu_tpu.comm.grad_sync import uses_error_feedback
+                if uses_error_feedback(self._grad_compress):
+                    # the EF residuals ride in the optimizer-state pytree:
+                    # they checkpoint, donate and reshard with the moments
+                    from hetu_tpu.optim.optimizer import ef_state_entry
+                    ef0, ef_sh = ef_state_entry(self._bucket_plan, mesh, dp)
+                    self.opt_state["ef"] = ef0
+                    self._sshard = dict(self._sshard, ef=ef_sh)
             if self._scaler is not None:
                 self.scaler_state = jax.device_put(
                     self._scaler.init(), NamedSharding(mesh, P()))
@@ -248,11 +292,31 @@ class Trainer:
             est = estimate_from_compiled(plan, with_phases=False)
         except Exception:
             est = {}
+        comm = {}
+        try:
+            # bytes-on-wire of this plan's collectives (obs.comm) — this
+            # is where a HETU_TPU_GRAD_COMPRESS win becomes a RunLog fact.
+            # It costs one as_text() of the optimized HLO per fresh
+            # compile (the same materialization the phase walk avoids);
+            # that is once per plan, not per step, but very large programs
+            # can opt out via HETU_TPU_COMM_ANALYZE=0
+            from hetu_tpu.utils import flags as _flags
+            if _flags.bool_flag("HETU_TPU_COMM_ANALYZE"):
+                from hetu_tpu.obs.comm import collective_report
+                comm = collective_report(plan)
+        except Exception:
+            comm = {}
         self.run_log.log(
             "compile", name=pool_name, plan=str(key)[:500],
             compile_s=compile_s, flops=est.get("flops_per_step"),
             estimated_mfu=est.get("estimated_mfu"),
-            estimated_step_s=est.get("estimated_step_s"))
+            estimated_step_s=est.get("estimated_step_s"),
+            comm_bytes=comm.get("total_wire_bytes"),
+            comm_s_est=comm.get("predicted_comm_s"),
+            collectives={op: rec["count"] for op, rec in
+                         (comm.get("collectives") or {}).items()} or None,
+            grad_compress=(self._grad_compress
+                           if self._grad_compress != "none" else None))
 
     # ------------------------------------------------------------------
     def _loss_fn(self, params, batch, rng):
@@ -271,6 +335,12 @@ class Trainer:
         c = self.config
         lead = jax.tree.leaves(batches)[0]
         n_micro = lead.shape[0]
+        # the EF residuals ride in opt_state but belong to the SYNC, not
+        # the optimizer update: lift them out here, reattach updated ones
+        # below ({} when mode "int8" carries no residuals)
+        ef_state, new_ef = {}, {}
+        if self._grad_compress != "none":
+            ef_state = opt_state.pop("ef", {})
         if self._scaler is not None:
             # normalize the scale by the STATIC token-slot count so fp16
             # cotangent magnitudes are batch-size-independent (the torch
@@ -314,26 +384,17 @@ class Trainer:
 
                 (_, (lsum, csum)), grads = jax.value_and_grad(
                     pp_loss, has_aux=True)(params)
-        else:
-            def micro(acc, xs):
-                batch, key = xs
-
-                def scaled_loss(p):
-                    l, count = self._loss_fn(p, batch, key)
-                    return l.astype(jnp.float32) * scale, (l, count)
-
-                (_, (l, count)), g = jax.value_and_grad(
-                    scaled_loss, has_aux=True)(params)
-                acc_g, acc_l, acc_c = acc
-                return (jax.tree.map(jnp.add, acc_g, g), acc_l + l,
-                        acc_c + count), None
-
-            zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
-                                  params)
-            zero = jnp.zeros((), jnp.float32)
+        elif self._grad_compress != "none":
+            # quantized DP sync (comm/grad_sync.py): per-replica grads in a
+            # shard_map over dp, then int8 all-to-all/all-gather instead of
+            # the f32 all-reduce GSPMD would insert
             keys = jax.random.split(rng, n_micro)
-            (grads, lsum, csum), _ = jax.lax.scan(
-                micro, (zero_g, zero, zero), (batches, keys))
+            grads, lsum, csum, new_ef = self._compressed_grads(
+                params, batches, keys, scale, ef_state)
+        else:
+            keys = jax.random.split(rng, n_micro)
+            grads, lsum, csum = self._accumulate_grads(
+                params, batches, keys, scale)
 
         denom = jnp.maximum(csum, 1.0)
         # fold the unscale into the token normalize (one pass over grads)
@@ -348,6 +409,8 @@ class Trainer:
         metrics = {"loss": lsum / denom}
         if self._scaler is None:
             params, opt_state = self.optimizer.update(grads, opt_state, params)
+            if new_ef:
+                opt_state["ef"] = new_ef
             metrics["grad_norm"] = gnorm
             metrics["lr"] = self.optimizer._lr(opt_state["step"])
             return params, opt_state, metrics, scaler_state
@@ -362,12 +425,83 @@ class Trainer:
                               new_params, params)
         opt_state = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
                                  new_opt, opt_state)
+        if new_ef:
+            # a skipped step keeps the previous residuals too: the grads
+            # that produced new_ef never entered the params
+            opt_state["ef"] = jax.tree.map(
+                lambda n, o: jnp.where(finite, n, o), new_ef, ef_state)
         scaler_state = self._scaler.update(scaler_state, finite)
         metrics["grad_norm"] = jnp.where(finite, gnorm, jnp.nan)
         metrics["lr"] = self.optimizer._lr(opt_state["step"])
         metrics["loss_scale"] = scaler_state["scale"]
         metrics["amp_skipped"] = 1.0 - finite.astype(jnp.float32)
         return params, opt_state, metrics, scaler_state
+
+    # ------------------------------------------------------------------
+    def _accumulate_grads(self, params, batches, keys, scale):
+        """The micro-batch grad-accumulation scan -> (sum-grads, loss sum,
+        token count).  ONE definition shared by the GSPMD path and the
+        compressed shard_map body — fp32/int8 loss parity is defined by
+        these being the same arithmetic, so they must not drift apart."""
+        def micro(acc, xs):
+            batch, key = xs
+
+            def scaled_loss(p):
+                l, count = self._loss_fn(p, batch, key)
+                return l.astype(jnp.float32) * scale, (l, count)
+
+            (_, (l, count)), g = jax.value_and_grad(
+                scaled_loss, has_aux=True)(params)
+            acc_g, acc_l, acc_c = acc
+            return (jax.tree.map(jnp.add, acc_g, g), acc_l + l,
+                    acc_c + count), None
+
+        zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
+                              params)
+        zero = jnp.zeros((), jnp.float32)
+        (grads, lsum, csum), _ = jax.lax.scan(
+            micro, (zero_g, zero, zero), (batches, keys))
+        return grads, lsum, csum
+
+    def _compressed_grads(self, params, batches, keys, scale, ef_state):
+        """Per-replica grad accumulation + quantized DP sync, as ONE
+        shard_map over the dp axis (comm/grad_sync.py).
+
+        Inside the manual region each replica runs the same micro-batch
+        scan as the GSPMD path over its local batch rows, then the sync
+        replaces GSPMD's f32 grad all-reduce with int8 all-to-all +
+        all-gather (~3.94x fewer bytes on wire, comm/wire.py).  Loss/token
+        sums psum as f32 scalars.  Dropout keys are shared across replicas
+        (same mask per replica on different rows) — pretraining defaults
+        run deterministic, see docs/comm_compression.md."""
+        from jax.experimental.shard_map import shard_map
+        from hetu_tpu.comm.grad_sync import ef_specs, quantized_grad_sync
+        dp = self.strategy.dp
+
+        def body(params, batches, keys, scale, ef_state):
+            grads, lsum, csum = self._accumulate_grads(
+                params, batches, keys, scale)
+            grads, new_ef = quantized_grad_sync(
+                grads, "dp", dp, self._bucket_plan, self._grad_compress,
+                ef_state)
+            return (grads, jax.lax.psum(lsum, "dp"),
+                    jax.lax.psum(csum, "dp"), new_ef)
+
+        batch_specs = jax.tree.map(
+            lambda v: P(*([None, "dp"] + [None] * (v.ndim - 2))), batches)
+        especs = (ef_specs(self._bucket_plan) if ef_state else {})
+        fn = shard_map(
+            body, mesh=self.mesh,
+            in_specs=(P(), batch_specs, P(), P(), especs),
+            out_specs=(P(), P(), P(), especs),
+            # the gathered grads ARE replicated over dp but the checker
+            # cannot infer that through all-to-all
+            check_rep=False)
+        from hetu_tpu.dstates import suppress_constraints
+        with suppress_constraints():
+            # the model's activation constraints (strategy.constrain) are
+            # illegal AND vacuous inside the fully-manual region
+            return fn(params, batches, keys, scale, ef_state)
 
     # ------------------------------------------------------------------
     def _batch_sharding(self, ndim: int):
@@ -663,7 +797,15 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def state(self):
-        s = {"params": self.params, "opt_state": self.opt_state,
+        opt_state = self.opt_state
+        if isinstance(opt_state, dict) and "ef" in opt_state:
+            # the EF residuals ("ef") deliberately do NOT checkpoint: they
+            # are a bounded one-step quantization memory, zero is a correct
+            # cold start, and their [dp, L] layout would pin resumes to the
+            # exact compress mode + dp degree — an elastic re-mesh or a
+            # flag change must never brick a restore
+            opt_state = {k: v for k, v in opt_state.items() if k != "ef"}
+        s = {"params": self.params, "opt_state": opt_state,
              "step": self.global_step}
         if self.scaler_state is not None:
             s["scaler"] = self.scaler_state
@@ -680,7 +822,9 @@ class Trainer:
         assert self._ckpt is not None, "no ckpt_dir configured"
         if self.params is None:
             self.build()
-        target = self.state()
+        target = self.state()   # never carries "ef" — see state()
+        fresh_ef = (self.opt_state.get("ef")
+                    if isinstance(self.opt_state, dict) else None)
         try:
             restored = self._ckpt.restore(step, target=target)
         except ValueError:
@@ -695,6 +839,10 @@ class Trainer:
             restored = self._ckpt.restore(step, target=target)
         self.params = restored["params"]
         self.opt_state = restored["opt_state"]
+        if fresh_ef is not None:
+            # re-attach build()'s zero EF residuals (cold start; the
+            # checkpoint intentionally excludes them — see state())
+            self.opt_state["ef"] = fresh_ef
         self.global_step = int(restored["step"])
         if "scaler" in restored and self._scaler is not None:
             self.scaler_state = restored["scaler"]

@@ -1,17 +1,22 @@
 """Unified telemetry: metrics registry, structured run events, Chrome-trace
-timelines, and the hardware-free MFU/roofline reporter.
+timelines, the hardware-free MFU/roofline reporter, and the bytes-on-wire
+collective analyzer.
 
-Four pieces, one import surface:
+Five pieces, one import surface:
 
     from hetu_tpu import obs
     obs.get_registry().inc("elastic.replans")
     log = obs.RunLog("/ckpts/runlog.jsonl"); log.step(1, 0.42, loss=2.3)
     obs.pipeline_schedule_trace(4, 8, schedule="1f1b").save("sched.json")
     obs.estimate_from_compiled(compiled)["estimated_mfu"]
+    obs.collective_report(compiled)["total_wire_bytes"]
 
 See docs/observability.md for the env flags, the RunLog schema, and how
-the estimated MFU is derived.
+the estimated MFU is derived; docs/comm_compression.md for the collective
+analyzer's wire-byte model.
 """
+from hetu_tpu.obs.comm import (collective_report,  # noqa: F401
+                               collective_table)
 from hetu_tpu.obs.metrics import (Histogram, MetricsRegistry,  # noqa: F401
                                   get_registry)
 from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
@@ -31,4 +36,5 @@ __all__ = [
     "trace_from_runlog",
     "estimate_mfu", "estimate_from_compiled", "flops_of_compiled",
     "analytic_transformer_estimate", "load_hardware_profile",
+    "collective_report", "collective_table",
 ]

@@ -1,0 +1,184 @@
+"""Bytes-on-wire analyzer: count collectives in a compiled step's HLO.
+
+The TPU tunnel being down must not make a comm optimization unverifiable:
+this module walks the POST-OPTIMIZATION HLO text of a compiled train step
+(available on any backend, incl. the 8-device CPU test mesh) and reports,
+per collective opcode —  all-reduce / reduce-scatter / all-gather /
+all-to-all / collective-permute — the op count and the bytes each puts on
+the wire per participant under the standard ring algorithms:
+
+    all-reduce          2 (n-1)/n * payload
+    all-gather            (n-1)/n * gathered output
+    reduce-scatter        (n-1)   * scattered output   (= (n-1)/n * input)
+    all-to-all            (n-1)/n * local buffer
+    collective-permute              output             (one hop)
+
+`n` is parsed from each op's replica_groups.  Predicted comm time prices
+all-reduce-class ops at the profile's `ici_allreduce_gbps` bus bandwidth
+and permutes at `ici_p2p_gbps` (hardware_profile_v5e.json — the same
+numbers the search cost model uses).
+
+Consumers: Trainer compile run-events (RunLog `comm_bytes`), bench.py
+(`comm_bytes_per_step` even when the backend is unreachable, via the
+analytic twin in comm/wire.py), tools_comm_report.py (the per-collective
+table), and the ZeRO-1 HLO-assertion test (reduce-scatter + all-gather
+tripwire for GSPMD regressions).
+
+Caveat: the count is STATIC — a collective inside a while-loop body
+(scan-over-layers, grad-accumulation scan) is counted once, not
+trip-count times.  For exact per-step accounting lower the model with
+`use_scan=False` (the comm tests and tools_comm_report.py do).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional
+
+from hetu_tpu.comm.wire import analytic_dp_sync  # noqa: F401  (re-export)
+
+#: collective opcodes we account (async "-start" forms fold into these)
+COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
+                  "all-to-all", "collective-permute")
+
+_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1,
+                "f8e5m2": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4,
+                "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1, "c64": 8,
+                "c128": 16}
+
+# `%x = <shapes> opcode(...)` — same output-section anchoring as
+# utils.profiling.phase_breakdown: shapes AFTER '=' and BEFORE the opcode
+# token; operand shapes (inside the parens) must not count
+_LINE_PAT = re.compile(r'=\s*(?P<out>.*?)\s*(?P<op>[a-z][a-z0-9_.-]*)\(')
+_SHAPE_PAT = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
+_GROUPS_PAT = re.compile(r'replica_groups=\{\{([0-9, ]*)\}')
+_IOTA_GROUPS_PAT = re.compile(r'replica_groups=\[(\d+),(\d+)\]<=')
+
+
+def _component_bytes(section: str):
+    out = []
+    for dt, dims in _SHAPE_PAT.findall(section):
+        numel = 1
+        for d in dims.split(","):
+            if d:
+                numel *= int(d)
+        out.append(numel * _DTYPE_BYTES.get(dt, 4))
+    return out
+
+
+def _payload_bytes(section: str, is_start: bool) -> int:
+    """Payload of one collective from its output-shape section.
+
+    Sync forms: the output IS the payload (sum tuple components — a tuple
+    all-to-all's components add up to the local buffer).  Async "-start"
+    forms output a tuple carrying the OPERAND buffer(s) too —
+    (operand, result, context...) — so summing would double-count; the
+    largest component is the full transfer buffer for every async
+    collective (result for all-gather, operand for reduce-scatter, either
+    for all-reduce/permute), and `_wire_bytes` applies full-buffer
+    formulas for starts."""
+    comps = _component_bytes(section)
+    if not comps:
+        return 0
+    return max(comps) if is_start else sum(comps)
+
+
+def _group_size(line: str, default_world: int) -> int:
+    m = _GROUPS_PAT.search(line)
+    if m:
+        first = [t for t in m.group(1).split(",") if t.strip()]
+        return max(len(first), 1)
+    m = _IOTA_GROUPS_PAT.search(line)
+    if m:  # iota form [num_groups, group_size]<=[world]
+        return max(int(m.group(2)), 1)
+    return max(default_world, 1)
+
+
+def _wire_bytes(op: str, payload: int, n: int, is_start: bool) -> float:
+    """Per-participant ring wire bytes.  `payload` is the output-section
+    payload (_payload_bytes): for sync reduce-scatter that is the SHARD
+    (output), for async starts it is the FULL buffer — hence the two
+    reduce-scatter formulas."""
+    if op == "collective-permute":
+        # point-to-point: one hop, group size does not apply (the op
+        # carries source_target_pairs, not replica_groups)
+        return float(payload)
+    if n <= 1:
+        return 0.0
+    if op == "all-reduce":
+        return 2.0 * (n - 1) / n * payload
+    if op == "all-gather":
+        return (n - 1) / n * payload
+    if op == "reduce-scatter":
+        if is_start:  # payload = full input buffer
+            return (n - 1) / n * payload
+        return float(n - 1) * payload  # payload = the output shard
+    if op == "all-to-all":
+        return (n - 1) / n * payload
+    return 0.0
+
+
+def collective_table(compiled_or_text, default_world: int = 1
+                     ) -> List[Dict[str, Any]]:
+    """One row per collective instruction in the optimized HLO:
+    {op, out_bytes, group_size, wire_bytes, line}.  Accepts a compiled
+    executable (as_text()) or the HLO text itself."""
+    txt = (compiled_or_text if isinstance(compiled_or_text, str)
+           else compiled_or_text.as_text())
+    rows = []
+    for line in txt.splitlines():
+        # cheap prefilter before the regex work
+        if "all-" not in line and "reduce-scatter" not in line \
+                and "collective-permute" not in line:
+            continue
+        m = _LINE_PAT.search(line)
+        if m is None:
+            continue
+        op = m.group("op")
+        if op.endswith("-done"):
+            continue  # the -start carries the payload
+        is_start = op.endswith("-start")
+        base = op[:-6] if is_start else op
+        if base not in COLLECTIVE_OPS:
+            continue
+        out_bytes = _payload_bytes(m.group("out"), is_start)
+        n = _group_size(line, default_world)
+        rows.append({
+            "op": base,
+            "out_bytes": out_bytes,
+            "group_size": n,
+            "wire_bytes": _wire_bytes(base, out_bytes, n, is_start),
+            "line": line.strip()[:200],
+        })
+    return rows
+
+
+def collective_report(compiled_or_text, *, hw: Optional[Dict] = None,
+                      default_world: int = 1) -> Dict[str, Any]:
+    """Aggregate bytes-on-wire report for one compiled step.
+
+    {collectives: {op: {count, wire_bytes}}, num_collectives,
+     total_wire_bytes, predicted_comm_s, chip} — predicted_comm_s is the
+    serial ring-time estimate over the hardware profile's ICI rates (an
+    upper bound: real collectives overlap compute)."""
+    rows = collective_table(compiled_or_text, default_world)
+    per_op: Dict[str, Dict[str, float]] = {}
+    for r in rows:
+        rec = per_op.setdefault(r["op"], {"count": 0, "wire_bytes": 0.0})
+        rec["count"] += 1
+        rec["wire_bytes"] += r["wire_bytes"]
+    if hw is None:
+        from hetu_tpu.obs.mfu import load_hardware_profile
+        hw = load_hardware_profile()
+    ar_bw = float(hw.get("ici_allreduce_gbps", 45.0)) * 1e9
+    p2p_bw = float(hw.get("ici_p2p_gbps", 90.0)) * 1e9
+    t = 0.0
+    for op, rec in per_op.items():
+        bw = p2p_bw if op == "collective-permute" else ar_bw
+        t += rec["wire_bytes"] / bw
+    return {
+        "collectives": per_op,
+        "num_collectives": len(rows),
+        "total_wire_bytes": sum(r["wire_bytes"] for r in rows),
+        "predicted_comm_s": t,
+        "chip": hw.get("chip", "unknown"),
+    }

@@ -24,9 +24,17 @@ import jax.numpy as jnp
 
 def clip_by_global_norm(grads, max_norm: float):
     """Global-norm clip (used by the trainer; reference clips via
-    GradScaler/CheckFinite pipeline)."""
+    GradScaler/CheckFinite pipeline).
+
+    The per-leaf squared sums are stacked and reduced with ONE jnp.sum —
+    a python `sum(...)` over the leaf scalars lowers to a serial chain of
+    O(n_leaves) scalar adds in HLO (each dependent on the last), which on
+    a scan-free 100+-leaf model is a visible critical path; the stacked
+    reduction is a single tree-reduce."""
     leaves = jax.tree.leaves(grads)
-    gnorm = jnp.sqrt(sum(jnp.sum(jnp.square(g.astype(jnp.float32))) for g in leaves))
+    sq = jnp.stack([jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in leaves])
+    gnorm = jnp.sqrt(jnp.sum(sq))
     scale = jnp.minimum(1.0, max_norm / jnp.maximum(gnorm, 1e-12))
     return jax.tree.map(lambda g: (g.astype(jnp.float32) * scale).astype(g.dtype), grads), gnorm
 
@@ -159,6 +167,24 @@ def zero_shardings(param_shardings, abstract_params, mesh, axis: str = "dp"):
         return ns
 
     return jax.tree.map(shard_one, param_shardings, abstract_params)
+
+
+# ---------------------------------------------------------------------------
+# compressed-grad-sync error-feedback state (HETU_TPU_GRAD_COMPRESS=int8-ef)
+# ---------------------------------------------------------------------------
+
+def ef_state_entry(bucket_plan, mesh, dp: int, axis: str = "dp"):
+    """(initial EF residuals, their shardings) for the optimizer-state
+    pytree's "ef" entry — the quantized DP sync's error-feedback memory
+    (comm/grad_sync.py) rides in the SAME state dict as Adam's moments so
+    it checkpoints, donates and reshards with them.  Residual layout:
+    per-replica [dp, L] (split over dp) + per-shard [L] (split over dp)
+    per bucket."""
+    from hetu_tpu.comm.grad_sync import ef_init, ef_shardings
+    shardings = ef_shardings(bucket_plan, mesh, axis)
+    state = jax.jit(lambda: ef_init(bucket_plan, dp),
+                    out_shardings=shardings)()
+    return state, shardings
 
 
 # ---------------------------------------------------------------------------

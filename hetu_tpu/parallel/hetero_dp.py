@@ -67,7 +67,8 @@ class HeteroDPEngine:
     """
 
     def __init__(self, model_factory: Callable, optimizer,
-                 groups: List[HeteroDPGroup]):
+                 groups: List[HeteroDPGroup],
+                 grad_compress: Optional[str] = None):
         if not groups:
             raise ValueError("need at least one group")
         for gi, g in enumerate(groups):
@@ -75,6 +76,18 @@ class HeteroDPEngine:
                 raise ValueError(
                     f"hetero-dp group {gi} ({g.strategy.describe()}): share "
                     f"must be a positive integer, got {g.share!r}")
+        # bridge compression (HETU_TPU_GRAD_COMPRESS, overridable per
+        # engine): non-resident groups ship int8+scales across meshes
+        # instead of f32 sum-grads — quantize-before-device_put, with
+        # per-GROUP error-feedback residuals living on the source mesh
+        # (docs/comm_compression.md)
+        from hetu_tpu.utils import flags as _flags
+        self.grad_compress = (grad_compress if grad_compress is not None
+                              else _flags.str_flag("HETU_TPU_GRAD_COMPRESS"))
+        from hetu_tpu.comm.grad_sync import MODES
+        if self.grad_compress not in MODES:
+            raise ValueError(f"grad_compress must be one of {MODES}, got "
+                             f"{self.grad_compress!r}")
         self.optimizer = optimizer
         self.groups = groups
         self.models = [model_factory(g.strategy) for g in groups]
@@ -89,6 +102,11 @@ class HeteroDPEngine:
         self._grad_fns = []
         self._update_fn = None
         self._pshards = []
+        # bridge-compression state: per source group a jitted quantize fn
+        # and (int8-ef) its error-feedback residual tree, mesh-resident
+        self._compress_fns: List = []
+        self._accum_fn = None
+        self._bridge_residuals: List = []
 
     # ------------------------------------------------------------------
     def build(self, rng=None):
@@ -121,12 +139,64 @@ class HeteroDPEngine:
             self._update_fn = jax.jit(
                 _update, out_shardings=(self._pshards[0], None),
                 donate_argnums=(0, 1))
+
+        if self.grad_compress != "none" and len(self.groups) > 1:
+            from hetu_tpu.comm.grad_sync import (bridge_accumulate,
+                                                 bridge_compress,
+                                                 bridge_residual_init,
+                                                 uses_error_feedback)
+            ef = uses_error_feedback(self.grad_compress)
+            self._compress_fns = [None]
+            self._bridge_residuals = [None]
+            for gi in range(1, len(self.groups)):
+                with use_mesh(self.meshes[gi]):
+                    if ef:
+                        self._bridge_residuals.append(
+                            jax.jit(bridge_residual_init)(self.params[gi]))
+                        self._compress_fns.append(
+                            jax.jit(lambda g, r: bridge_compress(g, r)))
+                    else:
+                        self._bridge_residuals.append(None)
+                        self._compress_fns.append(
+                            jax.jit(lambda g: bridge_compress(g)))
+            with use_mesh(self.meshes[0]):
+                self._accum_fn = jax.jit(
+                    bridge_accumulate, out_shardings=self._pshards[0])
         return self
 
     # ------------------------------------------------------------------
+    def bridged_grads(self, host_batch: Dict[str, np.ndarray]):
+        """The bridge's output WITHOUT stepping: (token-weighted mean grad
+        on group 0's layout, token count, loss).  This is the quantity the
+        parity regression test pins down — G must be sum_g grads_g divided
+        by the global token count (never share- or group-weighted).
+        Inspection must not perturb training: EF residuals are NOT
+        committed (the quantization error of a discarded transfer must
+        not be 'corrected' on the next real step)."""
+        gsum, tokens, loss = self._grads_and_bridge(
+            host_batch, commit_residuals=False)
+        G = jax.tree.map(lambda x: x / np.float32(tokens), gsum)
+        return G, tokens, loss
+
     def train_step(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         """One global step: per-group grads -> bridge -> update -> broadcast.
         The batch is split along dim 0 by the union's shares."""
+        gsum, tokens, loss = self._grads_and_bridge(host_batch)
+        with use_mesh(self.meshes[0]):
+            self.params[0], self.opt_state = self._update_fn(
+                self.params[0], self.opt_state, gsum, tokens)
+        # broadcast updated params to the other groups' layouts
+        for gi in range(1, len(self.groups)):
+            self.params[gi] = jax.device_put(self.params[0],
+                                             self._pshards[gi])
+        return {"loss": loss, "tokens": tokens}
+
+    def _grads_and_bridge(self, host_batch: Dict[str, np.ndarray],
+                          commit_residuals: bool = True):
+        """Per-group sum-grads + the cross-mesh bridge reduce; returns
+        (gsum on group 0, global token count, token-weighted loss).
+        commit_residuals=False evaluates the bridge without advancing the
+        EF state (bridged_grads inspection)."""
         ids = np.asarray(host_batch["input_ids"])
         parts = self.batch_union.split_host(ids)
         for gi, (part, grp) in enumerate(zip(parts, self.groups)):
@@ -146,18 +216,30 @@ class HeteroDPEngine:
             counts.append(c)
             grads.append(g)
         # bridge: bring every group's sum-grads onto group 0's layout and
-        # accumulate (the union's cross-group reduce)
+        # accumulate (the union's cross-group reduce).  Compressed modes
+        # ship int8+scales (~3.9x fewer bridge bytes, comm/wire.py) and
+        # keep the quantization error as a per-group EF residual on the
+        # source mesh; group 0's own grads never quantize (resident).
         gsum = grads[0]
-        for g in grads[1:]:
-            g0 = jax.device_put(g, self._pshards[0])
-            gsum = jax.tree.map(lambda a, b: a + b, gsum, g0)
+        for gi in range(1, len(grads)):
+            if self.grad_compress == "none":
+                g0 = jax.device_put(grads[gi], self._pshards[0])
+                gsum = jax.tree.map(lambda a, b: a + b, gsum, g0)
+                continue
+            with use_mesh(self.meshes[gi]):
+                if self._bridge_residuals[gi] is not None:
+                    qs, ss, new_res = self._compress_fns[gi](
+                        grads[gi], self._bridge_residuals[gi])
+                    if commit_residuals:
+                        self._bridge_residuals[gi] = new_res
+                else:
+                    qs, ss, _ = self._compress_fns[gi](grads[gi])
+            from jax.sharding import NamedSharding, PartitionSpec as P
+            rep0 = NamedSharding(self.meshes[0], P())
+            qs0 = jax.device_put(qs, rep0)
+            ss0 = jax.device_put(ss, rep0)
+            with use_mesh(self.meshes[0]):
+                gsum = self._accum_fn(gsum, qs0, ss0)
         tokens = sum(float(c) for c in counts)
         loss = sum(float(s) for s in sums) / max(tokens, 1.0)
-        with use_mesh(self.meshes[0]):
-            self.params[0], self.opt_state = self._update_fn(
-                self.params[0], self.opt_state, gsum, tokens)
-        # broadcast updated params to the other groups' layouts
-        for gi in range(1, len(self.groups)):
-            self.params[gi] = jax.device_put(self.params[0],
-                                             self._pshards[gi])
-        return {"loss": loss, "tokens": tokens}
+        return gsum, tokens, loss

@@ -41,6 +41,12 @@ class StrategyCandidate:
     # cond-skipping shard_map bodies are pp-only — see pipeline_1f1b.py
     # skip_dead_halves)
     pp_schedule: str = "gpipe"
+    # compressed DP grad sync (hetu_tpu/comm, HETU_TPU_GRAD_COMPRESS):
+    # "none" | "int8" | "int8-ef" — scales the grad-sync wire bytes by
+    # comm.wire.wire_factor (~0.254 at int8), so the searcher sees the
+    # bandwidth the flag buys.  Compute cost of quantize/dequantize is
+    # VPU-elementwise and negligible next to the bytes saved.
+    grad_compress: str = "none"
 
     @property
     def num_devices(self):
@@ -60,6 +66,8 @@ class StrategyCandidate:
             bits.append("rc")
         if self.pp > 1 and self.pp_schedule != "gpipe":
             bits.append(self.pp_schedule)
+        if self.grad_compress != "none":
+            bits.append("gc" + self.grad_compress.replace("int", ""))
         return "x".join(bits) or "single"
 
     @property
@@ -153,9 +161,14 @@ class CostModel:
             t_comm += 4 * self.num_layers * ring / (
                 self._allreduce_gbps("tp", c.tp) * 1e9) / max(c.pp, 1)
 
-        # DP/ZeRO grad sync: reduce-scatter + all-gather of the local shard
+        # DP/ZeRO grad sync: reduce-scatter + all-gather of the local shard.
+        # Quantized sync (grad_compress, hetu_tpu/comm) moves int8+scales
+        # instead of f32 over the same ring structure — same 2(dp-1)/dp
+        # factor, ~1/4 the bytes per element (comm/wire.py)
         if c.dp > 1:
-            shard_bytes = 4 * self.num_params / max(c.tp * c.pp, 1)
+            from hetu_tpu.comm.wire import wire_factor
+            shard_bytes = (4 * self.num_params / max(c.tp * c.pp, 1)
+                           * wire_factor(c.grad_compress))
             ring = 2 * (c.dp - 1) / c.dp * shard_bytes
             t_dp += ring / (self._allreduce_gbps("dp", c.dp) * 1e9)
 

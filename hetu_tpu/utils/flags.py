@@ -54,11 +54,23 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "write a Chrome-trace render of the pipeline micro-batch schedule "
          "(obs.pipeline_schedule_trace) to this path at build time when "
          "pp > 1; open in Perfetto / chrome://tracing"),
+    Flag("HETU_TPU_COMM_ANALYZE", "bool", True,
+         "per-compile bytes-on-wire analysis (obs.comm) in RunLog compile "
+         "events; costs one as_text() of the optimized HLO per fresh "
+         "compile — set 0 on very large programs where stringifying the "
+         "module is noticeable next to the compile itself"),
     Flag("HETU_TPU_MAX_PLANS", "int", 8,
          "max compiled train-step plans per strategy (one per batch-shape "
          "bucket); a new shape past the cap is a loud error instead of a "
          "silent recompile (HETU_SHAPE_MISMATCH analog); 0 = unbounded"),
     # -- kernel / execution routing (reference: HETU_PARALLEL_ATTN*) -----
+    Flag("HETU_TPU_GRAD_COMPRESS", "str", "none",
+         "compressed DP grad sync (hetu_tpu/comm/): none = f32 collectives "
+         "(byte-identical default), int8 = blockwise-int8 quantized "
+         "reduce-scatter/all-gather (+ quantized hetero-DP bridge), "
+         "int8-ef = int8 with error-feedback residuals carried in the "
+         "optimizer state; see docs/comm_compression.md",
+         choices=("none", "int8", "int8-ef")),
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "flash-attention kernel routing: auto (shape-gated), 1 (force "
          "Pallas), 0 (force the XLA composition)",

@@ -128,6 +128,113 @@ def test_malleus_shares_exact_dp_over_greedy():
         plan_hetero_dp_shares(p6, [[0, 1], [2, 3, 4, 5]], [2, 4], 7)
 
 
+def _plain_groups(shares, devs, cfg):
+    """tp-free groups (dp2 + single-device) so the checks below isolate
+    the BRIDGE math from tp-layout numerics."""
+    return [
+        HeteroDPGroup(ParallelStrategy(mesh=MeshConfig(dp=2), zero=False),
+                      devs[:2], shares[0]),
+        HeteroDPGroup(ParallelStrategy(mesh=MeshConfig(), zero=False),
+                      devs[2:3], shares[1]),
+    ]
+
+
+def test_bridge_token_weighted_mean_regression():
+    """Uneven batch shares must produce a TOKEN-weighted mean gradient:
+    G = (sum_g grads_g) / (sum_g tokens_g).
+
+    Two layers of assertion: (1) f32 BIT-LEVEL — the engine's bridged
+    mean grad equals the same combination computed independently in
+    numpy from the engine's own per-group sum-grads (catches the
+    regression class this guards: share-weighted or group-mean-of-means
+    combinations, wrong denominators); (2) tolerance — it matches the
+    single-group full-batch gradient (cross-program reduction order
+    differs in the last ulps, so bit-equality is not defined there)."""
+    devs = jax.devices()
+    cfg = LlamaConfig.tiny(remat=False, num_key_value_heads=4,
+                           use_scan=False)
+    eng = HeteroDPEngine(lambda st: LlamaLMHeadModel(cfg, st),
+                         optim.SGD(lr=0.1),
+                         _plain_groups((3, 1), devs, cfg),
+                         grad_compress="none")
+    eng.build(jax.random.key(0))
+    batch = {"input_ids": _ids()}
+    G, tokens, _ = eng.bridged_grads(batch)
+    assert tokens == 8 * 63  # every non-pad next-token target counts
+
+    # (1) independent recombination from the engine's per-group programs
+    parts = eng.batch_union.split_host(np.asarray(batch["input_ids"]))
+    assert [p.shape[0] for p in parts] == [6, 2]  # uneven 3:1 rows
+    gsums, counts = [], []
+    from hetu_tpu.core.mesh import use_mesh
+    for gi, part in enumerate(parts):
+        with use_mesh(eng.meshes[gi]):
+            _, c, g = eng._grad_fns[gi](eng.params[gi], part)
+        gsums.append(jax.tree.map(np.asarray, g))
+        counts.append(float(c))
+    ref = jax.tree.map(
+        lambda a, b: (a + b) / np.float32(sum(counts)), gsums[0], gsums[1])
+    for a, b in zip(jax.tree.leaves(G), jax.tree.leaves(ref)):
+        np.testing.assert_array_equal(np.asarray(a), b)
+
+    # (2) against the true full-batch gradient (token weighting holds)
+    gm = LlamaLMHeadModel(cfg, ParallelStrategy())
+    gp = gm.init(jax.random.key(0))
+
+    def full(p, ids):
+        def loss_sum(p):
+            s, c = gm(p, ids, labels=ids, loss_reduction="sum")
+            return s, c
+        (_, c), g = jax.value_and_grad(loss_sum, has_aux=True)(p)
+        return jax.tree.map(lambda x: x / c, g)
+
+    gg = jax.jit(full)(gp, batch["input_ids"])
+    for a, b in zip(jax.tree.leaves(G), jax.tree.leaves(gg)):
+        a, b = np.asarray(a), np.asarray(b)
+        denom = max(float(np.abs(b).max()), 1e-6)
+        # cross-program reduction order drifts ~5e-3 relative on the
+        # token-scatter leaves; a share-weighted or mean-of-means bug is
+        # an O(1) error and blows far past this
+        assert float(np.abs(a - b).max()) / denom < 2e-2
+
+
+def test_bridge_compression_tracks_f32_and_keeps_replicas_synced():
+    """int8/int8-ef bridge modes: same training trajectory as the f32
+    bridge within quantization tolerance, EF residuals alive on the
+    source mesh, and the post-step broadcast still bit-syncs replicas."""
+    devs = jax.devices()
+    cfg = LlamaConfig.tiny(remat=False, num_key_value_heads=4,
+                           use_scan=False)
+    batch = {"input_ids": _ids()}
+    losses = {}
+    for mode in ("none", "int8", "int8-ef"):
+        eng = HeteroDPEngine(lambda st: LlamaLMHeadModel(cfg, st),
+                             optim.SGD(lr=0.1),
+                             _plain_groups((3, 1), devs, cfg),
+                             grad_compress=mode)
+        eng.build(jax.random.key(0))
+        losses[mode] = [eng.train_step(batch)["loss"] for _ in range(5)]
+        for gi in range(1, len(eng.groups)):
+            for a, b in zip(jax.tree.leaves(eng.params[0]),
+                            jax.tree.leaves(eng.params[gi])):
+                np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+        if mode == "int8-ef":
+            res = eng._bridge_residuals[1]
+            assert res is not None
+            assert max(float(jax.numpy.abs(r).max())
+                       for r in jax.tree.leaves(res)) > 0
+        else:
+            assert eng._bridge_residuals == [] or \
+                eng._bridge_residuals[1] is None
+    np.testing.assert_allclose(losses["int8"], losses["none"], rtol=2e-3)
+    np.testing.assert_allclose(losses["int8-ef"], losses["none"], rtol=2e-3)
+    bad = HeteroDPGroup(ParallelStrategy(mesh=MeshConfig(), zero=False),
+                        devs[:1], 1)
+    with pytest.raises(ValueError, match="grad_compress"):
+        HeteroDPEngine(lambda st: LlamaLMHeadModel(cfg, st),
+                       optim.SGD(lr=0.1), [bad], grad_compress="fp8")
+
+
 def test_share_and_dp_degree_validated():
     # non-positive share rejected at construction
     devs = jax.devices()

@@ -36,6 +36,42 @@ def test_fsdp_params_are_dp_sharded_and_train():
     assert losses[-1] < losses[0] - 0.3, losses
 
 
+def test_zero1_lowering_has_sharded_sync_collectives():
+    """HLO tripwire for GSPMD regressions (obs.comm analyzer): ZeRO-1 is
+    documented (optim/optimizer.py zero_shardings) to lower the grad sync
+    against dp-sharded optimizer state plus an all-gather param refresh.
+    Assert those collectives actually appear in the lowered step — on TPU
+    the sync is a reduce-scatter; XLA:CPU's partitioner realizes the same
+    contract as all-reduce + dynamic-slice, so accept either form.  The
+    all-gather refresh must gather at least the full parameter bytes; if
+    GSPMD ever silently drops the opt-state sharding, the all-gathers
+    disappear and this fails."""
+    from hetu_tpu.obs.comm import collective_report
+    cfg = LlamaConfig.tiny(remat=False, use_scan=False)
+    st = ParallelStrategy(mesh=MeshConfig(dp=4), zero=True)
+    model = LlamaLMHeadModel(cfg, st)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=64,
+                        warmup_steps=2, total_steps=10, log_every=100)
+    tr = Trainer(model, tc, st).build()
+    hb = _batch()
+    key = tuple(sorted((k, tuple(v.shape)) for k, v in hb.items()))
+    rep = collective_report(tr._compiled_for_shape(hb, key))
+    ops = rep["collectives"]
+    # grad sync: reduce-scatter (TPU) or all-reduce (XLA:CPU realization)
+    assert ("reduce-scatter" in ops) or ("all-reduce" in ops), ops
+    # param refresh: the ZeRO-1 signature on every backend
+    assert "all-gather" in ops, ops
+    n_param_bytes = 4 * sum(
+        int(np.prod(p.shape)) for p in jax.tree.leaves(tr.params))
+    gathered = ops["all-gather"]["wire_bytes"] / (3 / 4)  # undo (n-1)/n
+    assert gathered >= n_param_bytes * 0.9, (gathered, n_param_bytes)
+    # and the same step WITHOUT zero has no param-refresh all-gather
+    st0 = ParallelStrategy(mesh=MeshConfig(dp=4), zero=False)
+    tr0 = Trainer(LlamaLMHeadModel(cfg, st0), tc, st0).build()
+    rep0 = collective_report(tr0._compiled_for_shape(hb, key))
+    assert "all-gather" not in rep0["collectives"], rep0["collectives"]
+
+
 @pytest.mark.slow
 def test_zero_stages_match_numerics():
     # zero-1 vs zero-2 vs zero-3 must produce the same training trajectory

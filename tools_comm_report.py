@@ -1,0 +1,116 @@
+"""Per-collective bytes-on-wire table for a compiled train step.
+
+Lowers one Trainer train step for a tiny LLaMA on a virtual dp-mesh
+(CPU — no device contact, safe when the TPU tunnel is down), walks the
+optimized HLO with the obs.comm analyzer, and prints every collective's
+payload/wire bytes plus the aggregate report — the comm twin of
+tools_obs_report.py.
+
+    python tools_comm_report.py                      # dp=4, fp32 sync
+    python tools_comm_report.py --compress int8-ef   # quantized sync
+    python tools_comm_report.py --compare            # both + the ratio
+    python tools_comm_report.py --dp 8 --zero        # ZeRO-1 lowering
+
+The model lowers with use_scan=False so every collective is top-level in
+the HLO and the static count is exact (obs.comm's while-loop caveat).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+if __name__ == "__main__":
+    # must precede any jax import: the analyzer needs a real dp mesh
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    _flags = os.environ.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in _flags:
+        os.environ["XLA_FLAGS"] = (
+            _flags + " --xla_force_host_platform_device_count=8").strip()
+
+
+def lowered_step_report(mode: str, *, dp: int = 4, zero: bool = False,
+                        batch: int = 8, seq: int = 64):
+    """(collective_report, collective_table) for one compiled tiny-LLaMA
+    train step under HETU_TPU_GRAD_COMPRESS=`mode`."""
+    os.environ["HETU_TPU_GRAD_COMPRESS"] = mode
+    import numpy as np
+
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.obs.comm import collective_report, collective_table
+    from hetu_tpu.parallel import ParallelStrategy
+
+    cfg = LlamaConfig.tiny(remat=False, use_scan=False)
+    st = ParallelStrategy(mesh=MeshConfig(dp=dp), zero=zero)
+    tc = TrainingConfig(global_batch_size=batch,
+                        micro_batch_size=max(batch // dp, 1), seq_len=seq,
+                        warmup_steps=2, total_steps=10, log_every=1000)
+    tr = Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+    rng = np.random.default_rng(0)
+    hb = {"input_ids": rng.integers(1, 250, (batch, seq)).astype(np.int32),
+          "labels": rng.integers(1, 250, (batch, seq)).astype(np.int32)}
+    key = tuple(sorted((k, tuple(v.shape)) for k, v in hb.items()))
+    compiled = tr._compiled_for_shape(hb, key)
+    return collective_report(compiled), collective_table(compiled)
+
+
+def _print_table(mode: str, report, table, verbose: bool):
+    print(f"== HETU_TPU_GRAD_COMPRESS={mode} ==")
+    print(f"{'collective':<20}{'count':>6}{'wire bytes':>14}")
+    for op, rec in sorted(report["collectives"].items()):
+        print(f"{op:<20}{rec['count']:>6}{rec['wire_bytes']:>14,.0f}")
+    print(f"{'TOTAL':<20}{report['num_collectives']:>6}"
+          f"{report['total_wire_bytes']:>14,.0f}"
+          f"   predicted {report['predicted_comm_s'] * 1e6:.1f}us "
+          f"({report['chip']})")
+    if verbose:
+        for r in table:
+            print(f"  {r['op']:<18}{r['out_bytes']:>10} B  "
+                  f"n={r['group_size']}  wire={r['wire_bytes']:,.0f}")
+    print()
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Bytes-on-wire table of a compiled train step "
+                    "(hardware-free; obs.comm analyzer).")
+    ap.add_argument("--compress", default="none",
+                    choices=("none", "int8", "int8-ef"))
+    ap.add_argument("--compare", action="store_true",
+                    help="lower BOTH none and int8-ef, print the ratio")
+    ap.add_argument("--dp", type=int, default=4)
+    ap.add_argument("--zero", action="store_true",
+                    help="ZeRO-1 (reduce-scatter/all-gather lowering)")
+    ap.add_argument("--batch", type=int, default=8)
+    ap.add_argument("--seq", type=int, default=64)
+    ap.add_argument("-v", "--verbose", action="store_true",
+                    help="also print each collective instruction")
+    args = ap.parse_args(argv)
+
+    modes = (("none", "int8-ef") if args.compare else (args.compress,))
+    reports = {}
+    for mode in modes:
+        rep, table = lowered_step_report(
+            mode, dp=args.dp, zero=args.zero, batch=args.batch,
+            seq=args.seq)
+        reports[mode] = rep
+        _print_table(mode, rep, table, args.verbose)
+
+    summary = {m: {"total_wire_bytes": r["total_wire_bytes"],
+                   "num_collectives": r["num_collectives"],
+                   "predicted_comm_s": r["predicted_comm_s"]}
+               for m, r in reports.items()}
+    if args.compare:
+        f32 = reports["none"]["total_wire_bytes"]
+        q = reports["int8-ef"]["total_wire_bytes"]
+        summary["ratio"] = (f32 / q) if q else None
+        print(f"bytes-on-wire ratio fp32/int8: {summary['ratio']:.2f}x")
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,69 @@
+"""Deterministic fault injection for the elastic control plane.
+
+One process-global `FaultPlan` (or None — the default, meaning chaos is
+OFF and every injection point is identity).  The plan resolves lazily
+from the `HETU_TPU_CHAOS=<schedule.json>` flag on first query, or is set
+programmatically with `install()` in tests and the chaos harness:
+
+    from hetu_tpu import chaos
+    plan = chaos.get_plan()          # None unless a schedule is active
+    chaos.install(chaos.FaultPlan([...], seed=0))
+    chaos.reset()                    # back to flag-resolved / off
+
+With no plan installed and HETU_TPU_CHAOS unset, `get_plan()` is a single
+attribute read returning None — the rpc wire layer and heartbeat loop pay
+nothing.  See docs/fault_tolerance.md for the schedule format and
+hetu_tpu/chaos/harness.py for the replayable demo run.
+"""
+from __future__ import annotations
+
+import threading
+from typing import Optional
+
+from hetu_tpu.chaos.inject import (corrupt_latest,  # noqa: F401
+                                   corrupt_step, newest_step)
+from hetu_tpu.chaos.plan import (CORRUPT_MODES, KINDS,  # noqa: F401
+                                 FaultPlan, FaultSpec)
+
+_lock = threading.Lock()
+_plan: Optional[FaultPlan] = None
+_resolved = False
+
+
+def get_plan() -> Optional[FaultPlan]:
+    """The active FaultPlan, or None (chaos off — the identity path).
+    Resolves HETU_TPU_CHAOS once per process; `install()`/`reset()`
+    override."""
+    global _plan, _resolved
+    if _plan is not None or _resolved:
+        return _plan
+    with _lock:
+        if _resolved or _plan is not None:
+            return _plan
+        from hetu_tpu.utils import flags
+        path = flags.str_flag("HETU_TPU_CHAOS")
+        if path:
+            _plan = FaultPlan.load(path)
+        _resolved = True
+    return _plan
+
+
+def install(plan: FaultPlan):
+    """Activate a plan for this process (tests / the chaos harness)."""
+    global _plan, _resolved
+    with _lock:
+        _plan = plan
+        _resolved = True
+
+
+def reset():
+    """Deactivate chaos; the next get_plan() re-reads HETU_TPU_CHAOS."""
+    global _plan, _resolved
+    with _lock:
+        _plan = None
+        _resolved = False
+
+
+__all__ = ["FaultPlan", "FaultSpec", "KINDS", "CORRUPT_MODES",
+           "get_plan", "install", "reset",
+           "corrupt_step", "corrupt_latest", "newest_step"]

@@ -1,0 +1,312 @@
+"""Replayable chaos demo: a miniature multi-worker elastic run under a
+seeded FaultPlan, with full recovery accounting.
+
+Drives the REAL control plane — CoordinationServer/Client (reconnecting
+wire layer), ElasticController (re-plan/rebuild/resume), CheckpointManager
+(manifests + verified fallback) — around a deliberately model-free
+StubTrainer, so a whole kill/partition/corrupt scenario runs in seconds
+on CPU with no jax compile.  Used by tests/test_chaos.py (the acceptance
+test) and tools_chaos.py (the replay CLI).
+
+The StubTrainer's "model" is a counter pytree checkpointed through orbax:
+real bytes on disk, real manifests, real fallback — only the math is fake.
+"""
+from __future__ import annotations
+
+import os
+import threading
+import time
+from typing import Any, Dict, List, Optional
+
+from hetu_tpu import chaos
+from hetu_tpu.chaos.inject import corrupt_step, newest_step
+from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
+from hetu_tpu.obs.metrics import get_registry
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("chaos.harness")
+
+#: counters the recovery report reconciles (summed across label sets)
+_REPORT_COUNTERS = (
+    "chaos.injected_rpc_drop", "chaos.injected_rpc_delay",
+    "chaos.injected_rpc_dup", "chaos.injected_heartbeat_stall",
+    "chaos.injected_worker_kill", "chaos.injected_ckpt_corrupt",
+    "rpc.disconnects", "rpc.reconnects", "rpc.reattaches",
+    "rpc.heartbeat_lost", "rpc.workers_lost",
+    "ckpt.fallbacks", "ckpt.quarantined", "ckpt.manifests_written",
+    "elastic.replans", "elastic.step_failures", "elastic.emergency_saves",
+    "elastic.recovery_attempts", "elastic.recovery_success",
+    "elastic.restore_failures", "elastic.save_failures",
+)
+
+
+def _counter_totals(reg) -> Dict[str, float]:
+    snap = reg.snapshot()
+    out = {name: 0.0 for name in _REPORT_COUNTERS}
+    for rec in snap["counters"]:
+        if rec["name"] in out:
+            out[rec["name"]] += rec["value"]
+    return out
+
+
+class StubTrainer:
+    """Checkpoint-real, model-free trainer the ElasticController drives."""
+
+    def __init__(self, ckpt_dir: Optional[str], plan: Dict):
+        import numpy as np
+        self.global_step = 0
+        self._v = np.zeros(4, np.float64)
+        self.plan = plan
+        self.run_log = None
+        self._ckpt = None
+        if ckpt_dir:
+            from hetu_tpu.utils.checkpoint import CheckpointManager
+            self._ckpt = CheckpointManager(ckpt_dir, max_to_keep=8,
+                                           async_save=False)
+
+    def train_step(self, batch) -> Dict[str, float]:
+        self._v = self._v + 1.0
+        self.global_step += 1
+        return {"loss": 1.0 / (1.0 + self.global_step)}
+
+    def save(self, wait: bool = False):
+        assert self._ckpt is not None
+        self._ckpt.save(self.global_step,
+                        {"v": self._v, "step": self.global_step}, wait=True)
+
+    def _target(self):
+        # a fresh CheckpointManager (each generation builds one) can only
+        # restore against an explicit target template
+        import numpy as np
+        return {"v": np.zeros_like(self._v), "step": 0}
+
+    def restore(self, step: Optional[int] = None):
+        import numpy as np
+        assert self._ckpt is not None
+        restored = self._ckpt.restore(step, target=self._target())
+        self._v = np.asarray(restored["v"])
+        self.global_step = int(restored["step"])
+        return self
+
+    def restore_latest_valid(self):
+        import numpy as np
+        assert self._ckpt is not None
+        _step, restored = self._ckpt.restore_latest_valid(
+            target=self._target())
+        self._v = np.asarray(restored["v"])
+        self.global_step = int(restored["step"])
+        return self
+
+    def close(self):
+        if self._ckpt is not None:
+            self._ckpt.close()
+
+
+class _Killed(Exception):
+    """Raised by a worker's batch stream when its worker_kill fires."""
+
+
+def _run_worker(idx: int, port: int, plan: FaultPlan, ckpt_dir: str,
+                num_steps: int, pace: float, expected_world: int,
+                results: Dict[int, Dict[str, Any]],
+                ckpt_every: int, recovery_budget: int):
+    """One elastic worker: client + controller + chaos-aware batch
+    stream.  Rank 0 (the leader in these demos) owns the checkpoint dir
+    and applies any scheduled checkpoint corruption before its rebuilds —
+    i.e. between the boundary save and the restore, exactly where a torn
+    write lands in production."""
+    from hetu_tpu.engine.elastic import ElasticController
+    from hetu_tpu.rpc.client import CoordinationClient
+
+    rec: Dict[str, Any] = {"rank": None, "generations": [],
+                           "resumed_steps": [], "final_step": None,
+                           "killed": False, "error": None}
+    results[idx] = rec
+    client = None
+    try:
+        client = CoordinationClient("127.0.0.1", port,
+                                    heartbeat_interval=0.1,
+                                    op_timeout=10.0,
+                                    max_reconnect_wait=20.0,
+                                    info={"slot": idx})
+        rec["rank"] = client.rank
+
+        def factory(ds_plan):
+            # the initial leader (rank 0) owns the shared checkpoint dir,
+            # matching the reference's rank-0 saves
+            return StubTrainer(ckpt_dir if client.rank == 0 else None,
+                               ds_plan)
+
+        def planner(alive: List[int]) -> Dict:
+            return {"strategy": {"dp": len(alive), "tp": 1, "pp": 1}}
+
+        ctl = ElasticController(client, factory, planner,
+                                expected_world=expected_world,
+                                rendezvous_timeout=60.0,
+                                recovery_budget=recovery_budget)
+
+        orig_rebuild = ctl._rebuild
+
+        def chaotic_rebuild():
+            if client.rank == 0:
+                step = newest_step(ckpt_dir)
+                spec = plan.take_ckpt_corrupt(step)
+                if spec is not None:
+                    path = corrupt_step(ckpt_dir, step, mode=spec.mode,
+                                        seed=plan.seed)
+                    logger.warning(f"chaos: corrupted checkpoint step "
+                                   f"{step} ({spec.mode}) at {path}")
+            orig_rebuild()
+            rec["generations"].append(ctl.generation)
+            rec["resumed_steps"].append(ctl.trainer.global_step)
+
+        ctl._rebuild = chaotic_rebuild
+
+        def _ckpts_on_disk() -> int:
+            try:
+                return sum(1 for n in os.listdir(ckpt_dir) if n.isdigit())
+            except OSError:
+                return 0
+
+        def batches():
+            while True:
+                time.sleep(pace)
+                step = (ctl.trainer.global_step
+                        if ctl.trainer is not None else 0)
+                if plan.should_kill(client.rank, step):
+                    # event-driven death: once scheduled, wait until the
+                    # leader has >= 2 checkpoints on disk before dying, so
+                    # a scheduled corruption of the newest step always
+                    # leaves a prior VALID step to fall back to — the
+                    # scenario's semantics are pinned instead of racing
+                    # wall-clock against save latency
+                    deadline = time.time() + 60.0
+                    while _ckpts_on_disk() < 2 and time.time() < deadline:
+                        time.sleep(0.02)
+                    raise _Killed()
+                yield {"x": 0}
+
+        def cb(trainer, metrics):
+            # the first two steps always checkpoint (fallback material for
+            # the earliest possible kill), then every ckpt_every
+            if trainer._ckpt is not None and \
+                    (trainer.global_step <= 2 or
+                     trainer.global_step % ckpt_every == 0):
+                trainer.save(wait=True)
+
+        trainer = ctl.run(batches(), num_steps, step_callback=cb)
+        rec["final_step"] = trainer.global_step
+        client.exit()
+    except _Killed:
+        rec["killed"] = True
+        # simulate process death: stop beating AND tear the socket; the
+        # server's reattach grace expires with nobody reattaching
+        client._shutdown = True
+        try:
+            client._conn.close()
+        except OSError:
+            pass
+    except Exception as e:   # surfaced in the report, not swallowed
+        rec["error"] = repr(e)
+        logger.error(f"worker slot {idx} failed: {e!r}")
+
+
+def run_chaos_demo(workdir: str, plan: FaultPlan, num_steps: int = 36,
+                   workers: int = 2, pace: float = 0.04,
+                   ckpt_every: int = 4, heartbeat_timeout: float = 0.6,
+                   recovery_budget: int = 2) -> Dict[str, Any]:
+    # defaults are tuned so a mid-run kill is DETECTED mid-run: loss
+    # detection costs ~heartbeat_timeout+sweep, which at `pace` must land
+    # well before the survivor finishes its num_steps
+    """Run the demo elastic cluster under `plan`; returns the recovery
+    report (per-worker outcomes, injected-fault summary, counter deltas,
+    re-mesh latency percentiles).  Installs the plan process-globally for
+    the duration of the run."""
+    from hetu_tpu.rpc.server import CoordinationServer
+
+    reg = get_registry()
+    before = _counter_totals(reg)
+    replan_before = reg.histogram("elastic.replan_s")
+    replan_count0 = replan_before.count if replan_before else 0
+
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    server = CoordinationServer(world_size=workers,
+                                heartbeat_timeout=heartbeat_timeout)
+    chaos.install(plan)
+    results: Dict[int, Dict[str, Any]] = {}
+    threads = []
+    t0 = time.perf_counter()
+    try:
+        for idx in range(workers):
+            t = threading.Thread(
+                target=_run_worker,
+                args=(idx, server.port, plan, ckpt_dir, num_steps, pace,
+                      workers, results, ckpt_every, recovery_budget),
+                daemon=True)
+            t.start()
+            threads.append(t)
+        for t in threads:
+            t.join(timeout=120.0)
+        wall_s = time.perf_counter() - t0
+    finally:
+        chaos.reset()
+        server.close()
+
+    after = _counter_totals(reg)
+    deltas = {k: after[k] - before[k] for k in _REPORT_COUNTERS
+              if after[k] != before[k]}
+    replan_h = reg.histogram("elastic.replan_s")
+    replan = None
+    if replan_h is not None and replan_h.count > replan_count0:
+        replan = {"count": replan_h.count - replan_count0,
+                  "p50_s": replan_h.percentile(50),
+                  "p95_s": replan_h.percentile(95),
+                  "max_s": replan_h.vmax}
+    return {
+        "wall_s": round(wall_s, 3),
+        "num_steps": num_steps,
+        "workers": {i: results.get(i) for i in range(workers)},
+        "injected": plan.summary(),
+        "metrics": deltas,
+        "replan_s": replan,
+        "completed": all(
+            r and (r["final_step"] is not None and
+                   r["final_step"] >= num_steps or r["killed"])
+            for r in results.values()),
+    }
+
+
+# ------------------------------------------------------------ schedules
+def named_plan(name: str, **kw) -> FaultPlan:
+    """Built-in schedules for the replay CLI and the acceptance test."""
+    if name == "kill-partition-corrupt":
+        # the acceptance scenario: one worker dies mid-run, the leader's
+        # control-plane link drops a window of heartbeats, and the newest
+        # checkpoint is corrupted before the post-kill restore
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="worker_kill", rank=1, at_step=4),
+            FaultSpec(kind="rpc_drop", op="heartbeat", rank=0,
+                      after_calls=6, count=2),
+            FaultSpec(kind="ckpt_corrupt", at_step=1, mode="flip"),
+        ])
+    if name == "partition":
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="rpc_drop", op="heartbeat", rank=0,
+                      after_calls=5, count=4),
+        ])
+    if name == "corrupt":
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="worker_kill", rank=1, at_step=5),
+            FaultSpec(kind="ckpt_corrupt", at_step=1,
+                      mode=kw.get("mode", "truncate")),
+        ])
+    if name == "stall":
+        # a heartbeat stall longer than the server timeout: the classic
+        # long-XLA-compile false positive — the stalled worker is declared
+        # dead and must NOT resurrect into the old mesh
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="heartbeat_stall", rank=1, at_beat=8,
+                      stall_s=kw.get("stall_s", 2.5)),
+        ])
+    raise ValueError(f"unknown schedule {name!r}; known: "
+                     "kill-partition-corrupt, partition, corrupt, stall")

@@ -1,0 +1,82 @@
+"""Checkpoint-corruption injection (the `ckpt_corrupt` fault kind).
+
+Deterministic byte-level damage to an on-disk checkpoint step, used by the
+chaos harness and tests to prove `restore_latest_valid()` walks back to
+the newest checkpoint whose manifest verifies instead of crashing the
+surviving cluster.
+
+Target selection is seeded and size-biased: the largest file under the
+step directory is the tensor data (where a torn write actually lands);
+ties break lexicographically so the choice is stable across runs.
+"""
+from __future__ import annotations
+
+import os
+from typing import List, Optional, Tuple
+
+
+def _step_files(step_dir: str) -> List[Tuple[str, int]]:
+    out = []
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in sorted(files):
+            p = os.path.join(root, fn)
+            out.append((p, os.path.getsize(p)))
+    return sorted(out, key=lambda t: (-t[1], t[0]))
+
+
+def corrupt_step(directory: str, step: int, mode: str = "flip",
+                 seed: int = 0) -> str:
+    """Damage checkpoint `step` under `directory`; returns the path hit.
+
+    flip      XOR eight seeded byte positions (silent bit rot)
+    truncate  cut the file to half length (a torn write / full disk)
+    delete    remove the file entirely (a lost object / partial upload)
+    """
+    import random
+    step_dir = os.path.join(directory, str(step))
+    files = _step_files(step_dir)
+    if not files:
+        raise FileNotFoundError(f"no files under checkpoint step {step_dir}")
+    path, size = files[0]
+    rng = random.Random(seed)
+    if mode == "flip":
+        with open(path, "r+b") as f:
+            for _ in range(8):
+                pos = rng.randrange(max(size, 1))
+                f.seek(pos)
+                b = f.read(1)
+                if not b:
+                    continue
+                f.seek(pos)
+                f.write(bytes([b[0] ^ 0xFF]))
+    elif mode == "truncate":
+        with open(path, "r+b") as f:
+            f.truncate(size // 2)
+    elif mode == "delete":
+        os.remove(path)
+    else:
+        raise ValueError(f"unknown corruption mode {mode!r}")
+    return path
+
+
+def newest_step(directory: str) -> Optional[int]:
+    """Newest step number in a checkpoint root by directory name (pure
+    filesystem scan — works without an open CheckpointManager)."""
+    steps = []
+    if not os.path.isdir(directory):
+        return None
+    for name in os.listdir(directory):
+        if name.isdigit() and os.path.isdir(os.path.join(directory, name)):
+            steps.append(int(name))
+    return max(steps) if steps else None
+
+
+def corrupt_latest(directory: str, mode: str = "flip",
+                   seed: int = 0) -> Optional[int]:
+    """Corrupt the newest checkpoint step; returns its number (None when
+    the root holds no checkpoints yet)."""
+    step = newest_step(directory)
+    if step is None:
+        return None
+    corrupt_step(directory, step, mode=mode, seed=seed)
+    return step

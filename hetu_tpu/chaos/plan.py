@@ -1,0 +1,232 @@
+"""Deterministic fault-injection schedules.
+
+The chaos subsystem makes the elastic recovery paths *provokable*: a
+`FaultPlan` is a seeded, replayable schedule of faults the runtime layers
+consult at well-defined injection points —
+
+    rpc_drop / rpc_delay / rpc_dup   the client's wire layer (one
+                                     request/response exchange) — a lost,
+                                     slow, or duplicated message
+    heartbeat_stall                  the client heartbeat loop — mimics a
+                                     long GIL-pinned XLA compile that
+                                     starves the beat thread
+    worker_kill                      the chaos harness — a worker dies at
+                                     a given training step
+    ckpt_corrupt                     the chaos harness — flip/truncate
+                                     bytes in the newest checkpoint before
+                                     a restore
+
+Everything is deterministic given the plan: trigger windows are counted in
+*matching calls* (not wall time), and probabilistic faults draw from one
+`random.Random(seed)` stream, so the same plan against the same run
+injects the same faults.  Every injection increments a
+`chaos.injected_<kind>` counter in the metrics registry, which is what the
+acceptance tests reconcile against the `elastic.recovery_*` /
+`ckpt.fallbacks` / `rpc.reconnects` accounting on the observation side.
+
+Plans load from JSON (the `HETU_TPU_CHAOS=<schedule.json>` flag — see
+docs/fault_tolerance.md) or are built programmatically in tests.  This
+module is stdlib-only: importing it from the rpc hot path costs nothing.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import random
+import threading
+from typing import Any, Dict, List, Optional
+
+KINDS = ("rpc_drop", "rpc_delay", "rpc_dup",
+         "heartbeat_stall", "worker_kill", "ckpt_corrupt")
+_WIRE_KINDS = ("rpc_drop", "rpc_delay", "rpc_dup")
+CORRUPT_MODES = ("flip", "truncate", "delete")
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    """One scheduled fault.  Schedule fields (set by the plan author):
+
+    kind         one of KINDS
+    op           rpc op pattern for rpc_* kinds ("*" = any op)
+    rank         restrict to one client rank (None = any rank)
+    after_calls  skip this many matching calls before firing (rpc_* /
+                 heartbeat_stall: matching beats via at_beat instead)
+    count        fire on this many consecutive matching calls (a window —
+                 count > 1 models a partition that eats several messages)
+    prob         per-match firing probability (drawn from the plan's
+                 seeded stream — deterministic)
+    delay_s      rpc_delay: added latency per fired call
+    at_step      worker_kill / ckpt_corrupt: trigger once the observed
+                 training step reaches this value
+    at_beat      heartbeat_stall: fire at this beat index
+    stall_s      heartbeat_stall: how long the beat thread freezes
+    mode         ckpt_corrupt: flip | truncate | delete
+
+    Runtime bookkeeping (never set by the author): seen, injected, done.
+    """
+    kind: str
+    op: str = "*"
+    rank: Optional[int] = None
+    after_calls: int = 0
+    count: int = 1
+    prob: float = 1.0
+    delay_s: float = 0.0
+    at_step: Optional[int] = None
+    at_beat: Optional[int] = None
+    stall_s: float = 0.0
+    mode: str = "flip"
+    seen: int = 0
+    injected: int = 0
+    done: bool = False
+
+    def __post_init__(self):
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r}; "
+                             f"known: {KINDS}")
+        if self.kind == "ckpt_corrupt" and self.mode not in CORRUPT_MODES:
+            raise ValueError(f"unknown ckpt_corrupt mode {self.mode!r}; "
+                             f"known: {CORRUPT_MODES}")
+        if not (0.0 <= self.prob <= 1.0):
+            raise ValueError(f"prob must be in [0, 1], got {self.prob}")
+
+
+def _reg():
+    from hetu_tpu.obs.metrics import get_registry
+    return get_registry()
+
+
+class FaultPlan:
+    """A seeded schedule of FaultSpecs with thread-safe trigger state."""
+
+    _SCHEDULE_FIELDS = ("kind", "op", "rank", "after_calls", "count",
+                        "prob", "delay_s", "at_step", "at_beat",
+                        "stall_s", "mode")
+
+    def __init__(self, faults: List[FaultSpec], seed: int = 0):
+        self.seed = int(seed)
+        self.faults = list(faults)
+        self._rng = random.Random(self.seed)
+        self._lock = threading.Lock()
+
+    # ------------------------------------------------------------ loading
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FaultPlan":
+        faults = []
+        for f in d.get("faults", []):
+            unknown = set(f) - set(cls._SCHEDULE_FIELDS)
+            if unknown:
+                raise ValueError(
+                    f"unknown fault fields {sorted(unknown)} in {f!r}; "
+                    f"known: {cls._SCHEDULE_FIELDS}")
+            faults.append(FaultSpec(**f))
+        return cls(faults, seed=int(d.get("seed", 0)))
+
+    @classmethod
+    def load(cls, path: str) -> "FaultPlan":
+        with open(path) as f:
+            return cls.from_dict(json.load(f))
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"seed": self.seed, "faults": [
+            {k: getattr(s, k) for k in self._SCHEDULE_FIELDS}
+            for s in self.faults]}
+
+    # --------------------------------------------------------- injection
+    def _rank_matches(self, spec: FaultSpec, rank: Optional[int]) -> bool:
+        if spec.rank is None:
+            return True
+        return rank is not None and rank == spec.rank
+
+    def wire_fault(self, op: str, rank: Optional[int]) -> Optional[FaultSpec]:
+        """Consulted by the rpc client once per request/response exchange.
+        Advances the matching-call counter of EVERY matching rpc_* spec
+        (order-independent bookkeeping) and returns the first spec whose
+        window covers this call; None = deliver the message untouched."""
+        fired = None
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind not in _WIRE_KINDS:
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                if spec.op != "*" and spec.op != op:
+                    continue
+                idx = spec.seen
+                spec.seen += 1
+                if idx < spec.after_calls or \
+                        idx >= spec.after_calls + spec.count:
+                    continue
+                if spec.prob < 1.0 and self._rng.random() >= spec.prob:
+                    continue
+                if fired is None:
+                    spec.injected += 1
+                    fired = spec
+        if fired is not None:
+            _reg().inc(f"chaos.injected_{fired.kind}", op=op)
+        return fired
+
+    def heartbeat_stall(self, beat: int, rank: Optional[int]) -> float:
+        """Seconds the heartbeat loop should freeze before this beat
+        (0.0 = no stall).  Mimics a long XLA compile pinning the GIL."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "heartbeat_stall":
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                start = spec.at_beat if spec.at_beat is not None else 0
+                if start <= beat < start + spec.count and spec.stall_s > 0:
+                    spec.injected += 1
+                    stall = spec.stall_s
+                    break
+            else:
+                return 0.0
+        _reg().inc("chaos.injected_heartbeat_stall")
+        return stall
+
+    def should_kill(self, rank: Optional[int], step: int) -> bool:
+        """One-shot: True when a worker_kill spec for this rank has its
+        at_step reached (the harness then kills/zombifies the worker)."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "worker_kill" or spec.done:
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                if step >= (spec.at_step or 0):
+                    spec.done = True
+                    spec.injected += 1
+                    break
+            else:
+                return False
+        _reg().inc("chaos.injected_worker_kill")
+        return True
+
+    def take_ckpt_corrupt(self,
+                          newest_step: Optional[int]) -> Optional[FaultSpec]:
+        """One-shot: the spec to apply when the newest on-disk checkpoint
+        step has reached at_step (the harness then corrupts that step)."""
+        if newest_step is None:
+            return None
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "ckpt_corrupt" or spec.done:
+                    continue
+                if newest_step >= (spec.at_step or 0):
+                    spec.done = True
+                    spec.injected += 1
+                    break
+            else:
+                return None
+        _reg().inc("chaos.injected_ckpt_corrupt")
+        return spec
+
+    # ------------------------------------------------------------ report
+    def summary(self) -> Dict[str, int]:
+        """Injected-fault counts by kind (kinds present in the plan appear
+        even at zero — a schedule that never fired is a signal too)."""
+        with self._lock:
+            out: Dict[str, int] = {}
+            for spec in self.faults:
+                out[spec.kind] = out.get(spec.kind, 0) + spec.injected
+            return out

@@ -20,7 +20,8 @@ import time
 from typing import Callable, Dict, Optional
 
 from hetu_tpu.obs.metrics import get_registry
-from hetu_tpu.rpc.client import CoordinationClient, VoteDisagreement
+from hetu_tpu.rpc.client import (CoordinationClient, StaleRankError,
+                                 VoteDisagreement)
 from hetu_tpu.utils.logging import get_logger
 
 logger = get_logger("elastic")
@@ -32,13 +33,19 @@ class ElasticController:
     trainer_factory(ds_config: dict) -> built Trainer (checkpoint-configured);
     planner_fn(alive: list[int]) -> ds-parallel config dict for the
     surviving membership (e.g. AmpelosPlanner with measured speeds).
+
+    recovery_budget: how many train_step exceptions may trigger a
+    re-mesh-and-resume recovery before the exception surfaces (0 =
+    emergency-checkpoint then re-raise — the conservative default: a
+    deterministic model bug would otherwise re-mesh in a loop forever).
     """
 
     def __init__(self, client: CoordinationClient,
                  trainer_factory: Callable[[Dict], object],
                  planner_fn: Callable[[list], Dict],
                  expected_world: Optional[int] = None,
-                 rendezvous_timeout: float = 300.0):
+                 rendezvous_timeout: float = 300.0,
+                 recovery_budget: int = 0):
         # checkpoint cadence belongs to TrainingConfig.ckpt_every; the
         # controller only saves at stop/exit boundaries
         self.client = client
@@ -46,9 +53,11 @@ class ElasticController:
         self.planner_fn = planner_fn
         self.expected_world = expected_world
         self.rendezvous_timeout = rendezvous_timeout
+        self.recovery_budget = recovery_budget
         self.generation = 0
         self.trainer = None
         self._consumed_epoch = 0   # newest plan round this worker took
+        self._recoveries_used = 0
 
     def _startup_rendezvous(self):
         """Wait for the full expected membership before the FIRST plan —
@@ -104,10 +113,7 @@ class ElasticController:
                 # would be rejected anyway, and broadcasting re-mesh
                 # requests from a dead-marked rank would thrash the
                 # survivors with needless checkpoint+rebuild cycles
-                raise RuntimeError(
-                    f"rank {self.client.rank} was declared dead by the "
-                    "coordination server; reconnect with a fresh client "
-                    "for a new rank (split-brain guard)")
+                raise self._split_brain_error()
             epoch = self._current_epoch()
             if epoch > self._consumed_epoch:
                 members = self.client.get(f"__elastic_members_e{epoch}__",
@@ -126,6 +132,19 @@ class ElasticController:
                         # a round member died mid-vote; a newer round is
                         # coming — keep looping
                         get_registry().inc("elastic.vote_timeouts")
+                        continue
+                    except StaleRankError:
+                        raise   # next membership() read raises the
+                                # split-brain RuntimeError anyway
+                    except ConnectionError:
+                        # partition ate the vote even after the client's
+                        # own same-round retries: survivable — a newer
+                        # round (or this one, re-read) supersedes
+                        get_registry().inc("elastic.vote_transport_errors")
+                        logger.warning(
+                            f"plan vote for epoch {epoch} lost to a "
+                            "transport failure; waiting for a "
+                            "superseding round")
                         continue
                     except VoteDisagreement:
                         # dual-leader race: two workers with divergent
@@ -196,12 +215,30 @@ class ElasticController:
             self.trainer.build()   # accept unbuilt trainers from the factory
         if getattr(self.trainer, "_ckpt", None) is not None:
             try:
-                self.trainer.restore()
+                # verified fallback: walk back past corrupt/torn saves to
+                # the newest checkpoint that actually restores (trainers
+                # without the method keep the plain restore)
+                if hasattr(self.trainer, "restore_latest_valid"):
+                    self.trainer.restore_latest_valid()
+                else:
+                    self.trainer.restore()
                 logger.info(f"[gen {self.generation}] resumed at step "
                             f"{self.trainer.global_step}")
             except FileNotFoundError:
                 logger.info(f"[gen {self.generation}] fresh start "
                             "(no checkpoint yet)")
+            except Exception as e:
+                # checkpoints exist but NONE restored
+                # (CheckpointCorruptError, or any restore blow-up from a
+                # fallback-less trainer): surviving with fresh state beats
+                # crashing the whole surviving cluster — but loudly, and
+                # accounted, because saved progress was lost
+                reg.inc("elastic.restore_failures")
+                logger.error(
+                    f"[gen {self.generation}] no valid checkpoint "
+                    f"({e!r}); FRESH START — saved progress was "
+                    "unrecoverable")
+                self._log_fault("restore_unrecoverable", error=repr(e))
         else:
             logger.info(f"[gen {self.generation}] no ckpt_dir configured — "
                         "state will NOT survive re-meshing")
@@ -217,31 +254,136 @@ class ElasticController:
         self.generation += 1
 
     # ------------------------------------------------------------------
+    def _split_brain_error(self) -> RuntimeError:
+        return RuntimeError(
+            f"rank {self.client.rank} was declared dead by the "
+            "coordination server; reconnect with a fresh client "
+            "for a new rank (split-brain guard)")
+
+    def _emergency_save(self) -> bool:
+        """Best-effort synchronous checkpoint on a failure path: bank the
+        local state so surfacing the failure loses at most one step, not a
+        checkpoint interval.  Never raises; accounted either way."""
+        if getattr(self.trainer, "_ckpt", None) is None:
+            return False
+        reg = get_registry()
+        try:
+            self.trainer.save(wait=True)
+            reg.inc("elastic.emergency_saves")
+            return True
+        except Exception as se:
+            reg.inc("elastic.emergency_save_failures")
+            logger.error(f"emergency checkpoint failed: {se!r}")
+            return False
+
+    def _log_fault(self, kind: str, **fields):
+        """Record an observed fault as a RunLog `fault` event (the chaos
+        accounting surface; a trainer without a run log records nothing)."""
+        run_log = getattr(self.trainer, "run_log", None)
+        if run_log is not None:
+            run_log.log("fault", fault=kind, generation=self.generation,
+                        **fields)
+
+    def _confirm_stop(self) -> bool:
+        """Fresh-heartbeat confirmation of a cached stop flag.  If the
+        control plane is unreachable the cached flag counts as real:
+        re-meshing spuriously is safe; ignoring a true stop wedges the
+        cluster."""
+        try:
+            return self.client.check_stop()
+        except StaleRankError:
+            # terminal, not transient: the rank is dead server-side —
+            # take the same path as the run-loop stale check (bank state,
+            # surface the split-brain error) instead of re-meshing into
+            # a membership read that re-raises this anyway
+            self._emergency_save()
+            raise self._split_brain_error()
+        except (ConnectionError, OSError):
+            get_registry().inc("elastic.stop_unconfirmed")
+            logger.warning("stop flag set but the control plane is "
+                           "unreachable; treating it as real")
+            return True
+
+    def _on_step_failure(self, exc: BaseException):
+        """A train_step raised.  Always: emergency checkpoint (a crash now
+        loses at most this one step, not a checkpoint interval) + fault
+        accounting.  Within recovery_budget: trigger a cluster re-mesh and
+        resume from the newest valid checkpoint; past it: re-raise."""
+        reg = get_registry()
+        reg.inc("elastic.step_failures")
+        step = getattr(self.trainer, "global_step", -1)
+        logger.error(f"train_step raised at step {step}: {exc!r}")
+        self._log_fault("step_exception", step=step, error=repr(exc))
+        self._emergency_save()
+        if self._recoveries_used >= self.recovery_budget:
+            raise exc
+        self._recoveries_used += 1
+        reg.inc("elastic.recovery_attempts")
+        logger.warning(f"attempting re-mesh recovery "
+                       f"({self._recoveries_used}/{self.recovery_budget})")
+        try:
+            self.client.worker_stop()   # the whole cluster re-meshes
+            self.client.check_stop()
+            self._rebuild()
+        except Exception as re_exc:
+            logger.error(f"re-mesh recovery failed: {re_exc!r}")
+            raise exc from re_exc
+        reg.inc("elastic.recovery_success")
+
     def run(self, batches, num_steps: int,
             step_callback: Optional[Callable] = None) -> object:
         """The elastic loop (reference: workers re-entering Trainer after
         WorkerStop).  Returns the final trainer.
         step_callback(trainer, metrics): per-step hook (loss-curve
         logging in the elastic demos/tests)."""
+        reg = get_registry()
         self._startup_rendezvous()
         self._rebuild()
         it = iter(batches)
         steps_done = self.trainer.global_step
         while steps_done < num_steps:
+            if self.client.stale:
+                # the heartbeat thread learned this rank was declared
+                # dead (reattach rejected): no op on this client can ever
+                # succeed again — surface instead of spinning, but first
+                # bank the local state (same guarantee as step failures:
+                # losing the rank must not also lose a checkpoint
+                # interval of completed steps)
+                self._emergency_save()
+                raise self._split_brain_error()
+            # transport turbulence is observable, not silent: the gauge
+            # flips while the client reconnects / the beat thread retries
+            reg.set_gauge("elastic.client_disconnected",
+                          1.0 if (self.client.disconnected or
+                                  self.client.heartbeat_lost) else 0.0)
             # confirm via a fresh heartbeat — the cached flag can be stale
             # for one beat around resume()
-            if self.client.should_stop and self.client.check_stop():
+            if self.client.should_stop and self._confirm_stop():
                 logger.warning("membership change signaled; checkpointing "
                                "and re-meshing")
                 if getattr(self.trainer, "_ckpt", None) is not None:
-                    self.trainer.save(wait=True)
+                    try:
+                        self.trainer.save(wait=True)
+                    except Exception as e:
+                        # a failed boundary save must not block the
+                        # re-mesh: the rebuild restores the newest VALID
+                        # checkpoint instead (losing <= one interval)
+                        reg.inc("elastic.save_failures")
+                        logger.error(
+                            f"checkpoint before re-mesh failed: {e!r}")
                 self._rebuild()
+                steps_done = self.trainer.global_step
                 continue
             try:
                 batch = next(it)
             except StopIteration:
                 break
-            metrics = self.trainer.train_step(batch)
+            try:
+                metrics = self.trainer.train_step(batch)
+            except Exception as e:
+                self._on_step_failure(e)
+                steps_done = self.trainer.global_step
+                continue
             if step_callback is not None:
                 step_callback(self.trainer, metrics)
             steps_done = self.trainer.global_step

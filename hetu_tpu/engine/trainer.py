@@ -847,3 +847,24 @@ class Trainer:
         if "scaler" in restored and self._scaler is not None:
             self.scaler_state = restored["scaler"]
         return self
+
+    def restore_latest_valid(self):
+        """Resume from the newest checkpoint whose manifest verifies,
+        walking back past corrupt/torn saves (each skipped step counts
+        `ckpt.fallbacks`; checksum-failed steps are quarantined so they
+        cannot shadow later re-saves).  The walk is the CheckpointManager's
+        (one copy of the fallback logic); each step restores through
+        restore() so the scaler-presence retry and EF residual re-attach
+        apply.  Raises FileNotFoundError when the directory has no
+        checkpoints (fresh start) and CheckpointCorruptError when
+        checkpoints exist but none is restorable."""
+        assert self._ckpt is not None, "no ckpt_dir configured"
+
+        def note_fallback(step, why):
+            if self.run_log is not None:
+                self.run_log.log("fault", fault="ckpt_corrupt",
+                                 step=step, detail=why)
+
+        _step, me = self._ckpt.restore_latest_valid(
+            restore_fn=self.restore, on_fallback=note_fallback)
+        return me

@@ -16,6 +16,9 @@ Kind fields:
     compile       name, plan, compile_s, flops, estimated_mfu
     switch        from_id, to_id, wall_s, moved_bytes, total_bytes
     elastic_epoch epoch, alive, strategy
+    fault         fault (ckpt_corrupt | step_exception |
+                  restore_unrecoverable), generation, detail/error —
+                  observed-fault accounting (docs/fault_tolerance.md)
     summary       metrics (a MetricsRegistry snapshot), profiler summary
 
 The writer is append-only and flushes per record by default: a preempted

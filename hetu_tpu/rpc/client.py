@@ -3,17 +3,51 @@ the C++ DeviceClient with Connect/GetRank/Barrier/KV/HeartBeat; and
 python/hetu/rpc/kv_store/client.py:101 KeyValueStoreClient).
 
 Worker-side API used by distributed_init, the elastic trainer, and the
-Hydraulis-style dynamic dispatch (KV producer/consumer)."""
+Hydraulis-style dynamic dispatch (KV producer/consumer).
+
+Transport hardening (docs/fault_tolerance.md): every exchange carries a
+per-op deadline (the socket timeout); on a torn/hung connection the client
+auto-reconnects with exponential backoff + full jitter and re-attaches its
+rank (`reattach` op — the server keeps the rank alive across a short
+reconnect grace window).  Only idempotent ops are re-issued after a
+reconnect — `connect` (allocates a rank) and `consistent` vote submissions
+(round-versioned; retried by `consistent()` itself, which pins the round)
+are not.  The chaos wire hook (`hetu_tpu.chaos`) injects message
+drop/delay/duplicate faults here; with no plan installed it is identity.
+"""
 from __future__ import annotations
 
-import json
+import random
 import socket
-import struct
 import threading
 import time
 from typing import Any, Dict, Optional
 
+from hetu_tpu import chaos
 from hetu_tpu.rpc.server import _recv, _send
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("rpc.client")
+
+#: ops safe to re-issue after a transparent reconnect: reads, last-write-
+#: wins writes, and set-insert style membership ops.  `barrier` qualifies
+#: only because barrier() pins every enter to its round via gen_expect —
+#: the re-sent payload carries the pin, so a retry spanning a release
+#: reads the release instead of leaking into the next round.  NOT here:
+#: `connect` (allocates a fresh rank per call), `reattach` (issued by the
+#: reconnect path itself), `consistent` (vote rounds are
+#: client-versioned; blind transport retry could double-submit across
+#: rounds — consistent() retries internally with the round pinned),
+#: `ps_push` (add/sgd modes accumulate — double-apply corrupts the
+#: table).
+_RETRYABLE_OPS = frozenset({
+    "heartbeat", "get", "put", "membership", "barrier", "barrier_poll",
+    "worker_stop", "resume", "ps_init", "ps_pull", "exit"})
+
+#: re-issue budget per op after reconnects (each retry means the transport
+#: was re-established in between; a chaos partition window of N dropped
+#: messages needs N retries to drain)
+_MAX_OP_RETRIES = 8
 
 
 class VoteDisagreement(RuntimeError):
@@ -24,42 +58,221 @@ class VoteDisagreement(RuntimeError):
     bare RuntimeError, or they misclassify transport/server errors."""
 
 
+class StaleRankError(ConnectionError):
+    """Reconnect succeeded at the TCP level but the server refused to
+    re-attach this rank: it was declared dead (split-brain guard).  The
+    only way forward is a fresh CoordinationClient (new rank) — retrying
+    with this one can never work, so this is terminal, not transient."""
+
+
 class CoordinationClient:
     def __init__(self, host: str, port: int, info: Optional[Dict] = None,
-                 heartbeat_interval: float = 2.0, auto_heartbeat: bool = True):
+                 heartbeat_interval: float = 2.0, auto_heartbeat: bool = True,
+                 op_timeout: float = 30.0, reconnect: bool = True,
+                 max_reconnect_wait: float = 60.0):
         self._addr = (host, port)
         self._lock = threading.Lock()
-        self._conn = socket.create_connection(self._addr, timeout=30)
-        resp = self._call({"op": "connect", "info": info or {}})
+        self._info = info or {}
+        self._op_timeout = op_timeout
+        self._reconnect_enabled = reconnect
+        self._max_reconnect_wait = max_reconnect_wait
+        self._rng = random.Random()     # backoff jitter only
+        self._shutdown = False
+        self._conn_gen = 0
+        self.rank: Optional[int] = None
+        #: observable transport state (the elastic controller reads these
+        #: instead of discovering a silently dead heartbeat thread):
+        self.disconnected = False       # no live socket right now
+        self.heartbeat_lost = False     # beat thread saw a transport error
+        self.stale = False              # rank declared dead server-side
+        self.reconnects = 0
+        self._conn = self._open_socket()
+        resp = self._call({"op": "connect", "info": self._info})
         self.rank = resp["rank"]
         self.world_size = resp.get("world_size")
         self.should_stop = False
         self._vote_round: Dict[str, int] = {}
         self._hb_interval = heartbeat_interval
-        self._shutdown = False
         if auto_heartbeat:
             self._hb = threading.Thread(target=self._heartbeat_loop,
                                         daemon=True)
             self._hb.start()
 
     # ------------------------------------------------------------------
-    def _call(self, req: Dict[str, Any]) -> Dict[str, Any]:
-        with self._lock:
-            _send(self._conn, req)
-            resp = _recv(self._conn)
+    def _open_socket(self,
+                     connect_timeout: Optional[float] = None
+                     ) -> socket.socket:
+        # connect deadline defaults to the per-op deadline; the reconnect
+        # loop passes its REMAINING budget instead, so a black-hole
+        # partition (SYNs dropped, no RST) cannot pin one attempt — and
+        # the lock — for longer than the caller's whole budget
+        if connect_timeout is None:
+            connect_timeout = self._op_timeout or 30.0
+        conn = socket.create_connection(self._addr, timeout=connect_timeout)
+        # per-op deadline: every send/recv on this socket times out on its
+        # own instead of hanging a caller forever on a wedged server
+        conn.settimeout(self._op_timeout if self._op_timeout else None)
+        return conn
+
+    def _exchange_locked(self, req: Dict[str, Any]) -> Dict[str, Any]:
+        """One request/response on the current socket (caller holds the
+        lock).  The chaos wire hook sits here — identity when no plan."""
+        plan = chaos.get_plan()
+        if plan is not None:
+            spec = plan.wire_fault(req.get("op", ""), self.rank)
+            if spec is not None:
+                if spec.kind == "rpc_delay":
+                    time.sleep(spec.delay_s)
+                elif spec.kind == "rpc_drop":
+                    # the message vanishes: tear the socket so the loss is
+                    # observable NOW (the torn-TCP analog of a dropped
+                    # datagram) instead of hanging out a full deadline
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                    raise ConnectionError(
+                        f"chaos: dropped {req.get('op')!r} in transit")
+                elif spec.kind == "rpc_dup":
+                    # duplicate delivery: the server must handle the same
+                    # request twice (idempotency check); framing stays
+                    # aligned because both responses are consumed here
+                    _send(self._conn, req)
+                    _send(self._conn, req)
+                    if _recv(self._conn) is None:
+                        raise ConnectionError(
+                            "server closed on duplicated request")
+                    resp = _recv(self._conn)
+                    if resp is None:
+                        raise ConnectionError(
+                            "server closed on duplicated request")
+                    return resp
+        _send(self._conn, req)
+        resp = _recv(self._conn)
         if resp is None:
             raise ConnectionError("coordination server closed the connection")
+        return resp
+
+    def _call(self, req: Dict[str, Any],
+              _max_wait: Optional[float] = None) -> Dict[str, Any]:
+        from hetu_tpu.obs.metrics import get_registry
+        op = req.get("op", "")
+        attempts = 0
+        while True:
+            err: Optional[BaseException] = None
+            with self._lock:
+                gen = self._conn_gen
+                try:
+                    resp = self._exchange_locked(req)
+                except (ConnectionError, OSError) as e:   # incl. timeouts
+                    err = e
+            if err is None:
+                break
+            get_registry().inc("rpc.transport_errors", op=op)
+            if self._shutdown or not self._reconnect_enabled or \
+                    self.rank is None:
+                raise err
+            # re-establish the transport regardless of the op — later ops
+            # need a live socket — but only re-ISSUE idempotent ops
+            self._reconnect(gen, err, max_wait=_max_wait)
+            attempts += 1
+            if op not in _RETRYABLE_OPS:
+                raise ConnectionError(
+                    f"rpc op {op!r} failed in transit ({err!r}); not "
+                    "retried (non-idempotent) — connection re-established"
+                ) from err
+            if attempts > _MAX_OP_RETRIES:
+                raise ConnectionError(
+                    f"rpc op {op!r} still failing after "
+                    f"{_MAX_OP_RETRIES} reconnect+retry cycles") from err
+            get_registry().inc("rpc.op_retries", op=op)
         if not resp.get("ok"):
             raise RuntimeError(f"rpc error: {resp.get('error')}")
         return resp
 
+    def _reconnect(self, gen: int, why: BaseException,
+                   max_wait: Optional[float] = None):
+        """Replace a torn connection: exponential backoff + full jitter,
+        then `reattach` so the server keeps this rank alive.  Raises
+        StaleRankError if the server already declared the rank dead, or
+        ConnectionError when the budget (`max_wait`) runs out."""
+        from hetu_tpu.obs.metrics import get_registry
+        budget = self._max_reconnect_wait if max_wait is None else max_wait
+        with self._lock:
+            if self._conn_gen != gen:
+                return   # another thread already re-established transport
+            was_down = self.disconnected
+            self.disconnected = True
+            reg = get_registry()
+            if not was_down:
+                reg.inc("rpc.disconnects")
+                logger.warning(f"connection to {self._addr} lost "
+                               f"({why!r}); reconnecting with backoff")
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+            delay = 0.05
+            deadline = time.monotonic() + budget
+            last: BaseException = why
+            while not self._shutdown:
+                try:
+                    conn = self._open_socket(connect_timeout=max(
+                        0.05, min(self._op_timeout or 5.0,
+                                  deadline - time.monotonic())))
+                    _send(conn, {"op": "reattach", "rank": self.rank,
+                                 "info": self._info})
+                    resp = _recv(conn)
+                    if resp is None:
+                        raise ConnectionError("server closed during reattach")
+                    if not resp.get("ok"):
+                        raise ConnectionError(
+                            f"reattach error: {resp.get('error')}")
+                    if not resp.get("accepted", False):
+                        conn.close()
+                        self.stale = True
+                        raise StaleRankError(
+                            f"reattach rejected: rank {self.rank} was "
+                            "declared dead — a fresh CoordinationClient "
+                            "(new rank) is required")
+                    self._conn = conn
+                    self._conn_gen += 1
+                    self.disconnected = False
+                    self.reconnects += 1
+                    reg.inc("rpc.reconnects")
+                    logger.info(f"reconnected to {self._addr} "
+                                f"(rank {self.rank} reattached)")
+                    return
+                except StaleRankError:
+                    raise
+                except (ConnectionError, OSError) as e:
+                    last = e
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"reconnect to {self._addr} gave up after "
+                        f"{budget:.1f}s: {last!r}") from last
+                time.sleep(delay * (0.5 + self._rng.random()))
+                delay = min(delay * 2.0, 2.0)
+            raise ConnectionError("client shut down during reconnect")
+
     def _heartbeat_loop(self):
         from hetu_tpu.obs.metrics import get_registry
         reg = get_registry()
+        beat = 0
         while not self._shutdown:
+            plan = chaos.get_plan()
+            if plan is not None:
+                stall = plan.heartbeat_stall(beat, self.rank)
+                if stall > 0:
+                    time.sleep(stall)   # a GIL-pinned XLA compile, faked
             try:
                 t0 = time.perf_counter()
-                resp = self._call({"op": "heartbeat", "rank": self.rank})
+                # short per-call reconnect budget: a dead server must not
+                # wedge one beat for minutes — the LOOP is the retry, at
+                # the beat cadence, so long partitions are still survived
+                resp = self._call({"op": "heartbeat", "rank": self.rank},
+                                  _max_wait=min(5.0,
+                                                self._max_reconnect_wait))
                 # heartbeat RTT is the cheapest coordination-health probe
                 # each worker has: a climbing p95 here means the control
                 # plane (not the compute) is the straggler
@@ -67,8 +280,28 @@ class CoordinationClient:
                             time.perf_counter() - t0, rank=self.rank)
                 if resp.get("stop"):
                     self.should_stop = True
-            except (ConnectionError, OSError, RuntimeError):
+                self.heartbeat_lost = False
+            except StaleRankError:
+                # the server declared this rank dead: beating can never
+                # help — flag it (self.stale) so the elastic layer can
+                # surface "reconnect with a fresh client" and stop
+                if not self.heartbeat_lost:
+                    self.heartbeat_lost = True
+                    reg.inc("rpc.heartbeat_lost")
+                logger.warning(
+                    f"heartbeat stopped: rank {self.rank} declared dead "
+                    "by the server (stale rank)")
                 return
+            except (ConnectionError, OSError, RuntimeError) as e:
+                # a broken socket must NEVER silently kill the beat
+                # thread: flag + count, keep beating — _call already
+                # attempted reconnect-with-backoff for this beat
+                if not self.heartbeat_lost:
+                    self.heartbeat_lost = True
+                    reg.inc("rpc.heartbeat_lost")
+                    logger.warning(f"heartbeat failed ({e!r}); transport "
+                                   "flagged, retrying at beat cadence")
+            beat += 1
             time.sleep(self._hb_interval)
 
     # -- KV store (reference: KeyValueStoreClient) ----------------------
@@ -90,8 +323,14 @@ class CoordinationClient:
 
     # -- barrier / consensus -------------------------------------------
     def barrier(self, name: str, count: int, timeout: float = 120.0):
+        # snapshot the round id first, and pin the enter to it
+        # (gen_expect): a transport-retried enter whose round released
+        # while the response was in flight reads the release instead of
+        # silently joining — and poisoning — the NEXT round
+        gen0 = self._call({"op": "barrier_poll", "name": name,
+                           "gen": -1}).get("gen", 0)
         resp = self._call({"op": "barrier", "name": name, "rank": self.rank,
-                           "count": count})
+                           "count": count, "gen_expect": gen0})
         if resp["released"]:
             return
         gen = resp["gen"]
@@ -115,9 +354,22 @@ class CoordinationClient:
         name = f"{name}#{rnd}"
         deadline = time.time() + timeout
         while True:
-            resp = self._call({"op": "consistent", "name": name,
-                               "rank": self.rank, "value": value,
-                               "count": count})
+            try:
+                resp = self._call({"op": "consistent", "name": name,
+                                   "rank": self.rank, "value": value,
+                                   "count": count})
+            except StaleRankError:
+                raise
+            except ConnectionError:
+                # the generic layer won't blindly re-send votes, but HERE
+                # the round identity is pinned: re-submitting the same
+                # (name#round, rank, value) is idempotent server-side (a
+                # dict insert keyed by rank), so retry within the deadline
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"consistent {name!r} timed out (transport)")
+                time.sleep(0.05)
+                continue
             if resp["done"]:
                 if not resp["agreed"]:
                     raise VoteDisagreement(
@@ -186,9 +438,12 @@ class CoordinationClient:
                     "data": encode_rows(rows), "mode": mode, "lr": lr})
 
     def exit(self):
-        try:
+        self._shutdown = True   # before the call: no reconnect spin on a
+        try:                    # dead server during teardown
             self._call({"op": "exit", "rank": self.rank})
-        except (ConnectionError, OSError):
+        except (ConnectionError, OSError, RuntimeError):
             pass
-        self._shutdown = True
-        self._conn.close()
+        try:
+            self._conn.close()
+        except OSError:
+            pass

@@ -58,9 +58,15 @@ class CoordinationServer:
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  world_size: Optional[int] = None,
-                 heartbeat_timeout: float = 10.0):
+                 heartbeat_timeout: float = 10.0,
+                 reattach_grace: Optional[float] = None):
         self.world_size = world_size
         self.heartbeat_timeout = heartbeat_timeout
+        # how long a rank whose connection tore may `reattach` before it
+        # is declared dead (None -> min(heartbeat_timeout, 2s)).  0 =
+        # legacy behavior: any connection loss is instant worker death.
+        self.reattach_grace = (min(heartbeat_timeout, 2.0)
+                               if reattach_grace is None else reattach_grace)
         self._sock = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._sock.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._sock.bind((host, port))
@@ -82,6 +88,7 @@ class CoordinationServer:
         self._ps_lock = threading.Lock()
         self._shutdown = False
         self._threads = []
+        self._conns = []
         self._accept_thread = threading.Thread(target=self._accept_loop,
                                                daemon=True)
         self._accept_thread.start()
@@ -99,13 +106,22 @@ class CoordinationServer:
             t = threading.Thread(target=self._serve_conn, args=(conn,),
                                  daemon=True)
             t.start()
-            self._threads.append(t)
+            # prune finished connection threads (and their sockets) before
+            # tracking the new one: long elastic runs see thousands of
+            # reconnects, and append-only lists grow without bound
+            live = [(x, c) for x, c in zip(self._threads, self._conns)
+                    if x.is_alive()]
+            self._threads = [x for x, _ in live] + [t]
+            self._conns = [c for _, c in live] + [conn]
 
     def _monitor_loop(self):
         """Dead-worker detection (reference: elastic server HeartBeat monitor
         :463 — on loss, mark dead and signal WorkerStop to the others)."""
+        sweep = min(self.heartbeat_timeout / 4,
+                    max(self.reattach_grace / 2, 0.05)
+                    if self.reattach_grace > 0 else float("inf"))
         while not self._shutdown:
-            time.sleep(self.heartbeat_timeout / 4)
+            time.sleep(sweep)
             now = time.time()
             with self._lock:
                 # sweep completed vote rounds whose collectors never returned
@@ -123,22 +139,31 @@ class CoordinationServer:
                         # path leaks one entry per interrupted vote
                         del self._votes[vname]
                 for rank, info in list(self._workers.items()):
-                    if info.get("alive") and \
-                            now - info["last_beat"] > self.heartbeat_timeout:
+                    if not info.get("alive"):
+                        continue
+                    if now - info["last_beat"] > self.heartbeat_timeout:
                         # stop BOTH the dead worker (if it resurrects, it must
                         # not rejoin the old mesh — split-brain guard) and the
                         # survivors so they can re-mesh
                         # (reference: WorkerStop broadcast on worker loss)
                         self._mark_lost_locked(rank, "heartbeat timeout")
+                    elif info.get("conn_lost_at") is not None and \
+                            now - info["conn_lost_at"] > self.reattach_grace:
+                        # its connection tore and no reattach arrived
+                        # within the grace window: that IS process death
+                        self._mark_lost_locked(
+                            rank, "connection lost (reattach grace expired)")
 
     # ------------------------------------------------------------------
     def _serve_conn(self, conn: socket.socket):
-        # each client holds ONE persistent socket, so a broken connection IS
-        # process death — detect it instantly instead of waiting out the
-        # heartbeat timeout (which can false-positive when a worker's GIL is
-        # pinned inside a long XLA compile).  Heartbeats stay as the backstop
-        # for network partitions (reference: gRPC channel-break detection).
-        state = {"rank": None, "clean": False}
+        # each client holds ONE persistent socket, so a broken connection is
+        # STRONG evidence of process death — but reconnecting clients get a
+        # short `reattach_grace` to re-attach their rank before it is
+        # declared dead (far shorter than the heartbeat timeout, which can
+        # false-positive when a worker's GIL is pinned inside a long XLA
+        # compile).  Heartbeats stay as the backstop for network partitions
+        # (reference: gRPC channel-break detection).
+        state = {"rank": None, "clean": False, "gen": 0}
         try:
             with conn:
                 while not self._shutdown:
@@ -162,7 +187,25 @@ class CoordinationServer:
                         return
         finally:
             if state["rank"] is not None and not state["clean"]:
-                self._mark_lost(state["rank"], why="connection lost")
+                self._conn_lost(state["rank"], state["gen"])
+
+    def _conn_lost(self, rank: int, gen: int):
+        """A worker's connection tore without a clean exit.  With a
+        reattach grace window the rank gets that long to come back on a
+        new socket (auto-reconnecting client); without one, this is
+        instant worker death (legacy behavior)."""
+        with self._lock:
+            w = self._workers.get(rank)
+            if w is None or not w.get("alive"):
+                return
+            if w.get("conn_gen", 0) != gen:
+                return   # a newer connection already took over this rank
+            if self.reattach_grace <= 0:
+                self._mark_lost_locked(rank, "connection lost")
+                return
+            w["conn_lost_at"] = time.time()
+            logger.info(f"worker {rank} connection lost; "
+                        f"{self.reattach_grace:.1f}s reattach grace")
 
     def _mark_lost(self, rank: int, why: str):
         with self._lock:
@@ -193,6 +236,7 @@ class CoordinationServer:
         if info is None or not info.get("alive"):
             return
         info["alive"] = False
+        info.pop("conn_lost_at", None)
         reg = get_registry()
         reg.inc("rpc.workers_lost", reason=why)
         reg.set_gauge("rpc.alive_workers", sum(
@@ -216,15 +260,40 @@ class CoordinationServer:
                 self._next_rank += 1
                 self._workers[rank] = {
                     "info": req.get("info", {}), "alive": True,
-                    "last_beat": time.time()}
+                    "last_beat": time.time(), "conn_gen": 0}
                 reg = get_registry()
                 reg.inc("rpc.connects")
                 reg.set_gauge("rpc.alive_workers", sum(
                     1 for w in self._workers.values() if w.get("alive")))
                 if conn_state is not None:
                     conn_state["rank"] = rank
+                    conn_state["gen"] = 0
                 return {"ok": True, "rank": rank,
                         "world_size": self.world_size}
+            if op == "reattach":       # reconnecting client re-claims rank
+                rank = req["rank"]
+                w = self._workers.get(rank)
+                if w is None:
+                    # a RESTARTED server has no membership: accept the
+                    # claimed rank (each client claims only the rank it
+                    # held, so claims are unique) and grow _next_rank past
+                    # it so fresh connects never collide
+                    w = self._workers[rank] = {
+                        "info": req.get("info", {}), "alive": True,
+                        "last_beat": time.time(), "conn_gen": 0}
+                    self._next_rank = max(self._next_rank, rank + 1)
+                if not w.get("alive"):
+                    # declared dead: resurrecting would re-enter the old
+                    # mesh (split-brain) — the client must connect fresh
+                    return {"ok": True, "accepted": False}
+                w["conn_gen"] = w.get("conn_gen", 0) + 1
+                w["last_beat"] = time.time()
+                w.pop("conn_lost_at", None)
+                if conn_state is not None:
+                    conn_state["rank"] = rank
+                    conn_state["gen"] = w["conn_gen"]
+                get_registry().inc("rpc.reattaches")
+                return {"ok": True, "accepted": True}
             if op == "heartbeat":      # HeartBeat
                 rank = req["rank"]
                 stop = rank in self._stop_flags
@@ -256,6 +325,14 @@ class CoordinationServer:
             if op == "barrier":        # Barrier
                 name, rank, count = req["name"], req["rank"], req["count"]
                 gen = self._barrier_gen.setdefault(name, 0)
+                # round pinning makes the enter idempotent: a retried or
+                # duplicated enter whose round already RELEASED must not
+                # leak into the next round's member set (it would release
+                # that round one entrant early and hang this client)
+                expect = req.get("gen_expect")
+                if expect is not None and gen != expect:
+                    return {"ok": True, "released": gen > expect,
+                            "gen": gen}
                 members = self._barriers.setdefault(name, set())
                 members.add(rank)
                 if len(members) >= count:
@@ -265,8 +342,8 @@ class CoordinationServer:
                 return {"ok": True, "released": False, "gen": gen}
             if op == "barrier_poll":
                 name, gen = req["name"], req["gen"]
-                return {"ok": True,
-                        "released": self._barrier_gen.get(name, 0) > gen}
+                cur = self._barrier_gen.get(name, 0)
+                return {"ok": True, "released": cur > gen, "gen": cur}
             if op == "consistent":     # Consistent consensus (:389)
                 name, rank, value, count = (req["name"], req["rank"],
                                             req["value"], req["count"])
@@ -274,13 +351,17 @@ class CoordinationServer:
                     name, {"votes": {}, "result": None, "collected": set(),
                            "done_at": None, "started_at": time.time()})
                 if st["result"] is not None:
-                    # a completed round: hand out the result; clear the round
-                    # once every participant has collected it, so the name is
-                    # reusable for the next vote
+                    # a completed round: hand out the result.  The round
+                    # is NOT deleted eagerly on full collection — if the
+                    # last collector's response is lost in transit, its
+                    # client-side retry must still read the result here
+                    # (deleting would recreate a phantom single-vote
+                    # round that can never complete).  The monitor's
+                    # done_at sweep reclaims it; names are
+                    # client-versioned (name#N) so lingering cannot
+                    # poison a later round.
                     st["collected"].add(rank)
                     agreed, val = st["result"]
-                    if st["collected"] >= set(st["votes"].keys()):
-                        del self._votes[name]
                     return {"ok": True, "done": True, "agreed": agreed,
                             "value": val}
                 st["votes"][rank] = value
@@ -390,3 +471,16 @@ class CoordinationServer:
             self._sock.close()
         except OSError:
             pass
+        # also tear down the serving connections: a closed server must not
+        # keep absorbing (and acking!) writes on old sockets — clients
+        # should see the break and fail over / reconnect
+        for conn in list(self._conns):
+            try:
+                conn.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+            try:
+                conn.close()
+            except OSError:
+                pass
+        self._conns = []

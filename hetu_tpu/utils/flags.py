@@ -79,6 +79,12 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "default context-parallel split pattern "
          "(reference: HETU_PARALLEL_ATTN_SPLIT_PATTERN SYM/STRIPE/NORMAL)",
          choices=("sym", "stripe", "normal")),
+    # -- robustness / chaos (hetu_tpu/chaos, docs/fault_tolerance.md) ----
+    Flag("HETU_TPU_CHAOS", "str", "",
+         "path to a deterministic fault-injection schedule JSON "
+         "(hetu_tpu.chaos.FaultPlan: seeded rpc drop/delay/dup, heartbeat "
+         "stalls, worker kills, checkpoint corruption).  Unset = chaos "
+         "off: the rpc wire layer is identity and nothing else changes"),
     # -- multi-process bootstrap (core/distributed.py) -------------------
     Flag("HETU_TPU_COORDINATOR", "str", "",
          "jax.distributed coordinator address host:port"),

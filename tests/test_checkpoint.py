@@ -2,7 +2,13 @@
 
 """Checkpoint path/backend behavior (reference: python/hetu/utils/checkpoint/
 model_saver.py — local + remote stores; reshard-on-load itself is covered in
-test_trainer.py::test_checkpoint_reshard_on_load and test_hot_switch.py)."""
+test_trainer.py::test_checkpoint_reshard_on_load and test_hot_switch.py) and
+the verified-fallback layer (manifests + restore_latest_valid,
+docs/fault_tolerance.md)."""
+import os
+
+import numpy as np
+import pytest
 
 
 def test_remote_uri_paths_pass_through():
@@ -13,3 +19,151 @@ def test_remote_uri_paths_pass_through():
     assert resolve_ckpt_path("gs://bucket/ckpts") == "gs://bucket/ckpts"
     assert resolve_ckpt_path("hdfs://nn/ckpts") == "hdfs://nn/ckpts"
     assert resolve_ckpt_path("relative/dir").startswith("/")
+
+
+def _mgr(path, **kw):
+    from hetu_tpu.utils.checkpoint import CheckpointManager
+    kw.setdefault("async_save", False)
+    kw.setdefault("max_to_keep", 8)
+    return CheckpointManager(str(path), **kw)
+
+
+def _state(step):
+    return {"v": np.arange(6.) + step, "step": step}
+
+
+def _target():
+    return {"v": np.zeros(6), "step": 0}
+
+
+def test_manifest_written_and_verifies(tmp_path):
+    from hetu_tpu.utils.checkpoint import manifest_path
+    mgr = _mgr(tmp_path)
+    mgr.save(3, _state(3), wait=True)
+    assert os.path.exists(manifest_path(str(tmp_path), 3))
+    ok, why = mgr.verify_step(3)
+    assert ok and why == "verified"
+    step, restored = mgr.restore_latest_valid(target=_target())
+    assert step == 3 and int(restored["step"]) == 3
+    mgr.close()
+
+
+def test_manifest_written_after_async_commit(tmp_path):
+    """Async saves must not get a manifest until the bytes are committed:
+    the manifest lands at the next wait/save/close boundary."""
+    from hetu_tpu.utils.checkpoint import manifest_path
+    mgr = _mgr(tmp_path, async_save=True)
+    mgr.save(1, _state(1))
+    mgr.wait()
+    assert os.path.exists(manifest_path(str(tmp_path), 1))
+    ok, _ = mgr.verify_step(1)
+    assert ok
+    mgr.close()
+
+
+@pytest.mark.parametrize("mode", ["flip", "truncate", "delete"])
+def test_restore_latest_valid_falls_back(tmp_path, mode):
+    """Satellite: corrupt the newest step -> restore_latest_valid returns
+    the prior step, increments ckpt.fallbacks, and quarantines the corrupt
+    step so a later re-save of that step number is not shadowed."""
+    from hetu_tpu import chaos
+    from hetu_tpu.obs.metrics import get_registry
+    reg = get_registry()
+    mgr = _mgr(tmp_path)
+    mgr.save(3, _state(3), wait=True)
+    mgr.save(6, _state(6), wait=True)
+    chaos.corrupt_step(str(tmp_path), 6, mode=mode, seed=0)
+    before = reg.counter_value("ckpt.fallbacks")
+    step, restored = mgr.restore_latest_valid(target=_target())
+    assert step == 3
+    np.testing.assert_allclose(np.asarray(restored["v"]), np.arange(6.) + 3)
+    assert reg.counter_value("ckpt.fallbacks") - before == 1
+    # the corrupt step was quarantined: gone from the step list (so a
+    # re-save of the same number actually writes) but its bytes are
+    # preserved aside for forensics/repair
+    assert mgr.all_steps() == [3]
+    qdir = str(tmp_path) + ".quarantine"
+    assert any(n.startswith("6_") for n in os.listdir(qdir))
+    mgr.save(6, _state(6), wait=True)
+    ok, _ = mgr.verify_step(6)
+    assert ok and mgr.latest_step() == 6
+    mgr.close()
+
+
+def test_all_checkpoints_corrupt_raises_loudly(tmp_path):
+    from hetu_tpu import chaos
+    from hetu_tpu.utils.checkpoint import CheckpointCorruptError
+    mgr = _mgr(tmp_path)
+    mgr.save(2, _state(2), wait=True)
+    mgr.save(4, _state(4), wait=True)
+    chaos.corrupt_step(str(tmp_path), 2, mode="flip", seed=1)
+    chaos.corrupt_step(str(tmp_path), 4, mode="flip", seed=2)
+    with pytest.raises(CheckpointCorruptError):
+        mgr.restore_latest_valid(target=_target())
+    # FileNotFoundError stays distinct: an EMPTY dir is a fresh start
+    with pytest.raises(FileNotFoundError):
+        _mgr(tmp_path / "empty").restore_latest_valid(target=_target())
+    mgr.close()
+
+
+def test_manifestless_step_is_unverified_but_restorable(tmp_path):
+    """Pre-manifest checkpoints (seed-era dirs) must keep restoring: a
+    missing manifest reads as 'unverified', not as corrupt."""
+    from hetu_tpu.utils.checkpoint import manifest_path
+    mgr = _mgr(tmp_path)
+    mgr.save(5, _state(5), wait=True)
+    os.remove(manifest_path(str(tmp_path), 5))
+    ok, why = mgr.verify_step(5)
+    assert ok and "unverified" in why
+    step, _ = mgr.restore_latest_valid(target=_target())
+    assert step == 5
+    mgr.close()
+
+
+def test_unverified_step_with_missing_file_still_falls_back(tmp_path):
+    """Review regression: a manifest-less step (remote store / failed
+    manifest write) that lost a data file — the partial-upload fault —
+    must fall back to the prior step, not surface FileNotFoundError as a
+    bogus 'fresh start'."""
+    import shutil
+
+    from hetu_tpu.utils.checkpoint import manifest_path
+    mgr = _mgr(tmp_path)
+    mgr.save(3, _state(3), wait=True)
+    mgr.save(6, _state(6), wait=True)
+    os.remove(manifest_path(str(tmp_path), 6))   # step 6 reads unverified
+    shutil.rmtree(tmp_path / "6" / "default")    # ...and lost its data
+    step, restored = mgr.restore_latest_valid(target=_target())
+    assert step == 3 and int(restored["step"]) == 3
+    mgr.close()
+
+
+def test_torn_manifest_does_not_condemn_intact_data(tmp_path):
+    """Review regression: a torn/unreadable manifest (crash between data
+    commit and manifest fsync) demotes the step to unverified — the
+    intact checkpoint restores and is NOT quarantined."""
+    from hetu_tpu.utils.checkpoint import manifest_path
+    mgr = _mgr(tmp_path)
+    mgr.save(3, _state(3), wait=True)
+    mgr.save(6, _state(6), wait=True)
+    with open(manifest_path(str(tmp_path), 6), "w") as f:
+        f.write('{"schema": 1, "files": {"trunc')   # torn json
+    step, restored = mgr.restore_latest_valid(target=_target())
+    assert step == 6 and int(restored["step"]) == 6
+    assert mgr.all_steps() == [3, 6]   # nothing deleted
+    assert not os.path.exists(manifest_path(str(tmp_path), 6))
+    mgr.close()
+
+
+def test_retention_prunes_manifests(tmp_path):
+    """Manifests follow orbax's retention: no orphan manifest files pile
+    up for steps the max_to_keep policy already deleted."""
+    from hetu_tpu.utils.checkpoint import manifest_path
+    mgr = _mgr(tmp_path, max_to_keep=2)
+    for s in (1, 2, 3, 4):
+        mgr.save(s, _state(s), wait=True)
+    assert mgr.all_steps() == [3, 4]
+    assert not os.path.exists(manifest_path(str(tmp_path), 1))
+    assert not os.path.exists(manifest_path(str(tmp_path), 2))
+    assert os.path.exists(manifest_path(str(tmp_path), 4))
+    mgr.close()

@@ -153,6 +153,143 @@ def test_resume_rejected_for_dead_rank(server):
         c.resume()
 
 
+def test_client_reconnects_after_server_restart():
+    """Satellite: reconnect-with-backoff against a server restarted
+    mid-run.  The client's next op fails in transit, the reconnect loop
+    backs off until the new server (same port, empty state) accepts the
+    reattach, and the retried idempotent op completes — rank preserved."""
+    import threading
+
+    from hetu_tpu.obs.metrics import get_registry
+    reg = get_registry()
+    before = reg.counter_value("rpc.reconnects")
+    s1 = CoordinationServer(world_size=1, heartbeat_timeout=30.0)
+    port = s1.port
+    c = CoordinationClient("127.0.0.1", port, auto_heartbeat=False,
+                           op_timeout=10.0, max_reconnect_wait=30.0)
+    rank = c.rank
+    c.put("before", 1)
+    s1.close()
+    holder = {}
+
+    def restart():
+        time.sleep(0.8)   # client must survive several refused attempts
+        holder["s2"] = CoordinationServer(port=port, world_size=1,
+                                          heartbeat_timeout=30.0)
+
+    t = threading.Thread(target=restart, daemon=True)
+    t.start()
+    try:
+        c.put("after", 2)          # retried once the restart lands
+        assert c.get("after") == 2
+        assert c.rank == rank      # reattach re-claimed the old rank
+        assert c.reconnects >= 1
+        assert reg.counter_value("rpc.reconnects") - before >= 1
+        # the restarted server knows the reattached rank as alive
+        t.join(10)
+        assert rank in holder["s2"].alive_ranks()
+        # and a FRESH connect gets a rank past the re-claimed one
+        c2 = CoordinationClient("127.0.0.1", port, auto_heartbeat=False)
+        assert c2.rank > rank
+        c2.exit()
+        c.exit()
+    finally:
+        if "s2" in holder:
+            holder["s2"].close()
+
+
+def test_socket_break_preserves_rank_within_grace(server):
+    """A torn socket + quick reconnect must NOT be treated as worker
+    death (the reattach grace window): membership is unchanged and no
+    worker-loss event fires."""
+    import socket as socket_mod
+
+    from hetu_tpu.obs.metrics import get_registry
+    reg = get_registry()
+    lost_before = reg.counter_value("rpc.workers_lost",
+                                    reason="connection lost")
+    c = _client(server)
+    c._conn.shutdown(socket_mod.SHUT_RDWR)   # tear the transport
+    assert c.membership() == [c.rank]        # reconnect + retried read
+    assert c.reconnects == 1
+    time.sleep(0.3)
+    assert c.rank in c.membership()
+    assert reg.counter_value("rpc.workers_lost",
+                             reason="connection lost") == lost_before
+    c.exit()
+
+
+def test_heartbeat_loss_is_flagged_not_swallowed():
+    """Satellite regression: a dead server must not silently kill the
+    heartbeat thread — the client flags it, counts rpc.heartbeat_lost,
+    and keeps retrying at beat cadence."""
+    from hetu_tpu.obs.metrics import get_registry
+    reg = get_registry()
+    before = reg.counter_value("rpc.heartbeat_lost")
+    s = CoordinationServer(world_size=1, heartbeat_timeout=30.0)
+    c = CoordinationClient("127.0.0.1", s.port, heartbeat_interval=0.1,
+                           op_timeout=2.0, max_reconnect_wait=0.2)
+    assert not c.heartbeat_lost
+    s.close()
+    deadline = time.time() + 15.0
+    while not c.heartbeat_lost:
+        assert time.time() < deadline, "heartbeat loss never flagged"
+        time.sleep(0.05)
+    assert c.disconnected
+    assert reg.counter_value("rpc.heartbeat_lost") - before >= 1
+    assert c._hb.is_alive()   # still retrying, not silently dead
+    c.exit()
+
+
+def test_accept_loop_prunes_dead_threads(server):
+    """Satellite regression: connection threads must not accumulate
+    forever across reconnect cycles (unbounded growth on long elastic
+    runs)."""
+    for _ in range(8):
+        c = _client(server)
+        c.exit()
+    # one live client forces an accept, which prunes the dead threads
+    live = _client(server)
+    time.sleep(0.2)
+    live2 = _client(server)
+    assert len(server._threads) <= 4, len(server._threads)
+    live.exit()
+    live2.exit()
+
+
+def test_reattach_rejected_for_dead_rank(server):
+    """A rank the server declared dead cannot sneak back via reattach
+    (split-brain guard): the client surfaces StaleRankError."""
+    import socket as socket_mod
+
+    from hetu_tpu.rpc.client import StaleRankError
+    c = _client(server)
+    server._mark_lost(c.rank, why="test")
+    c._conn.shutdown(socket_mod.SHUT_RDWR)
+    with pytest.raises(StaleRankError):
+        c.membership()
+    assert c.stale
+
+
+def test_vote_result_survives_lost_last_collection(server):
+    """Review regression: the completed vote round must outlive full
+    collection — if the LAST collector's response is lost in transit, its
+    retry re-submits the same round and must read the result, not open a
+    phantom single-vote round."""
+    h = server._handle
+    assert h({"op": "consistent", "name": "p#0", "rank": 0, "value": "a",
+              "count": 2})["done"] is False
+    done = h({"op": "consistent", "name": "p#0", "rank": 1, "value": "a",
+              "count": 2})
+    assert done["done"] and done["agreed"]
+    # rank 0 collects; rank 1's collection response is "lost" and retried
+    assert h({"op": "consistent", "name": "p#0", "rank": 0, "value": "a",
+              "count": 2})["done"]
+    retry = h({"op": "consistent", "name": "p#0", "rank": 1, "value": "a",
+               "count": 2})
+    assert retry["done"] and retry["agreed"] and retry["value"] == "a"
+
+
 def test_distributed_init_single_process(server):
     # single process: jax.distributed untouched; control client connects
     from hetu_tpu.core.distributed import distributed_init

@@ -1,0 +1,71 @@
+"""Replay a fault schedule against the demo elastic run and print a
+recovery report.
+
+    python tools_chaos.py                                # the acceptance
+    python tools_chaos.py --schedule partition           # named schedule
+    python tools_chaos.py --schedule my_schedule.json    # from disk
+    python tools_chaos.py --steps 48 --workers 2 --json report.json
+
+Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
+partition, corrupt, stall.  A path argument loads a FaultPlan JSON
+(docs/fault_tolerance.md has the schema — the same format the
+HETU_TPU_CHAOS flag takes for real runs).
+
+The demo run is CPU-only and model-free (StubTrainer checkpoints real
+bytes through orbax; the control plane — reconnecting rpc client,
+ElasticController, verified checkpoint fallback — is the real thing), so
+a whole kill/partition/corrupt scenario replays in a few seconds with
+deterministic seeds.  The report reconciles `chaos.injected_*` against
+the recovery accounting (`rpc.reconnects`, `ckpt.fallbacks`,
+`elastic.replans`) and prints re-mesh latency percentiles from the
+metrics registry.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        description="replay a chaos schedule against the demo elastic run")
+    ap.add_argument("--schedule", default="kill-partition-corrupt",
+                    help="named schedule or path to a FaultPlan JSON")
+    ap.add_argument("--steps", type=int, default=48,
+                    help="training steps the demo cluster must complete")
+    ap.add_argument("--workers", type=int, default=2)
+    ap.add_argument("--workdir", default=None,
+                    help="where checkpoints land (default: a tmp dir)")
+    ap.add_argument("--json", dest="json_out", default=None,
+                    help="also write the report to this path")
+    args = ap.parse_args(argv)
+
+    from hetu_tpu.chaos import FaultPlan
+    from hetu_tpu.chaos.harness import named_plan, run_chaos_demo
+
+    if os.path.exists(args.schedule):
+        plan = FaultPlan.load(args.schedule)
+    else:
+        plan = named_plan(args.schedule)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="hetu_chaos_")
+    report = run_chaos_demo(workdir, plan, num_steps=args.steps,
+                            workers=args.workers)
+    report["schedule"] = (args.schedule
+                          if os.path.exists(args.schedule)
+                          else {"name": args.schedule,
+                                "plan": plan.to_dict()})
+    out = json.dumps(report, indent=2, default=str)
+    print(out)
+    if args.json_out:
+        with open(args.json_out, "w") as f:
+            f.write(out + "\n")
+    return 0 if report["completed"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,9 +37,16 @@ def summarize(records) -> dict:
     compiles = [r for r in records if r.get("kind") == "compile"]
     switches = [r for r in records if r.get("kind") == "switch"]
     epochs = [r for r in records if r.get("kind") == "elastic_epoch"]
+    faults = [r for r in records if r.get("kind") == "fault"]
 
     out: dict = {"steps": len(steps), "compiles": len(compiles),
                  "switches": len(switches), "elastic_epochs": len(epochs)}
+    if faults:
+        by_kind: dict = {}
+        for r in faults:
+            k = str(r.get("fault", "unknown"))
+            by_kind[k] = by_kind.get(k, 0) + 1
+        out["faults"] = by_kind
 
     times = sorted(float(r["step_time_s"]) for r in steps
                    if r.get("step_time_s"))

@@ -19,7 +19,7 @@ import time
 from typing import Any, Dict, List, Optional
 
 from hetu_tpu import chaos
-from hetu_tpu.chaos.inject import corrupt_step, newest_step
+from hetu_tpu.chaos.inject import corrupt_step, maybe_slow_step, newest_step
 from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
 from hetu_tpu.obs.metrics import get_registry
 from hetu_tpu.utils.logging import get_logger
@@ -31,12 +31,18 @@ _REPORT_COUNTERS = (
     "chaos.injected_rpc_drop", "chaos.injected_rpc_delay",
     "chaos.injected_rpc_dup", "chaos.injected_heartbeat_stall",
     "chaos.injected_worker_kill", "chaos.injected_ckpt_corrupt",
+    "chaos.injected_slow_worker",
     "rpc.disconnects", "rpc.reconnects", "rpc.reattaches",
     "rpc.heartbeat_lost", "rpc.workers_lost",
+    "rpc.telemetry_pushes", "rpc.telemetry_push_failures",
+    "cluster.telemetry_pushes", "cluster.telemetry_dup_pushes",
+    "cluster.stragglers_flagged",
+    "health.anomalies",
     "ckpt.fallbacks", "ckpt.quarantined", "ckpt.manifests_written",
     "elastic.replans", "elastic.step_failures", "elastic.emergency_saves",
     "elastic.recovery_attempts", "elastic.recovery_success",
     "elastic.restore_failures", "elastic.save_failures",
+    "elastic.stragglers_persistent", "elastic.straggler_replans",
 )
 
 
@@ -50,14 +56,27 @@ def _counter_totals(reg) -> Dict[str, float]:
 
 
 class StubTrainer:
-    """Checkpoint-real, model-free trainer the ElasticController drives."""
+    """Checkpoint-real, model-free trainer the ElasticController drives.
 
-    def __init__(self, ckpt_dir: Optional[str], plan: Dict):
+    Mirrors the real Trainer's telemetry surface when the observability
+    flags ask for it: an optional per-slot RunLog (with the telemetry
+    tail), the HETU_TPU_HEALTH HealthMonitor observing every step, and
+    the chaos `slow_worker` per-step delay inflation (the fake
+    straggling host the cluster straggler detector must catch)."""
+
+    def __init__(self, ckpt_dir: Optional[str], plan: Dict,
+                 chaos_plan: Optional[FaultPlan] = None,
+                 rank: Optional[int] = None,
+                 run_log=None):
         import numpy as np
         self.global_step = 0
         self._v = np.zeros(4, np.float64)
         self.plan = plan
-        self.run_log = None
+        self._chaos = chaos_plan
+        self._rank = rank
+        self.run_log = run_log
+        from hetu_tpu.obs.health import maybe_health_monitor
+        self.health = maybe_health_monitor(runlog=run_log)
         self._ckpt = None
         if ckpt_dir:
             from hetu_tpu.utils.checkpoint import CheckpointManager
@@ -65,9 +84,21 @@ class StubTrainer:
                                            async_save=False)
 
     def train_step(self, batch) -> Dict[str, float]:
+        t0 = time.perf_counter()
+        # the slow_worker injection point: a straggling host, faked as a
+        # deterministic per-step sleep (identity when no plan/spec)
+        maybe_slow_step(self._chaos, self._rank, self.global_step)
         self._v = self._v + 1.0
         self.global_step += 1
-        return {"loss": 1.0 / (1.0 + self.global_step)}
+        metrics = {"loss": 1.0 / (1.0 + self.global_step)}
+        step_s = time.perf_counter() - t0
+        if self.run_log is not None:
+            self.run_log.step(self.global_step, step_s,
+                              loss=metrics["loss"])
+        if self.health is not None:
+            self.health.observe_step(self.global_step, step_s,
+                                     loss=metrics["loss"])
+        return metrics
 
     def save(self, wait: bool = False):
         assert self._ckpt is not None
@@ -123,7 +154,20 @@ def _run_worker(idx: int, port: int, plan: FaultPlan, ckpt_dir: str,
                            "killed": False, "error": None}
     results[idx] = rec
     client = None
+    run_log = None
     try:
+        # per-slot RunLog (telemetry tail + anomaly events) only when the
+        # observability flags ask for it — with both unset the harness
+        # runs exactly as before (the flags-unset identity contract)
+        from hetu_tpu.obs.aggregate import push_interval
+        from hetu_tpu.utils import flags as _flags
+        if push_interval() > 0 or _flags.bool_flag("HETU_TPU_HEALTH"):
+            from hetu_tpu.obs.runlog import RunLog
+            run_log = RunLog(
+                os.path.join(os.path.dirname(ckpt_dir) or ".",
+                             f"runlog_slot{idx}.jsonl"),
+                tail_records=128)
+
         client = CoordinationClient("127.0.0.1", port,
                                     heartbeat_interval=0.1,
                                     op_timeout=10.0,
@@ -133,9 +177,11 @@ def _run_worker(idx: int, port: int, plan: FaultPlan, ckpt_dir: str,
 
         def factory(ds_plan):
             # the initial leader (rank 0) owns the shared checkpoint dir,
-            # matching the reference's rank-0 saves
+            # matching the reference's rank-0 saves; the RunLog is per
+            # SLOT and survives trainer rebuilds (append-mode JSONL)
             return StubTrainer(ckpt_dir if client.rank == 0 else None,
-                               ds_plan)
+                               ds_plan, chaos_plan=plan,
+                               rank=client.rank, run_log=run_log)
 
         def planner(alive: List[int]) -> Dict:
             return {"strategy": {"dp": len(alive), "tp": 1, "pp": 1}}
@@ -209,6 +255,9 @@ def _run_worker(idx: int, port: int, plan: FaultPlan, ckpt_dir: str,
     except Exception as e:   # surfaced in the report, not swallowed
         rec["error"] = repr(e)
         logger.error(f"worker slot {idx} failed: {e!r}")
+    finally:
+        if run_log is not None:
+            run_log.close()
 
 
 def run_chaos_demo(workdir: str, plan: FaultPlan, num_steps: int = 36,
@@ -248,6 +297,11 @@ def run_chaos_demo(workdir: str, plan: FaultPlan, num_steps: int = 36,
         for t in threads:
             t.join(timeout=120.0)
         wall_s = time.perf_counter() - t0
+        # the coordinator's cluster view, captured BEFORE teardown: the
+        # ClusterSnapshot over the whole run window plus the straggler
+        # report (empty workers when telemetry push was off)
+        cluster = server.cluster_snapshot(window_s=max(wall_s * 2, 60.0))
+        straggler = server.telemetry.straggler_report(cluster)
     finally:
         chaos.reset()
         server.close()
@@ -269,6 +323,8 @@ def run_chaos_demo(workdir: str, plan: FaultPlan, num_steps: int = 36,
         "injected": plan.summary(),
         "metrics": deltas,
         "replan_s": replan,
+        "cluster": cluster,
+        "straggler": straggler,
         "completed": all(
             r and (r["final_step"] is not None and
                    r["final_step"] >= num_steps or r["killed"])
@@ -300,6 +356,17 @@ def named_plan(name: str, **kw) -> FaultPlan:
             FaultSpec(kind="ckpt_corrupt", at_step=1,
                       mode=kw.get("mode", "truncate")),
         ])
+    if name == "slow":
+        # a persistent straggler: one rank's steps inflate by delay_s
+        # from at_step on — the cluster straggler detector (telemetry
+        # push + aggregate.straggler_report) must flag it; pair with
+        # HETU_TPU_TELEMETRY_PUSH / HETU_TPU_HEALTH
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="slow_worker", rank=kw.get("rank", 1),
+                      at_step=kw.get("at_step", 6),
+                      count=kw.get("count", 10_000),
+                      delay_s=kw.get("delay_s", 0.15)),
+        ])
     if name == "stall":
         # a heartbeat stall longer than the server timeout: the classic
         # long-XLA-compile false positive — the stalled worker is declared
@@ -309,4 +376,5 @@ def named_plan(name: str, **kw) -> FaultPlan:
                       stall_s=kw.get("stall_s", 2.5)),
         ])
     raise ValueError(f"unknown schedule {name!r}; known: "
-                     "kill-partition-corrupt, partition, corrupt, stall")
+                     "kill-partition-corrupt, partition, corrupt, stall, "
+                     "slow")

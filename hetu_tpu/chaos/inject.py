@@ -1,4 +1,12 @@
-"""Checkpoint-corruption injection (the `ckpt_corrupt` fault kind).
+"""Fault-application helpers for the non-wire fault kinds:
+
+* `ckpt_corrupt` — deterministic byte-level damage to an on-disk
+  checkpoint step;
+* `slow_worker` — per-step delay inflation at a training-step injection
+  point (`maybe_slow_step`), the hardware-skew-free way to fake a
+  straggling host for the cluster straggler detector.
+
+Checkpoint-corruption details (the `ckpt_corrupt` fault kind):
 
 Deterministic byte-level damage to an on-disk checkpoint step, used by the
 chaos harness and tests to prove `restore_latest_valid()` walks back to
@@ -12,7 +20,21 @@ ties break lexicographically so the choice is stable across runs.
 from __future__ import annotations
 
 import os
+import time
 from typing import List, Optional, Tuple
+
+
+def maybe_slow_step(plan, rank: Optional[int], step: int) -> float:
+    """Apply any scheduled `slow_worker` delay for (rank, step): sleeps
+    the plan's per-step inflation and returns the seconds slept (0.0 when
+    no plan / no matching spec — the identity hot path is one None
+    check).  Call it at the top of a training step."""
+    if plan is None:
+        return 0.0
+    delay = plan.step_delay(rank, step)
+    if delay > 0:
+        time.sleep(delay)
+    return delay
 
 
 def _step_files(step_dir: str) -> List[Tuple[str, int]]:

@@ -15,6 +15,11 @@ consult at well-defined injection points —
     ckpt_corrupt                     the chaos harness — flip/truncate
                                      bytes in the newest checkpoint before
                                      a restore
+    slow_worker                      the training step — deterministic
+                                     per-step delay inflation on a target
+                                     rank (a straggling host, faked), so
+                                     the cluster straggler detector is
+                                     testable without real hardware skew
 
 Everything is deterministic given the plan: trigger windows are counted in
 *matching calls* (not wall time), and probabilistic faults draw from one
@@ -37,7 +42,7 @@ import threading
 from typing import Any, Dict, List, Optional
 
 KINDS = ("rpc_drop", "rpc_delay", "rpc_dup",
-         "heartbeat_stall", "worker_kill", "ckpt_corrupt")
+         "heartbeat_stall", "worker_kill", "ckpt_corrupt", "slow_worker")
 _WIRE_KINDS = ("rpc_drop", "rpc_delay", "rpc_dup")
 CORRUPT_MODES = ("flip", "truncate", "delete")
 
@@ -57,7 +62,9 @@ class FaultSpec:
                  seeded stream — deterministic)
     delay_s      rpc_delay: added latency per fired call
     at_step      worker_kill / ckpt_corrupt: trigger once the observed
-                 training step reaches this value
+                 training step reaches this value; slow_worker: first
+                 slowed step (with `count` following steps slowed and
+                 `delay_s` added per step)
     at_beat      heartbeat_stall: fire at this beat index
     stall_s      heartbeat_stall: how long the beat thread freezes
     mode         ckpt_corrupt: flip | truncate | delete
@@ -183,6 +190,27 @@ class FaultPlan:
                 return 0.0
         _reg().inc("chaos.injected_heartbeat_stall")
         return stall
+
+    def step_delay(self, rank: Optional[int], step: int) -> float:
+        """Seconds of slow_worker delay to inflate this training step by
+        (0.0 = none).  Deterministic: the window is [at_step, at_step +
+        count) in observed training steps, the delay a fixed delay_s per
+        step — a faked straggling host the straggler detector must catch.
+        Overlapping specs stack (their delays sum)."""
+        total = 0.0
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "slow_worker":
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                start = spec.at_step if spec.at_step is not None else 0
+                if start <= step < start + spec.count and spec.delay_s > 0:
+                    spec.injected += 1
+                    total += spec.delay_s
+        if total > 0:
+            _reg().inc("chaos.injected_slow_worker")
+        return total
 
     def should_kill(self, rank: Optional[int], step: int) -> bool:
         """One-shot: True when a worker_kill spec for this rank has its

@@ -38,6 +38,17 @@ class ElasticController:
     re-mesh-and-resume recovery before the exception surfaces (0 =
     emergency-checkpoint then re-raise — the conservative default: a
     deterministic model bug would otherwise re-mesh in a loop forever).
+
+    straggler_hook(client) -> straggler report (or None): consulted every
+    `straggler_interval` seconds in the run loop — normally
+    obs.aggregate.snapshot_straggler_hook(), which asks the coordination
+    server for its live report over the pushed telemetry.  A rank flagged
+    in `straggler_patience` CONSECUTIVE checks is persistent; within
+    `straggler_budget` re-meshes the controller then triggers the
+    existing replan path (worker_stop broadcast) so the planner can route
+    around it.  The default budget 0 means OBSERVE ONLY: gauges +
+    accounting, no replans — automated re-meshing on a noisy signal is an
+    operator opt-in, not a default.
     """
 
     def __init__(self, client: CoordinationClient,
@@ -45,7 +56,12 @@ class ElasticController:
                  planner_fn: Callable[[list], Dict],
                  expected_world: Optional[int] = None,
                  rendezvous_timeout: float = 300.0,
-                 recovery_budget: int = 0):
+                 recovery_budget: int = 0,
+                 straggler_hook: Optional[Callable] = None,
+                 straggler_budget: int = 0,
+                 straggler_patience: int = 3,
+                 straggler_interval: float = 2.0,
+                 telemetry_interval: Optional[float] = None):
         # checkpoint cadence belongs to TrainingConfig.ckpt_every; the
         # controller only saves at stop/exit boundaries
         self.client = client
@@ -54,10 +70,25 @@ class ElasticController:
         self.expected_world = expected_world
         self.rendezvous_timeout = rendezvous_timeout
         self.recovery_budget = recovery_budget
+        self.straggler_hook = straggler_hook
+        self.straggler_budget = straggler_budget
+        self.straggler_patience = max(1, straggler_patience)
+        self.straggler_interval = straggler_interval
         self.generation = 0
         self.trainer = None
         self._consumed_epoch = 0   # newest plan round this worker took
         self._recoveries_used = 0
+        # cluster telemetry push (obs/aggregate.py): the controller owns
+        # the worker's pusher because it owns both the client and the
+        # trainer (step times are measured around train_step, the RunLog
+        # tail drains from whatever trainer generation is current).
+        # Interval None -> the HETU_TPU_TELEMETRY_PUSH flag; 0/unset
+        # means NO pusher exists and the step loop pays one None check.
+        self._telemetry_interval = telemetry_interval
+        self._telemetry = None
+        self._straggler_strikes: Dict[int, int] = {}
+        self._straggler_replans_used = 0
+        self._straggler_next_check = 0.0
 
     def _startup_rendezvous(self):
         """Wait for the full expected membership before the FIRST plan —
@@ -330,6 +361,71 @@ class ElasticController:
             raise exc from re_exc
         reg.inc("elastic.recovery_success")
 
+    def _setup_telemetry(self):
+        """Start the telemetry pusher when pushing is enabled (the
+        HETU_TPU_TELEMETRY_PUSH flag or an explicit interval); None
+        otherwise — the run loop then does zero telemetry work."""
+        from hetu_tpu.obs.aggregate import TelemetryPusher, push_interval
+        interval = (push_interval() if self._telemetry_interval is None
+                    else self._telemetry_interval)
+        if interval <= 0 or self._telemetry is not None:
+            return
+        self._telemetry = TelemetryPusher(
+            self.client, interval=interval,
+            # the tail must follow trainer REBUILDS — resolve the runlog
+            # at push time, not at pusher construction
+            runlog_fn=lambda: getattr(self.trainer, "run_log", None))
+
+    def _check_stragglers(self):
+        """Consult the straggler hook; escalate a persistent straggler to
+        a re-mesh within straggler_budget (0 = observe only)."""
+        reg = get_registry()
+        try:
+            report = self.straggler_hook(self.client)
+        except Exception as e:
+            reg.inc("elastic.straggler_hook_errors")
+            logger.warning(f"straggler hook failed: {e!r}")
+            return
+        if not report:
+            return
+        flagged = {int(r) for r in report.get("stragglers", [])}
+        reg.set_gauge("elastic.stragglers", len(flagged))
+        self._straggler_strikes = {
+            r: self._straggler_strikes.get(r, 0) + 1 for r in flagged}
+        persistent = sorted(r for r, n in self._straggler_strikes.items()
+                            if n >= self.straggler_patience)
+        if not persistent:
+            return
+        reg.inc("elastic.stragglers_persistent")
+        if self._straggler_replans_used >= self.straggler_budget:
+            return   # observation only (the default)
+        # the straggler report is cluster-global but budgets are
+        # per-controller: only the LEADER (min alive rank) escalates, so
+        # one straggler costs at most straggler_budget re-meshes
+        # cluster-wide — not straggler_budget x world_size
+        try:
+            alive = self.client.membership()
+        except (ConnectionError, OSError):
+            return   # can't establish leadership; try next check
+        if alive and self.client.rank != min(alive):
+            return
+        self._straggler_replans_used += 1
+        reg.inc("elastic.straggler_replans")
+        logger.warning(
+            f"persistent straggler(s) {persistent} "
+            f"({self.straggler_patience} consecutive reports); triggering "
+            f"a re-mesh ({self._straggler_replans_used}/"
+            f"{self.straggler_budget})")
+        run_log = getattr(self.trainer, "run_log", None)
+        if run_log is not None:
+            run_log.log("straggler", stragglers=persistent,
+                        action="replan")
+        self._straggler_strikes = {}
+        try:
+            self.client.worker_stop()   # the existing replan path
+        except (ConnectionError, OSError) as e:
+            logger.warning(f"straggler re-mesh request failed: {e!r}")
+
     def run(self, batches, num_steps: int,
             step_callback: Optional[Callable] = None) -> object:
         """The elastic loop (reference: workers re-entering Trainer after
@@ -339,6 +435,7 @@ class ElasticController:
         reg = get_registry()
         self._startup_rendezvous()
         self._rebuild()
+        self._setup_telemetry()
         it = iter(batches)
         steps_done = self.trainer.global_step
         while steps_done < num_steps:
@@ -374,19 +471,39 @@ class ElasticController:
                 self._rebuild()
                 steps_done = self.trainer.global_step
                 continue
+            if self.straggler_hook is not None and \
+                    time.time() >= self._straggler_next_check:
+                self._straggler_next_check = (time.time()
+                                              + self.straggler_interval)
+                self._check_stragglers()
             try:
                 batch = next(it)
             except StopIteration:
                 break
             try:
+                if self._telemetry is not None:
+                    t_step = time.perf_counter()
                 metrics = self.trainer.train_step(batch)
             except Exception as e:
                 self._on_step_failure(e)
                 steps_done = self.trainer.global_step
                 continue
+            if self._telemetry is not None:
+                # the worker-side step record the cluster straggler
+                # scoring runs on; loss may be a device scalar — reading
+                # it is a sync the telemetry flag opted into
+                loss = metrics.get("loss") if isinstance(metrics, dict) \
+                    else None
+                self._telemetry.source.note_step(
+                    self.trainer.global_step,
+                    time.perf_counter() - t_step,
+                    loss=None if loss is None else float(loss))
             if step_callback is not None:
                 step_callback(self.trainer, metrics)
             steps_done = self.trainer.global_step
+        if self._telemetry is not None:
+            self._telemetry.close()   # flush the run's tail to the server
+            self._telemetry = None
         if getattr(self.trainer, "_ckpt", None) is not None:
             self.trainer.save(wait=True)
         return self.trainer

@@ -36,14 +36,7 @@ from hetu_tpu.utils.logging import get_logger
 logger = get_logger("trainer")
 
 
-def _device_mem_bytes():
-    """bytes_in_use on device 0, or None where the backend hides it (CPU)."""
-    try:
-        stats = jax.local_devices()[0].memory_stats() or {}
-        v = stats.get("bytes_in_use")
-        return int(v) if v is not None else None
-    except Exception:
-        return None
+from hetu_tpu.utils.profiling import device_mem_bytes as _device_mem_bytes
 
 
 class Trainer:
@@ -158,12 +151,32 @@ class Trainer:
         # shared filesystems
         if rl_path and jax.process_index() != 0:
             rl_path = None
-        self.run_log = RunLog(rl_path) if rl_path else None
+        # the RunLog keeps an in-memory tail for the cluster telemetry
+        # push only when pushing is on (obs.aggregate drains it)
+        from hetu_tpu.obs.aggregate import push_interval
+        tail = 256 if push_interval() > 0 else 0
+        self.run_log = (RunLog(rl_path, tail_records=tail)
+                        if rl_path else None)
+        # -- training health monitor (obs.health, HETU_TPU_HEALTH): None
+        # unless the flag is set — the per-step cost of "off" is one None
+        # check.  On anomalies of the severe kinds it emergency-saves
+        # through the PR 3 checkpoint path (best-effort, never raises).
+        from hetu_tpu.obs.health import maybe_health_monitor
+        self._health = maybe_health_monitor(
+            runlog=self.run_log,
+            emergency_hook=(self._health_emergency_save
+                            if self._ckpt is not None else None))
         c = config
         self.optimizer = optim.AdamW(
             lr=optim.cosine_schedule(c.lr, c.warmup_steps, c.total_steps,
                                      c.min_lr_ratio),
             b1=c.beta1, b2=c.beta2, eps=c.eps, weight_decay=c.weight_decay)
+
+    def _health_emergency_save(self):
+        """Bank state NOW (the HealthMonitor's emergency hook for NaN
+        anomalies): a synchronous save so a dying run loses at most the
+        poisoned step, not a checkpoint interval."""
+        self.save(wait=True)
 
     def _declared(self):
         """Context declaring this trainer's CP data layout to the ring for
@@ -684,8 +697,17 @@ class Trainer:
             self._registry.inc("trainer.steps")
             self._registry.inc("trainer.tokens", batch_tokens)
             self._registry.observe("trainer.step_time_s", step_s)
+            log_boundary = (self.global_step % c.log_every) == 0
             loss = None
-            if (self.global_step % c.log_every) == 0:
+            if self._health is not None:
+                # the monitor needs loss/grad_norm PER STEP — a device
+                # sync the HETU_TPU_HEALTH flag explicitly opts into
+                loss = float(metrics["loss"])
+                gn = metrics.get("grad_norm")
+                self._health.observe_step(
+                    self.global_step, step_s, loss=loss,
+                    grad_norm=None if gn is None else float(gn))
+            if log_boundary:
                 loss = float(metrics["loss"])  # forces device sync
                 dt = time.perf_counter() - t0
                 logger.info(
@@ -698,12 +720,20 @@ class Trainer:
                 # loss AND the device memory probe ride only on
                 # log-boundary steps — float(loss) is a device sync and
                 # memory_stats() a runtime query (a host round-trip on the
-                # remote-TPU backend) the hot path must not pay per step
+                # remote-TPU backend) the hot path must not pay per step.
+                # With HETU_TPU_MEMORY_PROFILE on, the profiler already
+                # probed this step — reuse its value so EVERY step record
+                # carries memory (the flag opted into the per-step query).
+                if self.profiler.mem_profile:
+                    mem = self.profiler.last_mem_bytes
+                else:
+                    # the probe stays on log boundaries even when the
+                    # health monitor synced loss on this step
+                    mem = _device_mem_bytes() if log_boundary else None
                 self.run_log.step(
                     self.global_step, step_s, loss=loss,
                     tokens_per_s=batch_tokens / max(step_s, 1e-9),
-                    device_mem_bytes=(_device_mem_bytes()
-                                      if loss is not None else None),
+                    device_mem_bytes=mem,
                     plan=self._plan_fingerprint(host_batch))
             if self._ckpt and (self.global_step % c.ckpt_every) == 0:
                 self.save()

@@ -1,8 +1,9 @@
 """Unified telemetry: metrics registry, structured run events, Chrome-trace
-timelines, the hardware-free MFU/roofline reporter, and the bytes-on-wire
-collective analyzer.
+timelines, the hardware-free MFU/roofline reporter, the bytes-on-wire
+collective analyzer, cluster-scope aggregation, and the training health
+monitor.
 
-Five pieces, one import surface:
+One import surface:
 
     from hetu_tpu import obs
     obs.get_registry().inc("elastic.replans")
@@ -10,13 +11,22 @@ Five pieces, one import surface:
     obs.pipeline_schedule_trace(4, 8, schedule="1f1b").save("sched.json")
     obs.estimate_from_compiled(compiled)["estimated_mfu"]
     obs.collective_report(compiled)["total_wire_bytes"]
+    obs.straggler_report(snapshot)["stragglers"]     # cluster scope
+    obs.HealthMonitor(runlog=log).observe_step(1, 0.42, loss=2.3)
 
-See docs/observability.md for the env flags, the RunLog schema, and how
-the estimated MFU is derived; docs/comm_compression.md for the collective
-analyzer's wire-byte model.
+See docs/observability.md for the env flags, the RunLog schema, the
+telemetry-push wire format and the ClusterSnapshot fields;
+docs/comm_compression.md for the collective analyzer's wire-byte model.
 """
+from hetu_tpu.obs.aggregate import (ClusterAggregator,  # noqa: F401
+                                    ClusterSnapshot, TelemetryPusher,
+                                    TelemetrySource, merge_offsets,
+                                    snapshot_straggler_hook,
+                                    straggler_report)
 from hetu_tpu.obs.comm import (collective_report,  # noqa: F401
                                collective_table)
+from hetu_tpu.obs.health import (HealthMonitor,  # noqa: F401
+                                 maybe_health_monitor)
 from hetu_tpu.obs.metrics import (Histogram, MetricsRegistry,  # noqa: F401
                                   get_registry)
 from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
@@ -25,7 +35,7 @@ from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
 from hetu_tpu.obs.runlog import (SCHEMA_VERSION, RunLog,  # noqa: F401
                                  default_runlog_path)
 from hetu_tpu.obs.trace import (ChromeTrace,  # noqa: F401
-                                pipeline_schedule_trace,
+                                merge_runlogs, pipeline_schedule_trace,
                                 schedule_bubble_fraction,
                                 trace_from_runlog)
 
@@ -33,8 +43,12 @@ __all__ = [
     "MetricsRegistry", "Histogram", "get_registry",
     "RunLog", "SCHEMA_VERSION", "default_runlog_path",
     "ChromeTrace", "pipeline_schedule_trace", "schedule_bubble_fraction",
-    "trace_from_runlog",
+    "trace_from_runlog", "merge_runlogs",
     "estimate_mfu", "estimate_from_compiled", "flops_of_compiled",
     "analytic_transformer_estimate", "load_hardware_profile",
     "collective_report", "collective_table",
+    "ClusterAggregator", "ClusterSnapshot", "TelemetrySource",
+    "TelemetryPusher", "straggler_report", "snapshot_straggler_hook",
+    "merge_offsets",
+    "HealthMonitor", "maybe_health_monitor",
 ]

@@ -1,0 +1,561 @@
+"""Cluster-scope telemetry aggregation.
+
+PR 1's telemetry is strictly process-local: the coordination server only
+ever sees heartbeat gaps, never step times, losses, MFU or comm bytes —
+so nothing online can feed the hetero-planner the load signals it needs
+(SURVEY §5.1/§5.6; Hetis closes the same gap with live cluster state,
+Galvatron fills it offline with profiling).  This module closes it:
+
+* ``TelemetrySource`` — worker side.  Builds **delta-encoded** snapshots
+  of the process-local metrics registry (counters ship as deltas since
+  the last delivered push; gauges last-write-wins) plus the recent step
+  records and RunLog tail events accumulated since the last push.  Every
+  payload carries a ``(boot, seq)`` identity so the server can fold
+  retried/duplicated deliveries exactly once — a reconnecting client may
+  re-send a push, a restarted worker starts a fresh ``boot``.
+* ``TelemetryPusher`` — the periodic worker loop: every
+  ``HETU_TPU_TELEMETRY_PUSH`` seconds it ships the next payload through
+  ``CoordinationClient.telemetry_push``.  A failed delivery is held
+  pending and re-sent with its original (boot, seq) identity, so an
+  applied-but-unacked push dedupes server-side instead of
+  double-counting, and no counts are ever lost.
+* ``ClusterAggregator`` — server side.  Folds pushes into per-worker
+  state with monotonic-counter delta merging (restarts/reattaches never
+  double-count) and renders the time-windowed ``ClusterSnapshot``:
+  per-worker step rate, step-time percentiles, loss, estimated MFU,
+  comm bytes, heartbeat gap, clock offset.
+* ``straggler_report`` — robust per-worker step-time ratios/z-scores
+  over the window (leave-one-out median/MAD, so one slow worker cannot
+  hide inside its own baseline), exposed as ``cluster.straggler_*``
+  gauges plus a ``straggler`` RunLog event on flag transitions.  The
+  elastic controller consumes the report via a pluggable hook
+  (``snapshot_straggler_hook``) so a persistent straggler can trigger
+  the existing replan path within a budget (default off).
+
+Everything is gated by ``HETU_TPU_TELEMETRY_PUSH`` (unset = no push op
+ever hits the wire) and deterministic on CPU — the chaos harness drives
+the acceptance test.  See docs/observability.md for the wire format and
+the ClusterSnapshot field reference.
+"""
+from __future__ import annotations
+
+import itertools
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("obs.aggregate")
+
+#: RunLog kinds worth shipping cluster-wide (step records travel on the
+#: dedicated ``steps`` channel; raw per-step records would dwarf the push)
+EVENT_KINDS = ("compile", "anomaly", "straggler", "fault", "elastic_epoch",
+               "switch")
+
+_boot_counter = itertools.count()
+
+
+def _default_registry():
+    from hetu_tpu.obs.metrics import get_registry
+    return get_registry()
+
+
+def flat_series(name: str, labels: Dict[str, Any]) -> str:
+    """One stable string key per (name, labels) series — the wire form of
+    the registry's tuple keys (``rpc.op_retries{op=put}``)."""
+    if not labels:
+        return name
+    inner = ",".join(f"{k}={v}" for k, v in sorted(labels.items()))
+    return f"{name}{{{inner}}}"
+
+
+def push_interval() -> float:
+    """Seconds between telemetry pushes from HETU_TPU_TELEMETRY_PUSH
+    (0.0 = telemetry push disabled — the default)."""
+    from hetu_tpu.utils import flags
+    raw = flags.str_flag("HETU_TPU_TELEMETRY_PUSH").strip()
+    if not raw:
+        return 0.0
+    try:
+        v = float(raw)
+    except ValueError:
+        raise ValueError(
+            f"HETU_TPU_TELEMETRY_PUSH={raw!r} is not a push interval in "
+            "seconds (e.g. '2.0'; unset/empty = off)") from None
+    return max(v, 0.0)
+
+
+def _percentile(sorted_vals: List[float], p: float) -> Optional[float]:
+    from hetu_tpu.obs.metrics import percentile_of_sorted
+    return percentile_of_sorted(sorted_vals, p)
+
+
+def _median(vals: List[float]) -> Optional[float]:
+    return _percentile(sorted(vals), 50) if vals else None
+
+
+# ---------------------------------------------------------------------------
+# worker side
+# ---------------------------------------------------------------------------
+
+class TelemetrySource:
+    """Worker-side delta encoder for the ``telemetry_push`` payload.
+
+    Counters are delta-encoded against the last *built* payload: the
+    registry's values at construction are the baseline (a source built in
+    a long-lived process must not re-ship history).  The TelemetryPusher
+    re-sends a failed payload with its original (boot, seq) identity, so
+    deltas are never lost or double-shipped; :meth:`unpush` exists for
+    manual senders that abandon a payload instead.  Step records arrive
+    via :meth:`note_step` (the elastic loop measures wall time around
+    ``train_step``); RunLog tail events drain from ``runlog_fn()`` at
+    payload-build time.
+    """
+
+    def __init__(self, worker: int, registry=None,
+                 runlog_fn: Optional[Callable[[], Any]] = None,
+                 max_steps: int = 512, max_events: int = 128):
+        self.worker = int(worker)
+        self.registry = registry if registry is not None \
+            else _default_registry()
+        self._runlog_fn = runlog_fn
+        self._max_steps = max_steps
+        self._max_events = max_events
+        #: restarts are visible server-side as a boot change: the pid and
+        #: an in-process counter make the id unique per source incarnation
+        self.boot = f"{os.getpid()}.{next(_boot_counter)}"
+        self.seq = 0
+        self._lock = threading.Lock()
+        self._steps: List[List[Any]] = []
+        self._events: List[Dict[str, Any]] = []
+        self._last_counters: Dict[str, float] = {
+            flat_series(rec["name"], rec["labels"]): rec["value"]
+            for rec in self.registry.snapshot()["counters"]}
+
+    # ------------------------------------------------------------------
+    def note_step(self, step: int, step_time_s: float, *,
+                  loss: Optional[float] = None,
+                  tokens_per_s: Optional[float] = None,
+                  t: Optional[float] = None):
+        """Record one completed training step for the next push."""
+        rec = [int(step), float(time.time() if t is None else t),
+               float(step_time_s),
+               None if loss is None else float(loss),
+               None if tokens_per_s is None else float(tokens_per_s)]
+        with self._lock:
+            self._steps.append(rec)
+            del self._steps[:-self._max_steps]
+
+    def note_event(self, rec: Dict[str, Any]):
+        """Queue a RunLog-shaped record (compile/anomaly/...) for the next
+        push.  Kinds outside EVENT_KINDS are dropped (step records travel
+        on the dedicated channel)."""
+        if rec.get("kind") not in EVENT_KINDS:
+            return
+        with self._lock:
+            self._events.append(rec)
+            del self._events[:-self._max_events]
+
+    def has_data(self) -> bool:
+        """Steps/events queued for the next payload (counter deltas are
+        only visible at build time — this is the cheap pre-check the
+        final flush uses)."""
+        with self._lock:
+            return bool(self._steps or self._events)
+
+    # ------------------------------------------------------------------
+    def payload(self, hb_rtt_s: Optional[float] = None) -> Dict[str, Any]:
+        """Build (and COMMIT) the next delta payload.  The caller owns
+        delivery: on permanent failure call :meth:`unpush` to merge the
+        payload back, else its deltas are lost."""
+        runlog = self._runlog_fn() if self._runlog_fn is not None else None
+        if runlog is not None:
+            for rec in getattr(runlog, "drain_tail", lambda: [])():
+                self.note_event(rec)
+        snap = self.registry.snapshot()
+        with self._lock:
+            counters: Dict[str, float] = {}
+            for rec in snap["counters"]:
+                key = flat_series(rec["name"], rec["labels"])
+                delta = rec["value"] - self._last_counters.get(key, 0.0)
+                if delta:
+                    counters[key] = delta
+                self._last_counters[key] = rec["value"]
+            gauges = {flat_series(rec["name"], rec["labels"]): rec["value"]
+                      for rec in snap["gauges"]}
+            self.seq += 1
+            out = {"worker": self.worker, "boot": self.boot,
+                   "seq": self.seq, "t": time.time(),
+                   "hb_rtt_s": hb_rtt_s, "counters": counters,
+                   "gauges": gauges, "steps": self._steps,
+                   "events": self._events}
+            self._steps = []
+            self._events = []
+        return out
+
+    def unpush(self, payload: Dict[str, Any]):
+        """Merge an undeliverable payload back so the next push re-ships
+        its counter deltas, steps and events (idempotent bookkeeping:
+        the server never saw this seq)."""
+        with self._lock:
+            for key, delta in payload.get("counters", {}).items():
+                self._last_counters[key] = \
+                    self._last_counters.get(key, 0.0) - delta
+            self._steps = (list(payload.get("steps", []))
+                           + self._steps)[-self._max_steps:]
+            self._events = (list(payload.get("events", []))
+                            + self._events)[-self._max_events:]
+
+
+class TelemetryPusher:
+    """Periodic telemetry push loop over a CoordinationClient.
+
+    ``interval`` defaults to the HETU_TPU_TELEMETRY_PUSH flag; 0 means
+    the pusher never starts a thread (``push_now()`` still works for
+    deterministic tests).  A payload whose delivery fails is held as
+    PENDING and re-sent **with the same (boot, seq) identity** on the
+    next beat — not rebuilt — so the case where the server applied the
+    push but the ack was lost in the tear resolves as a server-side
+    dup-ack, never a double-count.  Steps/events that accumulate while a
+    payload is pending simply ride the next one; nothing is lost.  A
+    lock serializes pushes, so close()'s final flush cannot race an
+    in-flight delivery and reorder seqs.
+    """
+
+    def __init__(self, client, source: Optional[TelemetrySource] = None,
+                 interval: Optional[float] = None, registry=None,
+                 runlog_fn: Optional[Callable[[], Any]] = None,
+                 start: bool = True):
+        self.client = client
+        self.registry = registry if registry is not None \
+            else _default_registry()
+        self.source = source or TelemetrySource(
+            client.rank, registry=self.registry, runlog_fn=runlog_fn)
+        self.interval = push_interval() if interval is None else \
+            float(interval)
+        self.pushes = 0
+        self.failures = 0
+        self._pending: Optional[Dict[str, Any]] = None
+        self._push_lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        if start and self.interval > 0:
+            self._thread = threading.Thread(target=self._loop, daemon=True)
+            self._thread.start()
+
+    def push_now(self) -> bool:
+        """One push, synchronously.  Returns delivery success.  Delivers
+        the pending (previously failed) payload before building a new
+        one; on failure the payload is kept pending for the next call."""
+        with self._push_lock:
+            payload = self._pending
+            if payload is None:
+                rtt_h = self.registry.histogram("rpc.heartbeat_rtt_s",
+                                                rank=self.client.rank)
+                payload = self.source.payload(
+                    hb_rtt_s=(rtt_h.percentile(50)
+                              if rtt_h is not None else None))
+            try:
+                self.client.telemetry_push(payload)
+            except Exception as e:
+                # keep the SAME payload (same seq) for the next beat: if
+                # the server DID apply it and only the ack was lost, the
+                # re-send dedupes server-side instead of double-counting
+                self._pending = payload
+                self.failures += 1
+                self.registry.inc("rpc.telemetry_push_failures")
+                logger.warning(f"telemetry push seq {payload['seq']} "
+                               f"failed ({e!r}); held pending for the "
+                               "next beat")
+                return False
+            self._pending = None
+            self.pushes += 1
+            self.registry.inc("rpc.telemetry_pushes")
+            return True
+
+    def _loop(self):
+        while not self._stop.wait(self.interval):
+            if getattr(self.client, "stale", False) or \
+                    getattr(self.client, "_shutdown", False):
+                return
+            self.push_now()
+
+    def close(self, final_push: bool = True):
+        """Stop the loop; by default flush one last payload so the tail
+        of the run (final steps, summary events) reaches the server.
+        The push lock serializes with any in-flight loop delivery even
+        if the join timed out on a wedged transport."""
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5.0)
+            self._thread = None
+        if final_push and not getattr(self.client, "stale", False):
+            try:
+                if self.push_now() and (self.source.has_data()
+                                        or self._pending is not None):
+                    self.push_now()   # the pending payload flushed; now
+                                      # ship what accumulated behind it
+            except Exception:
+                pass
+
+
+# ---------------------------------------------------------------------------
+# server side
+# ---------------------------------------------------------------------------
+
+class ClusterSnapshot(dict):
+    """The time-windowed cluster view the aggregator renders — a plain
+    JSON-serializable dict (it crosses the rpc wire as-is):
+
+        {"t": <server time>, "window_s": w,
+         "workers": {"<rank>": {
+             "steps_total", "steps_window", "step_rate",
+             "step_time_p50"/"p95"/"mean", "loss", "tokens_per_s",
+             "estimated_mfu", "comm_bytes_per_step",
+             "heartbeat_gap_s", "last_push_age_s", "clock_offset_s",
+             "pushes", "dup_pushes", "anomalies": {kind: n},
+             "counters": {series: total}, "gauges": {series: value}}}}
+
+    Worker keys are STRINGS (JSON object keys) — use ``int(rank)`` when
+    ordering numerically."""
+
+
+class _WorkerState:
+    __slots__ = ("boot", "last_seq", "counters", "gauges", "steps",
+                 "events", "anomalies", "last_push_t", "clock_offset_s",
+                 "pushes", "dup_pushes", "estimated_mfu", "comm_bytes",
+                 "steps_total")
+
+    def __init__(self):
+        self.boot: Optional[str] = None
+        self.last_seq = -1
+        self.counters: Dict[str, float] = {}
+        self.gauges: Dict[str, float] = {}
+        self.steps: List[tuple] = []     # (step, t_worker, dt, loss, tps)
+        self.events: List[Dict[str, Any]] = []
+        self.anomalies: Dict[str, int] = {}
+        self.last_push_t: Optional[float] = None
+        self.clock_offset_s: Optional[float] = None
+        self.pushes = 0
+        self.dup_pushes = 0
+        self.estimated_mfu: Optional[float] = None
+        self.comm_bytes: Optional[float] = None
+        self.steps_total = 0
+
+
+class ClusterAggregator:
+    """Folds workers' telemetry pushes into the ClusterSnapshot.
+
+    Monotonic-counter delta merging: each worker's counters accumulate
+    from shipped deltas; a duplicated delivery (same ``(boot, seq)`` —
+    a client retry after a reattach, or a chaos ``rpc_dup``) is applied
+    exactly once, and a **boot change** (worker restart) resets the seq
+    watermark while keeping the cumulative totals — restarts never
+    double-count and never lose history."""
+
+    def __init__(self, window_s: float = 60.0, max_steps: int = 2048,
+                 max_events: int = 64, runlog=None, registry=None):
+        self.window_s = float(window_s)
+        self._max_steps = max_steps
+        self._max_events = max_events
+        self.runlog = runlog
+        self.registry = registry if registry is not None \
+            else _default_registry()
+        self._lock = threading.Lock()
+        self._workers: Dict[int, _WorkerState] = {}
+        self._last_flagged: frozenset = frozenset()
+
+    # ------------------------------------------------------------------
+    def ingest(self, payload: Dict[str, Any],
+               recv_t: Optional[float] = None) -> Dict[str, Any]:
+        """Fold one push payload; returns the ack ({'applied', 'seq'})."""
+        now = time.time() if recv_t is None else recv_t
+        worker = int(payload["worker"])
+        boot, seq = payload.get("boot"), int(payload.get("seq", 0))
+        with self._lock:
+            st = self._workers.setdefault(worker, _WorkerState())
+            if boot == st.boot and seq <= st.last_seq:
+                st.dup_pushes += 1
+                self.registry.inc("cluster.telemetry_dup_pushes")
+                return {"applied": False, "seq": st.last_seq}
+            if boot != st.boot:
+                # a restarted worker: fresh seq space, cumulative counters
+                # carry on (its source re-baselined at 0, so deltas are
+                # counts since restart — no overlap with history)
+                st.boot, st.last_seq = boot, -1
+            st.last_seq = seq
+            for key, delta in payload.get("counters", {}).items():
+                st.counters[key] = st.counters.get(key, 0.0) + float(delta)
+            st.gauges.update(payload.get("gauges", {}))
+            send_t = payload.get("t")
+            if send_t is not None:
+                # worker-clock -> server-clock offset, the heartbeat-RTT
+                # estimate: recv = send + offset + rtt/2
+                rtt = payload.get("hb_rtt_s") or 0.0
+                off = now - float(send_t) - rtt / 2.0
+                st.clock_offset_s = off if st.clock_offset_s is None else \
+                    0.8 * st.clock_offset_s + 0.2 * off
+            for s in payload.get("steps", []):
+                st.steps.append(tuple(s))
+                st.steps_total += 1
+            del st.steps[:-self._max_steps]
+            for ev in payload.get("events", []):
+                kind = ev.get("kind")
+                if kind == "compile":
+                    if ev.get("estimated_mfu") is not None:
+                        st.estimated_mfu = float(ev["estimated_mfu"])
+                    if ev.get("comm_bytes") is not None:
+                        st.comm_bytes = float(ev["comm_bytes"])
+                elif kind == "anomaly":
+                    k = str(ev.get("anomaly", "unknown"))
+                    st.anomalies[k] = st.anomalies.get(k, 0) + 1
+                st.events.append(ev)
+                del st.events[:-self._max_events]
+            st.last_push_t = now
+            st.pushes += 1
+        self.registry.inc("cluster.telemetry_pushes")
+        return {"applied": True, "seq": seq}
+
+    # ------------------------------------------------------------------
+    def worker_counter(self, worker: int, series: str) -> float:
+        """Cumulative value of one worker's pushed counter series."""
+        with self._lock:
+            st = self._workers.get(int(worker))
+            return 0.0 if st is None else st.counters.get(series, 0.0)
+
+    def snapshot(self, window_s: Optional[float] = None,
+                 heartbeats: Optional[Dict[int, float]] = None,
+                 now: Optional[float] = None) -> ClusterSnapshot:
+        """Render the ClusterSnapshot over the trailing window.
+        ``heartbeats`` ({rank: gap_s}, from the coordination server's
+        beat bookkeeping) enriches workers with their heartbeat gap."""
+        w = self.window_s if window_s is None else float(window_s)
+        now = time.time() if now is None else now
+        workers: Dict[str, Dict[str, Any]] = {}
+        with self._lock:
+            for rank in sorted(self._workers):
+                st = self._workers[rank]
+                off = st.clock_offset_s or 0.0
+                recent = [s for s in st.steps
+                          if now - (s[1] + off) <= w]
+                times = sorted(s[2] for s in recent)
+                losses = [s[3] for s in recent if s[3] is not None]
+                tps = [s[4] for s in recent if s[4] is not None]
+                workers[str(rank)] = {
+                    "steps_total": st.steps_total,
+                    "steps_window": len(recent),
+                    "step_rate": len(recent) / w if w > 0 else None,
+                    "step_time_p50": _percentile(times, 50),
+                    "step_time_p95": _percentile(times, 95),
+                    "step_time_mean": (sum(times) / len(times)
+                                       if times else None),
+                    "loss": losses[-1] if losses else None,
+                    "tokens_per_s": tps[-1] if tps else None,
+                    "estimated_mfu": st.estimated_mfu,
+                    "comm_bytes_per_step": st.comm_bytes,
+                    "last_push_age_s": (None if st.last_push_t is None
+                                        else now - st.last_push_t),
+                    "clock_offset_s": st.clock_offset_s,
+                    "pushes": st.pushes,
+                    "dup_pushes": st.dup_pushes,
+                    "anomalies": dict(st.anomalies),
+                    "counters": dict(st.counters),
+                    "gauges": dict(st.gauges),
+                }
+        for rank, gap in (heartbeats or {}).items():
+            workers.setdefault(str(rank), {})["heartbeat_gap_s"] = gap
+        return ClusterSnapshot(t=now, window_s=w, workers=workers)
+
+    # ------------------------------------------------------------------
+    def straggler_report(self, snapshot: Optional[Dict[str, Any]] = None,
+                         **kw) -> Dict[str, Any]:
+        """Compute the straggler report over a snapshot (defaults to a
+        fresh one), publish `cluster.straggler_*` gauges, and log a
+        `straggler` RunLog event when the flagged set changes."""
+        snap = snapshot if snapshot is not None else self.snapshot()
+        report = straggler_report(snap, **kw)
+        for rank_s, rec in report["workers"].items():
+            self.registry.set_gauge("cluster.straggler_ratio",
+                                    rec["ratio"], rank=rank_s)
+            self.registry.set_gauge("cluster.straggler_z",
+                                    rec["z"], rank=rank_s)
+        flagged = frozenset(report["stragglers"])
+        newly = flagged - self._last_flagged
+        if newly:
+            self.registry.inc("cluster.stragglers_flagged", len(newly))
+        if flagged != self._last_flagged:
+            self._last_flagged = flagged
+            if self.runlog is not None:
+                self.runlog.log(
+                    "straggler", stragglers=sorted(flagged),
+                    workers={r: {k: rec[k] for k in
+                                 ("step_time_p50", "baseline_p50",
+                                  "ratio", "z", "straggler")}
+                             for r, rec in report["workers"].items()})
+        return report
+
+
+def straggler_report(snapshot: Dict[str, Any], *,
+                     ratio_threshold: float = 2.0,
+                     z_threshold: float = 3.0,
+                     min_samples: int = 3) -> Dict[str, Any]:
+    """Robust per-worker step-time straggler scoring over a
+    ClusterSnapshot (pure function — no gauges, no log).
+
+    Each worker's window-median step time is compared against the
+    **leave-one-out** median of the other workers' medians (so a slow
+    worker cannot hide inside its own baseline), with a MAD-scaled
+    z-score.  At small world sizes the MAD degenerates (2 workers: the
+    spread of one sample is 0), so the scale is floored at 0.1% of the
+    baseline and the FLAG rule requires ratio AND z: the ratio carries
+    the decision when the spread is degenerate, the z-score guards
+    against flagging wide-but-normal distributions.
+    """
+    per: Dict[str, Dict[str, Any]] = {}
+    meds = {}
+    for rank_s, w in snapshot.get("workers", {}).items():
+        if w.get("step_time_p50") is not None and \
+                w.get("steps_window", 0) >= min_samples:
+            meds[rank_s] = float(w["step_time_p50"])
+    for rank_s, med in meds.items():
+        others = [m for r, m in meds.items() if r != rank_s]
+        if not others:
+            continue
+        base = _median(others)
+        mad = _median([abs(m - base) for m in others]) or 0.0
+        scale = 1.4826 * mad + 1e-3 * base + 1e-12
+        z = (med - base) / scale
+        ratio = med / base if base > 0 else math.inf
+        per[rank_s] = {
+            "step_time_p50": med, "baseline_p50": base,
+            "ratio": ratio, "z": z,
+            "straggler": bool(ratio >= ratio_threshold
+                              and z >= z_threshold),
+        }
+    return {"t": snapshot.get("t"), "window_s": snapshot.get("window_s"),
+            "workers": per,
+            "stragglers": sorted(int(r) for r, rec in per.items()
+                                 if rec["straggler"])}
+
+
+def snapshot_straggler_hook(window_s: Optional[float] = None):
+    """A ready-made straggler hook for ElasticController: fetch the
+    coordinator's snapshot+report via the worker's own client."""
+    def hook(client) -> Optional[Dict[str, Any]]:
+        resp = client.telemetry_snapshot(window_s=window_s)
+        return resp.get("straggler")
+    return hook
+
+
+def merge_offsets(snapshot: Dict[str, Any]) -> Dict[str, float]:
+    """{worker: clock_offset_s} from a ClusterSnapshot — feed to
+    obs.trace.merge_runlogs to align per-worker RunLogs on server time."""
+    out: Dict[str, float] = {}
+    for rank_s, w in snapshot.get("workers", {}).items():
+        off = w.get("clock_offset_s")
+        if off is not None:
+            out[rank_s] = float(off)
+    return out

@@ -1,0 +1,211 @@
+"""Training health monitor: online anomaly detection in the trainer loop.
+
+The reference's observability surface stops at recording costs; nothing
+watches the run.  ``HealthMonitor`` closes that: per-step EWMA+MAD
+detectors for the failure shapes that silently waste TPU-days —
+
+    loss_spike             loss jumps far above its EWMA baseline
+    nan_loss / nan_grad    non-finite loss / grad norm (an AMP overflow
+                           cascade, a data corruption, a bad kernel)
+    grad_blowup            grad-norm explosion above baseline
+    step_time_regression   step time regresses (a straggling host, a
+                           silent recompile, thermal throttling)
+    data_stall             the gap BETWEEN steps (host/input time) blows
+                           up — the data pipeline, not the device
+
+Each firing increments a ``health.<kind>`` counter, emits an ``anomaly``
+RunLog event, rides the telemetry push to the coordinator (via the
+TelemetrySource, when one is attached), and — for the severe kinds —
+can invoke the emergency-checkpoint hook (PR 3's bank-state-now path) so
+a dying run leaves a fresh checkpoint behind.
+
+Detectors use an EWMA mean plus an EWMA absolute deviation (the online
+stand-in for median/MAD — robust enough for thresholds, O(1) state) and
+fire only after ``warmup`` observations; a per-kind cooldown stops one
+regime shift from spamming hundreds of events while the EWMA
+re-baselines.  Gated by ``HETU_TPU_HEALTH`` (unset = the trainer does
+zero per-step health work); thresholds are constructor knobs, documented
+in docs/observability.md.
+"""
+from __future__ import annotations
+
+import math
+import time
+from typing import Any, Dict, List, Optional
+
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("obs.health")
+
+#: MAD -> sigma consistency constant (same convention as the straggler
+#: scoring in obs.aggregate)
+_MAD_SIGMA = 1.4826
+
+
+class Ewma:
+    """EWMA mean + EWMA absolute deviation, with a sample count."""
+
+    __slots__ = ("alpha", "mean", "dev", "n")
+
+    def __init__(self, alpha: float = 0.1):
+        self.alpha = alpha
+        self.mean: Optional[float] = None
+        self.dev = 0.0
+        self.n = 0
+
+    def update(self, v: float):
+        if self.mean is None:
+            self.mean = v
+        else:
+            a = self.alpha
+            self.dev = (1 - a) * self.dev + a * abs(v - self.mean)
+            self.mean = (1 - a) * self.mean + a * v
+        self.n += 1
+
+
+class HealthMonitor:
+    """Per-step anomaly detection for a training loop.
+
+    Call :meth:`observe_step` once per completed step.  Returns the list
+    of anomalies fired on that step (empty almost always) — the caller
+    never needs to look at it; counters/RunLog carry the signal.
+
+    ``emergency_hook`` (no-arg callable, e.g. a bound ``save``) runs on
+    kinds in ``emergency_kinds`` — best-effort, never raises into the
+    training loop.
+    """
+
+    KINDS = ("loss_spike", "nan_loss", "nan_grad", "grad_blowup",
+             "step_time_regression", "data_stall")
+
+    def __init__(self, runlog=None, registry=None, source=None,
+                 emergency_hook=None,
+                 emergency_kinds=("nan_loss", "nan_grad"),
+                 warmup: int = 8, alpha: float = 0.1,
+                 loss_k: float = 6.0, grad_k: float = 8.0,
+                 step_time_k: float = 6.0, step_time_ratio: float = 2.0,
+                 stall_ratio: float = 5.0, stall_min_s: float = 1.0,
+                 cooldown_steps: int = 16):
+        from hetu_tpu.obs.metrics import get_registry
+        self.runlog = runlog
+        self.registry = registry if registry is not None else get_registry()
+        self.source = source          # optional obs.aggregate.TelemetrySource
+        self.emergency_hook = emergency_hook
+        self.emergency_kinds = frozenset(emergency_kinds)
+        self.warmup = warmup
+        self.loss_k, self.grad_k = loss_k, grad_k
+        self.step_time_k, self.step_time_ratio = step_time_k, step_time_ratio
+        self.stall_ratio, self.stall_min_s = stall_ratio, stall_min_s
+        self.cooldown_steps = cooldown_steps
+        self._loss = Ewma(alpha)
+        self._grad = Ewma(alpha)
+        self._step_time = Ewma(alpha)
+        self._fetch = Ewma(alpha)
+        self._last_t: Optional[float] = None
+        self._cooldown_until: Dict[str, int] = {}
+        self.anomalies_total = 0
+
+    # ------------------------------------------------------------------
+    def _spike(self, ewma: Ewma, v: float, k: float,
+               ratio: Optional[float] = None) -> bool:
+        """v far above the EWMA baseline.  Two independent rules, either
+        fires: the additive `mean + k*MAD-sigma` (catches spikes in noisy
+        signals, where sigma is meaningful) OR the multiplicative
+        `mean * ratio` (carries the decision on steady signals whose
+        deviation converged to ~0 — and stays live while a sustained
+        regression is inflating the deviation, where the additive
+        threshold chases the anomaly)."""
+        if ewma.n < self.warmup or ewma.mean is None:
+            return False
+        if v > ewma.mean + k * (_MAD_SIGMA * ewma.dev
+                                + 1e-3 * abs(ewma.mean) + 1e-12):
+            return True
+        return ratio is not None and v > ewma.mean * ratio
+
+    def _fire(self, kind: str, step: int, value: float,
+              baseline: Optional[float], t: float,
+              out: List[Dict[str, Any]]):
+        if step < self._cooldown_until.get(kind, -1):
+            return
+        self._cooldown_until[kind] = step + self.cooldown_steps
+        self.anomalies_total += 1
+        self.registry.inc(f"health.{kind}")
+        self.registry.inc("health.anomalies")
+        rec = {"kind": "anomaly", "t": t, "anomaly": kind, "step": step,
+               "value": value, "baseline": baseline}
+        logger.warning(f"anomaly[{kind}] at step {step}: value={value!r} "
+                       f"baseline={baseline!r}")
+        if self.runlog is not None:
+            written = self.runlog.log("anomaly", anomaly=kind, step=step,
+                                      value=value, baseline=baseline)
+            rec = written or rec
+        if self.source is not None:
+            self.source.note_event(rec)
+        out.append(rec)
+        if self.emergency_hook is not None and kind in self.emergency_kinds:
+            try:
+                self.emergency_hook()
+                self.registry.inc("health.emergency_saves")
+            except Exception as e:   # telemetry never kills a step
+                self.registry.inc("health.emergency_save_failures")
+                logger.error(f"emergency hook for {kind} failed: {e!r}")
+
+    # ------------------------------------------------------------------
+    def observe_step(self, step: int, step_time_s: float, *,
+                     loss: Optional[float] = None,
+                     grad_norm: Optional[float] = None,
+                     t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one completed step; returns anomalies fired (usually [])."""
+        t = time.time() if t is None else t
+        fired: List[Dict[str, Any]] = []
+
+        # data stall: host/input time = inter-observe gap minus the step
+        # itself.  The device can be perfectly healthy while the input
+        # pipeline starves it — that shows up HERE and nowhere else.
+        if self._last_t is not None:
+            fetch = max(0.0, (t - self._last_t) - step_time_s)
+            if self._fetch.n >= self.warmup and fetch > max(
+                    self.stall_min_s,
+                    (self._fetch.mean or 0.0) * self.stall_ratio):
+                self._fire("data_stall", step, fetch, self._fetch.mean,
+                           t, fired)
+            self._fetch.update(fetch)
+        self._last_t = t
+
+        if loss is not None:
+            if not math.isfinite(loss):
+                self._fire("nan_loss", step, loss, self._loss.mean, t, fired)
+            else:
+                if self._spike(self._loss, loss, self.loss_k):
+                    self._fire("loss_spike", step, loss, self._loss.mean,
+                               t, fired)
+                self._loss.update(loss)
+
+        if grad_norm is not None:
+            if not math.isfinite(grad_norm):
+                self._fire("nan_grad", step, grad_norm, self._grad.mean,
+                           t, fired)
+            else:
+                if self._spike(self._grad, grad_norm, self.grad_k):
+                    self._fire("grad_blowup", step, grad_norm,
+                               self._grad.mean, t, fired)
+                self._grad.update(grad_norm)
+
+        if self._spike(self._step_time, step_time_s, self.step_time_k,
+                       ratio=self.step_time_ratio):
+            self._fire("step_time_regression", step, step_time_s,
+                       self._step_time.mean, t, fired)
+        self._step_time.update(step_time_s)
+        return fired
+
+
+def maybe_health_monitor(runlog=None, source=None, emergency_hook=None,
+                         **kw) -> Optional[HealthMonitor]:
+    """A HealthMonitor when HETU_TPU_HEALTH is set, else None — the one
+    gate every training loop uses, so 'flag unset' provably means zero
+    per-step health work (a single None check)."""
+    from hetu_tpu.utils import flags
+    if not flags.bool_flag("HETU_TPU_HEALTH"):
+        return None
+    return HealthMonitor(runlog=runlog, source=source,
+                         emergency_hook=emergency_hook, **kw)

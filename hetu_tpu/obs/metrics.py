@@ -31,6 +31,19 @@ def _label_key(labels: Dict[str, Any]) -> _LabelKey:
     return tuple(sorted((str(k), str(v)) for k, v in labels.items()))
 
 
+def percentile_of_sorted(sorted_vals: List[float],
+                         p: float) -> Optional[float]:
+    """Nearest-rank percentile (p in [0, 100]) over an ascending list —
+    THE percentile definition every obs surface shares (Histogram,
+    cluster aggregation), so worker-side and cluster-side p50/p95 can
+    never diverge on rounding semantics."""
+    if not sorted_vals:
+        return None
+    idx = min(len(sorted_vals) - 1,
+              max(0, int(round(p / 100.0 * (len(sorted_vals) - 1)))))
+    return sorted_vals[idx]
+
+
 class Histogram:
     """Bounded-reservoir timing histogram.
 
@@ -64,11 +77,7 @@ class Histogram:
 
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100] over the reservoir (exact until `cap` samples)."""
-        if not self._sample:
-            return None
-        s = sorted(self._sample)
-        idx = min(len(s) - 1, max(0, int(round(p / 100.0 * (len(s) - 1)))))
-        return s[idx]
+        return percentile_of_sorted(sorted(self._sample), p)
 
     def summary(self) -> Dict[str, Any]:
         out = {"count": self.count, "sum": self.total,

@@ -19,15 +19,36 @@ Kind fields:
     fault         fault (ckpt_corrupt | step_exception |
                   restore_unrecoverable), generation, detail/error —
                   observed-fault accounting (docs/fault_tolerance.md)
+    anomaly       anomaly (obs.health.HealthMonitor.KINDS), step, value,
+                  baseline — online health-detector firings
+    straggler     stragglers (flagged ranks), workers (per-rank
+                  ratio/z) — the cluster straggler report transitions
+    rotated       segment, records — the size-cap rotation marker (the
+                  last record of a rotated segment)
     summary       metrics (a MetricsRegistry snapshot), profiler summary
 
 The writer is append-only and flushes per record by default: a preempted
 TPU worker's log is valid up to its last completed step.
+
+Long runs can size-cap the log: with ``HETU_TPU_RUNLOG_MAX_MB`` set (or
+``max_bytes`` passed), a segment that overflows the cap is closed with a
+``rotated`` marker record and renamed to ``<path>.<n>`` (n increasing —
+``<path>.1`` is the OLDEST segment), and a fresh segment opens at
+``path``.  ``iter_records``/``read`` follow the whole chain in
+chronological order, so downstream tooling (tools_obs_report,
+trace_from_runlog) never notices the rotation.
+
+An optional in-memory tail buffer (``tail_records``) keeps the last N
+records for the cluster telemetry push (obs.aggregate drains it with
+``drain_tail()``); it works even after a disk-write failure disabled the
+file writer — telemetry keeps flowing when the disk does not.
 """
 from __future__ import annotations
 
+import collections
 import json
 import os
+import re
 import threading
 import time
 from typing import Any, Dict, Iterator, List, Optional
@@ -42,7 +63,8 @@ REQUIRED_FIELDS = ("schema", "kind", "t")
 class RunLog:
     """Append-only JSONL run-event writer."""
 
-    def __init__(self, path: str, flush_every: int = 1):
+    def __init__(self, path: str, flush_every: int = 1,
+                 max_bytes: Optional[int] = None, tail_records: int = 0):
         self.path = path
         d = os.path.dirname(path)
         if d:
@@ -52,6 +74,18 @@ class RunLog:
         self._flush_every = max(1, flush_every)
         self._since_flush = 0
         self.records_written = 0
+        if max_bytes is None:
+            from hetu_tpu.utils import flags
+            mb = flags.int_flag("HETU_TPU_RUNLOG_MAX_MB")
+            max_bytes = mb * (1 << 20) if mb > 0 else None
+        self._max_bytes = max_bytes
+        try:
+            self._bytes = os.path.getsize(path)
+        except OSError:
+            self._bytes = 0
+        self.rotations = 0
+        self._tail = (collections.deque(maxlen=tail_records)
+                      if tail_records > 0 else None)
 
     # ------------------------------------------------------------------
     def log(self, kind: str, **fields) -> Dict[str, Any]:
@@ -59,15 +93,22 @@ class RunLog:
         rec.update(fields)
         line = json.dumps(rec, default=_jsonable)
         with self._lock:
+            if self._tail is not None:
+                # the telemetry tail rides even when the file writer is
+                # disabled/closed — cluster visibility outlives the disk
+                self._tail.append(json.loads(line))
             if self._f.closed:
                 return rec   # post-close stragglers (daemon threads) drop
             try:
                 self._f.write(line + "\n")
+                self._bytes += len(line) + 1
                 self._since_flush += 1
                 self.records_written += 1
                 if self._since_flush >= self._flush_every:
                     self._f.flush()
                     self._since_flush = 0
+                if self._max_bytes and self._bytes >= self._max_bytes:
+                    self._rotate_locked()
             except OSError as e:
                 # telemetry must not kill a step: a full disk / dead mount
                 # under the runlog disables the writer (warn once) while
@@ -93,6 +134,45 @@ class RunLog:
                         device_mem_bytes=device_mem_bytes, plan=plan,
                         **extra)
 
+    def _rotate_locked(self):
+        """Close the overflowing segment (ending it with a `rotated`
+        marker so readers can SEE the cut), rename it to the next
+        `<path>.<n>`, and start a fresh segment at `path`.  A rename
+        failure (exotic filesystems) disables rotation rather than the
+        log."""
+        idx = _max_segment_index(self.path) + 1
+        marker = {"schema": SCHEMA_VERSION, "kind": "rotated",
+                  "t": time.time(), "segment": idx,
+                  "records": self.records_written}
+        try:
+            self._f.write(json.dumps(marker) + "\n")
+            self._f.flush()
+            self._f.close()
+            os.replace(self.path, f"{self.path}.{idx}")
+            self._f = open(self.path, "a")
+            self._bytes = 0
+            self._since_flush = 0
+            self.rotations += 1
+        except OSError as e:
+            from hetu_tpu.utils.logging import get_logger
+            get_logger("obs.runlog").warning(
+                f"run log rotation of {self.path} failed ({e!r}); "
+                "disabling rotation for this run")
+            self._max_bytes = None
+            if self._f.closed:
+                # reopen append on whichever file survived the failure
+                self._f = open(self.path, "a")
+
+    def drain_tail(self) -> List[Dict[str, Any]]:
+        """Return-and-clear the in-memory tail (the telemetry push feed);
+        [] when the tail buffer is disabled."""
+        with self._lock:
+            if not self._tail:
+                return []
+            out = list(self._tail)
+            self._tail.clear()
+            return out
+
     def close(self):
         with self._lock:
             if not self._f.closed:
@@ -112,20 +192,51 @@ class RunLog:
         return list(RunLog.iter_records(path))
 
     @staticmethod
+    def segments(path: str) -> List[str]:
+        """All on-disk segments of a (possibly rotated) run log, oldest
+        first: `<path>.1`, `<path>.2`, ..., then `path` itself."""
+        out = [f"{path}.{n}" for n in _segment_indices(path)]
+        if os.path.exists(path) or not out:
+            out.append(path)
+        return out
+
+    @staticmethod
     def iter_records(path: str) -> Iterator[Dict[str, Any]]:
-        """Yields records, skipping torn trailing lines (a preempted
-        writer's final partial write must not poison the whole log)."""
-        with open(path) as f:
-            for line in f:
-                line = line.strip()
-                if not line:
-                    continue
-                try:
-                    rec = json.loads(line)
-                except ValueError:
-                    continue
-                if isinstance(rec, dict) and rec.get("kind"):
-                    yield rec
+        """Yields records across ALL rotated segments in chronological
+        order, skipping torn trailing lines (a preempted writer's final
+        partial write must not poison the whole log)."""
+        for seg in RunLog.segments(path):
+            with open(seg) as f:
+                for line in f:
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        rec = json.loads(line)
+                    except ValueError:
+                        continue
+                    if isinstance(rec, dict) and rec.get("kind"):
+                        yield rec
+
+
+def _segment_indices(path: str) -> List[int]:
+    """Sorted rotation indices n for which `<path>.<n>` exists."""
+    d, base = os.path.split(path)
+    pat = re.compile(re.escape(base) + r"\.(\d+)$")
+    out = []
+    try:
+        for name in os.listdir(d or "."):
+            m = pat.match(name)
+            if m:
+                out.append(int(m.group(1)))
+    except OSError:
+        pass
+    return sorted(out)
+
+
+def _max_segment_index(path: str) -> int:
+    idx = _segment_indices(path)
+    return idx[-1] if idx else 0
 
 
 def _jsonable(obj):

@@ -182,22 +182,21 @@ def schedule_bubble_fraction(pp: int, n_micro: int,
 # run-event conversion (RunLog -> timeline)
 # ---------------------------------------------------------------------------
 
-def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
-    """Convert RunLog records into a wall-clock timeline: step spans on a
-    'train' lane, hot-switch phases on a 'switch' lane, elastic epochs as
-    instants on an 'elastic' lane."""
-    recs = [r for r in records if isinstance(r, dict) and "t" in r]
-    tr = ChromeTrace()
-    if not recs:
-        return tr
-    t0 = min(float(r["t"]) for r in recs)
-    pid = "run"
-    tr.name_process(pid, "training run")
+def _name_run_lanes(tr: ChromeTrace, pid: Any, title: str):
+    tr.name_process(pid, title)
     tr.name_thread(pid, "train", "train steps")
     tr.name_thread(pid, "switch", "hot switches")
     tr.name_thread(pid, "elastic", "elastic epochs")
+    tr.name_thread(pid, "health", "anomalies / faults / stragglers")
+
+
+def _emit_run_events(tr: ChromeTrace, recs: Iterable[Dict[str, Any]],
+                     pid: Any, t0: float, offset_s: float = 0.0):
+    """Draw RunLog records into `tr` under process `pid`; each record's
+    wall time is shifted by `offset_s` (a worker-clock -> reference-clock
+    correction) before being made relative to `t0`."""
     for r in recs:
-        ts = (float(r["t"]) - t0) * 1e6
+        ts = (float(r["t"]) + offset_s - t0) * 1e6
         kind = r.get("kind")
         if kind == "step":
             dur = float(r.get("step_time_s") or 0.0) * 1e6
@@ -205,7 +204,8 @@ def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
             tr.add_complete(f"step {r.get('step')}", ts - dur, dur,
                             pid=pid, tid="train", cat="step",
                             args={k: r[k] for k in
-                                  ("loss", "tokens_per_s", "plan")
+                                  ("loss", "tokens_per_s", "plan",
+                                   "device_mem_bytes")
                                   if r.get(k) is not None})
         elif kind == "switch":
             dur = float(r.get("wall_s") or 0.0) * 1e6
@@ -222,4 +222,68 @@ def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
             dur = float(r.get("compile_s") or 0.0) * 1e6
             tr.add_complete(f"compile {r.get('name')}", ts - dur, dur,
                             pid=pid, tid="train", cat="compile")
+        elif kind == "anomaly":
+            tr.add_instant(f"anomaly {r.get('anomaly')}", ts, pid=pid,
+                           tid="health", cat="anomaly",
+                           args={k: r[k] for k in ("step", "value",
+                                                   "baseline")
+                                 if r.get(k) is not None})
+        elif kind == "fault":
+            tr.add_instant(f"fault {r.get('fault')}", ts, pid=pid,
+                           tid="health", cat="fault",
+                           args={k: r[k] for k in ("step", "detail",
+                                                   "error", "generation")
+                                 if r.get(k) is not None})
+        elif kind == "straggler":
+            tr.add_instant("straggler report", ts, pid=pid, tid="health",
+                           cat="straggler",
+                           args={"stragglers": r.get("stragglers")})
+
+
+def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
+    """Convert RunLog records into a wall-clock timeline: step spans on a
+    'train' lane, hot-switch phases on a 'switch' lane, elastic epochs as
+    instants on an 'elastic' lane, anomalies/faults/straggler reports on
+    a 'health' lane."""
+    recs = [r for r in records if isinstance(r, dict) and "t" in r]
+    tr = ChromeTrace()
+    if not recs:
+        return tr
+    t0 = min(float(r["t"]) for r in recs)
+    pid = "run"
+    _name_run_lanes(tr, pid, "training run")
+    _emit_run_events(tr, recs, pid, t0)
+    return tr
+
+
+def merge_runlogs(runlogs: Dict[Any, Iterable[Dict[str, Any]]],
+                  offsets_s: Optional[Dict[Any, float]] = None
+                  ) -> ChromeTrace:
+    """Merge several workers' RunLogs into ONE cluster timeline: pid per
+    worker, the same lanes per worker as `trace_from_runlog`, timestamps
+    aligned onto a common (server) clock via per-worker offsets.
+
+    `runlogs` maps worker id -> records (e.g. ``RunLog.read(path)`` per
+    worker); `offsets_s` maps worker id -> that worker's clock offset in
+    seconds (server_time ~= worker_time + offset).  The coordinator
+    estimates offsets from heartbeat-RTT-corrected telemetry pushes —
+    take them from a ClusterSnapshot with
+    ``obs.aggregate.merge_offsets(snapshot)``.  Missing offsets default
+    to 0 (same-host workers)."""
+    offsets = offsets_s or {}
+    per: Dict[Any, List[Dict[str, Any]]] = {}
+    for worker, records in runlogs.items():
+        per[worker] = [r for r in records
+                       if isinstance(r, dict) and "t" in r]
+    tr = ChromeTrace()
+    all_t = [float(r["t"]) + float(offsets.get(w, 0.0))
+             for w, recs in per.items() for r in recs]
+    if not all_t:
+        return tr
+    t0 = min(all_t)
+    for worker in sorted(per, key=str):
+        off = float(offsets.get(worker, 0.0))
+        pid = f"worker {worker}"
+        _name_run_lanes(tr, pid, f"worker {worker}")
+        _emit_run_events(tr, per[worker], pid, t0, offset_s=off)
     return tr

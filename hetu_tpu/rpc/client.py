@@ -42,7 +42,11 @@ logger = get_logger("rpc.client")
 #: table).
 _RETRYABLE_OPS = frozenset({
     "heartbeat", "get", "put", "membership", "barrier", "barrier_poll",
-    "worker_stop", "resume", "ps_init", "ps_pull", "exit"})
+    "worker_stop", "resume", "ps_init", "ps_pull", "exit",
+    # telemetry_push is idempotent by construction: the server folds each
+    # (worker, boot, seq) exactly once, so a retry whose first delivery
+    # DID land just acks without re-applying; telemetry_snapshot is a read
+    "telemetry_push", "telemetry_snapshot"})
 
 #: re-issue budget per op after reconnects (each retry means the transport
 #: was re-established in between; a chaos partition window of N dropped
@@ -405,6 +409,27 @@ class CoordinationClient:
         self.should_stop = stop
         return stop
 
+    # -- cluster telemetry (hetu_tpu/obs/aggregate.py) ------------------
+    def telemetry_push(self, payload: Dict[str, Any]) -> Dict[str, Any]:
+        """Ship one delta-encoded telemetry payload (a TelemetrySource
+        product) to the coordination server.  Safe to transport-retry:
+        the server dedupes on the payload's (worker, boot, seq)."""
+        from hetu_tpu.rpc.wire import encode_telemetry
+        resp = self._call({"op": "telemetry_push", "rank": self.rank,
+                           "data": encode_telemetry(payload)})
+        return {"applied": resp.get("applied"), "seq": resp.get("seq")}
+
+    def telemetry_snapshot(self,
+                           window_s: Optional[float] = None
+                           ) -> Dict[str, Any]:
+        """The coordinator's live ClusterSnapshot + straggler report."""
+        req: Dict[str, Any] = {"op": "telemetry_snapshot"}
+        if window_s is not None:
+            req["window_s"] = float(window_s)
+        resp = self._call(req)
+        return {"snapshot": resp.get("snapshot"),
+                "straggler": resp.get("straggler")}
+
     # -- parameter-server embedding tables (reference: v1 ps-lite worker
     # ops ParameterServerCommunicate.py pull/push; server side handlers in
     # rpc/server.py ps_init/ps_pull/ps_push) ---------------------------
@@ -445,5 +470,37 @@ class CoordinationClient:
             pass
         try:
             self._conn.close()
+        except OSError:
+            pass
+
+
+def fetch_cluster_snapshot(host: str, port: int,
+                           window_s: Optional[float] = None,
+                           timeout: float = 10.0) -> Dict[str, Any]:
+    """One-shot OBSERVER fetch of the ClusterSnapshot + straggler report.
+
+    Deliberately NOT a CoordinationClient: connecting one allocates a
+    rank and joins membership, so a dashboard poll would look like a
+    worker (and its disconnect like a worker death, stop-flagging the
+    whole cluster).  This opens a bare connection, exchanges a single
+    telemetry_snapshot, and leaves no trace — tools_cluster.py's path."""
+    conn = socket.create_connection((host, port), timeout=timeout)
+    try:
+        conn.settimeout(timeout)
+        req: Dict[str, Any] = {"op": "telemetry_snapshot"}
+        if window_s is not None:
+            req["window_s"] = float(window_s)
+        _send(conn, req)
+        resp = _recv(conn)
+        if resp is None:
+            raise ConnectionError("server closed during telemetry_snapshot")
+        if not resp.get("ok"):
+            raise RuntimeError(f"telemetry_snapshot error: "
+                               f"{resp.get('error')}")
+        return {"snapshot": resp.get("snapshot"),
+                "straggler": resp.get("straggler")}
+    finally:
+        try:
+            conn.close()
         except OSError:
             pass

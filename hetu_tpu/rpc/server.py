@@ -24,8 +24,9 @@ from typing import Any, Dict, Optional, Set
 
 import numpy as np
 
+from hetu_tpu.obs.aggregate import ClusterAggregator
 from hetu_tpu.obs.metrics import get_registry
-from hetu_tpu.rpc.wire import decode_rows, encode_rows
+from hetu_tpu.rpc.wire import decode_rows, decode_telemetry, encode_rows
 from hetu_tpu.utils.logging import get_logger
 
 logger = get_logger("rpc.server")
@@ -59,7 +60,8 @@ class CoordinationServer:
     def __init__(self, host: str = "127.0.0.1", port: int = 0,
                  world_size: Optional[int] = None,
                  heartbeat_timeout: float = 10.0,
-                 reattach_grace: Optional[float] = None):
+                 reattach_grace: Optional[float] = None,
+                 telemetry_window_s: float = 60.0):
         self.world_size = world_size
         self.heartbeat_timeout = heartbeat_timeout
         # how long a rank whose connection tore may `reattach` before it
@@ -86,6 +88,13 @@ class CoordinationServer:
         # (the monitor would mark every worker lost mid-transfer)
         self._ps: Dict[str, np.ndarray] = {}
         self._ps_lock = threading.Lock()
+        # cluster telemetry aggregation (hetu_tpu/obs/aggregate.py): folds
+        # workers' telemetry_push payloads into the time-windowed
+        # ClusterSnapshot.  Owns its own lock — ingest/snapshot must not
+        # stall heartbeats on the coordination lock.  Idle (no pushes —
+        # HETU_TPU_TELEMETRY_PUSH unset on the workers) it holds no state
+        # and costs nothing.
+        self.telemetry = ClusterAggregator(window_s=telemetry_window_s)
         self._shutdown = False
         self._threads = []
         self._conns = []
@@ -254,6 +263,11 @@ class CoordinationServer:
         op = req.get("op")
         if isinstance(op, str) and op.startswith("ps_"):
             return self._handle_ps(op, req)
+        if op in ("telemetry_push", "telemetry_snapshot"):
+            # the aggregator has its own lock; a fat push/snapshot must
+            # not stall heartbeats on the coordination lock (same policy
+            # as the PS tables)
+            return self._handle_telemetry(op, req)
         with self._lock:
             if op == "connect":        # Connect + GetRank
                 rank = self._next_rank
@@ -402,6 +416,38 @@ class CoordinationServer:
                     conn_state["clean"] = True
                 return {"ok": True}
         raise ValueError(f"unknown op {op!r}")
+
+    def _handle_telemetry(self, op: str, req: Dict[str, Any]) -> Dict[str, Any]:
+        """Cluster telemetry plane (docs/observability.md):
+
+        telemetry_push      fold one worker's delta-encoded payload
+                            (wire: zlib+base64 JSON — wire.decode_telemetry)
+                            into the aggregator.  Idempotent per
+                            (worker, boot, seq): retried/duplicated
+                            deliveries ack without re-applying, which is
+                            what makes the op safe to transport-retry.
+        telemetry_snapshot  the live ClusterSnapshot (heartbeat-gap
+                            enriched) + the straggler report.  Pure read;
+                            observers (tools_cluster.py) may call it on a
+                            raw connection without ever joining
+                            membership.
+        """
+        if op == "telemetry_push":
+            ack = self.telemetry.ingest(decode_telemetry(req["data"]))
+            return {"ok": True, **ack}
+        snap = self.cluster_snapshot(window_s=req.get("window_s"))
+        return {"ok": True, "snapshot": snap,
+                "straggler": self.telemetry.straggler_report(snap)}
+
+    def cluster_snapshot(self, window_s: Optional[float] = None):
+        """The live ClusterSnapshot, enriched with per-worker heartbeat
+        gaps from the coordination bookkeeping."""
+        now = time.time()
+        with self._lock:
+            hb = {r: now - w["last_beat"] for r, w in self._workers.items()
+                  if w.get("alive")}
+        return self.telemetry.snapshot(window_s=window_s, heartbeats=hb,
+                                       now=now)
 
     @staticmethod
     def _ps_ids(table, ids) -> np.ndarray:

@@ -1,9 +1,17 @@
-"""Wire codec for PS row payloads: contiguous float32 + base64.
-One definition shared by client and server so the format cannot drift
-(dtype/endianness changes happen in exactly one place)."""
+"""Wire codecs shared by the rpc client and server, so the formats cannot
+drift (dtype/endianness/compression changes happen in exactly one place):
+
+* PS row payloads — contiguous float32 + base64;
+* telemetry push payloads — zlib-compressed compact JSON + base64 (a
+  worker's delta-encoded metrics snapshot + RunLog tail is repetitive
+  key-heavy JSON; compression cuts the bytes-on-wire of the periodic
+  push by ~5-10x so telemetry stays negligible next to heartbeats).
+"""
 from __future__ import annotations
 
 import base64
+import json
+import zlib
 
 import numpy as np
 
@@ -16,3 +24,14 @@ def encode_rows(rows) -> str:
 def decode_rows(data: str, n: int, dim: int) -> np.ndarray:
     return np.frombuffer(base64.b64decode(data),
                          np.float32).reshape(n, dim).copy()
+
+
+def encode_telemetry(payload: dict) -> str:
+    """Telemetry push payload -> compressed base64 string (the `data`
+    field of the `telemetry_push` op)."""
+    raw = json.dumps(payload, separators=(",", ":")).encode()
+    return base64.b64encode(zlib.compress(raw)).decode()
+
+
+def decode_telemetry(data: str) -> dict:
+    return json.loads(zlib.decompress(base64.b64decode(data)).decode())

@@ -54,6 +54,29 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "write a Chrome-trace render of the pipeline micro-batch schedule "
          "(obs.pipeline_schedule_trace) to this path at build time when "
          "pp > 1; open in Perfetto / chrome://tracing"),
+    Flag("HETU_TPU_RUNLOG_MAX_MB", "int", 0,
+         "size-cap one RunLog segment to this many MiB; on overflow the "
+         "writer appends a 'rotated' marker record, renames the file to "
+         "<path>.<n> and starts a fresh segment (iter_records follows the "
+         "whole chain in order).  0 (default) = no rotation"),
+    Flag("HETU_TPU_TELEMETRY_PUSH", "str", "",
+         "cluster telemetry push interval in seconds (e.g. '2.0'): each "
+         "worker's control-plane client ships a delta-encoded metrics "
+         "snapshot + recent RunLog tail to the coordination server, which "
+         "folds them into the time-windowed ClusterSnapshot "
+         "(hetu_tpu/obs/aggregate.py, docs/observability.md).  Unset/empty "
+         "= off: no telemetry_push op ever hits the wire"),
+    Flag("HETU_TPU_HEALTH", "bool", False,
+         "run the training health monitor (obs.health.HealthMonitor) in "
+         "the trainer loop: EWMA+MAD detectors for loss spikes, NaN/Inf "
+         "grads, grad-norm blowups, step-time regressions and data-pipeline "
+         "stalls -> health.* counters + 'anomaly' RunLog events.  Costs a "
+         "per-step device sync for loss/grad_norm; off (default) = zero "
+         "per-step work"),
+    Flag("HETU_TPU_HW_PROFILE", "str", "",
+         "hardware profile JSON for the MFU/roofline reporter (obs.mfu); "
+         "default: repo-root hardware_profile_v5e.json, else built-in v5e "
+         "constants"),
     Flag("HETU_TPU_COMM_ANALYZE", "bool", True,
          "per-compile bytes-on-wire analysis (obs.comm) in RunLog compile "
          "events; costs one as_text() of the optimized HLO per fresh "
@@ -94,6 +117,14 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "this process's rank for multi-process init"),
     Flag("HETU_TPU_CONTROL", "str", "",
          "coordination-server address host:port (KV/barrier/elastic)"),
+    # -- launcher-injected worker env (rpc/launcher.py sets these in each
+    #    spawned worker; workers read them back for slot identity) --------
+    Flag("HETU_TPU_COORD", "str", "",
+         "coordination-server host:port handed to launcher-spawned workers"),
+    Flag("HETU_TPU_WORKER_ID", "int", 0,
+         "stable launcher slot id (0..n-1); a relaunched worker keeps it"),
+    Flag("HETU_TPU_NUM_WORKERS", "int", 0,
+         "launcher world size handed to spawned workers"),
 ]}
 
 

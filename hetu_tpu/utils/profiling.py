@@ -34,6 +34,18 @@ def env_flags() -> Dict[str, str]:
     return flags.active()
 
 
+def device_mem_bytes() -> Optional[int]:
+    """bytes_in_use on device 0, or None where the backend hides it (CPU).
+    ONE definition shared by the trainer's RunLog probe and the
+    HETU_TPU_MEMORY_PROFILE step stats."""
+    try:
+        stats = jax.local_devices()[0].memory_stats() or {}
+        v = stats.get("bytes_in_use")
+        return int(v) if v is not None else None
+    except Exception:
+        return None
+
+
 class StepProfiler:
     """Step-level timing/trace hooks for the trainer loop."""
 
@@ -45,6 +57,11 @@ class StepProfiler:
         self._trace_done = False
         self._first_step: Optional[int] = None
         self._times = []
+        #: most recent HETU_TPU_MEMORY_PROFILE probe (bytes_in_use), so
+        #: the RunLog step record and merged cluster traces see memory
+        #: too, not just the log line (None: profiling off / backend
+        #: hides memory_stats)
+        self.last_mem_bytes: Optional[int] = None
 
     def _stop_trace(self):
         if self._trace_active:
@@ -75,14 +92,11 @@ class StepProfiler:
             if self.event_timing:
                 logger.info(f"step {step_idx}: {dt * 1000:.1f} ms")
             if self.mem_profile:
-                try:
-                    stats = jax.local_devices()[0].memory_stats() or {}
-                    used = stats.get("bytes_in_use")
-                    if used is not None:
-                        logger.info(
-                            f"step {step_idx}: {used / 1e9:.2f} GB in use")
-                except Exception:
-                    pass
+                used = device_mem_bytes()
+                self.last_mem_bytes = used
+                if used is not None:
+                    logger.info(
+                        f"step {step_idx}: {used / 1e9:.2f} GB in use")
             if self._trace_active and rel >= trace_steps[1]:
                 self._stop_trace()
 

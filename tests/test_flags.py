@@ -33,6 +33,35 @@ def test_unknown_flag_rejected():
         flags.bool_flag("HETU_TPU_NOT_A_FLAG")
 
 
+def test_every_env_read_is_registered():
+    """Flag-registry audit: every HETU_TPU_* name the runtime source
+    mentions must be registered in utils/flags.py — an env var someone
+    reads via os.environ but never registers is invisible to
+    `flags.describe()` and silently undocumented."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(flags.__file__).resolve().parents[2]
+    sources = (list((root / "hetu_tpu").rglob("*.py"))
+               + list(root.glob("tools_*.py"))
+               + [root / "bench.py"])
+    assert len(sources) > 50, "audit walked the wrong root"
+    pat = re.compile(r"HETU_TPU_[A-Z0-9_]+")
+    found: dict = {}
+    for py in sources:
+        for name in pat.findall(py.read_text()):
+            found.setdefault(name, py.name)
+    # the test file itself fabricates one unknown name on purpose
+    unregistered = {n: f for n, f in found.items() if n not in flags.REGISTRY}
+    assert not unregistered, (
+        f"HETU_TPU_* env reads not registered in utils/flags.py: "
+        f"{unregistered}")
+    # and the new telemetry/health/rotation flags are part of the surface
+    for name in ("HETU_TPU_TELEMETRY_PUSH", "HETU_TPU_HEALTH",
+                 "HETU_TPU_RUNLOG_MAX_MB"):
+        assert name in flags.REGISTRY
+
+
 def test_describe_and_active(monkeypatch):
     monkeypatch.setenv("HETU_TPU_TRACE_DIR", "/tmp/t")
     text = flags.describe()

@@ -7,9 +7,12 @@ recovery report.
     python tools_chaos.py --steps 48 --workers 2 --json report.json
 
 Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
-partition, corrupt, stall.  A path argument loads a FaultPlan JSON
+partition, corrupt, stall, slow.  A path argument loads a FaultPlan JSON
 (docs/fault_tolerance.md has the schema — the same format the
-HETU_TPU_CHAOS flag takes for real runs).
+HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs with
+HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster straggler
+detector: the report then carries the coordinator's ClusterSnapshot and
+straggler verdict (`cluster` / `straggler` keys).
 
 The demo run is CPU-only and model-free (StubTrainer checkpoints real
 bytes through orbax; the control plane — reconnecting rpc client,

@@ -1,0 +1,150 @@
+"""Live cluster telemetry dashboard.
+
+Fetches the coordination server's ClusterSnapshot + straggler report
+(hetu_tpu/obs/aggregate.py, fed by the workers' HETU_TPU_TELEMETRY_PUSH
+loop) over a bare observer connection — it never joins membership, so
+polling the dashboard cannot look like a worker (or a worker death).
+
+    python tools_cluster.py --addr 127.0.0.1:7777            # text dashboard
+    python tools_cluster.py --addr 127.0.0.1:7777 --json     # raw JSON report
+    python tools_cluster.py --addr 127.0.0.1:7777 --watch 2  # refresh loop
+    python tools_cluster.py --addr h:p --merge-traces out.json \
+        0=ckpt0/runlog.jsonl 1=ckpt1/runlog.jsonl   # ids = worker ranks
+
+--merge-traces additionally merges per-worker RunLog files into ONE
+Chrome trace (pid = worker, timestamps aligned on the server-estimated
+clock offsets from the snapshot) — open at https://ui.perfetto.dev.
+
+Pure host-side: no jax, no device contact.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _fmt(v, scale=1.0, suffix="", digits=3):
+    if v is None:
+        return "-"
+    return f"{v * scale:.{digits}g}{suffix}"
+
+
+def render_dashboard(snapshot: dict, straggler: dict) -> str:
+    """The ClusterSnapshot as a fixed-width text dashboard."""
+    lines = []
+    workers = snapshot.get("workers", {})
+    lines.append(f"cluster snapshot @ {snapshot.get('t'):.3f}  "
+                 f"window={snapshot.get('window_s')}s  "
+                 f"workers={len(workers)}")
+    hdr = (f"{'rank':>4} {'steps':>6} {'rate/s':>7} {'p50 ms':>8} "
+           f"{'p95 ms':>8} {'loss':>9} {'mfu':>6} {'hb gap':>7} "
+           f"{'push age':>8} {'anoms':>5} {'ratio':>7} {'flag':>4}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    srep = (straggler or {}).get("workers", {})
+    for rank_s in sorted(workers, key=lambda r: int(r) if r.isdigit() else r):
+        w = workers[rank_s]
+        s = srep.get(rank_s, {})
+        anoms = sum((w.get("anomalies") or {}).values())
+        lines.append(
+            f"{rank_s:>4} {w.get('steps_total', 0):>6} "
+            f"{_fmt(w.get('step_rate')):>7} "
+            f"{_fmt(w.get('step_time_p50'), 1e3):>8} "
+            f"{_fmt(w.get('step_time_p95'), 1e3):>8} "
+            f"{_fmt(w.get('loss'), digits=4):>9} "
+            f"{_fmt(w.get('estimated_mfu'), digits=2):>6} "
+            f"{_fmt(w.get('heartbeat_gap_s'), digits=2):>7} "
+            f"{_fmt(w.get('last_push_age_s'), digits=2):>8} "
+            f"{anoms:>5} "
+            f"{_fmt(s.get('ratio'), digits=3):>7} "
+            f"{'YES' if s.get('straggler') else '':>4}")
+    flagged = (straggler or {}).get("stragglers") or []
+    if flagged:
+        lines.append(f"stragglers flagged: {flagged}")
+    anomalies: dict = {}
+    for w in workers.values():
+        for kind, n in (w.get("anomalies") or {}).items():
+            anomalies[kind] = anomalies.get(kind, 0) + n
+    if anomalies:
+        lines.append("anomalies: " + ", ".join(
+            f"{k}={n}" for k, n in sorted(anomalies.items())))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Render the coordination server's live ClusterSnapshot "
+                    "(telemetry-push aggregation) as a text dashboard or "
+                    "JSON report.")
+    ap.add_argument("--addr", required=True,
+                    help="coordination server host:port")
+    ap.add_argument("--window", type=float, default=None,
+                    help="aggregation window seconds (server default: 60)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the raw snapshot+straggler JSON instead "
+                         "of the text dashboard")
+    ap.add_argument("--watch", type=float, metavar="SECONDS", default=None,
+                    help="refresh the dashboard every N seconds until ^C")
+    ap.add_argument("--merge-traces", metavar="OUT.json", default=None,
+                    help="merge the given per-worker RunLog files into one "
+                         "offset-aligned Chrome trace")
+    ap.add_argument("runlogs", nargs="*",
+                    help="per-worker runlog.jsonl files for --merge-traces "
+                         "(worker id = position, or 'ID=path')")
+    args = ap.parse_args(argv)
+
+    host, _, port_s = args.addr.rpartition(":")
+    if not host or not port_s.isdigit():
+        ap.error(f"--addr must be host:port, got {args.addr!r}")
+
+    from hetu_tpu.rpc.client import fetch_cluster_snapshot
+
+    def fetch():
+        return fetch_cluster_snapshot(host, int(port_s),
+                                      window_s=args.window)
+
+    while True:
+        resp = fetch()
+        if args.json:
+            print(json.dumps(resp, indent=2))
+        else:
+            print(render_dashboard(resp["snapshot"], resp["straggler"]))
+        if args.watch is None:
+            break
+        try:
+            time.sleep(args.watch)
+        except KeyboardInterrupt:
+            break
+        print()
+
+    if args.merge_traces:
+        if not args.runlogs:
+            ap.error("--merge-traces needs runlog files")
+        from hetu_tpu.obs.aggregate import merge_offsets
+        from hetu_tpu.obs.runlog import RunLog
+        from hetu_tpu.obs.trace import merge_runlogs
+        logs = {}
+        for i, spec in enumerate(args.runlogs):
+            wid, _, path = spec.rpartition("=")
+            wid = wid or str(i)
+            logs[wid] = RunLog.read(path)
+        offsets = merge_offsets(fetch()["snapshot"])
+        # snapshot offsets are keyed by rank string ("0", "1", ...);
+        # tolerate decorated worker ids like "w0=path" by falling back
+        # to the trailing digits
+        aligned = {}
+        for wid in logs:
+            digits = "".join(c for c in str(wid) if c.isdigit())
+            off = offsets.get(str(wid), offsets.get(digits))
+            if off is not None:
+                aligned[wid] = off
+        merge_runlogs(logs, offsets_s=aligned).save(args.merge_traces)
+        print(f"# merged cluster trace written to {args.merge_traces}",
+              file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

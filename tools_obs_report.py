@@ -33,11 +33,14 @@ def summarize(records) -> dict:
     """Aggregate RunLog records (any iterable of dicts) into the BENCH
     summary shape.  Tolerates partial logs: a preempted run still reports
     everything up to its last completed step."""
+    records = list(records)
     steps = [r for r in records if r.get("kind") == "step"]
     compiles = [r for r in records if r.get("kind") == "compile"]
     switches = [r for r in records if r.get("kind") == "switch"]
     epochs = [r for r in records if r.get("kind") == "elastic_epoch"]
     faults = [r for r in records if r.get("kind") == "fault"]
+    anomalies = [r for r in records if r.get("kind") == "anomaly"]
+    stragglers = [r for r in records if r.get("kind") == "straggler"]
 
     out: dict = {"steps": len(steps), "compiles": len(compiles),
                  "switches": len(switches), "elastic_epochs": len(epochs)}
@@ -47,6 +50,40 @@ def summarize(records) -> dict:
             k = str(r.get("fault", "unknown"))
             by_kind[k] = by_kind.get(k, 0) + 1
         out["faults"] = by_kind
+
+    # health-monitor anomalies (obs.health): counts by kind + the span a
+    # BENCH regression hunt needs (when did it start, did it recover)
+    if anomalies:
+        by_kind = {}
+        for r in anomalies:
+            k = str(r.get("anomaly", "unknown"))
+            by_kind[k] = by_kind.get(k, 0) + 1
+        out["anomalies"] = {
+            "total": len(anomalies), "by_kind": by_kind,
+            "first": {k: anomalies[0].get(k)
+                      for k in ("anomaly", "step", "t")},
+            "last": {k: anomalies[-1].get(k)
+                     for k in ("anomaly", "step", "t")},
+        }
+
+    # cluster straggler reports (obs.aggregate): flag-transition events —
+    # counts per worker plus the worst observed ratio
+    if stragglers:
+        by_rank: dict = {}
+        top_ratio, top_rank = None, None
+        for r in stragglers:
+            for rank in r.get("stragglers") or []:
+                by_rank[str(rank)] = by_rank.get(str(rank), 0) + 1
+            for rank_s, w in (r.get("workers") or {}).items():
+                ratio = w.get("ratio")
+                if ratio is not None and (top_ratio is None
+                                          or ratio > top_ratio):
+                    top_ratio, top_rank = ratio, rank_s
+        out["stragglers"] = {"events": len(stragglers),
+                             "flagged_by_rank": by_rank}
+        if top_ratio is not None:
+            out["stragglers"]["top_ratio"] = top_ratio
+            out["stragglers"]["top_rank"] = top_rank
 
     times = sorted(float(r["step_time_s"]) for r in steps
                    if r.get("step_time_s"))

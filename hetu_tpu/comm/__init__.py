@@ -1,34 +1,52 @@
-"""Compressed gradient collectives (HETU_TPU_GRAD_COMPRESS).
+"""Compressed + hierarchical collectives (HETU_TPU_GRAD_COMPRESS,
+HETU_TPU_SP_COMPRESS, HETU_TPU_COMM_TOPOLOGY).
 
-Four pieces, one import surface (docs/comm_compression.md):
+Six pieces, one import surface (docs/comm_compression.md):
 
-    comm.wire       — the bytes-on-wire model (pure python; shared with
-                      obs.comm, search/cost_model.py and bench.py)
-    comm.compress   — blockwise int8 quantize/dequantize (+ stochastic
-                      rounding, + error-feedback quantize)
-    comm.bucketer   — BucketPlan: fuse small grads into flat buffers
-    comm.grad_sync  — the quantized DP sync (shard_map-internal) and the
-                      hetero-DP bridge compress/accumulate pair
+    comm.wire        — the bytes-on-wire model (pure python; shared with
+                       obs.comm, search/cost_model.py and bench.py)
+    comm.compress    — blockwise int8/int4 quantize/dequantize
+                       (+ stochastic rounding, + error-feedback quantize,
+                       + two-per-byte int4 packing)
+    comm.bucketer    — BucketPlan: fuse small grads into flat buffers
+    comm.grad_sync   — the quantized DP sync (shard_map-internal, flat or
+                       two-level) and the hetero-DP bridge pair
+    comm.collectives — drop-in quantized all_gather/reduce_scatter/
+                       all_to_all/all_reduce for any shard_map region
+                       (custom-vjp: backward transports quantize too)
+    comm.topology    — slice topology descriptor + two-level group
+                       construction (HetCCL-style hierarchy)
 """
 from hetu_tpu.comm.bucketer import BucketPlan  # noqa: F401
+from hetu_tpu.comm.collectives import (all_gather_q,  # noqa: F401
+                                       all_reduce_q, all_to_all_q,
+                                       reduce_scatter_q)
 from hetu_tpu.comm.compress import (dequantize_blockwise,  # noqa: F401
-                                    ef_quantize, quantize_blockwise)
+                                    ef_quantize, pack_int4,
+                                    quantize_blockwise, unpack_int4)
 from hetu_tpu.comm.grad_sync import (MODES, bridge_accumulate,  # noqa: F401
                                      bridge_compress, bridge_residual_init,
                                      ef_init, ef_shardings, ef_specs,
-                                     quantized_grad_sync,
+                                     per_replica_keys, quantized_grad_sync,
                                      uses_error_feedback)
+from hetu_tpu.comm.topology import Topology, load_topology  # noqa: F401
 from hetu_tpu.comm.wire import (COMPRESSED_MODES, DEFAULT_BLOCK,  # noqa: F401
                                 analytic_dp_sync, dp_sync_wire_bytes,
+                                mode_bits, ring_wire_bytes,
+                                two_level_sync_bytes,
                                 wire_bytes_per_element, wire_factor)
 
 __all__ = [
     "BucketPlan",
     "quantize_blockwise", "dequantize_blockwise", "ef_quantize",
+    "pack_int4", "unpack_int4",
     "MODES", "COMPRESSED_MODES", "DEFAULT_BLOCK",
     "quantized_grad_sync", "ef_init", "ef_specs", "ef_shardings",
-    "uses_error_feedback",
+    "uses_error_feedback", "per_replica_keys",
     "bridge_compress", "bridge_accumulate", "bridge_residual_init",
+    "all_gather_q", "reduce_scatter_q", "all_to_all_q", "all_reduce_q",
+    "Topology", "load_topology",
     "wire_bytes_per_element", "wire_factor", "dp_sync_wire_bytes",
-    "analytic_dp_sync",
+    "analytic_dp_sync", "ring_wire_bytes", "two_level_sync_bytes",
+    "mode_bits",
 ]

@@ -1,18 +1,23 @@
-"""Blockwise int8 quantize/dequantize primitives for gradient collectives.
+"""Blockwise int8/int4 quantize/dequantize primitives for collectives.
 
 The payload format is the one `comm/wire.py` prices: flat f32 buffers cut
-into blocks of `block_size`, each block carried as int8 values plus one
-f32 absmax scale.  Unlike `ops/quantization.py` (weight-only storage
-quantization, arbitrary nd-shapes), these primitives are collective-facing:
-they keep the block axis outermost so chunks of whole blocks can ride
-all-to-all / all-gather rows, and they offer
+into blocks of `block_size`, each block carried as int8 values (or int4
+values packed two per byte, `pack_int4`) plus one f32 absmax scale.
+Unlike `ops/quantization.py` (weight-only storage quantization, arbitrary
+nd-shapes), these primitives are collective-facing: they keep the block
+axis outermost so chunks of whole blocks can ride all-to-all /
+all-gather rows, and they offer
 
   * stochastic rounding — unbiased E[deq(q)] = x, the standard variance-
-    for-bias trade for gradient compression (EQuARX, PAPERS.md), and
+    for-bias trade for gradient compression (EQuARX, PAPERS.md),
   * error feedback — `ef_quantize` folds the previous round's
     quantization residual into the buffer before quantizing and returns
     the new residual, the SGD-with-memory correction that restores
-    convergence when the same buffer is compressed every step.
+    convergence when the same buffer is compressed every step, and
+  * int4 (`bits=4`): symmetric [-7, 7] grid, absmax/7 scale, same block
+    layout.  The wire carries two values per byte (`pack_int4` /
+    `unpack_int4` — offset-binary nibbles, value+8 in [1, 15], high
+    nibble = even index); block_size must be even.
 
 All functions are jit-safe and shard_map-safe (elementwise + block
 reductions only, no collectives here).
@@ -27,14 +32,26 @@ import jax.numpy as jnp
 from hetu_tpu.comm.wire import DEFAULT_BLOCK
 
 
+def _qmax(bits: int) -> float:
+    if bits == 8:
+        return 127.0
+    if bits == 4:
+        return 7.0
+    raise ValueError(f"bits must be 8 or 4, got {bits}")
+
+
 def quantize_blockwise(x, block_size: int = DEFAULT_BLOCK, *,
                        stochastic: bool = False,
-                       rng: Optional[jax.Array] = None
+                       rng: Optional[jax.Array] = None,
+                       bits: int = 8
                        ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """Flat f32 [n] (n % block_size == 0) -> (q int8 [n//bs, bs],
     scales f32 [n//bs]).  Deterministic round-to-nearest by default;
     stochastic=True rounds up with probability equal to the fractional
-    part (needs `rng`), making the dequantized value unbiased."""
+    part (needs `rng`), making the dequantized value unbiased.
+    bits=4 quantizes to the [-7, 7] grid (still carried as int8 here;
+    `pack_int4` packs two values per byte for the wire)."""
+    qmax = _qmax(bits)
     flat = x.reshape(-1).astype(jnp.float32)
     n = flat.shape[0]
     if n % block_size:
@@ -42,7 +59,7 @@ def quantize_blockwise(x, block_size: int = DEFAULT_BLOCK, *,
                          f"block_size={block_size}; pad first "
                          f"(comm.bucketer does)")
     blocks = flat.reshape(-1, block_size)
-    scale = jnp.max(jnp.abs(blocks), axis=1) / 127.0
+    scale = jnp.max(jnp.abs(blocks), axis=1) / qmax
     scale = jnp.maximum(scale, 1e-12)
     y = blocks / scale[:, None]
     if stochastic:
@@ -54,7 +71,7 @@ def quantize_blockwise(x, block_size: int = DEFAULT_BLOCK, *,
         y = floor + up.astype(jnp.float32)
     else:
         y = jnp.round(y)
-    q = jnp.clip(y, -127.0, 127.0).astype(jnp.int8)
+    q = jnp.clip(y, -qmax, qmax).astype(jnp.int8)
     return q, scale
 
 
@@ -63,9 +80,31 @@ def dequantize_blockwise(q, scale) -> jnp.ndarray:
     return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
 
 
+def pack_int4(q) -> jnp.ndarray:
+    """int8 [nb, bs] with values in [-8, 7] -> uint8 [nb, bs//2]: two
+    offset-binary nibbles per byte (value+8; even index rides the high
+    nibble).  The wire format of the int4 modes."""
+    if q.shape[-1] % 2:
+        raise ValueError(f"int4 packing needs an even block, got "
+                         f"{q.shape[-1]}")
+    u = (q.astype(jnp.int32) + 8).astype(jnp.uint8)
+    hi = u[..., 0::2]
+    lo = u[..., 1::2]
+    return (hi << 4) | lo
+
+
+def unpack_int4(p) -> jnp.ndarray:
+    """uint8 [nb, bs//2] -> int8 [nb, bs] (inverse of `pack_int4`)."""
+    hi = ((p >> 4) & 0xF).astype(jnp.int8) - 8
+    lo = (p & 0xF).astype(jnp.int8) - 8
+    return jnp.stack([hi, lo], axis=-1).reshape(p.shape[:-1] +
+                                                (2 * p.shape[-1],))
+
+
 def ef_quantize(x, residual, block_size: int = DEFAULT_BLOCK, *,
                 stochastic: bool = False,
-                rng: Optional[jax.Array] = None
+                rng: Optional[jax.Array] = None,
+                bits: int = 8
                 ) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
     """Error-feedback quantize: compress c = x + residual and return
     (q, scales, new_residual = c - dequantize(q)).  With residual=None
@@ -74,6 +113,6 @@ def ef_quantize(x, residual, block_size: int = DEFAULT_BLOCK, *,
     flat = x.reshape(-1).astype(jnp.float32)
     c = flat if residual is None else flat + residual.reshape(-1)
     q, scale = quantize_blockwise(c, block_size, stochastic=stochastic,
-                                  rng=rng)
+                                  rng=rng, bits=bits)
     new_residual = c - dequantize_blockwise(q, scale)
     return q, scale, new_residual

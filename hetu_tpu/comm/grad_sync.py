@@ -8,28 +8,39 @@ comm/wire.py):
   with the EQuARX-shaped pattern (PAPERS.md):
 
       quantize local sum-grads
-        -> all-to-all int8 chunks + f32 block scales   (ring reduce-scatter)
+        -> all-to-all int chunks + f32 block scales    (ring reduce-scatter)
         -> dequantize + sum the dp chunks of my shard
         -> re-quantize the reduced shard
-        -> all-gather int8 + scales -> dequantize      (param-refresh gather)
+        -> all-gather int + scales -> dequantize       (param-refresh gather)
 
-  ~3.94x fewer bytes on wire than the f32 all-reduce at block 256
-  (wire.py).  Each quantize point carries an optional error-feedback
-  residual: "a2a" residuals are PER-REPLICA (each replica compresses its
-  own grads — globally a [dp, L] array split over dp), "ag" residuals are
-  per-shard (globally [L] split over dp).  The residuals ride in the
-  optimizer state pytree (engine/trainer.py) so they checkpoint, donate
-  and reshard with the rest of the training state.
+  ~3.94x (int8) / ~7.76x (int4, packed two values per byte) fewer bytes
+  on wire than the f32 all-reduce at block 256 (wire.py).  Each quantize
+  point carries an optional error-feedback residual: "a2a" residuals are
+  PER-REPLICA (each replica compresses its own grads — globally a
+  [dp, L] array split over dp), "ag" residuals are per-shard (globally
+  [L] split over dp).  The residuals ride in the optimizer state pytree
+  (engine/trainer.py) so they checkpoint, donate and reshard with the
+  rest of the training state.
+
+  With a `Topology` (comm/topology.py, HETU_TPU_COMM_TOPOLOGY=two_level)
+  the ring schedule goes HIERARCHICAL (HetCCL): reduce-scatter inside
+  each slice over the fast intra links, exchange only the 1/slice shard
+  across slices, all-gather back inside the slice — the slow inter-slice
+  links move slice_devices-fold fewer bytes (wire.two_level_sync_bytes).
+  The two-level path is stateless-quantize only (int8/int4): its four
+  quantize points have different shapes than the flat path's two, so EF
+  residual state cannot be carried across the mode switch — requesting
+  both raises loudly.
 
 * The hetero-DP cross-mesh bridge (`bridge_compress` /
   `bridge_accumulate`) — quantize-before-`jax.device_put`
-  (parallel/hetero_dp.py): each non-resident group ships int8+scales
-  instead of f32 sum-grads, with a per-GROUP error-feedback residual
-  living on the source group's mesh.
+  (parallel/hetero_dp.py): each non-resident group ships int8/packed-int4
+  + scales instead of f32 sum-grads, with a per-GROUP error-feedback
+  residual living on the source group's mesh.
 """
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Dict, List, Optional
 
 import jax
 import jax.numpy as jnp
@@ -38,23 +49,46 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from hetu_tpu.comm.bucketer import BucketPlan
 from hetu_tpu.comm.compress import (dequantize_blockwise, ef_quantize,
-                                    quantize_blockwise)
-from hetu_tpu.comm.wire import COMPRESSED_MODES, DEFAULT_BLOCK
+                                    pack_int4, quantize_blockwise,
+                                    unpack_int4)
+from hetu_tpu.comm.topology import Topology
+from hetu_tpu.comm.wire import COMPRESSED_MODES, DEFAULT_BLOCK, mode_bits
 
 #: HETU_TPU_GRAD_COMPRESS values (utils/flags.py); "none" = the f32 path
 MODES = ("none",) + COMPRESSED_MODES
 
 
 def uses_error_feedback(mode: str) -> bool:
-    return mode == "int8-ef"
+    return mode.endswith("-ef")
+
+
+def per_replica_keys(keys, axis_name: str):
+    """Fold this replica's axis index into a [n] array of PRNG keys.
+
+    Inside the manual grad-sync region every replica traces the same
+    micro-batch scan with the same `keys` — without this fold, dropout
+    masks are IDENTICAL across replicas (same mask on different rows:
+    correlated noise the GSPMD path does not have).  Folding the axis
+    index in gives each replica an independent stream, matching the
+    per-row independence of the GSPMD lowering."""
+    idx = lax.axis_index(axis_name)
+    return jax.vmap(lambda k: jax.random.fold_in(k, idx))(keys)
 
 
 # ---------------------------------------------------------------------------
 # homogeneous DP/ZeRO: shard_map-internal quantized reduce-scatter+all-gather
 # ---------------------------------------------------------------------------
 
+def _maybe_pack(q, bits: int):
+    return pack_int4(q) if bits == 4 else q
+
+
+def _maybe_unpack(q, bits: int):
+    return unpack_int4(q) if bits == 4 else q
+
+
 def _sync_bucket(flat, axis_name: str, dp: int, block_size: int,
-                 ef_a2a, ef_ag):
+                 ef_a2a, ef_ag, bits: int = 8):
     """One flat bucket [L] of local sum-grads -> fully reduced [L]
     (replicated).  L % (dp * block_size) == 0 (BucketPlan guarantees).
     ef_a2a: local [1, L] or None; ef_ag: local [L // dp] or None."""
@@ -65,43 +99,120 @@ def _sync_bucket(flat, axis_name: str, dp: int, block_size: int,
     # stage 1: quantize my whole buffer, all-to-all whole-block chunks so
     # peer i receives every replica's piece of shard i
     q, s, new_a2a = ef_quantize(
-        flat, None if ef_a2a is None else ef_a2a[0], block_size)
+        flat, None if ef_a2a is None else ef_a2a[0], block_size, bits=bits)
     if ef_a2a is not None:
         new_a2a = new_a2a[None]                      # keep the [1, L] lane
-    q = q.reshape(dp, nblk, block_size)
+    q = _maybe_pack(q.reshape(dp, nblk, block_size), bits)
     s = s.reshape(dp, nblk)
     q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
     s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0)
+    q = _maybe_unpack(q, bits)
     shard = jnp.sum(jax.vmap(dequantize_blockwise)(q, s), axis=0)  # [chunk]
 
     # stage 2: re-quantize the reduced shard, gather everyone's shard
-    q2, s2, new_ag = ef_quantize(shard, ef_ag, block_size)
-    qg = lax.all_gather(q2, axis_name, axis=0)       # [dp, nblk, bs]
+    q2, s2, new_ag = ef_quantize(shard, ef_ag, block_size, bits=bits)
+    qg = lax.all_gather(_maybe_pack(q2, bits), axis_name, axis=0)
     sg = lax.all_gather(s2, axis_name, axis=0)       # [dp, nblk]
+    qg = _maybe_unpack(qg, bits)
     full = jax.vmap(dequantize_blockwise)(qg, sg).reshape(L)
     return full, new_a2a, new_ag
 
 
+def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
+                           bits: int, topo: Topology):
+    """Hierarchical twin of `_sync_bucket` (no EF): intra-slice quantized
+    reduce-scatter -> inter-slice quantized all-reduce of the 1/k shard
+    (a2a + re-quantized gather) -> intra-slice quantized all-gather.
+    Inter-slice links carry only L/k elements instead of L."""
+    intra, inter = topo.groups(dp)
+    k = topo.slice_devices
+    m = dp // k
+    L = flat.shape[0]
+    chunk = L // k          # my intra-slice shard
+    sub = chunk // m        # my inter-slice sub-shard
+    # BucketPlan pads to dp*block multiples, so sub % block == 0
+    nblk_c = chunk // block_size
+    nblk_s = sub // block_size
+
+    def q_rows(x, rows, nblk):
+        q, s = quantize_blockwise(x, block_size, bits=bits)
+        return (_maybe_pack(q.reshape(rows, nblk, block_size), bits),
+                s.reshape(rows, nblk))
+
+    def dq_sum(q, s):
+        q = _maybe_unpack(q, bits)
+        return jnp.sum(jax.vmap(dequantize_blockwise)(q, s), axis=0)
+
+    # stage 1: intra-slice reduce-scatter (fast links, full buffer)
+    q, s = q_rows(flat, k, nblk_c)
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                       axis_index_groups=intra)
+    s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                       axis_index_groups=intra)
+    shard = dq_sum(q, s)                              # [chunk], slice-summed
+
+    # stage 2: inter-slice all-reduce of the 1/k shard (slow links)
+    q, s = q_rows(shard, m, nblk_s)
+    q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                       axis_index_groups=inter)
+    s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
+                       axis_index_groups=inter)
+    sub_sum = dq_sum(q, s)                            # [sub], globally summed
+    q2, s2 = quantize_blockwise(sub_sum, block_size, bits=bits)
+    qg = lax.all_gather(_maybe_pack(q2, bits), axis_name, axis=0,
+                        axis_index_groups=inter)
+    sg = lax.all_gather(s2, axis_name, axis=0, axis_index_groups=inter)
+    shard_full = jax.vmap(dequantize_blockwise)(
+        _maybe_unpack(qg, bits), sg).reshape(chunk)   # [chunk], global sum
+
+    # stage 3: intra-slice all-gather of the finished shard (fast links)
+    q3, s3 = quantize_blockwise(shard_full, block_size, bits=bits)
+    qg = lax.all_gather(_maybe_pack(q3.reshape(nblk_c, block_size), bits),
+                        axis_name, axis=0, axis_index_groups=intra)
+    sg = lax.all_gather(s3, axis_name, axis=0, axis_index_groups=intra)
+    full = jax.vmap(dequantize_blockwise)(
+        _maybe_unpack(qg, bits),
+        sg.reshape(k, nblk_c)).reshape(L)
+    return full
+
+
 def quantized_grad_sync(grads, axis_name: str, dp: int, plan: BucketPlan,
                         mode: str, ef_state: Dict[str, List[jnp.ndarray]],
-                        block_size: int = DEFAULT_BLOCK):
+                        block_size: int = DEFAULT_BLOCK,
+                        topology: Optional[Topology] = None):
     """shard_map-internal: local sum-grad pytree -> globally summed pytree
-    (replicated over `axis_name`), via bucketed int8 collectives.
+    (replicated over `axis_name`), via bucketed int8/int4 collectives.
 
-    ef_state: {} for mode "int8"; for "int8-ef" a dict
+    ef_state: {} for the stateless modes; for "-ef" modes a dict
     {"a2a": [local [1, L] per bucket], "ag": [local [L//dp] per bucket]}
-    (the local view of `ef_init`'s global arrays).  Returns
-    (synced grads, new ef_state of the same structure)."""
+    (the local view of `ef_init`'s global arrays).  topology: a slice
+    Topology that `applies(dp)` routes every bucket through the two-level
+    scheme (stateless modes only).  Returns (synced grads, new ef_state
+    of the same structure)."""
     if mode not in COMPRESSED_MODES:
         raise ValueError(f"mode {mode!r} does not compress; caller should "
                          f"have taken the plain path")
     ef = uses_error_feedback(mode)
+    bits = mode_bits(mode)
+    two_level = topology is not None and topology.applies(dp)
+    if two_level and ef:
+        raise ValueError(
+            "two-level topology routing composes with the stateless "
+            "modes only (int8/int4): the hierarchical schedule has "
+            "different quantize points than the flat path, so EF "
+            "residual state cannot carry across — set "
+            "HETU_TPU_GRAD_COMPRESS=int8 or HETU_TPU_COMM_TOPOLOGY=flat")
     flats = plan.pack(grads)
     out, new_a2a, new_ag = [], [], []
     for i, flat in enumerate(flats):
+        if two_level:
+            out.append(_sync_bucket_two_level(
+                flat, axis_name, dp, block_size, bits, topology))
+            continue
         ea = ef_state["a2a"][i] if ef else None
         eg = ef_state["ag"][i] if ef else None
-        full, na, ng = _sync_bucket(flat, axis_name, dp, block_size, ea, eg)
+        full, na, ng = _sync_bucket(flat, axis_name, dp, block_size, ea, eg,
+                                    bits)
         out.append(full)
         if ef:
             new_a2a.append(na)
@@ -159,19 +270,21 @@ def bridge_residual_init(params_like, block_size: int = DEFAULT_BLOCK):
 
 
 def bridge_compress(grads, residuals=None,
-                    block_size: int = DEFAULT_BLOCK):
+                    block_size: int = DEFAULT_BLOCK, bits: int = 8):
     """Per-leaf quantize of a sum-grad pytree for the cross-mesh bridge.
     Returns ({q}, {scales}, {new residuals}) pytrees — q/scales are the
-    small arrays to `device_put` across meshes.  With residuals=None
-    (mode "int8") the third output is None and no residual is computed —
-    a jit output can't be DCE'd, so materializing a discarded
+    small arrays to `device_put` across meshes (bits=4 packs two values
+    per byte, halving the shipped payload again).  With residuals=None
+    (stateless modes) the third output is None and no residual is
+    computed — a jit output can't be DCE'd, so materializing a discarded
     params-sized f32 tree would cost every bridge step."""
     is_t = lambda t: isinstance(t, tuple)
     if residuals is None:
         def one_plain(g):
             flat = _pad_to_block(g.reshape(-1).astype(jnp.float32),
                                  block_size)
-            return quantize_blockwise(flat, block_size)
+            q, s = quantize_blockwise(flat, block_size, bits=bits)
+            return _maybe_pack(q, bits), s
         pairs = jax.tree.map(one_plain, grads)
         qs = jax.tree.map(lambda t: t[0], pairs, is_leaf=is_t)
         ss = jax.tree.map(lambda t: t[1], pairs, is_leaf=is_t)
@@ -179,7 +292,8 @@ def bridge_compress(grads, residuals=None,
 
     def one(g, r):
         flat = _pad_to_block(g.reshape(-1).astype(jnp.float32), block_size)
-        return ef_quantize(flat, r, block_size)
+        q, s, nr = ef_quantize(flat, r, block_size, bits=bits)
+        return _maybe_pack(q, bits), s, nr
     triples = jax.tree.map(one, grads, residuals)
     qs = jax.tree.map(lambda t: t[0], triples, is_leaf=is_t)
     ss = jax.tree.map(lambda t: t[1], triples, is_leaf=is_t)
@@ -187,10 +301,10 @@ def bridge_compress(grads, residuals=None,
     return qs, ss, rs
 
 
-def bridge_accumulate(acc, qs, ss):
+def bridge_accumulate(acc, qs, ss, bits: int = 8):
     """acc + dequantize(qs, ss) leaf-wise (runs jitted on the resident
     group's mesh; the dequant drops each leaf's block padding)."""
     def one(a, q, s):
-        flat = dequantize_blockwise(q, s)
+        flat = dequantize_blockwise(_maybe_unpack(q, bits), s)
         return a + lax.slice(flat, (0,), (a.size,)).reshape(a.shape)
     return jax.tree.map(one, acc, qs, ss)

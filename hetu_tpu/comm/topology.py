@@ -1,0 +1,110 @@
+"""Cluster topology descriptor + two-level collective routing (HetCCL).
+
+A TPU deployment is rarely one flat ring: chips group into slices with
+fast intra-slice ICI, and slices connect over a slower inter-slice
+fabric (DCN, or the long way around a twisted torus).  A flat ring
+collective paces every hop at the SLOWEST link; the HetCCL-style fix is
+hierarchical: reduce-scatter inside each slice over ICI, exchange only
+the 1/k shard across slices, all-gather back inside the slice —
+inter-slice traffic drops by the slice size (byte math in
+`comm/wire.py::two_level_sync_bytes`).
+
+The descriptor loads from the `topology` section of the hardware
+profile (`hardware_profile_v5e.json`, schema-validated by
+`obs.mfu.validate_hardware_profile`):
+
+    "topology": {"slice_devices": 4, "slice_shape": [2, 2],
+                 "intra_gbps": 45.0, "inter_gbps": 6.25}
+
+`HETU_TPU_COMM_TOPOLOGY=two_level` opts the DP grad sync's ring schedule
+(comm/grad_sync.py) into the hierarchical scheme; `flat` (the default)
+is byte-identical to an unset environment.  Inside a shard_map the two
+levels run over ONE named axis via `axis_index_groups`: intra groups are
+contiguous runs of `slice_devices` ranks, inter groups are the strided
+transversals (`Topology.groups`).  The analyzer (obs.comm) classifies
+each lowered collective's replica_groups back into intra/inter and
+prices them at the two rates.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Slice topology: `slice_devices` chips per slice at `intra_gbps`,
+    slices joined at `inter_gbps` (allreduce bus bandwidths, GB/s)."""
+
+    slice_devices: int
+    intra_gbps: float
+    inter_gbps: float
+    slice_shape: Optional[Tuple[int, ...]] = None
+
+    def __post_init__(self):
+        if self.slice_devices < 1:
+            raise ValueError(
+                f"topology.slice_devices must be >= 1, got "
+                f"{self.slice_devices}")
+
+    # ------------------------------------------------------------------
+    @staticmethod
+    def from_profile(hw: Dict[str, Any]) -> Optional["Topology"]:
+        """The profile's `topology` section as a descriptor (None when
+        the profile has none — flat accounting everywhere)."""
+        sec = (hw or {}).get("topology")
+        if not sec:
+            return None
+        shape = sec.get("slice_shape")
+        return Topology(
+            slice_devices=int(sec["slice_devices"]),
+            intra_gbps=float(sec["intra_gbps"]),
+            inter_gbps=float(sec["inter_gbps"]),
+            slice_shape=tuple(int(d) for d in shape) if shape else None)
+
+    def applies(self, world: int) -> bool:
+        """True when a `world`-rank group actually spans slices and
+        factors evenly into them (the two-level envelope)."""
+        k = self.slice_devices
+        return k > 1 and world > k and world % k == 0
+
+    def num_slices(self, world: int) -> int:
+        return world // self.slice_devices
+
+    # ------------------------------------------------------------------
+    def groups(self, world: int
+               ) -> Tuple[Tuple[Tuple[int, ...], ...],
+                          Tuple[Tuple[int, ...], ...]]:
+        """(intra_groups, inter_groups) axis_index_groups for a
+        `world`-rank axis: intra = contiguous runs of slice_devices
+        ranks, inter = the k strided transversals linking equal intra
+        positions across slices."""
+        if not self.applies(world):
+            raise ValueError(
+                f"two-level topology (slice_devices={self.slice_devices}) "
+                f"does not apply to a group of {world}")
+        k = self.slice_devices
+        s = world // k
+        intra = tuple(tuple(range(b * k, (b + 1) * k)) for b in range(s))
+        inter = tuple(tuple(i + b * k for b in range(s)) for i in range(k))
+        return intra, inter
+
+    def classify_group(self, ranks) -> str:
+        """"intra" when every rank of a replica group lives in one slice,
+        else "inter" — how the analyzer prices a lowered collective."""
+        slices = {int(r) // self.slice_devices for r in ranks}
+        return "intra" if len(slices) <= 1 else "inter"
+
+
+def load_topology(hw: Optional[Dict[str, Any]] = None) -> Optional[Topology]:
+    """Topology from the (loaded or default) hardware profile."""
+    if hw is None:
+        from hetu_tpu.obs.mfu import load_hardware_profile
+        hw = load_hardware_profile()
+    return Topology.from_profile(hw)
+
+
+def topology_mode() -> str:
+    """The HETU_TPU_COMM_TOPOLOGY flag ("flat" | "two_level")."""
+    from hetu_tpu.utils import flags
+    return flags.str_flag("HETU_TPU_COMM_TOPOLOGY")

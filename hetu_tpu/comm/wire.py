@@ -1,47 +1,96 @@
-"""Wire-format accounting for compressed gradient collectives.
+"""Wire-format accounting for compressed + hierarchical collectives.
 
 Pure python (no jax): the SAME byte model is consumed by the HLO analyzer
 (`hetu_tpu.obs.comm`), the strategy-search cost model
-(`search/cost_model.py` DP grad-sync term) and `bench.py`'s
-unreachable-backend fallback, so "how many bytes does a sync move" has
-exactly one definition in the repo.
+(`search/cost_model.py` DP grad-sync / TP-SP terms), `bench.py`'s
+unreachable-backend fallback and `tools_comm_report.py`'s per-path table,
+so "how many bytes does a sync move" has exactly one definition in the
+repo.
 
 The compressed DP sync (comm/grad_sync.py) is the EQuARX-shaped pattern
 (PAPERS.md): quantize -> all-to-all (the ring reduce-scatter step, each
-peer receives int8 chunks + f32 block scales) -> local dequant+sum ->
+peer receives quantized chunks + f32 block scales) -> local dequant+sum ->
 re-quantize the reduced shard -> all-gather.  Per ring participant of
 n devices and a flat f32 buffer of N elements:
 
-    fp32 all-reduce       2 (n-1)/n * 4N          bytes on wire
-    int8 a2a + all-gather 2 (n-1)/n * N*(1 + 4/B) bytes on wire
+    fp32 all-reduce       2 (n-1)/n * 4N            bytes on wire
+    int8 a2a + all-gather 2 (n-1)/n * N*(1 + 4/B)   bytes on wire
+    int4 a2a + all-gather 2 (n-1)/n * N*(1/2 + 4/B) bytes on wire
 
-with B the quantization block size (one f32 absmax scale per B int8
-payload bytes).  The ratio is 4 / (1 + 4/B) ~ 3.94x at B=256,
-independent of n — the "~4x fewer DP-sync bytes" the flag buys.
+with B the quantization block size (one f32 absmax scale per B quantized
+values; int4 packs two values per byte).  The ratios are 4/(1+4/B) ~
+3.94x and 4/(0.5+4/B) ~ 7.76x at B=256, independent of n.
+
+Two-level (HetCCL-style) hierarchy over a topology of s slices of k
+chips each (n = s*k): reduce-scatter intra-slice, all-reduce the 1/k
+shard inter-slice, all-gather intra-slice.  Per participant:
+
+    intra bytes: 2 (k-1)/k * N * w        (fast intra-slice links)
+    inter bytes: 2 (s-1)/s * (N/k) * w    (slow inter-slice links)
+
+with w the per-element wire bytes of the mode — the inter-slice (DCN)
+traffic drops by the slice size k vs a flat ring that spans slices.
 """
 from __future__ import annotations
 
 from typing import Any, Dict, Optional
 
-#: default quantization block (one f32 scale per 256 int8 values)
+#: default quantization block (one f32 scale per 256 quantized values)
 DEFAULT_BLOCK = 256
 
 #: the HETU_TPU_GRAD_COMPRESS modes that actually compress
-COMPRESSED_MODES = ("int8", "int8-ef")
+COMPRESSED_MODES = ("int8", "int8-ef", "int4", "int4-ef")
+
+#: payload bytes per quantized VALUE (before the per-block f32 scale)
+_MODE_PAYLOAD = {"int8": 1.0, "int8-ef": 1.0, "int4": 0.5, "int4-ef": 0.5}
 
 
-def wire_bytes_per_element(mode: str, block_size: int = DEFAULT_BLOCK) -> float:
-    """Bytes on wire per f32 gradient element under `mode` (scales
-    included)."""
+def mode_bits(mode: str) -> int:
+    """Quantized bits per value under `mode` (8 for the uncompressed
+    modes: they move full-width elements)."""
+    return 4 if mode.startswith("int4") else 8
+
+
+def wire_bytes_per_element(mode: str, block_size: int = DEFAULT_BLOCK,
+                           elem_bytes: float = 4.0) -> float:
+    """Bytes on wire per gradient/activation element under `mode`
+    (per-block f32 scales included).  `elem_bytes` is the UNcompressed
+    element width (4 for f32 grads, 2 for bf16 activations)."""
     if mode in COMPRESSED_MODES:
-        return 1.0 + 4.0 / float(block_size)
-    return 4.0
+        return _MODE_PAYLOAD[mode] + 4.0 / float(block_size)
+    return float(elem_bytes)
 
 
-def wire_factor(mode: str, block_size: int = DEFAULT_BLOCK) -> float:
-    """Multiplier on the fp32 DP grad-sync wire bytes under `mode`
-    (1.0 for "none"; ~0.254 for int8 at the default block)."""
-    return wire_bytes_per_element(mode, block_size) / 4.0
+def wire_factor(mode: str, block_size: int = DEFAULT_BLOCK,
+                elem_bytes: float = 4.0) -> float:
+    """Multiplier on the uncompressed wire bytes under `mode` (1.0 for
+    "none"; ~0.254 for int8 and ~0.129 for int4 at the default block vs
+    f32)."""
+    return (wire_bytes_per_element(mode, block_size, elem_bytes)
+            / float(elem_bytes))
+
+
+def ring_wire_bytes(op: str, payload_bytes: float, n: int) -> float:
+    """Per-participant ring wire bytes for one collective moving a FULL
+    local buffer of `payload_bytes` over a group of `n`:
+
+        all-reduce      2 (n-1)/n * payload
+        all-gather        (n-1)/n * gathered output
+        reduce-scatter    (n-1)/n * input buffer
+        all-to-all        (n-1)/n * local buffer
+        collective-permute          payload (one hop)
+
+    The SAME formulas the HLO analyzer (obs.comm) applies per
+    instruction — the cross-validation test pins them together."""
+    if op == "collective-permute":
+        return float(payload_bytes)
+    if n <= 1:
+        return 0.0
+    if op == "all-reduce":
+        return 2.0 * (n - 1) / n * payload_bytes
+    if op in ("all-gather", "reduce-scatter", "all-to-all"):
+        return (n - 1) / n * payload_bytes
+    raise ValueError(f"unknown collective op {op!r}")
 
 
 def dp_sync_wire_bytes(n_elements: float, dp: int, mode: str = "none",
@@ -52,6 +101,29 @@ def dp_sync_wire_bytes(n_elements: float, dp: int, mode: str = "none",
         return 0.0
     ring = 2.0 * (dp - 1) / dp
     return ring * n_elements * wire_bytes_per_element(mode, block_size)
+
+
+def two_level_sync_bytes(n_elements: float, dp: int, slice_devices: int,
+                         mode: str = "none",
+                         block_size: int = DEFAULT_BLOCK
+                         ) -> Dict[str, float]:
+    """Per-chip intra/inter-slice byte split of a two-level DP grad sync
+    (intra reduce-scatter -> inter all-reduce of the 1/k shard -> intra
+    all-gather) of `n_elements` f32 values over `dp` devices arranged as
+    dp/k slices of k chips.  Falls back to flat accounting (all bytes
+    "intra") when the topology does not apply."""
+    w = wire_bytes_per_element(mode, block_size)
+    k = int(slice_devices)
+    if dp <= 1:
+        return {"intra_bytes": 0.0, "inter_bytes": 0.0}
+    if k <= 1 or dp % k or dp <= k:
+        return {"intra_bytes": dp_sync_wire_bytes(n_elements, dp, mode,
+                                                  block_size),
+                "inter_bytes": 0.0}
+    s = dp // k
+    intra = 2.0 * (k - 1) / k * n_elements * w
+    inter = 2.0 * (s - 1) / s * (n_elements / k) * w
+    return {"intra_bytes": intra, "inter_bytes": inter}
 
 
 def analytic_dp_sync(n_params: float, dp: int, *,

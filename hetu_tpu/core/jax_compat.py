@@ -1,0 +1,105 @@
+"""jax version-portability shims.
+
+The codebase targets the current jax API surface (`jax.shard_map`,
+`lax.axis_size`, `lax.pvary`/`lax.pcast`, `jax.typeof` with `.vma`
+varying-manual-axes tracking).  Older releases (e.g. 0.4.x, the one this
+image bakes) predate all of those; `install()` adds each MISSING name as
+a semantically-equivalent shim and never overrides an existing one, so
+on a current jax this module is a no-op:
+
+* `jax.shard_map`     -> `jax.experimental.shard_map.shard_map`, with
+                         `axis_names=`/`check_vma=` translated to the old
+                         `auto=`/`check_rep=` spelling.
+* `lax.axis_size`     -> the bound-axis size via `jax.core.axis_frame`
+                         (which returns either a frame or the size).
+* `lax.pvary`/`pcast` -> identity: releases without vma tracking have no
+                         varying-axes type to promote, so the promotion
+                         IS a no-op there.
+* `jax.typeof`        -> an aval view whose `.vma` is the empty set
+                         (matching the identity pvary above).
+
+Installed once from `hetu_tpu/__init__` (and tests/conftest.py, which
+runs before any test module's own `from jax import shard_map`).
+"""
+from __future__ import annotations
+
+import jax
+from jax import lax
+
+
+class _AvalView:
+    """`jax.typeof(x)` stand-in: the aval plus an empty `.vma` set."""
+
+    __slots__ = ("_aval",)
+    vma: frozenset = frozenset()
+
+    def __init__(self, aval):
+        object.__setattr__(self, "_aval", aval)
+
+    def __getattr__(self, name):
+        return getattr(object.__getattribute__(self, "_aval"), name)
+
+
+def _shard_map_compat(f, *, mesh, in_specs, out_specs, check_rep=None,
+                      check_vma=None, axis_names=None, auto=None):
+    from jax.experimental.shard_map import shard_map as esm
+    kwargs = {}
+    if check_vma is not None:
+        # new-style vma checking has no old-jax equivalent: the legacy
+        # check_rep pass is a DIFFERENT, stricter analysis with no rules
+        # for e.g. checkpoint_name — run unchecked instead of mischecked
+        kwargs["check_rep"] = False
+    elif check_rep is not None:
+        kwargs["check_rep"] = bool(check_rep)
+    if auto is None and axis_names is not None:
+        names = tuple(getattr(mesh, "axis_names", ()) or ())
+        auto = frozenset(names) - frozenset(axis_names)
+    if auto:
+        kwargs["auto"] = frozenset(auto)
+    return esm(f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+               **kwargs)
+
+
+def _axis_size_compat(axis_name):
+    import jax.core as jc
+    frame = jc.axis_frame(axis_name)
+    return int(getattr(frame, "size", frame))
+
+
+def _pvary_compat(x, axis_names):  # noqa: ARG001 - signature parity
+    return x
+
+
+def _pcast_compat(x, axis_names, *, to=None):  # noqa: ARG001
+    return x
+
+
+def install():
+    """Add the missing names (idempotent; never overrides present ones)."""
+    if not hasattr(jax, "shard_map"):
+        jax.shard_map = _shard_map_compat
+    if not hasattr(jax, "typeof"):
+        jax.typeof = lambda x: _AvalView(jax.core.get_aval(x))
+    if not hasattr(lax, "axis_size"):
+        lax.axis_size = _axis_size_compat
+    if not hasattr(lax, "pvary"):
+        lax.pvary = _pvary_compat
+    if not hasattr(lax, "pcast"):
+        lax.pcast = _pcast_compat
+    if not hasattr(jax.sharding, "get_abstract_mesh"):
+        jax.sharding.get_abstract_mesh = _get_abstract_mesh_compat
+    try:
+        from jax.experimental.pallas import tpu as pltpu
+        if (not hasattr(pltpu, "CompilerParams")
+                and hasattr(pltpu, "TPUCompilerParams")):
+            pltpu.CompilerParams = pltpu.TPUCompilerParams
+    except Exception:  # pallas optional on some builds
+        pass
+
+
+def _get_abstract_mesh_compat():
+    try:
+        from jax._src import mesh as _mesh
+        return _mesh.get_abstract_mesh()
+    except Exception:
+        return None

@@ -174,7 +174,10 @@ class DistributedStates:
 
     # -- emission to JAX ----------------------------------------------------
     def partition_spec(self) -> P:
-        return P(*[axes if axes else None for axes in self.spec])
+        # single-axis dims emit the bare name: older jax compares
+        # P(("dp",)) != P("dp") (newer releases normalize the 1-tuple)
+        return P(*[(axes[0] if len(axes) == 1 else axes) if axes else None
+                   for axes in self.spec])
 
     def named_sharding(self, mesh: Mesh) -> NamedSharding:
         if self.partial:
@@ -343,19 +346,45 @@ def convert(x, src: DistributedStates, dst: DistributedStates):
     by the layouts.  This is the executable form of SubstituteCommOp
     (reference: executable_graph.cc:366): each CommPlan lowers to one XLA
     collective on the bound axis.
+
+    HETU_TPU_SP_COMPRESS (int8 | int4) routes the gather / scatter /
+    all-to-all / all-reduce emissions through the quantized collectives
+    in comm/collectives.py (blockwise int + f32 scales on the wire,
+    quantized transpose in the backward); "none" — the default — emits
+    exactly the plain lax calls below, HLO-byte-identical to before the
+    flag existed.  Non-float payloads and sub-block buffers always take
+    the exact path (collectives.eligible).
     """
+    from hetu_tpu.comm import collectives as qc
+    mode = qc.sp_mode()
     for plan in deduce_comm(src, dst):
         if plan.kind is CommType.NONE:
             continue
         elif plan.kind is CommType.ALL_REDUCE:
-            x = lax.psum(x, plan.axis)
+            if mode != "none":
+                x = qc.all_reduce_q(x, plan.axis, mode=mode)
+            else:
+                x = lax.psum(x, plan.axis)
         elif plan.kind is CommType.REDUCE_SCATTER:
-            x = lax.psum_scatter(x, plan.axis, scatter_dimension=plan.dst_dim, tiled=True)
+            if mode != "none":
+                x = qc.reduce_scatter_q(x, plan.axis,
+                                        scatter_dimension=plan.dst_dim,
+                                        tiled=True, mode=mode)
+            else:
+                x = lax.psum_scatter(x, plan.axis, scatter_dimension=plan.dst_dim, tiled=True)
         elif plan.kind is CommType.ALL_GATHER:
-            x = lax.all_gather(x, plan.axis, axis=plan.src_dim, tiled=True)
+            if mode != "none":
+                x = qc.all_gather_q(x, plan.axis, axis=plan.src_dim,
+                                    tiled=True, mode=mode)
+            else:
+                x = lax.all_gather(x, plan.axis, axis=plan.src_dim, tiled=True)
         elif plan.kind is CommType.ALL_TO_ALL:
-            x = lax.all_to_all(x, plan.axis, split_axis=plan.dst_dim,
-                               concat_axis=plan.src_dim, tiled=True)
+            if mode != "none":
+                x = qc.all_to_all_q(x, plan.axis, split_axis=plan.dst_dim,
+                                    concat_axis=plan.src_dim, mode=mode)
+            else:
+                x = lax.all_to_all(x, plan.axis, split_axis=plan.dst_dim,
+                                   concat_axis=plan.src_dim, tiled=True)
         elif plan.kind is CommType.SPLIT:
             idx = lax.axis_index(plan.axis)
             size = lax.axis_size(plan.axis)

@@ -134,6 +134,64 @@ class Trainer:
                     f"HETU_TPU_GRAD_COMPRESS={self._grad_compress} ignored: "
                     f"dp=1 has no grad sync to compress")
                 self._grad_compress = "none"
+        # -- two-level (HetCCL) routing of the compressed sync's ring
+        # schedule (HETU_TPU_COMM_TOPOLOGY + the hardware profile's
+        # `topology` section, comm/topology.py).  "flat" = byte-identical.
+        self._comm_topology = None
+        if (self._grad_compress == "none"
+                and _flags.str_flag("HETU_TPU_COMM_TOPOLOGY") == "two_level"):
+            # the flag only routes the COMPRESSED sync's ring schedule —
+            # without grad compression nothing changes; say so loudly
+            logger.warning(
+                "HETU_TPU_COMM_TOPOLOGY=two_level has no effect without "
+                "HETU_TPU_GRAD_COMPRESS (the flag routes the compressed "
+                "DP sync's ring schedule); running the plain f32 sync")
+        if (self._grad_compress != "none"
+                and _flags.str_flag("HETU_TPU_COMM_TOPOLOGY") == "two_level"):
+            from hetu_tpu.comm.grad_sync import uses_error_feedback
+            from hetu_tpu.comm.topology import load_topology
+            topo = load_topology()
+            if topo is None:
+                raise ValueError(
+                    "HETU_TPU_COMM_TOPOLOGY=two_level needs a `topology` "
+                    "section in the hardware profile "
+                    "(hardware_profile_v5e.json / HETU_TPU_HW_PROFILE)")
+            if uses_error_feedback(self._grad_compress):
+                raise ValueError(
+                    "HETU_TPU_COMM_TOPOLOGY=two_level composes with the "
+                    "stateless compress modes only (int8/int4); "
+                    f"got HETU_TPU_GRAD_COMPRESS={self._grad_compress!r}")
+            if topo.applies(self.strategy.dp):
+                self._comm_topology = topo
+            else:
+                logger.info(
+                    f"two-level topology (slice_devices="
+                    f"{topo.slice_devices}) does not apply to dp="
+                    f"{self.strategy.dp}; using the flat ring")
+        # -- quantized ZeRO-1/2 param refresh (optim/zero_refresh.py,
+        # HETU_TPU_ZERO_COMPRESS): the explicit delta-gather replaces
+        # GSPMD's f32 param all-gather.  Same envelope as the grad sync.
+        self._zero_compress = _flags.str_flag("HETU_TPU_ZERO_COMPRESS")
+        if self._zero_compress != "none":
+            st = self.strategy
+            if (st.tp > 1 or st.cp > 1 or st.pp > 1 or st.ep > 1
+                    or st.zero_stage >= 3):
+                raise ValueError(
+                    f"HETU_TPU_ZERO_COMPRESS={self._zero_compress!r} "
+                    f"supports homogeneous DP ZeRO-1/2 only (dp>1, "
+                    f"tp=cp=pp=ep=1, zero_stage<3); got "
+                    f"{self.strategy.describe()}")
+            if st.dp > 1 and not st.zero:
+                raise ValueError(
+                    f"HETU_TPU_ZERO_COMPRESS={self._zero_compress!r} "
+                    f"compresses the ZeRO param refresh, but this strategy "
+                    f"has zero=False (no refresh exists); enable ZeRO or "
+                    f"unset the flag")
+            if st.dp <= 1:
+                logger.info(
+                    f"HETU_TPU_ZERO_COMPRESS={self._zero_compress} ignored: "
+                    f"dp=1 has no param refresh to compress")
+                self._zero_compress = "none"
 
         from hetu_tpu.utils.profiling import StepProfiler
         self.profiler = StepProfiler()
@@ -231,6 +289,13 @@ class Trainer:
                     ef0, ef_sh = ef_state_entry(self._bucket_plan, mesh, dp)
                     self.opt_state["ef"] = ef0
                     self._sshard = dict(self._sshard, ef=ef_sh)
+            if self._zero_compress != "none":
+                # static slicing/gather plan of the quantized refresh:
+                # which dim zero_shardings split over dp, per leaf
+                from hetu_tpu.optim.zero_refresh import (refresh_dims,
+                                                         refresh_specs)
+                self._zr_dims = refresh_dims(self._sshard["m"])
+                self._zr_specs = refresh_specs(self._sshard["m"])
             if self._scaler is not None:
                 self.scaler_state = jax.device_put(
                     self._scaler.init(), NamedSharding(mesh, P()))
@@ -329,7 +394,11 @@ class Trainer:
             collectives={op: rec["count"] for op, rec in
                          (comm.get("collectives") or {}).items()} or None,
             grad_compress=(self._grad_compress
-                           if self._grad_compress != "none" else None))
+                           if self._grad_compress != "none" else None),
+            zero_compress=(self._zero_compress
+                           if self._zero_compress != "none" else None),
+            comm_topology=("two_level" if self._comm_topology is not None
+                           else None))
 
     # ------------------------------------------------------------------
     def _loss_fn(self, params, batch, rng):
@@ -412,16 +481,19 @@ class Trainer:
         denom = jnp.maximum(csum, 1.0)
         # fold the unscale into the token normalize (one pass over grads)
         grads = jax.tree.map(lambda g: g / (denom * scale), grads)
+        grads_sharded = False
         if getattr(self.strategy, "zero_stage", 1) >= 2 and self.strategy.dp > 1:
             # ZeRO-2: keep grads dp-sharded through clip+update (GSPMD turns
             # the grad sync into reduce-scatter; params re-gather after)
             grads = jax.tree.map(
                 lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
                 grads, self._sshard["m"])
+            grads_sharded = True
         grads, gnorm = optim.clip_by_global_norm(grads, c.grad_clip)
         metrics = {"loss": lsum / denom}
         if self._scaler is None:
-            params, opt_state = self.optimizer.update(grads, opt_state, params)
+            params, opt_state = self._apply_update(
+                grads, opt_state, params, grads_sharded)
             if new_ef:
                 opt_state["ef"] = new_ef
             metrics["grad_norm"] = gnorm
@@ -432,8 +504,8 @@ class Trainer:
         # (reference: CheckFinite.cc + update_scale.cc semantics)
         finite = self._scaler.all_finite(grads)
         safe_grads = jax.tree.map(jnp.nan_to_num, grads)
-        new_params, new_opt = self.optimizer.update(
-            safe_grads, opt_state, params)
+        new_params, new_opt = self._apply_update(
+            safe_grads, opt_state, params, grads_sharded)
         params = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
                               new_params, params)
         opt_state = jax.tree.map(lambda n, o: jnp.where(finite, n, o),
@@ -449,6 +521,22 @@ class Trainer:
         metrics["loss_scale"] = scaler_state["scale"]
         metrics["amp_skipped"] = 1.0 - finite.astype(jnp.float32)
         return params, opt_state, metrics, scaler_state
+
+    def _apply_update(self, grads, opt_state, params,
+                      grads_sharded: bool = False):
+        """The optimizer update, routed through the quantized ZeRO
+        refresh when HETU_TPU_ZERO_COMPRESS is on: the update math runs
+        on each rank's dp shard of the opt state and the param DELTA
+        all-gathers as int8/int4 + scales instead of GSPMD's f32 param
+        all-gather (optim/zero_refresh.py).  "none" calls the plain
+        update — traced program unchanged."""
+        if self._zero_compress == "none":
+            return self.optimizer.update(grads, opt_state, params)
+        from hetu_tpu.optim.zero_refresh import quantized_zero_update
+        return quantized_zero_update(
+            self.optimizer, grads, opt_state, params, mesh=self.mesh,
+            dims=self._zr_dims, specs=self._zr_specs,
+            mode=self._zero_compress, grads_sharded=grads_sharded)
 
     # ------------------------------------------------------------------
     def _accumulate_grads(self, params, batches, keys, scale):
@@ -482,21 +570,25 @@ class Trainer:
 
         Inside the manual region each replica runs the same micro-batch
         scan as the GSPMD path over its local batch rows, then the sync
-        replaces GSPMD's f32 grad all-reduce with int8 all-to-all +
-        all-gather (~3.94x fewer bytes on wire, comm/wire.py).  Loss/token
-        sums psum as f32 scalars.  Dropout keys are shared across replicas
-        (same mask per replica on different rows) — pretraining defaults
-        run deterministic, see docs/comm_compression.md."""
+        replaces GSPMD's f32 grad all-reduce with int8/int4 all-to-all +
+        all-gather (~3.94x / ~7.76x fewer bytes on wire, comm/wire.py),
+        hierarchically routed when a two-level topology applies.
+        Loss/token sums psum as f32 scalars.  Dropout keys fold in the
+        replica's axis index (grad_sync.per_replica_keys) so each replica
+        draws independent masks — matching the per-row independence of
+        the GSPMD lowering."""
         from jax.experimental.shard_map import shard_map
-        from hetu_tpu.comm.grad_sync import ef_specs, quantized_grad_sync
+        from hetu_tpu.comm.grad_sync import (ef_specs, per_replica_keys,
+                                             quantized_grad_sync)
         dp = self.strategy.dp
 
         def body(params, batches, keys, scale, ef_state):
+            keys = per_replica_keys(keys, "dp")
             grads, lsum, csum = self._accumulate_grads(
                 params, batches, keys, scale)
             grads, new_ef = quantized_grad_sync(
                 grads, "dp", dp, self._bucket_plan, self._grad_compress,
-                ef_state)
+                ef_state, topology=self._comm_topology)
             return (grads, jax.lax.psum(lsum, "dp"),
                     jax.lax.psum(csum, "dp"), new_ef)
 

@@ -13,26 +13,39 @@ the wire per participant under the standard ring algorithms:
     all-to-all            (n-1)/n * local buffer
     collective-permute              output             (one hop)
 
-`n` is parsed from each op's replica_groups.  Predicted comm time prices
-all-reduce-class ops at the profile's `ici_allreduce_gbps` bus bandwidth
-and permutes at `ici_p2p_gbps` (hardware_profile_v5e.json — the same
-numbers the search cost model uses).
+(the same formulas comm/wire.py prices analytically — the
+cross-validation test pins the two together).  `n` is parsed from each
+op's replica_groups.
+
+Scanned layers: a collective inside a `while` body (scan-over-layers,
+grad-accumulation) executes TRIP-COUNT times per step, not once.  The
+analyzer resolves each while's trip count from its condition computation
+(`compare(induction, constant), direction=LT` — the 0-based unit-step
+form every lax.scan lowers to) and multiplies the enclosed collectives'
+count and bytes through, nested whiles composing multiplicatively.  When
+the comparison bound is NOT a literal constant the enclosed rows are
+counted once and the report carries `dynamic_trip_count: true` — lower
+with `use_scan=False` for exact accounting in that case.
+
+Predicted comm time prices all-reduce-class ops at the profile's
+`ici_allreduce_gbps` bus bandwidth and permutes at `ici_p2p_gbps`.  When
+the profile carries a `topology` section (comm/topology.py), each
+collective's replica group is CLASSIFIED: groups confined to one slice
+ride `topology.intra_gbps`, groups spanning slices ride the (slower)
+`topology.inter_gbps` — so a flat ring over the whole pod is priced at
+the inter rate while a two-level schedule's intra stages keep the fast
+rate, and the report splits `predicted_comm_s_intra` / `_inter`.
 
 Consumers: Trainer compile run-events (RunLog `comm_bytes`), bench.py
 (`comm_bytes_per_step` even when the backend is unreachable, via the
 analytic twin in comm/wire.py), tools_comm_report.py (the per-collective
-table), and the ZeRO-1 HLO-assertion test (reduce-scatter + all-gather
-tripwire for GSPMD regressions).
-
-Caveat: the count is STATIC — a collective inside a while-loop body
-(scan-over-layers, grad-accumulation scan) is counted once, not
-trip-count times.  For exact per-step accounting lower the model with
-`use_scan=False` (the comm tests and tools_comm_report.py do).
+and per-path tables), and the ZeRO-1 HLO-assertion test (reduce-scatter
++ all-gather tripwire for GSPMD regressions).
 """
 from __future__ import annotations
 
 import re
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 from hetu_tpu.comm.wire import analytic_dp_sync  # noqa: F401  (re-export)
 
@@ -50,8 +63,21 @@ _DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1,
 # token; operand shapes (inside the parens) must not count
 _LINE_PAT = re.compile(r'=\s*(?P<out>.*?)\s*(?P<op>[a-z][a-z0-9_.-]*)\(')
 _SHAPE_PAT = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
-_GROUPS_PAT = re.compile(r'replica_groups=\{\{([0-9, ]*)\}')
-_IOTA_GROUPS_PAT = re.compile(r'replica_groups=\[(\d+),(\d+)\]<=')
+_GROUPS_PAT = re.compile(r'replica_groups=\{(\{[0-9,{} ]*\})\}')
+_IOTA_GROUPS_PAT = re.compile(
+    r'replica_groups=\[(\d+),(\d+)\]<=(?:\[[\d,]+\])(T\([\d,]+\))?')
+
+# computation structure (while-loop trip counts)
+_COMP_HEAD_PAT = re.compile(
+    r'^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*.*\{')
+_WHILE_PAT = re.compile(r'=\s*[^=]*\bwhile\(')
+_COND_REF_PAT = re.compile(r'condition=%?([\w.\-]+)')
+_BODY_REF_PAT = re.compile(r'body=%?([\w.\-]+)')
+_CONST_PAT = re.compile(
+    r'%?([\w.\-]+)\s*=\s*[su]\d+\[\]\s+constant\((\d+)\)')
+_COMPARE_PAT = re.compile(
+    r'compare\(\s*\S+\s+%?([\w.\-]+),\s*\S+\s+%?([\w.\-]+)\s*\)')
+_DIRECTION_PAT = re.compile(r'direction=(\w+)')
 
 
 def _component_bytes(section: str):
@@ -82,15 +108,24 @@ def _payload_bytes(section: str, is_start: bool) -> int:
     return max(comps) if is_start else sum(comps)
 
 
-def _group_size(line: str, default_world: int) -> int:
+def _first_group(line: str, default_world: int
+                 ) -> Tuple[int, Optional[Tuple[int, ...]]]:
+    """(group size, first group's rank list when recoverable) of a
+    collective instruction."""
     m = _GROUPS_PAT.search(line)
     if m:
-        first = [t for t in m.group(1).split(",") if t.strip()]
-        return max(len(first), 1)
+        first = m.group(1).split("}")[0].lstrip("{")
+        ranks = tuple(int(t) for t in first.split(",") if t.strip())
+        return max(len(ranks), 1), (ranks or None)
     m = _IOTA_GROUPS_PAT.search(line)
-    if m:  # iota form [num_groups, group_size]<=[world]
-        return max(int(m.group(2)), 1)
-    return max(default_world, 1)
+    if m:  # iota form [num_groups, group_size]<=[world](T(perm))?
+        g, s = int(m.group(1)), int(m.group(2))
+        if m.group(3):  # transposed iota: group 0 strides by num_groups
+            ranks = tuple(range(0, g * s, g))[:s]
+        else:           # contiguous iota: group 0 = [0, s)
+            ranks = tuple(range(s))
+        return max(s, 1), ranks
+    return max(default_world, 1), None
 
 
 def _wire_bytes(op: str, payload: int, n: int, is_start: bool) -> float:
@@ -117,39 +152,151 @@ def _wire_bytes(op: str, payload: int, n: int, is_start: bool) -> float:
     return 0.0
 
 
+# ---------------------------------------------------------------------------
+# computation structure: while-loop trip counts
+# ---------------------------------------------------------------------------
+
+def _split_computations(txt: str) -> Dict[str, List[str]]:
+    """HLO text -> {computation name: its instruction lines}.  Text with
+    no computation headers (synthetic snippets) maps to one anonymous
+    computation holding every line."""
+    comps: Dict[str, List[str]] = {}
+    cur: Optional[str] = None
+    loose: List[str] = []
+    for line in txt.splitlines():
+        m = _COMP_HEAD_PAT.match(line)
+        if m:
+            cur = m.group(1)
+            comps[cur] = []
+            continue
+        if line.strip() == "}":
+            cur = None
+            continue
+        (comps[cur] if cur is not None else loose).append(line)
+    if loose:
+        comps[""] = loose
+    return comps
+
+
+def _cond_trip_count(lines: List[str]) -> Optional[int]:
+    """Trip count from a while condition computation: the
+    `compare(induction, constant), direction=LT` form lax.scan lowers to
+    (0-based, unit step).  Non-zero-start loops (fori_loop(2, 10, ...))
+    are safe too: XLA's while canonicalization rebases the induction to
+    0 and folds the start into the bound BEFORE the post-optimization
+    text this module parses (regression-pinned in test_comm).  None =
+    not statically recoverable."""
+    consts = {name: int(val)
+              for name, val in (_CONST_PAT.search(ln).groups()
+                                for ln in lines if _CONST_PAT.search(ln))}
+    for ln in lines:
+        cm = _COMPARE_PAT.search(ln)
+        if cm is None:
+            continue
+        dm = _DIRECTION_PAT.search(ln)
+        direction = dm.group(1) if dm else ""
+        lhs, rhs = cm.group(1), cm.group(2)
+        if direction == "LT" and rhs in consts:
+            return consts[rhs]
+        if direction == "GT" and lhs in consts:
+            return consts[lhs]
+    return None
+
+
+def _comp_multipliers(comps: Dict[str, List[str]]
+                      ) -> Dict[str, Tuple[int, bool]]:
+    """{computation: (effective trip multiplier, dynamic?)} — body
+    computations inherit their parent's multiplier times their while's
+    trip count; nested whiles compose.  dynamic=True marks an enclosing
+    while whose trip could not be resolved (multiplier stays 1 for it)."""
+    parent: Dict[str, Tuple[str, Optional[int]]] = {}
+    for cname, lines in comps.items():
+        for ln in lines:
+            if " while(" not in ln and not _WHILE_PAT.search(ln):
+                continue
+            bm = _BODY_REF_PAT.search(ln)
+            cm = _COND_REF_PAT.search(ln)
+            if bm is None:
+                continue
+            trip = None
+            if cm is not None and cm.group(1) in comps:
+                trip = _cond_trip_count(comps[cm.group(1)])
+            parent[bm.group(1)] = (cname, trip)
+
+    memo: Dict[str, Tuple[int, bool]] = {}
+
+    def mult(name: str, seen=()) -> Tuple[int, bool]:
+        if name in memo:
+            return memo[name]
+        if name not in parent or name in seen:
+            return (1, False)
+        pname, trip = parent[name]
+        pm, pdyn = mult(pname, seen + (name,))
+        out = (pm * (trip if trip else 1), pdyn or trip is None)
+        memo[name] = out
+        return out
+
+    return {name: mult(name) for name in comps}
+
+
+# ---------------------------------------------------------------------------
+# the table / report
+# ---------------------------------------------------------------------------
+
 def collective_table(compiled_or_text, default_world: int = 1
                      ) -> List[Dict[str, Any]]:
     """One row per collective instruction in the optimized HLO:
-    {op, out_bytes, group_size, wire_bytes, line}.  Accepts a compiled
-    executable (as_text()) or the HLO text itself."""
+    {op, out_bytes, group_size, wire_bytes, trip_count, dynamic_trip,
+    group_ranks, line}.  wire_bytes is PER EXECUTION; multiply by
+    trip_count for per-step totals (collective_report does).  Accepts a
+    compiled executable (as_text()) or the HLO text itself."""
     txt = (compiled_or_text if isinstance(compiled_or_text, str)
            else compiled_or_text.as_text())
+    comps = _split_computations(txt)
+    mults = _comp_multipliers(comps)
     rows = []
-    for line in txt.splitlines():
-        # cheap prefilter before the regex work
-        if "all-" not in line and "reduce-scatter" not in line \
-                and "collective-permute" not in line:
-            continue
-        m = _LINE_PAT.search(line)
-        if m is None:
-            continue
-        op = m.group("op")
-        if op.endswith("-done"):
-            continue  # the -start carries the payload
-        is_start = op.endswith("-start")
-        base = op[:-6] if is_start else op
-        if base not in COLLECTIVE_OPS:
-            continue
-        out_bytes = _payload_bytes(m.group("out"), is_start)
-        n = _group_size(line, default_world)
-        rows.append({
-            "op": base,
-            "out_bytes": out_bytes,
-            "group_size": n,
-            "wire_bytes": _wire_bytes(base, out_bytes, n, is_start),
-            "line": line.strip()[:200],
-        })
+    for cname, lines in comps.items():
+        trip, dynamic = mults.get(cname, (1, False))
+        for line in lines:
+            # cheap prefilter before the regex work
+            if "all-" not in line and "reduce-scatter" not in line \
+                    and "collective-permute" not in line:
+                continue
+            m = _LINE_PAT.search(line)
+            if m is None:
+                continue
+            op = m.group("op")
+            if op.endswith("-done"):
+                continue  # the -start carries the payload
+            is_start = op.endswith("-start")
+            base = op[:-6] if is_start else op
+            if base not in COLLECTIVE_OPS:
+                continue
+            out_bytes = _payload_bytes(m.group("out"), is_start)
+            n, ranks = _first_group(line, default_world)
+            rows.append({
+                "op": base,
+                "out_bytes": out_bytes,
+                "group_size": n,
+                "wire_bytes": _wire_bytes(base, out_bytes, n, is_start),
+                "trip_count": trip,
+                "dynamic_trip": dynamic,
+                "group_ranks": ranks,
+                "line": line.strip()[:200],
+            })
     return rows
+
+
+def _row_rate_class(row, topo) -> str:
+    """"intra" | "inter" | "p2p" — which bandwidth prices this row."""
+    if row["op"] == "collective-permute":
+        return "p2p"
+    if topo is None:
+        return "intra"
+    ranks = row.get("group_ranks")
+    if not ranks:
+        return "intra"
+    return topo.classify_group(ranks)
 
 
 def collective_report(compiled_or_text, *, hw: Optional[Dict] = None,
@@ -157,28 +304,51 @@ def collective_report(compiled_or_text, *, hw: Optional[Dict] = None,
     """Aggregate bytes-on-wire report for one compiled step.
 
     {collectives: {op: {count, wire_bytes}}, num_collectives,
-     total_wire_bytes, predicted_comm_s, chip} — predicted_comm_s is the
-    serial ring-time estimate over the hardware profile's ICI rates (an
-    upper bound: real collectives overlap compute)."""
+     total_wire_bytes, predicted_comm_s, predicted_comm_s_intra,
+     predicted_comm_s_inter, dynamic_trip_count, chip} — counts and bytes
+    include while-loop trip multipliers; predicted_comm_s is the serial
+    ring-time estimate over the profile's rates (an upper bound: real
+    collectives overlap compute), with slice-spanning groups priced at
+    the topology's inter-slice rate when the profile declares one."""
     rows = collective_table(compiled_or_text, default_world)
-    per_op: Dict[str, Dict[str, float]] = {}
-    for r in rows:
-        rec = per_op.setdefault(r["op"], {"count": 0, "wire_bytes": 0.0})
-        rec["count"] += 1
-        rec["wire_bytes"] += r["wire_bytes"]
     if hw is None:
         from hetu_tpu.obs.mfu import load_hardware_profile
         hw = load_hardware_profile()
+    from hetu_tpu.comm.topology import Topology
+    topo = Topology.from_profile(hw)
     ar_bw = float(hw.get("ici_allreduce_gbps", 45.0)) * 1e9
     p2p_bw = float(hw.get("ici_p2p_gbps", 90.0)) * 1e9
-    t = 0.0
-    for op, rec in per_op.items():
-        bw = p2p_bw if op == "collective-permute" else ar_bw
-        t += rec["wire_bytes"] / bw
-    return {
+    intra_bw = topo.intra_gbps * 1e9 if topo else ar_bw
+    inter_bw = topo.inter_gbps * 1e9 if topo else ar_bw
+    per_op: Dict[str, Dict[str, float]] = {}
+    t_intra = t_inter = t_p2p = 0.0
+    total = 0.0
+    dynamic = False
+    for r in rows:
+        trip = max(int(r["trip_count"]), 1)
+        dynamic = dynamic or r["dynamic_trip"]
+        wb = r["wire_bytes"] * trip
+        rec = per_op.setdefault(r["op"], {"count": 0, "wire_bytes": 0.0})
+        rec["count"] += trip
+        rec["wire_bytes"] += wb
+        total += wb
+        cls = _row_rate_class(r, topo)
+        if cls == "p2p":
+            t_p2p += wb / p2p_bw
+        elif cls == "inter":
+            t_inter += wb / inter_bw
+        else:
+            t_intra += wb / intra_bw
+    report: Dict[str, Any] = {
         "collectives": per_op,
-        "num_collectives": len(rows),
-        "total_wire_bytes": sum(r["wire_bytes"] for r in rows),
-        "predicted_comm_s": t,
+        "num_collectives": sum(int(rec["count"])
+                               for rec in per_op.values()),
+        "total_wire_bytes": total,
+        "predicted_comm_s": t_intra + t_inter + t_p2p,
+        "predicted_comm_s_intra": t_intra,
+        "predicted_comm_s_inter": t_inter,
         "chip": hw.get("chip", "unknown"),
     }
+    if dynamic:
+        report["dynamic_trip_count"] = True
+    return report

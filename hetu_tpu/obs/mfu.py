@@ -39,10 +39,90 @@ _DEFAULT_HW = {
 }
 
 
+#: required top-level keys of a hardware profile (value must be a
+#: positive number unless noted) — obs.mfu and obs.comm read these
+#: unconditionally, so a profile missing one must fail LOUDLY at load,
+#: not as a KeyError deep in a report
+_REQUIRED_KEYS = ("bf16_tflops", "hbm_gbytes", "hbm_gbps",
+                  "ici_allreduce_gbps", "ici_p2p_gbps")
+_TOPOLOGY_KEYS = ("slice_devices", "intra_gbps", "inter_gbps")
+
+
+def validate_hardware_profile(hw: Dict[str, Any],
+                              source: str = "<dict>") -> Dict[str, Any]:
+    """Schema-check a hardware profile, naming the offending key.
+
+    Required: `chip` (string) plus positive numbers for each of
+    {bf16_tflops, hbm_gbytes, hbm_gbps, ici_allreduce_gbps,
+    ici_p2p_gbps}.  Optional: `dcn_gbps` (positive number), `measured`
+    (dict of numbers), and `topology` — which, when present, must carry
+    positive {slice_devices (integer), intra_gbps, inter_gbps} and may
+    carry `slice_shape` (list of positive ints whose product equals
+    slice_devices).  Returns `hw` unchanged on success."""
+    def fail(key, why):
+        raise ValueError(
+            f"invalid hardware profile ({source}): key {key!r} {why}")
+
+    if not isinstance(hw, dict):
+        raise ValueError(
+            f"invalid hardware profile ({source}): expected a JSON "
+            f"object, got {type(hw).__name__}")
+    if not isinstance(hw.get("chip"), str) or not hw.get("chip"):
+        fail("chip", "must be a non-empty string")
+    for k in _REQUIRED_KEYS:
+        if k not in hw:
+            fail(k, "is missing")
+        v = hw[k]
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+            fail(k, f"must be a positive number, got {v!r}")
+    if "dcn_gbps" in hw:
+        v = hw["dcn_gbps"]
+        if not isinstance(v, (int, float)) or isinstance(v, bool) or v <= 0:
+            fail("dcn_gbps", f"must be a positive number, got {v!r}")
+    meas = hw.get("measured", {})
+    if meas is not None and not isinstance(meas, dict):
+        fail("measured", f"must be an object, got {type(meas).__name__}")
+    for k, v in (meas or {}).items():
+        if not isinstance(v, (int, float)) or isinstance(v, bool):
+            fail(f"measured.{k}", f"must be a number, got {v!r}")
+    topo = hw.get("topology")
+    if topo is not None:
+        if not isinstance(topo, dict):
+            fail("topology", f"must be an object, got {type(topo).__name__}")
+        for k in _TOPOLOGY_KEYS:
+            if k not in topo:
+                fail(f"topology.{k}", "is missing")
+            v = topo[k]
+            if (not isinstance(v, (int, float)) or isinstance(v, bool)
+                    or v <= 0):
+                fail(f"topology.{k}", f"must be a positive number, got {v!r}")
+        if topo["slice_devices"] != int(topo["slice_devices"]):
+            fail("topology.slice_devices",
+                 f"must be an integer, got {topo['slice_devices']!r}")
+        shape = topo.get("slice_shape")
+        if shape is not None:
+            if (not isinstance(shape, (list, tuple)) or not shape
+                    or any(not isinstance(d, int) or isinstance(d, bool)
+                           or d <= 0 for d in shape)):
+                fail("topology.slice_shape",
+                     f"must be a list of positive integers, got {shape!r}")
+            prod = 1
+            for d in shape:
+                prod *= d
+            if prod != int(topo["slice_devices"]):
+                fail("topology.slice_shape",
+                     f"product {prod} != slice_devices "
+                     f"{topo['slice_devices']}")
+    return hw
+
+
 def load_hardware_profile(path: Optional[str] = None) -> Dict[str, Any]:
     """Load a hardware profile JSON.  Resolution: explicit `path` ->
     HETU_TPU_HW_PROFILE env -> repo-root hardware_profile_v5e.json ->
-    built-in v5e constants."""
+    built-in v5e constants.  A file that OPENS but fails to parse or
+    validate raises loudly (naming the file and the offending key) —
+    silently falling through to defaults would let a typo'd profile
+    skew every MFU/comm estimate."""
     candidates = []
     if path:
         candidates.append(path)
@@ -55,9 +135,16 @@ def load_hardware_profile(path: Optional[str] = None) -> Dict[str, Any]:
     for c in candidates:
         try:
             with open(c) as f:
-                return json.load(f)
-        except (OSError, ValueError):
+                raw = f.read()
+        except OSError:
             continue
+        try:
+            hw = json.loads(raw)
+        except ValueError as e:
+            raise ValueError(
+                f"invalid hardware profile ({c}): not valid JSON: {e}"
+            ) from None
+        return validate_hardware_profile(hw, source=c)
     return dict(_DEFAULT_HW)
 
 

@@ -3,3 +3,6 @@ from hetu_tpu.optim.optimizer import (
     cosine_schedule, constant_schedule,
 )
 from hetu_tpu.optim.grad_scaler import GradScaler
+from hetu_tpu.optim.zero_refresh import (
+    quantized_zero_update, refresh_dims, refresh_specs,
+)

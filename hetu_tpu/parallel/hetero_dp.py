@@ -145,7 +145,9 @@ class HeteroDPEngine:
                                                  bridge_compress,
                                                  bridge_residual_init,
                                                  uses_error_feedback)
+            from hetu_tpu.comm.wire import mode_bits
             ef = uses_error_feedback(self.grad_compress)
+            bits = mode_bits(self.grad_compress)
             self._compress_fns = [None]
             self._bridge_residuals = [None]
             for gi in range(1, len(self.groups)):
@@ -154,14 +156,18 @@ class HeteroDPEngine:
                         self._bridge_residuals.append(
                             jax.jit(bridge_residual_init)(self.params[gi]))
                         self._compress_fns.append(
-                            jax.jit(lambda g, r: bridge_compress(g, r)))
+                            jax.jit(lambda g, r: bridge_compress(
+                                g, r, bits=bits)))
                     else:
                         self._bridge_residuals.append(None)
                         self._compress_fns.append(
-                            jax.jit(lambda g: bridge_compress(g)))
+                            jax.jit(lambda g: bridge_compress(
+                                g, bits=bits)))
             with use_mesh(self.meshes[0]):
                 self._accum_fn = jax.jit(
-                    bridge_accumulate, out_shardings=self._pshards[0])
+                    lambda acc, qs, ss: bridge_accumulate(
+                        acc, qs, ss, bits=bits),
+                    out_shardings=self._pshards[0])
         return self
 
     # ------------------------------------------------------------------

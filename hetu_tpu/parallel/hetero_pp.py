@@ -63,12 +63,30 @@ def _psum_wide(x, axis):
     return lax.psum(x, axis)
 
 
+def _sp_compress_mode() -> str:
+    """HETU_TPU_SP_COMPRESS routing for the SP edges below: int8/int4
+    move the seq gathers/scatters as quantized payloads
+    (comm/collectives.py custom-vjp collectives — backward transports
+    quantize too); "none" keeps the exact lax calls byte-identical."""
+    from hetu_tpu.comm.collectives import sp_mode
+    return sp_mode()
+
+
 def _reduce_out(x, axis, *, sp: bool, seq_dim: int = 1):
     """The row-parallel output reduction: all-reduce (plain TP) or
     reduce-scatter onto the seq dim (Megatron-SP) — same 16-bit widening
     guard as _psum_wide."""
     if not sp:
         return _psum_wide(x, axis)
+    mode = _sp_compress_mode()
+    if mode != "none":
+        # the quantized scatter is f32-wire by construction (int payload,
+        # f32 scales, f32 dequant) so the 16-bit widening guard below is
+        # moot on this path
+        from hetu_tpu.comm.collectives import reduce_scatter_q
+        return reduce_scatter_q(
+            x.astype(jnp.float32), axis, scatter_dimension=seq_dim,
+            tiled=True, mode=mode).astype(x.dtype)
     if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
         return lax.psum_scatter(
             x.astype(jnp.float32), axis, scatter_dimension=seq_dim,
@@ -86,6 +104,12 @@ def _gather_seq(x, axis, *, sp: bool, seq_dim: int = 1):
     widening around the gather keeps that transpose f32."""
     if not sp:
         return x
+    mode = _sp_compress_mode()
+    if mode != "none":
+        from hetu_tpu.comm.collectives import all_gather_q
+        return all_gather_q(
+            x.astype(jnp.float32), axis, axis=seq_dim, tiled=True,
+            mode=mode).astype(x.dtype)
     if _widen_16bit() and x.dtype in (jnp.bfloat16, jnp.float16):
         return lax.all_gather(x.astype(jnp.float32), axis, axis=seq_dim,
                               tiled=True).astype(x.dtype)

@@ -42,11 +42,30 @@ class StrategyCandidate:
     # skip_dead_halves)
     pp_schedule: str = "gpipe"
     # compressed DP grad sync (hetu_tpu/comm, HETU_TPU_GRAD_COMPRESS):
-    # "none" | "int8" | "int8-ef" — scales the grad-sync wire bytes by
-    # comm.wire.wire_factor (~0.254 at int8), so the searcher sees the
-    # bandwidth the flag buys.  Compute cost of quantize/dequantize is
-    # VPU-elementwise and negligible next to the bytes saved.
+    # "none" | "int8(-ef)" | "int4(-ef)" — scales the grad-sync wire
+    # bytes by comm.wire.wire_factor (~0.254 at int8, ~0.129 at int4),
+    # so the searcher sees the bandwidth the flag buys.  Compute cost of
+    # quantize/dequantize is VPU-elementwise and negligible next to the
+    # bytes saved.
     grad_compress: str = "none"
+    # quantized SP/TP activation collectives (HETU_TPU_SP_COMPRESS,
+    # comm/collectives.py): scales the per-layer TP/SP comm bytes by the
+    # activation wire factor (bf16 base: ~0.51 at int8, ~0.26 at int4).
+    # The QUALITY trade rides the loss-parity acceptance gates, not the
+    # time model — the searcher ranks by time and the caller chooses how
+    # aggressive a mode to allow.
+    sp_compress: str = "none"
+    # quantized ZeRO param refresh (HETU_TPU_ZERO_COMPRESS,
+    # optim/zero_refresh.py): scales the all-gather HALF of the DP sync
+    # term (the param-refresh direction) by its wire factor.
+    zero_refresh: str = "none"
+    # two-level collective routing (HETU_TPU_COMM_TOPOLOGY,
+    # comm/topology.py): "two_level" prices the DP sync hierarchically
+    # over the profile's topology section (intra bytes at intra_gbps,
+    # the 1/slice inter exchange at inter_gbps); "flat" prices a ring
+    # that SPANS slices at the slow inter rate — which is exactly why
+    # the searcher will prefer two_level on multi-slice dp.
+    comm_topology: str = "flat"
 
     @property
     def num_devices(self):
@@ -68,6 +87,12 @@ class StrategyCandidate:
             bits.append(self.pp_schedule)
         if self.grad_compress != "none":
             bits.append("gc" + self.grad_compress.replace("int", ""))
+        if self.sp_compress != "none":
+            bits.append("spc" + self.sp_compress.replace("int", ""))
+        if self.zero_refresh != "none":
+            bits.append("zr" + self.zero_refresh.replace("int", ""))
+        if self.comm_topology != "flat":
+            bits.append("2lvl")
         return "x".join(bits) or "single"
 
     @property
@@ -151,26 +176,67 @@ class CostModel:
             t_hetero_ag = 0.0
 
         # TP comm: 4 allreduces of [b_local, s, h] bf16 per layer (2 fwd+2 bwd),
-        # halved arithmetic but same bytes under SP (reduce-scatter+allgather)
+        # halved arithmetic but same bytes under SP (reduce-scatter+allgather).
+        # sp_compress scales the activation bytes by the bf16-based wire
+        # factor (comm/wire.py — ~0.51 at int8, ~0.26 at int4)
         t_comm = 0.0      # per-layer comm, overlappable with compute
         t_dp = 0.0        # grad-sync tail, serialized after backward
+        from hetu_tpu.comm.wire import wire_factor
         if c.tp > 1:
             b_local = self.global_batch / max(c.dp * c.cp, 1)
-            bytes_per = b_local * self.seq_len * self.hidden * 2
+            bytes_per = (b_local * self.seq_len * self.hidden * 2
+                         * wire_factor(c.sp_compress, elem_bytes=2.0))
             ring = 2 * (c.tp - 1) / c.tp * bytes_per
             t_comm += 4 * self.num_layers * ring / (
                 self._allreduce_gbps("tp", c.tp) * 1e9) / max(c.pp, 1)
 
-        # DP/ZeRO grad sync: reduce-scatter + all-gather of the local shard.
-        # Quantized sync (grad_compress, hetu_tpu/comm) moves int8+scales
-        # instead of f32 over the same ring structure — same 2(dp-1)/dp
-        # factor, ~1/4 the bytes per element (comm/wire.py)
+        # DP/ZeRO grad sync: reduce-scatter of grads + all-gather of the
+        # refreshed params.  grad_compress scales the whole ring;
+        # zero_refresh additionally scales the all-gather HALF (the
+        # param-refresh direction, optim/zero_refresh.py).  With a
+        # topology section in the profile, a flat ring that SPANS slices
+        # is priced at the slow inter-slice rate, while comm_topology=
+        # "two_level" splits bytes hierarchically (comm/wire.py) — the
+        # HetCCL trade the searcher can now see.
         if c.dp > 1:
-            from hetu_tpu.comm.wire import wire_factor
-            shard_bytes = (4 * self.num_params / max(c.tp * c.pp, 1)
-                           * wire_factor(c.grad_compress))
-            ring = 2 * (c.dp - 1) / c.dp * shard_bytes
-            t_dp += ring / (self._allreduce_gbps("dp", c.dp) * 1e9)
+            shard_elems = self.num_params / max(c.tp * c.pp, 1)
+            wf_g = wire_factor(c.grad_compress)
+            wf_r = (wire_factor(c.zero_refresh)
+                    if (c.zero and c.zero_refresh != "none") else wf_g)
+            half = (c.dp - 1) / c.dp * 4 * shard_elems
+            topo = None
+            tsec = getattr(self.hw, "topology", None)
+            if tsec:
+                from hetu_tpu.comm.topology import Topology
+                topo = Topology.from_profile({"topology": tsec})
+            bw_flat = self._allreduce_gbps("dp", c.dp) * 1e9
+            if topo is not None and topo.applies(c.dp):
+                if c.comm_topology == "two_level":
+                    from hetu_tpu.comm.wire import two_level_sync_bytes
+                    k = topo.slice_devices
+                    sg = two_level_sync_bytes(shard_elems, c.dp, k,
+                                              c.grad_compress)
+                    sr = two_level_sync_bytes(
+                        shard_elems, c.dp, k,
+                        c.zero_refresh if (c.zero and
+                                           c.zero_refresh != "none")
+                        else c.grad_compress)
+                    intra = (sg["intra_bytes"] + sr["intra_bytes"]) / 2
+                    inter = (sg["inter_bytes"] + sr["inter_bytes"]) / 2
+                    t_dp += (intra / (topo.intra_gbps * 1e9)
+                             + inter / (topo.inter_gbps * 1e9))
+                else:
+                    # flat ring spanning slices: every hop paced by the
+                    # slowest (inter-slice) link — unless the profiler
+                    # MEASURED this exact ring (the measurement already
+                    # includes the slice crossings; it must win over the
+                    # topology-derived estimate)
+                    measured = self.hw.measured.get(
+                        f"allreduce_gbps_dp{c.dp}")
+                    bw = (measured or topo.inter_gbps) * 1e9
+                    t_dp += half * (wf_g + wf_r) / bw
+            else:
+                t_dp += half * (wf_g + wf_r) / bw_flat
 
         # CP ring: kv blocks circulate cp-1 times
         if c.cp > 1:

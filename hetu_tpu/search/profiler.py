@@ -28,6 +28,9 @@ class HardwareProfile:
     ici_allreduce_gbps: float = 45.0    # bus bandwidth per chip (1D ring)
     ici_p2p_gbps: float = 90.0
     dcn_gbps: float = 6.25
+    # optional slice topology section (comm/topology.py Topology):
+    # {slice_devices, slice_shape?, intra_gbps, inter_gbps}
+    topology: Optional[Dict[str, object]] = None
     measured: Dict[str, float] = dataclasses.field(default_factory=dict)
 
     PRESETS = {

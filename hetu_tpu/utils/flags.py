@@ -91,9 +91,34 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "compressed DP grad sync (hetu_tpu/comm/): none = f32 collectives "
          "(byte-identical default), int8 = blockwise-int8 quantized "
          "reduce-scatter/all-gather (+ quantized hetero-DP bridge), "
-         "int8-ef = int8 with error-feedback residuals carried in the "
-         "optimizer state; see docs/comm_compression.md",
-         choices=("none", "int8", "int8-ef")),
+         "int4 = packed two-per-byte (~7.8x fewer bytes), -ef variants "
+         "carry error-feedback residuals in the optimizer state; see "
+         "docs/comm_compression.md",
+         choices=("none", "int8", "int8-ef", "int4", "int4-ef")),
+    Flag("HETU_TPU_SP_COMPRESS", "str", "none",
+         "quantized SP/TP activation collectives (comm/collectives.py): "
+         "the explicit shard_map paths' all-gathers/reduce-scatters/"
+         "all-to-alls (dstates.convert, hetero-TP pipeline SP edges) move "
+         "blockwise int8/int4 + f32 scales instead of full-width floats; "
+         "backward transports quantize too (custom_vjp transpose).  none "
+         "(default) is HLO-byte-identical to unset",
+         choices=("none", "int8", "int4")),
+    Flag("HETU_TPU_ZERO_COMPRESS", "str", "none",
+         "quantized ZeRO-1/2 param refresh (optim/zero_refresh.py): the "
+         "optimizer update runs on dp-sharded state inside a shard_map "
+         "and the param DELTA all-gathers as int8/int4 + scales instead "
+         "of GSPMD's f32 param all-gather (~3.9x/7.8x fewer refresh "
+         "bytes).  Same homogeneous-DP envelope as GRAD_COMPRESS; none "
+         "(default) is HLO-byte-identical to unset",
+         choices=("none", "int8", "int4")),
+    Flag("HETU_TPU_COMM_TOPOLOGY", "str", "flat",
+         "collective routing over the hardware profile's `topology` "
+         "section (comm/topology.py): two_level runs the DP grad sync "
+         "hierarchically (intra-slice reduce-scatter -> inter-slice "
+         "exchange of the 1/slice shard -> intra-slice all-gather, "
+         "HetCCL-style) so inter-slice links move slice_devices-fold "
+         "fewer bytes.  flat (default) is HLO-byte-identical to unset",
+         choices=("flat", "two_level")),
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "flash-attention kernel routing: auto (shape-gated), 1 (force "
          "Pallas), 0 (force the XLA composition)",

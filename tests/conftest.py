@@ -21,6 +21,12 @@ import jax  # noqa: E402
 # jax.config; tests always run on the virtual CPU mesh, so force it back.
 jax.config.update("jax_platforms", "cpu")
 
+# Version-portability shims (jax.shard_map / lax.axis_size / pvary...)
+# must land before any test module's own `from jax import shard_map`.
+from hetu_tpu.core import jax_compat  # noqa: E402
+
+jax_compat.install()
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
 

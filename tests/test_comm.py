@@ -291,9 +291,25 @@ def test_compress_noop_on_dp1(monkeypatch):
 
 def test_flag_rejects_unknown_mode(monkeypatch):
     from hetu_tpu.utils import flags
-    monkeypatch.setenv("HETU_TPU_GRAD_COMPRESS", "int4")
+    monkeypatch.setenv("HETU_TPU_GRAD_COMPRESS", "int2")
     with pytest.raises(ValueError, match="choices"):
         flags.str_flag("HETU_TPU_GRAD_COMPRESS")
+
+
+def test_int4_sync_trains_and_cuts_bytes_7x(monkeypatch):
+    """int4 (packed two-per-byte) halves the int8 wire again: >=7x fewer
+    DP-sync bytes than fp32, measured from lowered HLO, and still
+    trains."""
+    from hetu_tpu.obs.comm import collective_report
+    hb = _batch()
+    rep32 = collective_report(_lowered(_trainer("none", monkeypatch), hb))
+    tr4 = _trainer("int4-ef", monkeypatch)
+    rep4 = collective_report(_lowered(tr4, hb))
+    assert rep32["total_wire_bytes"] >= 7.0 * rep4["total_wire_bytes"], (
+        rep32["total_wire_bytes"], rep4["total_wire_bytes"])
+    losses = [float(tr4.train_step(hb)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert "ef" in tr4.opt_state
 
 
 # ---------------------------------------------------------------------------
@@ -388,3 +404,405 @@ def test_tools_comm_report_smoke(capsys):
     import json
     summary = json.loads(out.strip().splitlines()[-1])
     assert summary["none"]["total_wire_bytes"] > 0
+
+
+@pytest.mark.slow
+def test_tools_comm_report_path_table(capsys):
+    """--compare prints the per-path fp32-vs-compressed table with every
+    path >= 3x (the components are tier-1-covered individually; the full
+    CLI pass lowers six programs, hence slow)."""
+    import tools_comm_report
+    rc = tools_comm_report.main(["--compare", "--seq", "32"])
+    assert rc == 0
+    out = capsys.readouterr().out
+    import json
+    summary = json.loads(out.strip().splitlines()[-1])
+    for path in ("dp_grad_sync", "sp_activations", "zero_refresh",
+                 "hetero_bridge"):
+        assert summary["paths"][path]["ratio"] >= 3.0, (path, summary)
+
+
+# ---------------------------------------------------------------------------
+# quantized ZeRO-1/2 param refresh (HETU_TPU_ZERO_COMPRESS)
+# ---------------------------------------------------------------------------
+
+def _zc_trainer(zc, monkeypatch, *, grad=None, zero=True, dp=4, lr=3e-3,
+                zero_stage=1):
+    for name, val in (("HETU_TPU_ZERO_COMPRESS", zc),
+                      ("HETU_TPU_GRAD_COMPRESS", grad)):
+        if val is None:
+            monkeypatch.delenv(name, raising=False)
+        else:
+            monkeypatch.setenv(name, val)
+    cfg = LlamaConfig.tiny(remat=False, use_scan=False)
+    st = ParallelStrategy(mesh=MeshConfig(dp=dp), zero=zero,
+                          zero_stage=zero_stage)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=8 // dp,
+                        seq_len=64, lr=lr, warmup_steps=2, total_steps=40,
+                        log_every=1000)
+    return Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+
+
+def test_zero_compress_none_is_hlo_identical_to_unset(monkeypatch):
+    hb = _batch()
+    base = _lowered(_zc_trainer(None, monkeypatch), hb).as_text()
+    none = _lowered(_zc_trainer("none", monkeypatch), hb).as_text()
+    assert base == none
+
+
+def test_zero_refresh_int8_cuts_gather_bytes_3x(monkeypatch):
+    """Acceptance: the ZeRO-1 param refresh moves >=3x fewer all-gather
+    bytes with int8 enabled, measured from lowered HLO."""
+    from hetu_tpu.obs.comm import collective_report
+    hb = _batch()
+    rep32 = collective_report(_lowered(_zc_trainer(None, monkeypatch), hb))
+    rep8 = collective_report(_lowered(_zc_trainer("int8", monkeypatch), hb))
+    ag32 = rep32["collectives"]["all-gather"]["wire_bytes"]
+    ag8 = rep8["collectives"]["all-gather"]["wire_bytes"]
+    assert ag32 >= 3.0 * ag8, (ag32, ag8)
+
+
+def test_zero_refresh_int8_loss_parity(monkeypatch):
+    """Acceptance: quantized delta-gather refresh reaches the fp32
+    refresh's final loss within 1%."""
+    hb = _batch()
+    steps = 12
+    tr32 = _zc_trainer(None, monkeypatch)
+    l32 = [float(tr32.train_step(hb)["loss"]) for _ in range(steps)]
+    tr8 = _zc_trainer("int8", monkeypatch)
+    l8 = [float(tr8.train_step(hb)["loss"]) for _ in range(steps)]
+    assert l32[-1] < l32[0] - 0.5
+    assert l8[-1] < l8[0] - 0.5
+    assert abs(l8[-1] - l32[-1]) / l32[-1] < 0.01, (l8[-1], l32[-1])
+
+
+@pytest.mark.slow
+def test_zero_refresh_composes_with_grad_compress_and_stage2(monkeypatch):
+    tr = _zc_trainer("int8", monkeypatch, grad="int8-ef", zero_stage=2)
+    hb = _batch()
+    losses = [float(tr.train_step(hb)["loss"]) for _ in range(4)]
+    assert losses[-1] < losses[0]
+    assert "ef" in tr.opt_state
+
+
+def test_zero_compress_requires_zero(monkeypatch):
+    monkeypatch.setenv("HETU_TPU_ZERO_COMPRESS", "int8")
+    cfg = LlamaConfig.tiny(remat=False)
+    st = ParallelStrategy(mesh=MeshConfig(dp=4), zero=False)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=64)
+    with pytest.raises(ValueError, match="zero=False"):
+        Trainer(LlamaLMHeadModel(cfg, st), tc, st)
+
+
+# ---------------------------------------------------------------------------
+# two-level (HetCCL) topology routing in the trainer
+# ---------------------------------------------------------------------------
+
+def _topo_profile(tmp_path, slice_devices=4):
+    import json as _json
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    hw = load_hardware_profile()
+    hw["topology"] = {"slice_devices": slice_devices,
+                      "intra_gbps": 45.0, "inter_gbps": 6.25}
+    p = tmp_path / "hw.json"
+    p.write_text(_json.dumps(hw))
+    return str(p)
+
+
+@pytest.mark.slow
+def test_trainer_two_level_sync_trains_close_to_flat(tmp_path, monkeypatch):
+    monkeypatch.setenv("HETU_TPU_HW_PROFILE", _topo_profile(tmp_path))
+    hb = _batch()
+    flat = _trainer("int8", monkeypatch, dp=8)
+    lf = [float(flat.train_step(hb)["loss"]) for _ in range(6)]
+    monkeypatch.setenv("HETU_TPU_COMM_TOPOLOGY", "two_level")
+    two = _trainer("int8", monkeypatch, dp=8)
+    assert two._comm_topology is not None
+    lt = [float(two.train_step(hb)["loss"]) for _ in range(6)]
+    assert lt[-1] < lt[0] - 0.3
+    assert abs(lt[-1] - lf[-1]) / lf[-1] < 0.05, (lt[-1], lf[-1])
+
+
+def test_trainer_two_level_rejects_ef(tmp_path, monkeypatch):
+    monkeypatch.setenv("HETU_TPU_HW_PROFILE", _topo_profile(tmp_path))
+    monkeypatch.setenv("HETU_TPU_COMM_TOPOLOGY", "two_level")
+    monkeypatch.setenv("HETU_TPU_GRAD_COMPRESS", "int8-ef")
+    cfg = LlamaConfig.tiny(remat=False)
+    st = ParallelStrategy(mesh=MeshConfig(dp=8), zero=False)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=1, seq_len=64)
+    with pytest.raises(ValueError, match="stateless"):
+        Trainer(LlamaLMHeadModel(cfg, st), tc, st)
+
+
+def test_trainer_two_level_flag_flat_is_hlo_identical(tmp_path, monkeypatch):
+    monkeypatch.setenv("HETU_TPU_HW_PROFILE", _topo_profile(tmp_path))
+    hb = _batch()
+    base = _lowered(_trainer("int8", monkeypatch, dp=8), hb).as_text()
+    monkeypatch.setenv("HETU_TPU_COMM_TOPOLOGY", "flat")
+    flat = _lowered(_trainer("int8", monkeypatch, dp=8), hb).as_text()
+    assert base == flat
+
+
+# ---------------------------------------------------------------------------
+# dropout keys fold the replica index (PR 2 known-limit fix)
+# ---------------------------------------------------------------------------
+
+def test_per_replica_keys_differ_across_replicas():
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from hetu_tpu.comm.grad_sync import per_replica_keys
+    from hetu_tpu.core.mesh import create_mesh
+    mesh = create_mesh(MeshConfig(dp=4))
+    keys = jax.random.split(jax.random.key(0), 2)
+
+    def body(keys):
+        k = per_replica_keys(keys, "dp")
+        bits = jax.vmap(
+            lambda kk: jax.random.bits(kk, (4,), jnp.uint32))(k)
+        return bits[None]
+
+    out = np.asarray(jax.jit(shard_map(
+        body, mesh=mesh, in_specs=(P(),), out_specs=P("dp"),
+        check_rep=False))(keys))          # [dp, n_micro, 4]
+    flat = out.reshape(4, -1)
+    for i in range(4):
+        for j in range(i + 1, 4):
+            assert not np.array_equal(flat[i], flat[j]), (i, j)
+
+
+def test_compressed_sync_with_dropout_trains(monkeypatch):
+    """Regression for the PR 2 limit: dropout + compressed sync now runs
+    with per-replica independent masks (keys fold the dp axis index)."""
+    monkeypatch.setenv("HETU_TPU_GRAD_COMPRESS", "int8-ef")
+    cfg = LlamaConfig.tiny(remat=False, use_scan=False, hidden_dropout=0.1)
+    st = ParallelStrategy(mesh=MeshConfig(dp=4), zero=False)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=2, seq_len=64,
+                        lr=3e-3, warmup_steps=2, total_steps=40,
+                        log_every=1000, dropout_deterministic=False)
+    tr = Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+    hb = _batch()
+    losses = [float(tr.train_step(hb)["loss"]) for _ in range(6)]
+    assert all(np.isfinite(losses))
+    assert losses[-1] < losses[0]
+
+
+# ---------------------------------------------------------------------------
+# wire.py <-> analyzer cross-validation (formula drift tripwire)
+# ---------------------------------------------------------------------------
+
+def test_wire_formulas_match_analyzer_on_lowered_programs():
+    """Every ring formula in comm/wire.py must agree with what the
+    analyzer reports for a real lowered program emitting that collective
+    — catches drift as new variants land."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from hetu_tpu.comm.wire import ring_wire_bytes
+    from hetu_tpu.core.mesh import create_mesh
+    from hetu_tpu.obs.comm import collective_table
+    n = 4
+    mesh = create_mesh(MeshConfig(dp=n))
+    N = 1024                      # local f32 elements
+    payload = N * 4.0
+
+    cases = {
+        "all-reduce": lambda x: jax.lax.psum(x, "dp"),
+        "reduce-scatter": lambda x: jax.lax.psum_scatter(
+            x, "dp", scatter_dimension=0, tiled=True),
+        "all-gather": lambda x: jax.lax.all_gather(
+            x, "dp", axis=0, tiled=True),
+        "all-to-all": lambda x: jax.lax.all_to_all(
+            x.reshape(n, N // n), "dp", split_axis=0, concat_axis=0
+        ).reshape(-1),
+    }
+    expected_payload = {
+        # analyzer formulas are output/buffer-anchored; translate each
+        # op's N-element local input into its formula payload
+        "all-reduce": payload,
+        "reduce-scatter": payload,             # (n-1) * shard == (n-1)/n * in
+        "all-gather": payload * n,             # gathered output
+        "all-to-all": payload,                 # local buffer
+    }
+    for op, fn in cases.items():
+        lowered = jax.jit(shard_map(
+            fn, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_rep=False)).lower(jnp.zeros((N,), jnp.float32)).compile()
+        rows = [r for r in collective_table(lowered) if r["op"] == op]
+        assert rows, f"no {op} in lowered HLO"
+        measured = sum(r["wire_bytes"] for r in rows)
+        analytic = ring_wire_bytes(op, expected_payload[op], n)
+        assert measured == pytest.approx(analytic, rel=1e-6), (
+            op, measured, analytic)
+
+
+# ---------------------------------------------------------------------------
+# analyzer while-loop trip counts (PR 2 static-undercount fix)
+# ---------------------------------------------------------------------------
+
+_WHILE_SYNTH = """\
+HloModule m
+%body.1 (p: (s32[], f32[1024])) -> (s32[], f32[1024]) {
+  %x = f32[1024]{0} all-reduce(f32[1024]{0} %a), replica_groups={{0,1,2,3}}
+}
+%cond.1 (p: (s32[], f32[1024])) -> pred[] {
+  %gte = s32[] get-tuple-element((s32[], f32[1024]) %p), index=0
+  %c5 = s32[] constant(8)
+  ROOT %cmp = pred[] compare(s32[] %gte, s32[] %c5), direction=LT
+}
+ENTRY %main (a: f32[1024]) -> f32[1024] {
+  %w = (s32[], f32[1024]) while((s32[], f32[1024]) %t), condition=%cond.1, body=%body.1
+  %y = f32[512]{0} all-gather(f32[128]{0} %b), replica_groups={{0,1,2,3}}, dimensions={0}
+}
+"""
+
+
+def test_analyzer_multiplies_while_body_collectives():
+    from hetu_tpu.obs.comm import collective_report
+    rep = collective_report(_WHILE_SYNTH, hw={
+        "chip": "t", "ici_allreduce_gbps": 45, "ici_p2p_gbps": 90})
+    assert rep["collectives"]["all-reduce"]["count"] == 8
+    assert rep["collectives"]["all-reduce"]["wire_bytes"] == pytest.approx(
+        8 * 2 * 3 / 4 * 4096)
+    assert rep["collectives"]["all-gather"]["count"] == 1  # outside loop
+    assert "dynamic_trip_count" not in rep
+
+
+def test_analyzer_flags_dynamic_trip_count():
+    from hetu_tpu.obs.comm import collective_report
+    dyn = _WHILE_SYNTH.replace("  %c5 = s32[] constant(8)\n", "").replace(
+        "%c5", "%gte2")
+    rep = collective_report(dyn, hw={"chip": "t"})
+    assert rep.get("dynamic_trip_count") is True
+    assert rep["collectives"]["all-reduce"]["count"] == 1  # counted once
+
+
+def test_analyzer_trip_count_nonzero_start_fori_loop():
+    """fori_loop(2, 10) must count 8 trips: XLA's while canonicalization
+    rebases the induction to 0 and folds the start into the compare
+    bound before the post-optimization text the analyzer parses — this
+    pins that assumption."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from hetu_tpu.core.mesh import create_mesh
+    from hetu_tpu.obs.comm import collective_report
+
+    mesh = create_mesh(MeshConfig(dp=4))
+
+    def step(x):
+        def body(i, c):
+            return c + jax.lax.psum(c, "dp")
+        return jax.lax.fori_loop(2, 10, body, x[0])[None]
+
+    compiled = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+        check_rep=False)).lower(jnp.ones((4, 256))).compile()
+    rep = collective_report(compiled, hw={
+        "chip": "t", "ici_allreduce_gbps": 45, "ici_p2p_gbps": 90})
+    assert rep["collectives"]["all-reduce"]["count"] == 8
+    assert "dynamic_trip_count" not in rep
+
+
+def test_analyzer_counts_real_scanned_collectives():
+    """A real lax.scan with a psum inside lowers to a while whose trip
+    count the analyzer must recover (the documented PR 2 undercount)."""
+    from jax.experimental.shard_map import shard_map
+    from jax.sharding import PartitionSpec as P
+    from hetu_tpu.core.mesh import create_mesh
+    from hetu_tpu.obs.comm import collective_report
+
+    mesh = create_mesh(MeshConfig(dp=4))
+
+    def step(x):
+        def body(c, _):
+            return c + jax.lax.psum(c, "dp"), None
+        y, _ = jax.lax.scan(body, x[0], None, length=5)
+        return y[None]
+
+    compiled = jax.jit(shard_map(
+        step, mesh=mesh, in_specs=(P("dp"),), out_specs=P("dp"),
+        check_rep=False)).lower(jnp.ones((4, 512))).compile()
+    rep = collective_report(compiled, hw={
+        "chip": "t", "ici_allreduce_gbps": 45, "ici_p2p_gbps": 90})
+    assert rep["collectives"]["all-reduce"]["count"] == 5
+    assert "dynamic_trip_count" not in rep
+
+
+# ---------------------------------------------------------------------------
+# hardware-profile schema validation (obs.mfu)
+# ---------------------------------------------------------------------------
+
+def test_hardware_profile_validates_on_load():
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    hw = load_hardware_profile()          # the repo profile must be valid
+    assert hw["topology"]["slice_devices"] >= 1
+
+
+@pytest.mark.parametrize("mutate,key", [
+    (lambda hw: hw.pop("ici_allreduce_gbps"), "ici_allreduce_gbps"),
+    (lambda hw: hw.update(bf16_tflops=-1), "bf16_tflops"),
+    (lambda hw: hw.update(chip=7), "chip"),
+    (lambda hw: hw["topology"].pop("inter_gbps"), "topology.inter_gbps"),
+    (lambda hw: hw["topology"].update(slice_shape=[3, 2]),
+     "topology.slice_shape"),
+    (lambda hw: hw.update(measured={"x": "nan?"}), "measured.x"),
+])
+def test_hardware_profile_schema_names_offending_key(mutate, key):
+    import copy
+    from hetu_tpu.obs.mfu import (load_hardware_profile,
+                                  validate_hardware_profile)
+    hw = copy.deepcopy(load_hardware_profile())
+    mutate(hw)
+    with pytest.raises(ValueError, match=key.replace(".", r"\.")):
+        validate_hardware_profile(hw, "unit")
+
+
+def test_hardware_profile_bad_file_is_loud(tmp_path, monkeypatch):
+    bad = tmp_path / "hw.json"
+    bad.write_text('{"chip": "v5e"}')
+    monkeypatch.setenv("HETU_TPU_HW_PROFILE", str(bad))
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    with pytest.raises(ValueError, match="bf16_tflops"):
+        load_hardware_profile()
+    bad.write_text("{not json")
+    with pytest.raises(ValueError, match="not valid JSON"):
+        load_hardware_profile()
+
+
+# ---------------------------------------------------------------------------
+# cost model: the searcher sees the quantized wire factors
+# ---------------------------------------------------------------------------
+
+def test_cost_model_ranking_reflects_wire_factors():
+    from hetu_tpu.search.cost_model import CostModel, StrategyCandidate
+    from hetu_tpu.search.profiler import HardwareProfile
+    hw = HardwareProfile(topology={"slice_devices": 4, "intra_gbps": 45.0,
+                                   "inter_gbps": 6.25})
+    cm = CostModel(hw=hw, num_layers=12, hidden=1024, intermediate=4096,
+                   vocab=32000, num_params=4e8, global_batch=64,
+                   seq_len=2048)
+    base = cm.step_time(StrategyCandidate(dp=8, zero=True))
+    gc8 = cm.step_time(StrategyCandidate(dp=8, zero=True,
+                                         grad_compress="int8"))
+    gc4 = cm.step_time(StrategyCandidate(dp=8, zero=True,
+                                         grad_compress="int4"))
+    two = cm.step_time(StrategyCandidate(dp=8, zero=True,
+                                         grad_compress="int8",
+                                         comm_topology="two_level"))
+    zr = cm.step_time(StrategyCandidate(dp=8, zero=True,
+                                        zero_refresh="int8"))
+    assert base > gc8 > gc4          # more compression, faster
+    assert gc8 > two                 # hierarchy beats the flat pod ring
+    assert base > zr                 # refresh compression alone helps
+    sp0 = cm.step_time(StrategyCandidate(dp=2, tp=4,
+                                         sequence_parallel=True))
+    sp8 = cm.step_time(StrategyCandidate(dp=2, tp=4,
+                                         sequence_parallel=True,
+                                         sp_compress="int8"))
+    sp4 = cm.step_time(StrategyCandidate(dp=2, tp=4,
+                                         sequence_parallel=True,
+                                         sp_compress="int4"))
+    assert sp0 > sp8 > sp4
+    # describe() carries the knobs so ranked tables stay readable
+    d = StrategyCandidate(dp=8, zero=True, grad_compress="int4",
+                          zero_refresh="int8",
+                          comm_topology="two_level").describe()
+    assert "gc4" in d and "zr8" in d and "2lvl" in d

@@ -36,7 +36,7 @@ def _golden(q, k, v):
     return attention(q, k, v, causal=True)
 
 
-@pytest.mark.parametrize("tp_eff", [(2, 2), (2, 1), (1, 2), (1, 1)])
+@pytest.mark.parametrize("tp_eff", [(2, 1), (1, 1)])
 def test_hetero_ring_matches_golden(tp_eff):
     """Any mix of effective tp degrees must reproduce plain causal
     attention exactly (the resplit slices never touch pad garbage)."""
@@ -51,6 +51,13 @@ def test_hetero_ring_matches_golden(tp_eff):
                                atol=2e-5)
 
 
+@pytest.mark.slow  # tier-1 budget: the remaining geometries
+@pytest.mark.parametrize("tp_eff", [(2, 2), (1, 2)])
+def test_hetero_ring_matches_golden_slow(tp_eff):
+    test_hetero_ring_matches_golden(tp_eff)
+
+
+@pytest.mark.slow  # see note above
 def test_hetero_ring_equals_homogeneous_ring():
     """With all tp_eff == tp the hetero path must be numerically the
     homogeneous ring (same merge order, same kernels)."""
@@ -65,6 +72,8 @@ def test_hetero_ring_equals_homogeneous_ring():
                                atol=1e-6)
 
 
+@pytest.mark.slow  # ~45-55s each on the CPU mesh; healed from the
+# jax-version failure block but too heavy for the tier-1 budget
 @pytest.mark.parametrize("tp_eff", [(2, 1), (1, 2)])
 def test_hetero_ring_grads_match_golden(tp_eff):
     """Full piggyback-dkv backward parity: grads of a scalar loss w.r.t.
@@ -104,6 +113,8 @@ def test_hetero_ring_validates_geometry():
             a, b_, c, tp_eff=(3, 2)), q, k, v, mesh)
 
 
+@pytest.mark.slow  # ~45-55s each on the CPU mesh; healed from the
+# jax-version failure block but too heavy for the tier-1 budget
 @pytest.mark.parametrize("tp_eff", [(2, 1), (2, 2)])
 def test_hetero_ring_gqa(tp_eff):
     """GQA: kv heads per device != q heads per device — the resplit must

@@ -136,6 +136,47 @@ def _hardware_free_comm_paths(dp: int = 8, tp: int = 4, batch: int = 8,
     return out
 
 
+def _hardware_free_serving(slots: int = 8, ctx: int = 2048):
+    """Analytic serving record for the bench config: continuous-batching
+    decode tokens/s (roofline over the profiled chip: params read once
+    per step, every slot reads its context KV) + per-sequence KV-cache
+    bytes across page modes (fp32 exact / fp16 / blockwise-int8 paged,
+    serving/kv_pool.py).  Hardware-free like the comm record — the
+    numbers BENCH tracks for the serving engine while the tunnel is
+    down (docs/serving.md)."""
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    from hetu_tpu.serving.kv_pool import kv_bytes_per_token
+    hw = load_hardware_profile()
+    cfg = _bench_config()
+    n = float(cfg.num_params())
+    L, hd = cfg.num_hidden_layers, cfg.head_dim
+    n_kv = cfg.num_key_value_heads
+    peak = float(hw["bf16_tflops"]) * 1e12
+    hbm = float(hw["hbm_gbps"]) * 1e9
+    # per decoded token: the 2N matmul FLOPs + attention over ctx cached
+    # positions (qk + pv, 2 * 2 * ctx * hidden)
+    flops_tok = 2.0 * n + 4.0 * L * ctx * cfg.hidden_size
+    kv = {m: kv_bytes_per_token(L, n_kv, hd, m) * ctx
+          for m in ("fp32", "fp16", "int8")}
+
+    def tokens_per_s(kv_mode):
+        # one batched decode step: params (bf16) read once, each slot
+        # reads its own context KV
+        step_bytes = 2.0 * n + slots * kv[kv_mode]
+        step_flops = slots * flops_tok
+        return slots / max(step_flops / peak, step_bytes / hbm)
+
+    rec = {
+        "slots": slots, "context": ctx,
+        "decode_tokens_per_s": round(tokens_per_s("fp16"), 1),
+        "decode_tokens_per_s_int8_kv": round(tokens_per_s("int8"), 1),
+        "kv_bytes_per_seq": {m: round(v, 1) for m, v in kv.items()},
+        "kv_ratio_int8_vs_fp32": round(kv["fp32"] / kv["int8"], 3),
+        "kv_ratio_int8_vs_fp16": round(kv["fp16"] / kv["int8"], 3),
+    }
+    return rec
+
+
 def main():
     import jax
     import jax.numpy as jnp
@@ -204,6 +245,11 @@ def main():
                         est_s + comm["int8_comm_s"])
             except Exception as e:
                 print(f"# hardware-free comm estimate failed: {e!r}",
+                      file=sys.stderr)
+            try:
+                detail["serving"] = _hardware_free_serving()
+            except Exception as e:
+                print(f"# hardware-free serving estimate failed: {e!r}",
                       file=sys.stderr)
             print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
                               "unit": "fraction_of_peak", "vs_baseline": 0.0,
@@ -324,6 +370,12 @@ def main():
         detail["comm_bytes_per_step"] = comm_a["fp32_wire_bytes"]
     except Exception as e:
         print(f"# comm attach failed: {e!r}", file=sys.stderr)
+    try:
+        # analytic serving companion (same meaning as the unreachable
+        # path): continuous-batching decode tokens/s + paged-KV bytes
+        detail["serving"] = _hardware_free_serving()
+    except Exception as e:
+        print(f"# serving attach failed: {e!r}", file=sys.stderr)
 
     # Second point: the largest model one 16G v5e fits.  fp32 Adam moments
     # bound it: p*(2 bf16 param + 8 fp32 m/v + 2 grad) + ~2G logits/acts

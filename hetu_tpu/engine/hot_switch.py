@@ -29,6 +29,17 @@ from hetu_tpu.utils.logging import get_logger
 logger = get_logger("hot_switch")
 
 
+def param_handle(model_factory, strategy: ParallelStrategy) -> StrategyHandle:
+    """Params-only plan-pool entry: a StrategyHandle with the strategy's
+    mesh + param shardings and NO optimizer-state shardings.  The serving
+    engine's reuse shim over the hot-switch machinery
+    (hetu_tpu/serving/reshard.py) — inference moves params, never
+    moments, so the handle stays cheap to build per load tier."""
+    model = model_factory(strategy)
+    mesh = strategy.build_mesh()
+    return StrategyHandle(strategy, model, mesh, model.shardings(mesh), None)
+
+
 class HotSwitchTrainer(Trainer):
     """Trainer over a pool of strategies (one model instance per strategy,
     same architecture/config, different layouts)."""

@@ -11,6 +11,13 @@ per-layer KV caches are stacked [L, b, max_len, n_kv, hd] and the decode
 step scans layers with the cache rows as per-layer xs/ys.  prefill and
 decode_step dispatch on the family (LLaMA: RMSNorm/rotary/fused-GQA QKV;
 GPT: LayerNorm/wpe/biased fused QKV).
+
+Serving-facing surface (hetu_tpu/serving, docs/serving.md): the decode
+step also comes in a slot-masked form — `decode_step_slots` takes a
+PER-SLOT position vector (each batch row is an independent sequence at
+its own depth) and returns this step's per-layer K/V so a paged cache
+can scatter them into its pool — and `extend_cache` is the multi-token
+(chunked-prefill) sibling that advances a cache by a whole token block.
 """
 from __future__ import annotations
 
@@ -25,20 +32,47 @@ from hetu_tpu import ops
 
 
 def _attend_cached(q, ck, cv, pos, scale):
-    """q: [b, 1, nq, hd]; ck/cv: [b, M, n_kv, hd]; attend over cache[:pos+1]."""
+    """q: [b, 1, nq, hd]; ck/cv: [b, M, n_kv, hd]; attend over
+    cache[:pos+1] (pos scalar, or [b] for per-slot depths).
+
+    GQA attends in the GROUPED layout — q reshaped [b, C, n_kv, g, hd]
+    and contracted against the cache's n_kv heads directly — instead of
+    materializing a group-repeated copy of the whole cache every step
+    (the old jnp.repeat path copied M*n_kv*hd*(g-1) elements per layer
+    per token).  Head ordering matches the fused-QKV layout (q head
+    j = kv head j // g): the q·k scores are bit-identical to the repeat
+    path and the p·v output matches to float32-ulp (the weighted sum
+    over the cache axis reassociates without the materialized copy) —
+    regression-tested in tests/test_generation.py.
+
+    This is exactly the single-query case of `_attend_cached_chunk`
+    (one query at offset 0 from `pos`) — ONE implementation of the
+    grouped contraction + causal mask, so decode and chunked prefill
+    can never drift numerically."""
+    return _attend_cached_chunk(q, ck, cv, pos, scale)
+
+
+def _attend_cached_chunk(q, ck, cv, start, scale):
+    """Multi-query cached attention for chunked prefill.  q: [b, C, nq,
+    hd] sits at absolute positions start..start+C-1 (start scalar or
+    [b]); key position k is visible to query i iff k <= start + i
+    (causal within the chunk, full visibility of the already-cached
+    prefix).  Same grouped-GQA contraction as `_attend_cached`."""
     b, M, n_kv, hd = ck.shape
-    nq = q.shape[2]
+    C, nq = q.shape[1], q.shape[2]
     group = nq // n_kv
-    if group > 1:
-        ck = jnp.repeat(ck, group, axis=2)
-        cv = jnp.repeat(cv, group, axis=2)
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+    qg = q.reshape(b, C, n_kv, group, hd)
+    s = jnp.einsum("bqhgd,bkhd->bhgqk", qg.astype(jnp.float32),
                    ck.astype(jnp.float32)) * scale
-    mask = jnp.arange(M)[None, None, None, :] <= pos
-    s = jnp.where(mask, s, -1e30)
+    start = jnp.asarray(start)
+    if start.ndim == 0:
+        start = start[None]
+    qpos = start[:, None] + jnp.arange(C)[None, :]            # [b, C]
+    mask = jnp.arange(M)[None, None, :] <= qpos[..., None]    # [b, C, M]
+    s = jnp.where(mask[:, None, None, :, :], s, -1e30)
     p = jax.nn.softmax(s, axis=-1)
-    out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
-    return out.astype(q.dtype)
+    out = jnp.einsum("bhgqk,bkhd->bqhgd", p, cv.astype(jnp.float32))
+    return out.reshape(b, C, nq, hd).astype(q.dtype)
 
 
 def _is_gpt(model) -> bool:
@@ -95,11 +129,27 @@ def _prefill_gpt(model, params, input_ids, max_len: int):
     return logits, (cache_k, cache_v)
 
 
-def _decode_step_gpt(model, params, token, cache, pos):
+def _cache_write_token(ck, k, positions, uniform: bool):
+    """Write one token's K (or V) [b, 1, n_kv, hd] into a cache row
+    [b, M, n_kv, hd] at `positions`.  Uniform (scalar) positions keep
+    the old contiguous dynamic_update_slice lowering — the generate()
+    hot loop must not pay batched-scatter cost for a broadcast index —
+    per-slot vectors scatter per row (the serving form)."""
+    if uniform:
+        return lax.dynamic_update_slice(ck, k.astype(ck.dtype),
+                                        (0, positions, 0, 0))
+    b = ck.shape[0]
+    return ck.at[jnp.arange(b), positions].set(k[:, 0].astype(ck.dtype))
+
+
+def _decode_step_slots_gpt(model, params, tokens, cache, positions):
     c = model.config
     mp = params["model"]
-    b = token.shape[0]
-    x = _gpt_embed(model, mp, token[:, None], jnp.full((1,), pos, jnp.int32))
+    b = tokens.shape[0]
+    uniform = jnp.ndim(positions) == 0
+    pos_ids = (jnp.broadcast_to(positions, (1,)) if uniform
+               else positions[:, None])
+    x = _gpt_embed(model, mp, tokens[:, None], pos_ids)
     block = model.model.block
     att = block.attn
     nh, hd = c.num_attention_heads, c.head_dim
@@ -113,18 +163,20 @@ def _decode_step_gpt(model, params, token, cache, pos):
                          lp["attn"]["wqkv"].astype(h.dtype)) \
             + lp["attn"]["bqkv"].astype(h.dtype)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-        attn = _attend_cached(q, ck, cv, pos, scale)
+        kt, vt = k[:, 0], v[:, 0]                       # [b, n_kv, hd]
+        ck = _cache_write_token(ck, k, positions, uniform)
+        cv = _cache_write_token(cv, v, positions, uniform)
+        attn = _attend_cached(q, ck, cv, positions, scale)
         h = h + att.o_proj(lp["attn"]["o_proj"],
                            attn.reshape(b, 1, nh * hd))
         h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
-        return h, (ck, cv)
+        return h, (ck, cv, kt, vt)
 
-    x, (new_k, new_v) = lax.scan(body, x, (mp["blocks"], cache_k, cache_v))
+    x, (new_k, new_v, k_toks, v_toks) = lax.scan(
+        body, x, (mp["blocks"], cache_k, cache_v))
     hidden = model.model.final_ln(mp["final_ln"], x)
     logits = model.logits(params, hidden)[:, 0, :]
-    return logits, (new_k, new_v)
+    return logits, (new_k, new_v), (k_toks, v_toks)
 
 
 def prefill(model, params, input_ids, max_len: int):
@@ -171,24 +223,34 @@ def prefill(model, params, input_ids, max_len: int):
     return logits, (cache_k, cache_v)
 
 
-def decode_step(model, params, token, cache, pos):
-    """One token step. token: [b] int32; pos: scalar current position.
-    Returns (logits [b, vocab], new_cache)."""
+def decode_step_slots(model, params, tokens, cache, positions):
+    """One token step with PER-SLOT positions (the serving engine's form:
+    each batch row is an independent sequence at its own depth).
+
+    tokens: [b] int32; positions: [b] int32 (this token's absolute
+    position per slot) — or a scalar, which keeps the old contiguous
+    dynamic_update_slice cache lowering for the uniform-position
+    generate() hot loop.  Returns (logits [b, vocab], new_cache,
+    (k_toks, v_toks)) where k_toks/v_toks are THIS step's per-layer K/V
+    [L, b, n_kv, hd] — a paged cache scatters them into its pool instead
+    of carrying the dense cache."""
     c = model.config
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
                          "params)")
     if _is_gpt(model):
-        return _decode_step_gpt(model, params, token, cache, pos)
+        return _decode_step_slots_gpt(model, params, tokens, cache, positions)
     mp = params["model"]
-    b = token.shape[0]
-    x = model.model.embed(mp["embed"], token[:, None]).astype(c.compute_dtype)
+    b = tokens.shape[0]
+    uniform = jnp.ndim(positions) == 0
+    x = model.model.embed(mp["embed"], tokens[:, None]).astype(c.compute_dtype)
     cos, sin = ops.build_rope_cache(c.max_position_embeddings, c.head_dim,
                                     c.rope_theta)
     block = model.model.layers.block
     att = block.attn
     scale = c.head_dim ** -0.5
-    pos_ids = jnp.full((b, 1), pos, jnp.int32)
+    pos_ids = (jnp.broadcast_to(positions, (b, 1)) if uniform
+               else positions[:, None])
     cache_k, cache_v = cache
 
     def body(carry, xs):
@@ -202,11 +264,117 @@ def decode_step(model, params, token, cache, pos):
         v = qkv[..., att.group + 1, :]
         q = ops.apply_rotary(q, cos, sin, pos_ids)
         k = ops.apply_rotary(k, cos, sin, pos_ids)
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, pos, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, pos, 0, 0))
-        attn = _attend_cached(q, ck, cv, pos, scale)
+        kt, vt = k[:, 0], v[:, 0]                       # [b, n_kv, hd]
+        ck = _cache_write_token(ck, k, positions, uniform)
+        cv = _cache_write_token(cv, v, positions, uniform)
+        attn = _attend_cached(q, ck, cv, positions, scale)
         h = h + att.o_proj(layer_params["attn"]["o_proj"],
                            attn.reshape(b, 1, att.n_q * c.head_dim))
+        mlp_out = block.mlp(layer_params["mlp"],
+                            block.post_norm(layer_params["post_norm"], h))
+        if isinstance(mlp_out, tuple):  # MoE
+            mlp_out = mlp_out[0]
+        h = h + mlp_out
+        return h, (ck, cv, kt, vt)
+
+    x, (new_k, new_v, k_toks, v_toks) = lax.scan(
+        body, x, (mp["layers"]["layers"], cache_k, cache_v))
+    hidden = model.model.final_norm(mp["final_norm"], x)
+    logits = model.logits(params, hidden)[:, 0, :]
+    return logits, (new_k, new_v), (k_toks, v_toks)
+
+
+def decode_step(model, params, token, cache, pos):
+    """One token step. token: [b] int32; pos: scalar current position.
+    Returns (logits [b, vocab], new_cache).  Delegates to the slot-masked
+    form; the scalar position keeps the contiguous cache-update
+    lowering."""
+    logits, new_cache, _ = decode_step_slots(
+        model, params, token, cache, jnp.asarray(pos, jnp.int32))
+    return logits, new_cache
+
+
+def _extend_cache_gpt(model, params, tokens, cache, start):
+    c = model.config
+    mp = params["model"]
+    b, C = tokens.shape
+    rows = jnp.arange(b)
+    start = jnp.broadcast_to(jnp.asarray(start, jnp.int32), (b,))
+    qpos = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [b, C]
+    x = _gpt_embed(model, mp, tokens, qpos)
+    block = model.model.block
+    att = block.attn
+    nh, hd = c.num_attention_heads, c.head_dim
+    scale = hd ** -0.5
+    cache_k, cache_v = cache
+
+    def body(h, xs):
+        lp, ck, cv = xs
+        hn = block.ln1(lp["ln1"], h)
+        qkv = jnp.einsum("bsh,hngd->bsngd", hn,
+                         lp["attn"]["wqkv"].astype(h.dtype)) \
+            + lp["attn"]["bqkv"].astype(h.dtype)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        ck = ck.at[rows[:, None], qpos].set(k.astype(ck.dtype))
+        cv = cv.at[rows[:, None], qpos].set(v.astype(cv.dtype))
+        attn = _attend_cached_chunk(q, ck, cv, start, scale)
+        h = h + att.o_proj(lp["attn"]["o_proj"],
+                           attn.reshape(b, C, nh * hd))
+        h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
+        return h, (ck, cv)
+
+    x, (new_k, new_v) = lax.scan(body, x, (mp["blocks"], cache_k, cache_v))
+    hidden = model.model.final_ln(mp["final_ln"], x)
+    logits = model.logits(params, hidden)
+    return logits, (new_k, new_v)
+
+
+def extend_cache(model, params, tokens, cache, start):
+    """Advance a KV cache by a whole token block (chunked prefill).
+
+    tokens: [b, C] int32 at absolute positions start..start+C-1 (start
+    scalar or [b]); the chunk's K/V are written into the cache and each
+    query attends causally over cache[:start+i+1].  Returns
+    (logits [b, C, vocab], new_cache).  Running consecutive chunks
+    through this is numerically the incremental form of `prefill` — the
+    serving engine uses it so one long prompt never stalls the decode
+    batch (docs/serving.md)."""
+    c = model.config
+    if not c.use_scan:
+        raise ValueError("generation requires use_scan=True (stacked layer "
+                         "params)")
+    if _is_gpt(model):
+        return _extend_cache_gpt(model, params, tokens, cache, start)
+    mp = params["model"]
+    b, C = tokens.shape
+    rows = jnp.arange(b)
+    start = jnp.broadcast_to(jnp.asarray(start, jnp.int32), (b,))
+    qpos = start[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]  # [b, C]
+    x = model.model.embed(mp["embed"], tokens).astype(c.compute_dtype)
+    cos, sin = ops.build_rope_cache(c.max_position_embeddings, c.head_dim,
+                                    c.rope_theta)
+    block = model.model.layers.block
+    att = block.attn
+    scale = c.head_dim ** -0.5
+
+    cache_k, cache_v = cache
+
+    def body(carry, xs):
+        h = carry
+        layer_params, ck, cv = xs
+        hn = block.input_norm(layer_params["input_norm"], h)
+        qkv = jnp.einsum("bsh,hkgd->bskgd", hn,
+                         layer_params["attn"]["wqkv"].astype(h.dtype))
+        q = qkv[..., : att.group, :].reshape(b, C, att.n_q, c.head_dim)
+        k = qkv[..., att.group, :]
+        v = qkv[..., att.group + 1, :]
+        q = ops.apply_rotary(q, cos, sin, qpos)
+        k = ops.apply_rotary(k, cos, sin, qpos)
+        ck = ck.at[rows[:, None], qpos].set(k.astype(ck.dtype))
+        cv = cv.at[rows[:, None], qpos].set(v.astype(cv.dtype))
+        attn = _attend_cached_chunk(q, ck, cv, start, scale)
+        h = h + att.o_proj(layer_params["attn"]["o_proj"],
+                           attn.reshape(b, C, att.n_q * c.head_dim))
         mlp_out = block.mlp(layer_params["mlp"],
                             block.post_norm(layer_params["post_norm"], h))
         if isinstance(mlp_out, tuple):  # MoE
@@ -217,7 +385,7 @@ def decode_step(model, params, token, cache, pos):
     x, (new_k, new_v) = lax.scan(
         body, x, (mp["layers"]["layers"], cache_k, cache_v))
     hidden = model.model.final_norm(mp["final_norm"], x)
-    logits = model.logits(params, hidden)[:, 0, :]
+    logits = model.logits(params, hidden)
     return logits, (new_k, new_v)
 
 
@@ -225,15 +393,26 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
              temperature: float = 0.0, top_k: Optional[int] = None,
              top_p: Optional[float] = None,
              rng: Optional[jax.Array] = None,
-             eos_id: Optional[int] = None):
+             eos_id: Optional[int] = None,
+             eos_token_id: Optional[int] = None,
+             pad_token_id: Optional[int] = None):
     """Autoregressive generation (greedy when temperature == 0; top_k
     and/or top_p (nucleus) filtering when sampling).
-    input_ids: [b, plen] int32 -> [b, plen + max_new_tokens]."""
+    input_ids: [b, plen] int32 -> [b, plen + max_new_tokens].
+
+    EOS handling: with eos_token_id (alias: eos_id) set, a sequence that
+    emits EOS is done — it keeps emitting `pad_token_id` (default: the
+    EOS id itself, the pre-serving behavior) and, once EVERY sequence in
+    the batch is done, the remaining scan iterations skip the decode
+    computation entirely via lax.cond (the same active-mask early-exit
+    the serving scheduler uses per slot)."""
     b, plen = input_ids.shape
     max_len = plen + max_new_tokens
     # context-length validation happens in prefill (_check_context_length)
     logits, cache = prefill(model, params, input_ids, max_len)
     rng = rng if rng is not None else jax.random.key(0)
+    eos = eos_token_id if eos_token_id is not None else eos_id
+    fill = pad_token_id if pad_token_id is not None else eos
 
     def sample(logits, key):
         if temperature == 0.0:
@@ -269,10 +448,18 @@ def generate(model, params, input_ids, *, max_new_tokens: int,
         logits, cache, key, done = carry
         key, sub = jax.random.split(key)
         tok = sample(logits, sub)
-        if eos_id is not None:
-            tok = jnp.where(done, eos_id, tok)
-            done = done | (tok == eos_id)
-        logits, cache = decode_step(model, params, tok, cache, plen + i)
+        if eos is not None:
+            tok = jnp.where(done, fill, tok)
+            done = done | (tok == eos)
+            # all sequences finished -> skip the whole decode computation
+            # (a real branch under the scan: only the taken side runs)
+            logits, cache = lax.cond(
+                jnp.all(done),
+                lambda c: c,
+                lambda c: decode_step(model, params, tok, c[1], plen + i),
+                (logits, cache))
+        else:
+            logits, cache = decode_step(model, params, tok, cache, plen + i)
         return (logits, cache, key, done), tok
 
     done0 = jnp.zeros((b,), bool)

@@ -23,6 +23,13 @@ Kind fields:
                   baseline — online health-detector firings
     straggler     stragglers (flagged ranks), workers (per-rank
                   ratio/z) — the cluster straggler report transitions
+    serve         event (admit | done | reshard | report) + the serving
+                  SLO fields (hetu_tpu/serving, docs/serving.md):
+                  admit: req, slot, prompt_len, chunks, ttft_s;
+                  done: req, reason, tokens, ttft_s, e2e_s, tokens_per_s,
+                  queue_depth, slot_occupancy, page_util;
+                  reshard: tier, strategy; report: requests, tokens,
+                  elapsed_s, tokens_per_s
     rotated       segment, records — the size-cap rotation marker (the
                   last record of a rotated segment)
     summary       metrics (a MetricsRegistry snapshot), profiler summary

@@ -1,0 +1,33 @@
+"""Serving subsystem: continuous batching + paged KV cache over the
+training stack (docs/serving.md).
+
+    from hetu_tpu import serving
+    eng = serving.ServingEngine(model, params,
+                                serving.ServeConfig(num_slots=8))
+    results = eng.run(serving.synthetic_requests(16, vocab_size=256,
+                                                 seed=0))
+
+Deliberately NOT imported from the package root: training paths never
+pay for (or lower differently because of) the serving stack — the
+serving flags (HETU_TPU_KV_QUANT + the serve-shape flags) are read
+only inside this package, so leaving them unset cannot perturb any
+training program.
+"""
+from hetu_tpu.serving.engine import ServeConfig, ServingEngine  # noqa: F401
+from hetu_tpu.serving.kv_pool import (PagePool,  # noqa: F401
+                                      PoolArrays, kv_bytes_per_token)
+from hetu_tpu.serving.request import (Request,  # noqa: F401
+                                      RequestResult, RequestStats)
+from hetu_tpu.serving.reshard import LoadAdaptiveMesh  # noqa: F401
+from hetu_tpu.serving.scheduler import Scheduler, SlotState  # noqa: F401
+from hetu_tpu.serving.traces import (bursty_arrivals,  # noqa: F401
+                                     poisson_arrivals, synthetic_requests)
+
+__all__ = [
+    "ServingEngine", "ServeConfig",
+    "PagePool", "PoolArrays", "kv_bytes_per_token",
+    "Request", "RequestResult", "RequestStats",
+    "Scheduler", "SlotState",
+    "LoadAdaptiveMesh",
+    "poisson_arrivals", "bursty_arrivals", "synthetic_requests",
+]

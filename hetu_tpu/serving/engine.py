@@ -1,0 +1,415 @@
+"""Serving engine: continuous batching + paged KV cache over the
+training stack.
+
+The engine runs THREE jitted programs, all static-shape (TPU-shaped —
+one compile each, no shape-bucket churn):
+
+  * prefill chunk   — `models/generation.extend_cache` over a
+                      [1, prefill_chunk] token block into a per-request
+                      scratch cache.  Prefill is its OWN program
+                      (disaggregated from decode) and advances ONE chunk
+                      per engine step, interleaved with the decode
+                      batch: a long prompt costs extra engine steps for
+                      its own slot, never a multi-chunk stall in the
+                      other requests' inter-token gap.
+  * prefill write   — scatter the scratch K/V into the slot's pool pages
+                      (quantizing in the int8 page mode).
+  * decode step     — gather every slot's pages to dense views, run
+                      `decode_step_slots` over the full slot batch with
+                      per-slot positions, scatter the new token K/V back
+                      into the pool, argmax.  Inactive slots ride along
+                      pointing at the null page.
+
+Between device steps the host-side `Scheduler` admits/evicts at token
+granularity and the engine stamps SLO metrics into the `obs` registry
+(serve.* counters/gauges/histograms) and RunLog ``serve`` events — the
+same observability spine training runs use, so `tools_obs_report.py`
+reads a serving run like any other.
+
+Decoding is greedy (per-request EOS, length budgets).  Model families:
+llama + gpt, via the family dispatch in `models/generation`.
+
+The optional `reshard` hook (`serving/reshard.LoadAdaptiveMesh`) is the
+Hetis move: queue-depth tier changes re-shard the serving params through
+the hot-switch ParamSlice machinery.
+
+See docs/serving.md for the architecture and known limits.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+import time
+from typing import List, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.models.generation import (_check_context_length,
+                                        decode_step_slots, extend_cache)
+from hetu_tpu.obs.metrics import MetricsRegistry, get_registry
+from hetu_tpu.obs.runlog import RunLog, default_runlog_path
+from hetu_tpu.serving.kv_pool import PagePool, PoolArrays
+from hetu_tpu.serving.request import Request, RequestResult
+from hetu_tpu.serving.scheduler import Scheduler
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("serving.engine")
+
+
+@dataclasses.dataclass
+class ServeConfig:
+    """Engine shape knobs (all static: they pick the compiled programs).
+
+    num_pages=0 sizes the pool for FULL reservation —
+    num_slots * (max_len / page_size) usable pages, so admission never
+    waits on pages, only on slots.  Smaller pools trade queueing delay
+    for memory (the scheduler's reserve-on-admit keeps it deadlock-free
+    either way)."""
+    num_slots: int = 8
+    page_size: int = 16
+    max_len: int = 256
+    prefill_chunk: int = 32
+    num_pages: int = 0
+    kv_quant: str = "none"           # "none" (exact, default) | "int8"
+
+    def __post_init__(self):
+        if self.max_len % self.page_size:
+            raise ValueError(f"max_len {self.max_len} must be a multiple "
+                             f"of page_size {self.page_size}")
+        if self.max_len % self.prefill_chunk:
+            # the chunk program pads prompts to a chunk multiple; an
+            # uneven tail would scatter past the [.., max_len, ..]
+            # scratch cache (silently dropped by XLA — refuse instead of
+            # leaning on out-of-bounds semantics)
+            raise ValueError(f"max_len {self.max_len} must be a multiple "
+                             f"of prefill_chunk {self.prefill_chunk}")
+        if self.kv_quant not in ("none", "int8"):
+            raise ValueError(f"kv_quant {self.kv_quant!r} invalid; "
+                             "choices: ('none', 'int8')")
+        if self.num_pages == 0:
+            self.num_pages = self.num_slots * (self.max_len
+                                               // self.page_size)
+
+    @staticmethod
+    def from_flags(**overrides) -> "ServeConfig":
+        """Defaults from the serving flag surface (utils/flags.py:
+        HETU_TPU_KV_QUANT + the serve-shape flags); explicit kwargs
+        win."""
+        from hetu_tpu.utils import flags
+        vals = dict(
+            num_slots=flags.int_flag("HETU_TPU_SERVE_SLOTS"),
+            page_size=flags.int_flag("HETU_TPU_SERVE_PAGE"),
+            max_len=flags.int_flag("HETU_TPU_SERVE_MAX_LEN"),
+            prefill_chunk=flags.int_flag("HETU_TPU_SERVE_PREFILL_CHUNK"),
+            num_pages=flags.int_flag("HETU_TPU_SERVE_PAGES"),
+            kv_quant=flags.str_flag("HETU_TPU_KV_QUANT"),
+        )
+        vals.update(overrides)
+        return ServeConfig(**vals)
+
+
+class ServingEngine:
+    """Continuous-batching facade over (model, params)."""
+
+    def __init__(self, model, params, config: Optional[ServeConfig] = None,
+                 *, run_log: Optional[RunLog] = None,
+                 registry: Optional[MetricsRegistry] = None,
+                 reshard=None):
+        self.model = model
+        self.params = params
+        self.config = config or ServeConfig.from_flags()
+        c = model.config
+        _check_context_length(c, self.config.max_len)
+        n_kv = getattr(c, "num_key_value_heads", c.num_attention_heads)
+        self.pool = PagePool(
+            num_layers=c.num_hidden_layers,
+            num_pages=self.config.num_pages,
+            page_size=self.config.page_size,
+            num_kv_heads=n_kv, head_dim=c.head_dim,
+            dtype=c.compute_dtype, quant=self.config.kv_quant)
+        self.scheduler = Scheduler(num_slots=self.config.num_slots,
+                                   pool=self.pool,
+                                   max_len=self.config.max_len)
+        self.reshard = reshard
+        self._registry = registry if registry is not None else get_registry()
+        if run_log is None:
+            path = default_runlog_path(None)
+            run_log = RunLog(path) if path else None
+            self._owns_runlog = run_log is not None
+        else:
+            self._owns_runlog = False
+        self.run_log = run_log
+
+        # per-request prefill scratch: a dense [L, 1, max_len] cache the
+        # chunk program advances; template zeros reused (functionally)
+        # for every admission
+        shape = (c.num_hidden_layers, 1, self.config.max_len, n_kv,
+                 c.head_dim)
+        self._scratch = (jnp.zeros(shape, c.compute_dtype),
+                         jnp.zeros(shape, c.compute_dtype))
+        self._build_programs()
+
+    # ------------------------------------------------------------ build
+    def _build_programs(self):
+        model, pool = self.model, self.pool
+
+        def decode_fn(params, pool_tree, table, tokens, positions):
+            ck, cv = pool.gather(pool_tree, table)
+            logits, _, (kt, vt) = decode_step_slots(
+                model, params, tokens, (ck, cv), positions)
+            new_tree = pool.write_token(pool_tree, table, positions, kt, vt)
+            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            return nxt, new_tree
+
+        def chunk_fn(params, chunk, cache, start):
+            return extend_cache(model, params, chunk, cache, start)
+
+        def write_fn(pool_tree, pages_row, ks, vs):
+            return pool.write_pages(pool_tree, pages_row, ks, vs)
+
+        # the pool tree is donated: the KV pool is the engine's dominant
+        # allocation and it flows through every step — without donation
+        # XLA would copy the whole pool to update one token per slot
+        # (the engine always reassigns self.pool.arrays from the
+        # returned tree, so the donated input is never reused)
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+        self._chunk_jit = jax.jit(chunk_fn)
+        self._write_jit = jax.jit(write_fn, donate_argnums=(0,))
+
+    def warmup(self):
+        """Compile all three programs so the first request's TTFT is not
+        a compile.  The dummy decode/write still target the null page
+        (zero table/row), so pool CONTENT is untouched — but the pool
+        trees are donated through the calls, so the returned trees must
+        be committed back (discarding them would leave self.pool.arrays
+        pointing at deleted buffers on donating backends)."""
+        S, C = self.config.num_slots, self.config.prefill_chunk
+        table = jnp.zeros((S, self.scheduler.max_pages), jnp.int32)
+        toks = jnp.zeros(S, jnp.int32)
+        pos = jnp.zeros(S, jnp.int32)
+        nxt, tree = self._decode_jit(self.params, self.pool.arrays.tree(),
+                                     table, toks, pos)
+        self.pool.arrays = PoolArrays.from_tree(tree)
+        lg, cache = self._chunk_jit(self.params,
+                                    jnp.zeros((1, C), jnp.int32),
+                                    self._scratch, jnp.int32(0))
+        row = jnp.zeros(self.scheduler.max_pages, jnp.int32)
+        tree = self._write_jit(self.pool.arrays.tree(), row,
+                               cache[0][:, 0], cache[1][:, 0])
+        self.pool.arrays = PoolArrays.from_tree(tree)
+        jax.block_until_ready(nxt)
+        return self
+
+    # ----------------------------------------------------------- intake
+    def submit(self, req: Request, now: Optional[float] = None):
+        if now is not None:
+            req.arrival_t = now
+        self.scheduler.submit(req)
+        self._registry.inc("serve.requests_submitted")
+
+    # ------------------------------------------------------------- step
+    def step(self, now: float) -> List[RequestResult]:
+        """One engine iteration at driver time `now`: admit every
+        admissible queued request (reservation only), advance each
+        PREFILLING slot by exactly ONE chunk, then one decode step over
+        the slots whose prefill is complete.  One-chunk-per-step is the
+        disaggregation contract: a long prompt adds engine steps for its
+        own slot, never a multi-chunk stall to the decode batch's
+        inter-token gap.  Returns requests that finished this step."""
+        t0 = time.perf_counter()
+
+        def clock() -> float:
+            return now + (time.perf_counter() - t0)
+
+        finished: List[RequestResult] = []
+        while True:
+            adm = self.scheduler.admit_next(clock())
+            if adm is None:
+                break
+            slot_idx, st = adm
+            st.prefilling = True
+            st.prefill_cache = self._scratch
+
+        for i in self.scheduler.active_slots():
+            st = self.scheduler.slots[i]
+            if st is not None and st.prefilling:
+                self._advance_prefill(i, st, clock, finished)
+
+        active = [i for i in self.scheduler.active_slots()
+                  if not self.scheduler.slots[i].prefilling]
+        if active:
+            td = time.perf_counter()
+            # the decode batch's inputs are DERIVED from scheduler state
+            # every step (single source of truth): last emitted token +
+            # next write position per decoding slot; empty/prefilling
+            # rows ride along at (0, 0) writing into their masked region
+            tokens = np.zeros(self.config.num_slots, np.int32)
+            positions = np.zeros(self.config.num_slots, np.int32)
+            for i in active:
+                st = self.scheduler.slots[i]
+                tokens[i] = st.generated[-1]
+                positions[i] = st.pos
+            nxt, pool_tree = self._decode_jit(
+                self.params, self.pool.arrays.tree(),
+                jnp.asarray(self.scheduler.page_table),
+                jnp.asarray(tokens), jnp.asarray(positions))
+            nxt = np.asarray(nxt)
+            self.pool.arrays = PoolArrays.from_tree(pool_tree)
+            decode_wall = time.perf_counter() - td
+            self._registry.inc("serve.decode_steps")
+            # token_latency_s is the USER-visible inter-token gap: every
+            # active slot advances one token per decode step, so the gap
+            # IS the step wall.  The amortized per-token engine cost
+            # (wall / active slots — the throughput number) is its own
+            # series; conflating them would understate latency by up to
+            # num_slots x.
+            self._registry.observe("serve.token_latency_s", decode_wall)
+            self._registry.observe("serve.token_cost_s",
+                                   decode_wall / len(active))
+            tnow = clock()
+            for i in active:
+                st = self.scheduler.slots[i]
+                tok = int(nxt[i])
+                st.generated.append(tok)
+                st.pos += 1
+                self._registry.inc("serve.tokens_out")
+                self._maybe_finish(i, st, tok, tnow, finished)
+
+        self._registry.set_gauge("serve.queue_depth",
+                                 self.scheduler.queue_depth)
+        self._registry.set_gauge("serve.slot_occupancy",
+                                 self.scheduler.occupancy)
+        self._registry.set_gauge("serve.page_util", self.pool.utilization)
+
+        if self.reshard is not None:
+            tier = self.reshard.observe(self.scheduler.queue_depth)
+            if tier is not None:
+                with self._registry.timer("serve.reshard_s"):
+                    self.params = self.reshard.reshard(self.params, tier)
+                self._registry.inc("serve.reshards")
+                if self.run_log is not None:
+                    self.run_log.log("serve", event="reshard", tier=tier,
+                                     strategy=self.reshard.describe(tier),
+                                     queue_depth=self.scheduler.queue_depth)
+        return finished
+
+    # ---------------------------------------------------------- prefill
+    def _advance_prefill(self, slot_idx: int, st, clock, finished):
+        """Run ONE prefill chunk for a prefilling slot; on the last
+        chunk, scatter the scratch K/V into the slot's pages, emit the
+        first token, and join the decode batch."""
+        req = st.request
+        plen = req.prompt_len
+        C = self.config.prefill_chunk
+        padded = math.ceil(plen / C) * C
+        s = st.chunks_done * C
+        ids = np.zeros(C, np.int32)
+        seg = req.prompt[s: min(s + C, plen)]
+        ids[: len(seg)] = seg
+        logits, st.prefill_cache = self._chunk_jit(
+            self.params, jnp.asarray(ids[None]), st.prefill_cache,
+            jnp.int32(s))
+        st.chunks_done += 1
+        st.stats.prefill_chunks += 1
+        self._registry.inc("serve.prefill_chunks")
+        if s + C < padded:
+            return                        # more chunks: next engine step
+        # first generated token: argmax at the last VALID prompt position
+        # of the final chunk (padding tail positions carry garbage)
+        t1 = int(np.argmax(np.asarray(logits[0, plen - 1 - s])))
+
+        pages_row = np.full(self.scheduler.max_pages, PagePool.NULL_PAGE,
+                            np.int32)
+        pages_row[: len(st.pages)] = st.pages
+        tree = self._write_jit(self.pool.arrays.tree(),
+                               jnp.asarray(pages_row),
+                               st.prefill_cache[0][:, 0],
+                               st.prefill_cache[1][:, 0])
+        self.pool.arrays = PoolArrays.from_tree(tree)
+
+        st.prefilling = False
+        st.prefill_cache = None
+        st.pos = plen
+        st.generated.append(t1)
+        tnow = clock()
+        st.stats.first_token_t = tnow
+        ttft = st.stats.ttft_s
+        self._registry.observe("serve.ttft_s", ttft)
+        if st.stats.queue_wait_s is not None:
+            self._registry.observe("serve.queue_wait_s",
+                                   st.stats.queue_wait_s)
+        self._registry.inc("serve.tokens_out")
+        if self.run_log is not None:
+            self.run_log.log("serve", event="admit", req=req.rid,
+                             slot=slot_idx, prompt_len=plen,
+                             chunks=st.stats.prefill_chunks, ttft_s=ttft,
+                             queue_depth=self.scheduler.queue_depth,
+                             page_util=self.pool.utilization)
+        self._maybe_finish(slot_idx, st, t1, tnow, finished)
+
+    # ----------------------------------------------------------- finish
+    def _maybe_finish(self, slot_idx: int, st, tok: int, tnow: float,
+                      finished):
+        req = st.request
+        reason = None
+        if req.eos_token_id is not None and tok == req.eos_token_id:
+            reason = "eos"
+        elif len(st.generated) >= req.max_new_tokens:
+            reason = "length"
+        if reason is None:
+            return
+        st.stats.done_t = tnow
+        res = RequestResult(rid=req.rid, tokens=list(st.generated),
+                            finished_reason=reason, stats=st.stats)
+        self.scheduler.release(slot_idx)
+        self._registry.inc("serve.requests_done")
+        if st.stats.e2e_s is not None:
+            self._registry.observe("serve.e2e_s", st.stats.e2e_s)
+        if self.run_log is not None:
+            self.run_log.log(
+                "serve", event="done", req=req.rid, slot=slot_idx,
+                reason=reason, tokens=len(res.tokens),
+                ttft_s=st.stats.ttft_s, e2e_s=st.stats.e2e_s,
+                tokens_per_s=res.tokens_per_s,
+                queue_depth=self.scheduler.queue_depth,
+                slot_occupancy=self.scheduler.occupancy,
+                page_util=self.pool.utilization)
+        finished.append(res)
+
+    # -------------------------------------------------------------- run
+    def run(self, requests: Sequence[Request], *, start: float = 0.0
+            ) -> List[RequestResult]:
+        """Drive the engine over a request trace to completion under a
+        virtual clock: arrivals come from each request's `arrival_t`,
+        and time advances by the real wall cost of each engine step —
+        deterministic token output, realistic latency accounting."""
+        pending = sorted(requests, key=lambda r: (r.arrival_t, r.rid))
+        now = start
+        results: List[RequestResult] = []
+        i = 0
+        while True:
+            while i < len(pending) and pending[i].arrival_t <= now + 1e-12:
+                self.submit(pending[i])
+                i += 1
+            if not self.scheduler.active_slots() and not self.scheduler.queue:
+                if i >= len(pending):
+                    break
+                now = max(now, pending[i].arrival_t)   # idle-skip to next
+                continue
+            t0 = time.perf_counter()
+            results.extend(self.step(now))
+            now += time.perf_counter() - t0
+        if self.run_log is not None:
+            n_tokens = sum(len(r.tokens) for r in results)
+            elapsed = max(now - start, 1e-9)
+            self.run_log.log("serve", event="report",
+                             requests=len(results), tokens=n_tokens,
+                             elapsed_s=elapsed,
+                             tokens_per_s=n_tokens / elapsed)
+        return sorted(results, key=lambda r: r.rid)
+
+    def close(self):
+        if self._owns_runlog and self.run_log is not None:
+            self.run_log.close()

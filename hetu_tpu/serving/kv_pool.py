@@ -1,0 +1,237 @@
+"""Paged KV cache: a block-pool allocator with per-sequence page tables.
+
+The serving engine's cache is not one dense [b, max_len] buffer per
+sequence (today's `init_cache` shape) but a POOL of fixed-size pages
+
+    k_pool / v_pool : [L, num_pages, page_size, n_kv, hd]
+
+plus, per decode slot, a page table row [max_pages] of page indices that
+maps a sequence position p to (table[p // page_size], p % page_size).
+Sequences of different lengths share the pool; a finished sequence's
+pages go back on the free list and are recycled by the next admission —
+the vLLM move, TPU-shaped: every device-side shape stays static (the
+table is a fixed [slots, max_pages] int32 array; short sequences pad
+with the null page).
+
+Page 0 is the reserved NULL page: it is never allocated, unoccupied
+table entries point at it, and inactive slots' token writes land in it.
+It is never read either — gathers beyond a sequence's length are masked
+by the position mask in `models/generation._attend_cached`, so null-page
+garbage cannot reach attention.
+
+Quantized page mode (``HETU_TPU_KV_QUANT=int8``): pages store blockwise
+int8 values + one f32 absmax scale per head-vector (block = head_dim),
+reusing `comm/compress.py`'s collective quantization primitives.  Bytes
+per element drop 4 -> 1 + 4/hd (~3.88x smaller at hd=128, ~3.76x at
+hd=64, vs the fp32 exact path the CPU tests decode with; ~1.94x vs a
+bf16/fp16 cache).  The exact fp path is the default and stores pages in
+the model's compute dtype — byte-identical semantics to `init_cache`.
+
+Host side (allocator, free list) is plain Python; device side
+(gather/scatter) is pure-functional jax, jitted by the engine.
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from hetu_tpu.comm.compress import dequantize_blockwise, quantize_blockwise
+
+#: analytic bytes per element for each page mode (int8 carries one f32
+#: scale per head-vector block of `head_dim` elements)
+_ELEM_BYTES = {"fp32": 4.0, "bf16": 2.0, "fp16": 2.0}
+
+
+def kv_bytes_per_token(num_layers: int, num_kv_heads: int, head_dim: int,
+                       mode: str = "fp32") -> float:
+    """Cache bytes one token position occupies (K and V, all layers) —
+    the analytic model bench.py records (same pattern as comm/wire.py:
+    provable without hardware)."""
+    elems = 2.0 * num_layers * num_kv_heads * head_dim
+    if mode == "int8":
+        return elems * (1.0 + 4.0 / head_dim)
+    try:
+        return elems * _ELEM_BYTES[mode]
+    except KeyError:
+        raise ValueError(f"unknown kv mode {mode!r}; "
+                         f"known: {sorted(_ELEM_BYTES)} + ['int8']")
+
+
+def quantize_heads(x):
+    """[..., hd] f32 -> (int8 [..., hd], scales f32 [...]): one absmax
+    scale per head-vector (comm/compress blockwise with block = hd)."""
+    hd = x.shape[-1]
+    q, s = quantize_blockwise(x, block_size=hd)
+    return q.reshape(x.shape), s.reshape(x.shape[:-1])
+
+
+def dequantize_heads(q, s):
+    """Inverse of `quantize_heads`."""
+    return dequantize_blockwise(q.reshape(-1, q.shape[-1]),
+                                s.reshape(-1)).reshape(q.shape)
+
+
+@dataclasses.dataclass
+class PoolArrays:
+    """The device-side pool state threaded through the engine's jitted
+    step (a pytree: quant scales are None in the exact mode)."""
+    k: jnp.ndarray
+    v: jnp.ndarray
+    k_scale: Optional[jnp.ndarray] = None
+    v_scale: Optional[jnp.ndarray] = None
+
+    def tree(self):
+        if self.k_scale is None:
+            return (self.k, self.v)
+        return (self.k, self.v, self.k_scale, self.v_scale)
+
+    @staticmethod
+    def from_tree(t) -> "PoolArrays":
+        return PoolArrays(*t) if len(t) == 4 else PoolArrays(t[0], t[1])
+
+
+class PagePool:
+    """Host-side allocator + device-side page arrays.
+
+    num_pages counts USABLE pages; one extra null page (index 0) is
+    added on top, so the device arrays hold num_pages + 1 pages."""
+
+    NULL_PAGE = 0
+
+    def __init__(self, *, num_layers: int, num_pages: int, page_size: int,
+                 num_kv_heads: int, head_dim: int,
+                 dtype=jnp.float32, quant: str = "none"):
+        if quant not in ("none", "int8"):
+            raise ValueError(f"kv quant mode {quant!r} invalid; "
+                             "choices: ('none', 'int8')")
+        if num_pages < 1:
+            raise ValueError("need at least one usable page")
+        self.num_layers = num_layers
+        self.num_pages = num_pages
+        self.page_size = page_size
+        self.num_kv_heads = num_kv_heads
+        self.head_dim = head_dim
+        self.dtype = dtype
+        self.quant = quant
+        shape = (num_layers, num_pages + 1, page_size, num_kv_heads,
+                 head_dim)
+        if quant == "int8":
+            self.arrays = PoolArrays(
+                k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
+                k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                v_scale=jnp.zeros(shape[:-1], jnp.float32))
+        else:
+            self.arrays = PoolArrays(k=jnp.zeros(shape, dtype),
+                                     v=jnp.zeros(shape, dtype))
+        # LIFO free list: recently freed pages are reused first (their
+        # garbage is overwritten by the next prefill/decode write before
+        # any masked read can see it)
+        self._free: List[int] = list(range(num_pages, 0, -1))
+        self.allocs = 0
+        self.frees = 0
+
+    # ---------------------------------------------------------- allocator
+    def pages_for(self, tokens: int) -> int:
+        return max(1, math.ceil(tokens / self.page_size))
+
+    @property
+    def free_count(self) -> int:
+        return len(self._free)
+
+    @property
+    def used_count(self) -> int:
+        return self.num_pages - len(self._free)
+
+    @property
+    def utilization(self) -> float:
+        return self.used_count / self.num_pages
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """Pop n pages off the free list, or None (caller queues) when
+        the pool cannot satisfy the reservation."""
+        if n > len(self._free):
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self.allocs += n
+        return pages
+
+    def free(self, pages: List[int]):
+        for p in pages:
+            if not (0 < p <= self.num_pages):
+                raise ValueError(f"freeing invalid page id {p}")
+            if p in self._free:
+                raise ValueError(f"double free of page {p}")
+        self._free.extend(pages)
+        self.frees += len(pages)
+
+    # ------------------------------------------------------ device ops
+    # Pure functions over PoolArrays trees (the engine jits them inside
+    # its step programs; `self` only contributes static shape info).
+
+    def gather(self, arrays_tree, table):
+        """Dense per-slot cache views from the pool.  table: [S, mp]
+        int32 -> (ck, cv) [L, S, mp*page_size, n_kv, hd] in the compute
+        dtype (int8 pages dequantize here)."""
+        a = PoolArrays.from_tree(arrays_tree)
+        L = self.num_layers
+        S, mp = table.shape
+        M = mp * self.page_size
+
+        def dense(pool, scale):
+            g = pool[:, table]              # [L, S, mp, ps, n_kv, hd]
+            g = g.reshape(L, S, M, self.num_kv_heads, self.head_dim)
+            if scale is None:
+                return g
+            sc = scale[:, table].reshape(L, S, M, self.num_kv_heads)
+            return (g.astype(jnp.float32) * sc[..., None]).astype(self.dtype)
+
+        return (dense(a.k, a.k_scale), dense(a.v, a.v_scale))
+
+    def write_token(self, arrays_tree, table, positions, k_toks, v_toks):
+        """Scatter one decoded token's K/V into the pool.  positions:
+        [S] absolute write positions; k_toks/v_toks: [L, S, n_kv, hd].
+        Slots whose table entry is the null page (inactive) dump their
+        write harmlessly into it."""
+        a = PoolArrays.from_tree(arrays_tree)
+        S = positions.shape[0]
+        page = table[jnp.arange(S), positions // self.page_size]
+        off = positions % self.page_size
+
+        def put(pool, scale, toks):
+            if scale is None:
+                return pool.at[:, page, off].set(toks.astype(pool.dtype)), None
+            q, s = quantize_heads(toks.astype(jnp.float32))
+            return (pool.at[:, page, off].set(q),
+                    scale.at[:, page, off].set(s))
+
+        nk, nks = put(a.k, a.k_scale, k_toks)
+        nv, nvs = put(a.v, a.v_scale, v_toks)
+        return PoolArrays(nk, nv, nks, nvs).tree()
+
+    def write_pages(self, arrays_tree, pages_row, ks, vs):
+        """Bulk-write a prefilled sequence's K/V into its pages.
+        pages_row: [mp] int32 page ids (pad unused tail entries with the
+        null page — their garbage lands in page 0); ks/vs:
+        [L, mp*page_size, n_kv, hd]."""
+        a = PoolArrays.from_tree(arrays_tree)
+        L = self.num_layers
+        mp = pages_row.shape[0]
+        paged_shape = (L, mp, self.page_size, self.num_kv_heads,
+                       self.head_dim)
+
+        def put(pool, scale, x):
+            x = x.reshape(paged_shape)
+            if scale is None:
+                return pool.at[:, pages_row].set(x.astype(pool.dtype)), None
+            q, s = quantize_heads(x.astype(jnp.float32))
+            return (pool.at[:, pages_row].set(q),
+                    scale.at[:, pages_row].set(s))
+
+        nk, nks = put(a.k, a.k_scale, ks)
+        nv, nvs = put(a.v, a.v_scale, vs)
+        return PoolArrays(nk, nv, nks, nvs).tree()

@@ -1,0 +1,88 @@
+"""Serving request/result records and their SLO accounting.
+
+A `Request` is one user sequence: prompt ids + a decode budget.  The
+engine stamps the SLO-relevant timeline into `RequestStats` using the
+DRIVER'S clock (virtual in tests, wall in tools_serving.py) so TTFT /
+e2e latency percentiles are deterministic under a simulated timeline.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Optional
+
+import numpy as np
+
+
+@dataclasses.dataclass
+class Request:
+    """One generation request (greedy decode; per-request EOS)."""
+    rid: int
+    prompt: np.ndarray                 # [plen] int32 token ids
+    max_new_tokens: int
+    eos_token_id: Optional[int] = None
+    arrival_t: float = 0.0
+
+    def __post_init__(self):
+        self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
+        if self.prompt.size == 0:
+            raise ValueError(f"request {self.rid}: empty prompt")
+        if self.max_new_tokens < 1:
+            raise ValueError(f"request {self.rid}: max_new_tokens must be "
+                             ">= 1")
+
+    @property
+    def prompt_len(self) -> int:
+        return int(self.prompt.shape[0])
+
+    @property
+    def total_len(self) -> int:
+        """Worst-case cache footprint (prompt + full decode budget) —
+        what the scheduler reserves pages for at admission."""
+        return self.prompt_len + self.max_new_tokens
+
+
+@dataclasses.dataclass
+class RequestStats:
+    """Per-request SLO timeline (driver-clock seconds)."""
+    arrival_t: float = 0.0
+    admit_t: Optional[float] = None
+    first_token_t: Optional[float] = None
+    done_t: Optional[float] = None
+    prefill_chunks: int = 0
+
+    @property
+    def queue_wait_s(self) -> Optional[float]:
+        if self.admit_t is None:
+            return None
+        return self.admit_t - self.arrival_t
+
+    @property
+    def ttft_s(self) -> Optional[float]:
+        """Time to first token, from ARRIVAL (queue wait counts: a user
+        staring at a spinner does not care which side of the scheduler
+        the time went)."""
+        if self.first_token_t is None:
+            return None
+        return self.first_token_t - self.arrival_t
+
+    @property
+    def e2e_s(self) -> Optional[float]:
+        if self.done_t is None:
+            return None
+        return self.done_t - self.arrival_t
+
+
+@dataclasses.dataclass
+class RequestResult:
+    """What the engine hands back when a request completes."""
+    rid: int
+    tokens: List[int]                  # generated ids (EOS included)
+    finished_reason: str               # "eos" | "length"
+    stats: RequestStats = dataclasses.field(default_factory=RequestStats)
+
+    @property
+    def tokens_per_s(self) -> Optional[float]:
+        e2e = self.stats.e2e_s
+        if not e2e or e2e <= 0:
+            return None
+        return len(self.tokens) / e2e

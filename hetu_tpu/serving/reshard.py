@@ -1,0 +1,106 @@
+"""Load-adaptive serving mesh: the hot-switch engine pointed at serving.
+
+Hetis (PAPERS.md) serves heterogeneous clusters with fine-grained
+DYNAMIC parallelism — the layout follows the load.  This repo already
+owns that machinery for training (engine/hot_switch.py's per-strategy
+plan pool over parallel/switch.py's device_put resharding engine), so
+serving reuses it instead of forking it: a `LoadAdaptiveMesh` maps
+queue-depth tiers to `ParallelStrategy` entries, and when the load
+profile crosses a tier boundary the engine reshards its PARAMS onto the
+tier's mesh with the same `switch_tree` ParamSlice program a training
+hot-switch runs (params only — serving has no optimizer state; the
+compiled decode/prefill programs re-specialize automatically because
+jax.jit keys its plan cache on input shardings).
+
+Hysteresis: a tier change needs `patience` consecutive observations on
+the other side of the boundary, so one bursty step cannot thrash the
+mesh back and forth (same strike discipline as the straggler hook).
+
+Known limit (docs/serving.md): the KV pool stays on its original
+placement — only params move.  Re-paging the pool across meshes is the
+natural next step once a multi-slice serving mesh exists to test on.
+"""
+from __future__ import annotations
+
+from typing import Callable, List, Optional, Sequence, Tuple
+
+from hetu_tpu.engine.hot_switch import param_handle
+from hetu_tpu.parallel.strategy import ParallelStrategy
+from hetu_tpu.parallel.switch import StrategyHandle, switch_tree
+from hetu_tpu.utils.logging import get_logger
+
+logger = get_logger("serving.reshard")
+
+
+class LoadAdaptiveMesh:
+    """Queue-depth -> strategy tier map with hysteresis.
+
+    tiers: ascending [(min_queue_depth, strategy), ...]; tier 0 must
+    start at depth 0 (the idle layout)."""
+
+    def __init__(self, model_factory: Callable[[ParallelStrategy], object],
+                 tiers: Sequence[Tuple[int, ParallelStrategy]],
+                 *, patience: int = 2):
+        if not tiers:
+            raise ValueError("need at least one (threshold, strategy) tier")
+        thresholds = [t for t, _ in tiers]
+        if thresholds != sorted(thresholds) or thresholds[0] != 0:
+            raise ValueError("tier thresholds must ascend from 0, got "
+                             f"{thresholds}")
+        self.model_factory = model_factory
+        self.tiers = list(tiers)
+        self.patience = max(1, patience)
+        self.active_tier = 0
+        self._handles: List[Optional[StrategyHandle]] = [None] * len(tiers)
+        self._pending_tier: Optional[int] = None
+        self._strikes = 0
+        self.reshards = 0
+
+    def handle(self, tier: int) -> StrategyHandle:
+        h = self._handles[tier]
+        if h is None:
+            h = param_handle(self.model_factory, self.tiers[tier][1])
+            self._handles[tier] = h
+        return h
+
+    def tier_for(self, queue_depth: int) -> int:
+        tier = 0
+        for i, (threshold, _) in enumerate(self.tiers):
+            if queue_depth >= threshold:
+                tier = i
+        return tier
+
+    def observe(self, queue_depth: int) -> Optional[int]:
+        """Feed one load observation; returns the new tier id when the
+        strike budget commits a change, else None."""
+        want = self.tier_for(queue_depth)
+        if want == self.active_tier:
+            self._pending_tier, self._strikes = None, 0
+            return None
+        if want != self._pending_tier:
+            self._pending_tier, self._strikes = want, 0
+        self._strikes += 1
+        if self._strikes < self.patience:
+            return None
+        self.active_tier = want
+        self._pending_tier, self._strikes = None, 0
+        return want
+
+    def reshard(self, params, tier: int):
+        """Move params onto tier's mesh (the hot-switch ParamSlice
+        program, params-only mode).  donate=False: unlike the training
+        switcher, the serving hook does NOT own the params pytree — the
+        caller may share it with a trainer or later golden runs, and
+        donating it would delete their buffers on backends that honor
+        donation."""
+        dst = self.handle(tier)
+        new_params = switch_tree(params, dst.param_shardings, donate=False)
+        self.reshards += 1
+        logger.info(
+            f"serving reshard -> tier {tier} "
+            f"({self.tiers[tier][1].describe()})")
+        return new_params
+
+    def describe(self, tier: Optional[int] = None) -> str:
+        t = self.active_tier if tier is None else tier
+        return self.tiers[t][1].describe()

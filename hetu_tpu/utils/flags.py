@@ -119,6 +119,31 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "HetCCL-style) so inter-slice links move slice_devices-fold "
          "fewer bytes.  flat (default) is HLO-byte-identical to unset",
          choices=("flat", "two_level")),
+    # -- serving (hetu_tpu/serving, docs/serving.md) ---------------------
+    Flag("HETU_TPU_KV_QUANT", "str", "none",
+         "paged-KV-cache page mode (serving/kv_pool.py): int8 stores "
+         "pages as blockwise int8 + one f32 absmax scale per head-vector "
+         "(comm/compress primitives; ~3.9x smaller than the fp32 exact "
+         "cache at hd=128, ~1.9x vs bf16).  none (default) stores exact "
+         "pages in the model compute dtype — byte-identical semantics to "
+         "models/generation.init_cache",
+         choices=("none", "int8")),
+    Flag("HETU_TPU_SERVE_SLOTS", "int", 8,
+         "serving engine decode-slot count (the static batch dimension "
+         "of the continuous-batching decode program)"),
+    Flag("HETU_TPU_SERVE_PAGE", "int", 16,
+         "KV-cache page size in tokens (serving/kv_pool.py block size)"),
+    Flag("HETU_TPU_SERVE_MAX_LEN", "int", 256,
+         "per-sequence serving cap (prompt + decode budget); must be a "
+         "multiple of HETU_TPU_SERVE_PAGE and <= the model's "
+         "max_position_embeddings"),
+    Flag("HETU_TPU_SERVE_PREFILL_CHUNK", "int", 32,
+         "chunked-prefill token budget per engine step (one chunk per "
+         "step, interleaved with decode, so long prompts never stall "
+         "the decode batch); SERVE_MAX_LEN must be a multiple of it"),
+    Flag("HETU_TPU_SERVE_PAGES", "int", 0,
+         "usable KV pages in the pool; 0 (default) = full reservation "
+         "(slots * max_len / page), i.e. admission never waits on pages"),
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "flash-attention kernel routing: auto (shape-gated), 1 (force "
          "Pallas), 0 (force the XLA composition)",

@@ -60,6 +60,28 @@ def test_every_env_read_is_registered():
     for name in ("HETU_TPU_TELEMETRY_PUSH", "HETU_TPU_HEALTH",
                  "HETU_TPU_RUNLOG_MAX_MB"):
         assert name in flags.REGISTRY
+    # the serving surface (hetu_tpu/serving, docs/serving.md)
+    for name in ("HETU_TPU_KV_QUANT", "HETU_TPU_SERVE_SLOTS",
+                 "HETU_TPU_SERVE_PAGE", "HETU_TPU_SERVE_MAX_LEN",
+                 "HETU_TPU_SERVE_PREFILL_CHUNK", "HETU_TPU_SERVE_PAGES"):
+        assert name in flags.REGISTRY
+
+
+def test_serving_flag_defaults_are_off_path(monkeypatch):
+    """Serving defaults: kv cache exact, shapes sane; the flags feed
+    ServeConfig.from_flags and nothing on the training path reads them."""
+    assert flags.str_flag("HETU_TPU_KV_QUANT") == "none"
+    assert flags.int_flag("HETU_TPU_SERVE_PAGES") == 0
+    monkeypatch.setenv("HETU_TPU_KV_QUANT", "int3")
+    import pytest as _pytest
+    with _pytest.raises(ValueError):
+        flags.str_flag("HETU_TPU_KV_QUANT")
+    monkeypatch.setenv("HETU_TPU_KV_QUANT", "int8")
+    monkeypatch.setenv("HETU_TPU_SERVE_SLOTS", "2")
+    from hetu_tpu.serving.engine import ServeConfig
+    cfg = ServeConfig.from_flags(page_size=8, max_len=32, prefill_chunk=8)
+    assert cfg.kv_quant == "int8" and cfg.num_slots == 2
+    assert cfg.num_pages == 2 * (32 // 8)
 
 
 def test_describe_and_active(monkeypatch):

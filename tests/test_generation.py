@@ -97,6 +97,175 @@ def test_top_p_sampling_restricts_support():
     np.testing.assert_array_equal(np.asarray(outg), np.asarray(outp))
 
 
+def _attend_cached_repeat(q, ck, cv, pos, scale):
+    """The PRE-refactor GQA attention (materializes group-repeated K/V
+    with jnp.repeat every step) — kept here as the bit-exactness
+    reference for the grouped-einsum replacement."""
+    b, M, n_kv, hd = ck.shape
+    nq = q.shape[2]
+    group = nq // n_kv
+    if group > 1:
+        ck = jnp.repeat(ck, group, axis=2)
+        cv = jnp.repeat(cv, group, axis=2)
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   ck.astype(jnp.float32)) * scale
+    mask = jnp.arange(M)[None, None, None, :] <= pos
+    s = jnp.where(mask, s, -1e30)
+    p = jax.nn.softmax(s, axis=-1)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p, cv.astype(jnp.float32))
+    return out.astype(q.dtype)
+
+
+def test_gqa_attend_bit_exact_vs_repeat_path():
+    """Regression vs the old repeat-then-attend GQA path.  The grouped
+    q·k score contraction is BIT-identical (same per-head dot, same
+    mapping q head j -> kv head j // group; asserted exactly).  The p·v
+    output contraction reassociates the softmax-weighted sum over the
+    cache axis when the operand is not materialized group-repeated —
+    bounded here at float32-ulp scale — and end-to-end greedy decode
+    stays token-identical (the goldens elsewhere in this file pin that
+    against full recompute and HF)."""
+    from hetu_tpu.models.generation import _attend_cached
+    rng = np.random.default_rng(0)
+    b, M, n_kv, group, hd = 3, 24, 2, 4, 16
+    nq = n_kv * group
+    q = jnp.asarray(rng.normal(size=(b, 1, nq, hd)), jnp.float32)
+    ck = jnp.asarray(rng.normal(size=(b, M, n_kv, hd)), jnp.float32)
+    cv = jnp.asarray(rng.normal(size=(b, M, n_kv, hd)), jnp.float32)
+    # scores: grouped einsum == repeated einsum, bit for bit
+    ckr = jnp.repeat(ck, group, axis=2)
+    s_old = jnp.einsum("bqhd,bkhd->bhqk", q, ckr)
+    s_new = jnp.einsum("bqhgd,bkhd->bhgqk",
+                       q.reshape(b, 1, n_kv, group, hd), ck)
+    np.testing.assert_array_equal(
+        np.asarray(s_old), np.asarray(s_new).reshape(b, nq, 1, M))
+    # full attend: ulp-scale tolerance from the reassociated p·v sum
+    for pos in (0, 5, M - 1):
+        old = np.asarray(_attend_cached_repeat(q, ck, cv, pos, hd ** -0.5))
+        new = np.asarray(_attend_cached(q, ck, cv, pos, hd ** -0.5))
+        np.testing.assert_allclose(new, old, atol=5e-6, rtol=1e-5)
+    # MHA (group == 1): same code path shape, same tolerance contract
+    q1 = jnp.asarray(rng.normal(size=(b, 1, n_kv, hd)), jnp.float32)
+    np.testing.assert_allclose(
+        np.asarray(_attend_cached(q1, ck, cv, 7, hd ** -0.5)),
+        np.asarray(_attend_cached_repeat(q1, ck, cv, 7, hd ** -0.5)),
+        atol=5e-6, rtol=1e-5)
+    # and the decode-level contract: token-identical greedy continuations
+    # through the real model (GQA config) vs full recompute
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           num_key_value_heads=2, use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(7))
+    prompt = jnp.asarray([[11, 12, 13, 14]], jnp.int32)
+    out = generate(model, params, prompt, max_new_tokens=6)
+    seq = prompt
+    for _ in range(6):
+        nxt = jnp.argmax(model(params, seq)[:, -1, :], -1).astype(jnp.int32)
+        seq = jnp.concatenate([seq, nxt[:, None]], 1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(seq))
+
+
+def test_eos_pad_early_exit():
+    """With eos_token_id + pad_token_id set, finished sequences emit pad
+    (not the eos forever), and the legacy eos_id behavior is unchanged
+    when pad is unset."""
+    model, params = _model()
+    prompt = jnp.asarray([[1, 2, 3, 4]], jnp.int32)
+    from hetu_tpu.models.generation import prefill
+    logits, _ = prefill(model, params, prompt, max_len=12)
+    eos = int(jnp.argmax(logits[0]))   # the first greedy token IS eos
+    out = generate(model, params, prompt, max_new_tokens=8,
+                   eos_token_id=eos, pad_token_id=0)
+    tail = np.asarray(out)[0, 4:]
+    assert tail[0] == eos
+    np.testing.assert_array_equal(tail[1:], np.zeros(7, np.int32))
+    # legacy alias: eos_id with no pad keeps emitting eos
+    out_legacy = generate(model, params, prompt, max_new_tokens=8,
+                          eos_id=eos)
+    np.testing.assert_array_equal(np.asarray(out_legacy)[0, 4:],
+                                  np.full(8, eos, np.int32))
+    # a batch where only ONE row finishes: the other row keeps decoding
+    # exactly as the eos-free run does
+    prompt2 = jnp.asarray([[1, 2, 3, 4], [9, 8, 7, 6]], jnp.int32)
+    out2 = generate(model, params, prompt2, max_new_tokens=6,
+                    eos_token_id=eos, pad_token_id=0)
+    free = generate(model, params, prompt2, max_new_tokens=6)
+    row1_free = np.asarray(free)[1]
+    row1_eos = np.asarray(out2)[1]
+    cut = np.flatnonzero(row1_eos[4:] == eos)
+    upto = 4 + (cut[0] + 1 if len(cut) else 6)
+    np.testing.assert_array_equal(row1_eos[:upto], row1_free[:upto])
+
+
+def test_decode_step_slots_per_slot_positions():
+    """Two sequences at DIFFERENT depths decoded in one slot batch match
+    their individual decode_step results (the serving engine's core
+    contract), and the returned per-layer token K/V equal what was
+    written into the cache."""
+    from hetu_tpu.models.generation import (decode_step_slots, prefill,
+                                            init_cache)
+    model, params = _model()
+    rng = np.random.default_rng(4)
+    M = 16
+    pa = jnp.asarray(rng.integers(0, 256, (1, 5)), jnp.int32)
+    pb = jnp.asarray(rng.integers(0, 256, (1, 9)), jnp.int32)
+    la, ca = prefill(model, params, pa, max_len=M)
+    lb, cb = prefill(model, params, pb, max_len=M)
+    ta = jnp.argmax(la, -1).astype(jnp.int32)
+    tb = jnp.argmax(lb, -1).astype(jnp.int32)
+    # solo decodes
+    oa, na = decode_step(model, params, ta, ca, 5)
+    ob, nb = decode_step(model, params, tb, cb, 9)
+    # batched slot decode at per-slot positions
+    cab = tuple(jnp.concatenate([x, y], axis=1) for x, y in zip(ca, cb))
+    toks = jnp.concatenate([ta, tb])
+    positions = jnp.asarray([5, 9], jnp.int32)
+    out, new_cache, (kt, vt) = decode_step_slots(model, params, toks, cab,
+                                                 positions)
+    np.testing.assert_allclose(np.asarray(out[0]), np.asarray(oa[0]),
+                               rtol=1e-5, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(out[1]), np.asarray(ob[0]),
+                               rtol=1e-5, atol=1e-5)
+    # token K/V mirror the cache writes
+    np.testing.assert_array_equal(np.asarray(new_cache[0][:, 0, 5]),
+                                  np.asarray(kt[:, 0]))
+    np.testing.assert_array_equal(np.asarray(new_cache[1][:, 1, 9]),
+                                  np.asarray(vt[:, 1]))
+
+
+def test_extend_cache_chunked_matches_prefill():
+    """Chunked prefill (extend_cache over consecutive chunks) reproduces
+    the one-shot prefill: same last-token logits, same cached K/V."""
+    from hetu_tpu.models.generation import extend_cache, init_cache
+    model, params = _model()
+    rng = np.random.default_rng(6)
+    plen, C, M = 12, 4, 16
+    prompt = jnp.asarray(rng.integers(0, 256, (1, plen)), jnp.int32)
+    gold_logits, gold_cache = prefill(model, params, prompt, max_len=M)
+    cache = init_cache(model, 1, M)
+    logits = None
+    for s in range(0, plen, C):
+        logits, cache = extend_cache(model, params, prompt[:, s: s + C],
+                                     cache, s)
+    np.testing.assert_allclose(np.asarray(logits[:, -1]),
+                               np.asarray(gold_logits),
+                               rtol=2e-4, atol=2e-5)
+    np.testing.assert_allclose(np.asarray(cache[0][:, :, :plen]),
+                               np.asarray(gold_cache[0][:, :, :plen]),
+                               rtol=2e-4, atol=2e-5)
+    # GQA config through the chunked path too
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           num_key_value_heads=2, use_flash_attention=False)
+    m2 = LlamaLMHeadModel(cfg)
+    p2 = m2.init(jax.random.key(3))
+    g2, _ = prefill(m2, p2, prompt, max_len=M)
+    c2 = init_cache(m2, 1, M)
+    for s in range(0, plen, C):
+        l2, c2 = extend_cache(m2, p2, prompt[:, s: s + C], c2, s)
+    np.testing.assert_allclose(np.asarray(l2[:, -1]), np.asarray(g2),
+                               rtol=2e-4, atol=2e-5)
+
+
 def test_gpt_generate_matches_hf_greedy():
     """GPT family through the KV-cache decode loop: greedy continuations
     match HF transformers token-for-token under converted weights."""

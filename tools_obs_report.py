@@ -41,6 +41,7 @@ def summarize(records) -> dict:
     faults = [r for r in records if r.get("kind") == "fault"]
     anomalies = [r for r in records if r.get("kind") == "anomaly"]
     stragglers = [r for r in records if r.get("kind") == "straggler"]
+    serves = [r for r in records if r.get("kind") == "serve"]
 
     out: dict = {"steps": len(steps), "compiles": len(compiles),
                  "switches": len(switches), "elastic_epochs": len(epochs)}
@@ -84,6 +85,43 @@ def summarize(records) -> dict:
         if top_ratio is not None:
             out["stragglers"]["top_ratio"] = top_ratio
             out["stragglers"]["top_rank"] = top_rank
+
+    # serving runs (hetu_tpu/serving `serve` events): per-request SLO
+    # percentiles + aggregate throughput, so a serving run is inspectable
+    # with the same tooling as a training run
+    if serves:
+        dones = [r for r in serves if r.get("event") == "done"]
+        reshards = [r for r in serves if r.get("event") == "reshard"]
+        reports = [r for r in serves if r.get("event") == "report"]
+        srv: dict = {"events": len(serves), "requests_done": len(dones)}
+        ttfts = sorted(float(r["ttft_s"]) for r in dones
+                       if r.get("ttft_s") is not None)
+        if ttfts:
+            srv["ttft_s"] = {"median": _percentile(ttfts, 50),
+                             "p95": _percentile(ttfts, 95)}
+        e2es = sorted(float(r["e2e_s"]) for r in dones
+                      if r.get("e2e_s") is not None)
+        if e2es:
+            srv["e2e_s"] = {"median": _percentile(e2es, 50),
+                            "p95": _percentile(e2es, 95)}
+        toks = [int(r["tokens"]) for r in dones if r.get("tokens")]
+        if toks:
+            srv["tokens_out"] = sum(toks)
+        if reports:
+            last = reports[-1]
+            for k in ("tokens_per_s", "elapsed_s", "requests"):
+                if last.get(k) is not None:
+                    srv[k] = last[k]
+        if reshards:
+            srv["reshards"] = len(reshards)
+            srv["final_tier"] = reshards[-1].get("tier")
+        reasons: dict = {}
+        for r in dones:
+            k = str(r.get("reason", "unknown"))
+            reasons[k] = reasons.get(k, 0) + 1
+        if reasons:
+            srv["finished_by"] = reasons
+        out["serving"] = srv
 
     times = sorted(float(r["step_time_s"]) for r in steps
                    if r.get("step_time_s"))

@@ -93,6 +93,13 @@ class PlanPool:
                 try:
                     self.on_compile(self.name, key, plan, dt)
                 except Exception as e:
+                    # a broken telemetry hook must not cost the run —
+                    # EXCEPT a declared perf-budget enforcement failure
+                    # (obs.budget, "enforce": true): gating is the one
+                    # hook outcome that exists to stop the run
+                    from hetu_tpu.obs.budget import BudgetError
+                    if isinstance(e, BudgetError):
+                        raise
                     logger.warning(f"on_compile hook failed: {e!r}")
         return plan
 

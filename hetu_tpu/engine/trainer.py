@@ -360,45 +360,170 @@ class Trainer:
         self._registry.inc("trainer.compiles", pool=pool_name)
         self._registry.observe("trainer.compile_s", compile_s,
                                pool=pool_name)
-        if self.run_log is None:
+        from hetu_tpu.utils import flags as _flags
+        est, comm = {}, {}
+        # ONE lazy as_text() shared by the comm analysis and the
+        # profiler — stringifying a large module twice per compile is
+        # the cost HETU_TPU_COMM_ANALYZE=0 exists to avoid
+        hlo_txt = [None]
+
+        def _hlo_text():
+            if hlo_txt[0] is None:
+                hlo_txt[0] = plan.as_text()
+            return hlo_txt[0]
+        # the est/comm numbers feed BOTH the compile run-event and the
+        # declared-budget check — a budget with no RunLog still needs
+        # them (enforcement must not depend on where the log lives)
+        if (self.run_log is not None
+                or _flags.str_flag("HETU_TPU_BUDGETS")):
+            from hetu_tpu.obs.mfu import estimate_from_compiled
+            try:
+                # phase attribution parses the full HLO text — too heavy
+                # for a per-compile hook on big programs; mfu_report()
+                # does the phase-resolved version on demand
+                est = estimate_from_compiled(plan, with_phases=False)
+            except Exception:
+                est = {}
+            try:
+                # bytes-on-wire of this plan's collectives (obs.comm) —
+                # this is where a HETU_TPU_GRAD_COMPRESS win becomes a
+                # RunLog fact.  It costs the one shared as_text() per
+                # fresh compile; that is once per plan, not per step,
+                # but very large programs can opt out via
+                # HETU_TPU_COMM_ANALYZE=0
+                if _flags.bool_flag("HETU_TPU_COMM_ANALYZE"):
+                    from hetu_tpu.obs.comm import collective_report
+                    comm = collective_report(_hlo_text())
+            except Exception:
+                comm = {}
+        if self.run_log is not None:
+            self.run_log.log(
+                "compile", name=pool_name, plan=str(key)[:500],
+                compile_s=compile_s, flops=est.get("flops_per_step"),
+                estimated_mfu=est.get("estimated_mfu"),
+                estimated_step_s=est.get("estimated_step_s"),
+                comm_bytes=comm.get("total_wire_bytes"),
+                comm_s_est=comm.get("predicted_comm_s"),
+                collectives={op: rec["count"] for op, rec in
+                             (comm.get("collectives") or {}).items()}
+                or None,
+                grad_compress=(self._grad_compress
+                               if self._grad_compress != "none"
+                               else None),
+                zero_compress=(self._zero_compress
+                               if self._zero_compress != "none"
+                               else None),
+                comm_topology=("two_level"
+                               if self._comm_topology is not None
+                               else None))
+        # analytic step profile (HETU_TPU_PROFILE): per-layer HLO
+        # attribution + peak-HBM -> a schema-versioned `profile` record
+        # next to the compile event, then the declared-budget check
+        # (both run with or without a RunLog — enforcement must not
+        # depend on where the log lives)
+        prof = self._maybe_profile(plan, _hlo_text)
+        if prof is not None and self.run_log is not None:
+            self.run_log.log("profile", name=pool_name,
+                             plan=str(key)[:500], **prof)
+        self._check_budgets(pool_name, prof, est, comm)
+
+    def _maybe_profile(self, plan, hlo_text_fn=None):
+        """The flag-gated per-compile analytic profile
+        (obs.hlo_profile.profile_record), or None.  Costs one more walk
+        of the HLO text per FRESH compile; pure post-compile analysis —
+        the traced program is identical with the flag on or off."""
+        from hetu_tpu.utils import flags as _flags
+        if not _flags.bool_flag("HETU_TPU_PROFILE"):
+            return None
+        try:
+            from hetu_tpu.obs.hlo_profile import (flame_trace,
+                                                  layer_profile,
+                                                  profile_record)
+            # ONE as_text (shared with the hook's comm analysis) + ONE
+            # attribution walk, shared by the record and the flame graph
+            txt = hlo_text_fn() if hlo_text_fn is not None \
+                else plan.as_text()
+            full = layer_profile(txt)
+            prof = profile_record(
+                plan, top_k=_flags.int_flag("HETU_TPU_PROFILE_TOPK"),
+                profile=full, text=txt)
+            trace_path = _flags.str_flag("HETU_TPU_PROFILE_TRACE")
+            if trace_path:
+                try:
+                    flame_trace(full).save(trace_path)
+                    logger.info(
+                        f"analytic flame graph written to {trace_path}")
+                except OSError as e:
+                    logger.warning(f"flame graph to {trace_path} "
+                                   f"failed: {e!r}")
+            return prof
+        except Exception as e:
+            logger.warning(f"per-compile profile failed: {e!r}")
+            return None
+
+    def _check_budgets(self, pool_name, prof, est, comm):
+        """Check this compile's hardware-free metrics against the
+        declared perf budget (HETU_TPU_BUDGETS): breaches count
+        `budget.breaches`, leave a `budget` run event, log loudly, and
+        — only when the budget file declares `"enforce": true` — raise
+        BudgetError.  Unset flag = one str check, nothing else."""
+        from hetu_tpu.utils import flags as _flags
+        if not _flags.str_flag("HETU_TPU_BUDGETS"):
             return
-        from hetu_tpu.obs.mfu import estimate_from_compiled
+        from hetu_tpu.obs.budget import (BudgetError, PerfBudget,
+                                         check_absolute, enforce,
+                                         extract_metrics,
+                                         summarize_breaches)
         try:
-            # phase attribution parses the full HLO text — too heavy for a
-            # per-compile hook on big programs; mfu_report() does the
-            # phase-resolved version on demand
-            est = estimate_from_compiled(plan, with_phases=False)
-        except Exception:
-            est = {}
-        comm = {}
+            budget = PerfBudget.load()
+        except (OSError, ValueError) as e:
+            # a typo'd budget must not silently watch nothing (the
+            # loader's own contract): surface it as the one hook error
+            # the PlanPool lets through
+            raise BudgetError(
+                f"invalid perf budget "
+                f"({_flags.str_flag('HETU_TPU_BUDGETS')}): {e}") from e
         try:
-            # bytes-on-wire of this plan's collectives (obs.comm) — this
-            # is where a HETU_TPU_GRAD_COMPRESS win becomes a RunLog fact.
-            # It costs one as_text() of the optimized HLO per fresh
-            # compile (the same materialization the phase walk avoids);
-            # that is once per plan, not per step, but very large programs
-            # can opt out via HETU_TPU_COMM_ANALYZE=0
-            from hetu_tpu.utils import flags as _flags
-            if _flags.bool_flag("HETU_TPU_COMM_ANALYZE"):
-                from hetu_tpu.obs.comm import collective_report
-                comm = collective_report(plan)
-        except Exception:
-            comm = {}
-        self.run_log.log(
-            "compile", name=pool_name, plan=str(key)[:500],
-            compile_s=compile_s, flops=est.get("flops_per_step"),
-            estimated_mfu=est.get("estimated_mfu"),
-            estimated_step_s=est.get("estimated_step_s"),
-            comm_bytes=comm.get("total_wire_bytes"),
-            comm_s_est=comm.get("predicted_comm_s"),
-            collectives={op: rec["count"] for op, rec in
-                         (comm.get("collectives") or {}).items()} or None,
-            grad_compress=(self._grad_compress
-                           if self._grad_compress != "none" else None),
-            zero_compress=(self._zero_compress
-                           if self._zero_compress != "none" else None),
-            comm_topology=("two_level" if self._comm_topology is not None
-                           else None))
+            # estimator precedence is FIXED so a budget verdict cannot
+            # flip with HETU_TPU_PROFILE: step time always comes from
+            # the whole-program roofline (est) and comm bytes from the
+            # analyzer — the profile only contributes the metrics no
+            # other estimator produces (peak HBM)
+            metrics = {}
+            if est:
+                metrics["estimated_mfu"] = est.get("estimated_mfu")
+                metrics["step_time_s"] = est.get("estimated_step_s")
+            if comm:
+                metrics["comm_bytes"] = comm.get("total_wire_bytes")
+            for k, v in (extract_metrics(prof) if prof else {}).items():
+                if metrics.get(k) is None:
+                    metrics[k] = v
+            metrics = {k: v for k, v in metrics.items() if v is not None}
+            breaches = check_absolute(metrics, budget)
+            from hetu_tpu.obs.budget import ABSOLUTE_CEILINGS
+            missing = [k for k, attr, _kind in ABSOLUTE_CEILINGS
+                       if getattr(budget, attr) is not None
+                       and k not in metrics]
+            if missing:
+                # a declared ceiling that silently goes unchecked is the
+                # failure mode the sentinel exists to prevent — say so
+                logger.warning(
+                    f"budget ceilings on {missing} could not be checked "
+                    f"for compile {pool_name} (metric unavailable; "
+                    f"peak_hbm_bytes needs HETU_TPU_PROFILE=1)")
+        except Exception as e:
+            logger.warning(f"budget check failed: {e!r}")
+            return
+        self._registry.inc("budget.checks")
+        if breaches:
+            self._registry.inc("budget.breaches", len(breaches))
+            logger.error(f"perf budget breached (compile {pool_name}):\n"
+                         + summarize_breaches(breaches))
+        if self.run_log is not None:
+            self.run_log.log("budget", name=pool_name, ok=not breaches,
+                             breaches=breaches or None,
+                             budget=budget.source)
+        enforce(breaches, budget)
 
     # ------------------------------------------------------------------
     def _loss_fn(self, params, batch, rng):
@@ -489,7 +614,8 @@ class Trainer:
                 lambda g, sh: jax.lax.with_sharding_constraint(g, sh),
                 grads, self._sshard["m"])
             grads_sharded = True
-        grads, gnorm = optim.clip_by_global_norm(grads, c.grad_clip)
+        with jax.named_scope("optimizer"):
+            grads, gnorm = optim.clip_by_global_norm(grads, c.grad_clip)
         metrics = {"loss": lsum / denom}
         if self._scaler is None:
             params, opt_state = self._apply_update(
@@ -515,7 +641,19 @@ class Trainer:
             # that produced new_ef never entered the params
             opt_state["ef"] = jax.tree.map(
                 lambda n, o: jnp.where(finite, n, o), new_ef, ef_state)
-        scaler_state = self._scaler.update(scaler_state, finite)
+        new_scaler_state = self._scaler.update(scaler_state, finite)
+        if new_ef:
+            # EF residuals live in SCALED-grad units (the sync quantizes
+            # grads of scale * loss).  When the dynamic scale moves —
+            # growth streak or non-finite backoff — last step's residuals
+            # would be off by old/new scale at the next quantize (the
+            # PR 2 known limit: one step of stale error feedback per
+            # scale change).  Rescaling by new/old keeps them exact;
+            # the ratio is 1 on scale-stable steps.
+            ratio = new_scaler_state["scale"] / scaler_state["scale"]
+            opt_state["ef"] = jax.tree.map(lambda r: r * ratio,
+                                           opt_state["ef"])
+        scaler_state = new_scaler_state
         metrics["grad_norm"] = jnp.where(finite, gnorm, jnp.nan)
         metrics["lr"] = self.optimizer._lr(opt_state["step"])
         metrics["loss_scale"] = scaler_state["scale"]
@@ -530,13 +668,18 @@ class Trainer:
         all-gathers as int8/int4 + scales instead of GSPMD's f32 param
         all-gather (optim/zero_refresh.py).  "none" calls the plain
         update — traced program unchanged."""
-        if self._zero_compress == "none":
-            return self.optimizer.update(grads, opt_state, params)
-        from hetu_tpu.optim.zero_refresh import quantized_zero_update
-        return quantized_zero_update(
-            self.optimizer, grads, opt_state, params, mesh=self.mesh,
-            dims=self._zr_dims, specs=self._zr_specs,
-            mode=self._zero_compress, grads_sharded=grads_sharded)
+        # the "optimizer" scope marks the update region in HLO metadata
+        # so obs.hlo_profile attributes its FLOPs/bytes separately from
+        # the model layers (the GSPMD-inserted ZeRO param all-gather
+        # lands here too — it consumes the updated shards)
+        with jax.named_scope("optimizer"):
+            if self._zero_compress == "none":
+                return self.optimizer.update(grads, opt_state, params)
+            from hetu_tpu.optim.zero_refresh import quantized_zero_update
+            return quantized_zero_update(
+                self.optimizer, grads, opt_state, params, mesh=self.mesh,
+                dims=self._zr_dims, specs=self._zr_specs,
+                mode=self._zero_compress, grads_sharded=grads_sharded)
 
     # ------------------------------------------------------------------
     def _accumulate_grads(self, params, batches, keys, scale):
@@ -586,9 +729,15 @@ class Trainer:
             keys = per_replica_keys(keys, "dp")
             grads, lsum, csum = self._accumulate_grads(
                 params, batches, keys, scale)
-            grads, new_ef = quantized_grad_sync(
-                grads, "dp", dp, self._bucket_plan, self._grad_compress,
-                ef_state, topology=self._comm_topology)
+            # "grad_sync" scope: the explicit quantized collectives are
+            # individually attributable in the per-layer HLO profile
+            # (the GSPMD path's implicit all-reduce cannot be scoped —
+            # it inherits its producing layer's scope; documented limit)
+            with jax.named_scope("grad_sync"):
+                grads, new_ef = quantized_grad_sync(
+                    grads, "dp", dp, self._bucket_plan,
+                    self._grad_compress, ef_state,
+                    topology=self._comm_topology)
             return (grads, jax.lax.psum(lsum, "dp"),
                     jax.lax.psum(csum, "dp"), new_ef)
 
@@ -756,6 +905,24 @@ class Trainer:
             "_mfu_reports", host_batch,
             lambda key: estimate_from_compiled(
                 self._compiled_for_shape(host_batch, key)))
+
+    def profile_report(self, host_batch: Dict[str, np.ndarray]):
+        """On-demand per-layer analytic profile of the compiled train
+        step at this batch shape (obs.hlo_profile): the full roofline
+        attribution per named layer/op-group plus the liveness-based
+        peak-HBM estimate under "peak_hbm".  Shares the one AOT compile
+        per shape with memory_report/phase_report/mfu_report; the
+        flag-gated per-compile `profile` RunLog record is the compact
+        top-k version of this."""
+        from hetu_tpu.obs.hlo_profile import (layer_profile,
+                                              peak_hbm_estimate)
+
+        def compute(key):
+            compiled = self._compiled_for_shape(host_batch, key)
+            rep = layer_profile(compiled)
+            rep["peak_hbm"] = peak_hbm_estimate(compiled)
+            return rep
+        return self._memo_by_shape("_profile_reports", host_batch, compute)
 
     def train_step(self, host_batch: Dict[str, np.ndarray]) -> Dict[str, float]:
         batches = self.prepare_batch(host_batch)

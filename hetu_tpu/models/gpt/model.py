@@ -278,9 +278,11 @@ class GPTModel(Module):
                 return self.final_ln(params["final_ln"], x)
 
             def block_fn(layer_params, x_mb, pos_mb, seg_mb, rng=None):
-                out = self.block(layer_params, x_mb, position_ids=pos_mb,
-                                 segment_ids=seg_mb, rng=rng,
-                                 deterministic=rng is None)
+                with jax.named_scope("layer"):
+                    out = self.block(layer_params, x_mb,
+                                     position_ids=pos_mb,
+                                     segment_ids=seg_mb, rng=rng,
+                                     deterministic=rng is None)
                 return out, jnp.zeros((), jnp.float32)
 
             x, _aux = staged_stack_forward(
@@ -300,11 +302,14 @@ class GPTModel(Module):
         if c.use_scan:
             def body(carry, xs):
                 layer_params, layer_rng = xs
-                return self.block(layer_params, carry,
-                                  position_ids=position_ids,
-                                  segment_ids=segment_ids,
-                                  rng=layer_rng if use_drop else None,
-                                  deterministic=deterministic), None
+                # "layer" scope: per-layer HLO attribution of the
+                # scanned stack (obs.hlo_profile; see llama counterpart)
+                with jax.named_scope("layer"):
+                    return self.block(layer_params, carry,
+                                      position_ids=position_ids,
+                                      segment_ids=segment_ids,
+                                      rng=layer_rng if use_drop else None,
+                                      deterministic=deterministic), None
             fn = body
             if c.remat:
                 from hetu_tpu.nn.remat import remat_policy
@@ -317,10 +322,12 @@ class GPTModel(Module):
             from hetu_tpu.nn.remat import remat_policy
             for i in range(c.num_hidden_layers):
                 def blk(p, y, i=i):
-                    return self.block(p, y, position_ids=position_ids,
-                                      segment_ids=segment_ids,
-                                      rng=layer_rngs[i] if use_drop else None,
-                                      deterministic=deterministic)
+                    with jax.named_scope(f"layer_{i}"):
+                        return self.block(
+                            p, y, position_ids=position_ids,
+                            segment_ids=segment_ids,
+                            rng=layer_rngs[i] if use_drop else None,
+                            deterministic=deterministic)
                 if c.remat:
                     blk = jax.checkpoint(blk,
                                          policy=remat_policy(c.remat_policy))

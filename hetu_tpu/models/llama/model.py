@@ -239,12 +239,17 @@ class LlamaDecoderStack(Module):
         def body(carry, xs):
             x_c, aux_c = carry
             layer_params, layer_rng = xs
-            out, aux = self.block(layer_params, x_c, cos=cos, sin=sin,
-                                  position_ids=position_ids,
-                                  segment_ids=segment_ids,
-                                  rng=layer_rng if use_drop else None,
-                                  deterministic=deterministic,
-                                  token_ids=token_ids)
+            # the "layer" scope marks the scanned block body in HLO
+            # metadata: per-layer attribution (obs.hlo_profile) groups
+            # the whole stack under layer/... with the scan's trip
+            # count multiplying through (unrolled stacks get layer_<i>)
+            with jax.named_scope("layer"):
+                out, aux = self.block(layer_params, x_c, cos=cos, sin=sin,
+                                      position_ids=position_ids,
+                                      segment_ids=segment_ids,
+                                      rng=layer_rng if use_drop else None,
+                                      deterministic=deterministic,
+                                      token_ids=token_ids)
             return (out, aux_c + aux), None
 
         if c.use_scan:
@@ -260,12 +265,16 @@ class LlamaDecoderStack(Module):
         aux_total = jnp.zeros((), jnp.float32)
         for i in range(self.num_layers):
             def blk(p, y, i=i):
-                return self.block(p, y, cos=cos, sin=sin,
-                                  position_ids=position_ids,
-                                  segment_ids=segment_ids,
-                                  rng=layer_rngs[i] if use_drop else None,
-                                  deterministic=deterministic,
-                                  token_ids=token_ids)
+                # per-layer scope: decoder block i is individually
+                # attributable in the optimized HLO (obs.hlo_profile
+                # layer_table groups by layer_<i>/<phase>)
+                with jax.named_scope(f"layer_{i}"):
+                    return self.block(p, y, cos=cos, sin=sin,
+                                      position_ids=position_ids,
+                                      segment_ids=segment_ids,
+                                      rng=layer_rngs[i] if use_drop else None,
+                                      deterministic=deterministic,
+                                      token_ids=token_ids)
             if c.remat:
                 blk = jax.checkpoint(blk, policy=_remat_policy(c.remat_policy))
             x, aux = blk(params[f"layer_{i}"], x)
@@ -311,9 +320,10 @@ class LlamaDecoderStack(Module):
                 state_spec=st.pipeline_state_spec())
 
         def block_fn(layer_params, x_mb, pos_mb, seg_mb, rng=None):
-            return self.block(layer_params, x_mb, cos=cos, sin=sin,
-                              position_ids=pos_mb, segment_ids=seg_mb,
-                              rng=rng, deterministic=rng is None)
+            with jax.named_scope("layer"):
+                return self.block(layer_params, x_mb, cos=cos, sin=sin,
+                                  position_ids=pos_mb, segment_ids=seg_mb,
+                                  rng=rng, deterministic=rng is None)
 
         return staged_stack_forward(
             block_fn, params["layers"], x,
@@ -510,10 +520,11 @@ class LlamaLMHeadModel(Module):
                     # token stream, the id comes from the stage offset
                     layer_rng = jax.random.fold_in(
                         jax.random.key(drop_seed), gid)
-                out, aux = block(lp, x_c, cos=cos, sin=sin,
-                                 position_ids=pos, segment_ids=seg,
-                                 rng=layer_rng,
-                                 deterministic=not use_drop)
+                with jax.named_scope("layer"):
+                    out, aux = block(lp, x_c, cos=cos, sin=sin,
+                                     position_ids=pos, segment_ids=seg,
+                                     rng=layer_rng,
+                                     deterministic=not use_drop)
                 if mj is not None:
                     out = jnp.where(mj > 0, out, x_c)
                     aux = aux * mj

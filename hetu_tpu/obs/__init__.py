@@ -1,6 +1,7 @@
 """Unified telemetry: metrics registry, structured run events, Chrome-trace
 timelines, the hardware-free MFU/roofline reporter, the bytes-on-wire
-collective analyzer, cluster-scope aggregation, and the training health
+collective analyzer, the per-layer analytic step profiler (+ peak-HBM
+and perf budgets), cluster-scope aggregation, and the training health
 monitor.
 
 One import surface:
@@ -11,6 +12,9 @@ One import surface:
     obs.pipeline_schedule_trace(4, 8, schedule="1f1b").save("sched.json")
     obs.estimate_from_compiled(compiled)["estimated_mfu"]
     obs.collective_report(compiled)["total_wire_bytes"]
+    obs.layer_profile(compiled)["top"]               # per-layer roofline
+    obs.peak_hbm_estimate(compiled)["peak_bytes"]    # liveness peak HBM
+    obs.diff_metrics(old, new, obs.PerfBudget.load())["breaches"]
     obs.straggler_report(snapshot)["stragglers"]     # cluster scope
     obs.HealthMonitor(runlog=log).observe_step(1, 0.42, loss=2.3)
 
@@ -23,8 +27,15 @@ from hetu_tpu.obs.aggregate import (ClusterAggregator,  # noqa: F401
                                     TelemetrySource, merge_offsets,
                                     snapshot_straggler_hook,
                                     straggler_report)
+from hetu_tpu.obs.budget import (BudgetError, PerfBudget,  # noqa: F401
+                                 check_absolute, diff_metrics,
+                                 extract_metrics)
 from hetu_tpu.obs.comm import (collective_report,  # noqa: F401
                                collective_table)
+from hetu_tpu.obs.hlo_profile import (PROFILE_SCHEMA,  # noqa: F401
+                                      analytic_peak_hbm, flame_trace,
+                                      layer_profile, layer_table,
+                                      peak_hbm_estimate, profile_record)
 from hetu_tpu.obs.health import (HealthMonitor,  # noqa: F401
                                  maybe_health_monitor)
 from hetu_tpu.obs.metrics import (Histogram, MetricsRegistry,  # noqa: F401
@@ -47,6 +58,11 @@ __all__ = [
     "estimate_mfu", "estimate_from_compiled", "flops_of_compiled",
     "analytic_transformer_estimate", "load_hardware_profile",
     "collective_report", "collective_table",
+    "layer_table", "layer_profile", "peak_hbm_estimate",
+    "analytic_peak_hbm", "profile_record", "flame_trace",
+    "PROFILE_SCHEMA",
+    "PerfBudget", "BudgetError", "check_absolute", "diff_metrics",
+    "extract_metrics",
     "ClusterAggregator", "ClusterSnapshot", "TelemetrySource",
     "TelemetryPusher", "straggler_report", "snapshot_straggler_hook",
     "merge_offsets",

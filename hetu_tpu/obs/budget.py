@@ -1,0 +1,291 @@
+"""Declared perf budgets + the regression sentinel's diff logic.
+
+Every BENCH round and every compile leaves hardware-free perf numbers
+(estimated step time, bytes-on-wire, peak HBM, estimated MFU — obs.mfu /
+obs.comm / obs.hlo_profile).  Nothing watched the trajectory: a PR that
+quietly regressed predicted step time 10% shipped unless a human diffed
+the JSON.  This module is the watcher:
+
+* `PerfBudget` — declared ceilings (absolute: max step time / comm
+  bytes / peak HBM, min MFU) and relative regression thresholds for
+  round-over-round diffs, loaded from a JSON file via `HETU_TPU_BUDGETS`
+  (or defaults: +5% step time, +10% comm bytes, +10% peak HBM, -5% MFU).
+* `extract_metrics` — ONE reader for every record shape the repo
+  produces: driver-wrapped BENCH_r*.json, raw bench metric lines,
+  RunLog `compile`/`profile` records, plain dicts.
+* `check_absolute` / `diff_metrics` — breach lists; `enforce` raises
+  `BudgetError` (the "fails loudly" contract) when a budget declares
+  `"enforce": true`.
+
+Consumers: `tools_bench_diff.py` (the CLI sentinel — exits nonzero on a
+breach; wire it between BENCH rounds), the Trainer compile hook (a
+`budget` RunLog event + `budget.breaches` counter per offending
+compile), and `tools_obs_report.py`'s profile section (pass/fail
+summary).  docs/observability.md has the walkthrough.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import Any, Dict, List, Optional
+
+#: the comparable metric keys, and which direction is "worse"
+#: (True = larger is worse; False = smaller is worse)
+METRIC_DIRECTION = {
+    "step_time_s": True,
+    "comm_bytes": True,
+    "peak_hbm_bytes": True,
+    "estimated_mfu": False,
+    "mfu": False,
+}
+
+#: default relative regression thresholds for round-over-round diffs
+DEFAULT_THRESHOLDS = {
+    "step_time_s": 0.05,
+    "comm_bytes": 0.10,
+    "peak_hbm_bytes": 0.10,
+    "estimated_mfu": 0.05,
+    "mfu": 0.05,
+}
+
+
+class BudgetError(RuntimeError):
+    """A declared perf budget was breached (and enforcement is on)."""
+
+
+@dataclasses.dataclass
+class PerfBudget:
+    """Declared perf ceilings + regression thresholds.
+
+    Absolute ceilings (None = unchecked) apply to a single record;
+    `thresholds` are max relative regressions for diffs between two
+    records (fractions: 0.05 = 5%).  `enforce=True` makes `enforce()`
+    raise instead of just reporting — the trainer keeps it off by
+    default so a budget file can observe before it gates."""
+    max_step_time_s: Optional[float] = None
+    max_comm_bytes: Optional[float] = None
+    max_peak_hbm_bytes: Optional[float] = None
+    min_estimated_mfu: Optional[float] = None
+    thresholds: Dict[str, float] = dataclasses.field(
+        default_factory=lambda: dict(DEFAULT_THRESHOLDS))
+    enforce: bool = False
+    source: str = "<defaults>"
+
+    _ABS_KEYS = ("max_step_time_s", "max_comm_bytes",
+                 "max_peak_hbm_bytes", "min_estimated_mfu")
+
+    @staticmethod
+    def load(path: Optional[str] = None) -> "PerfBudget":
+        """Resolve the active budget: explicit `path` ->
+        `HETU_TPU_BUDGETS` env -> built-in defaults (no absolute
+        ceilings, default thresholds).  A file that opens but fails to
+        parse or carries unknown keys raises loudly — a typo'd budget
+        must not silently watch nothing."""
+        from hetu_tpu.utils import flags
+        path = path or flags.str_flag("HETU_TPU_BUDGETS")
+        if not path:
+            return PerfBudget()
+        with open(path) as f:
+            try:
+                raw = json.load(f)
+            except ValueError as e:
+                raise ValueError(
+                    f"invalid budget file ({path}): not valid JSON: {e}"
+                ) from None
+        if not isinstance(raw, dict):
+            raise ValueError(f"invalid budget file ({path}): expected a "
+                             f"JSON object, got {type(raw).__name__}")
+        known = set(PerfBudget._ABS_KEYS) | {"thresholds", "enforce"}
+        unknown = set(raw) - known
+        if unknown:
+            raise ValueError(
+                f"invalid budget file ({path}): unknown keys "
+                f"{sorted(unknown)}; known: {sorted(known)}")
+        thresholds = dict(DEFAULT_THRESHOLDS)
+        for k, v in (raw.get("thresholds") or {}).items():
+            if k not in METRIC_DIRECTION:
+                raise ValueError(
+                    f"invalid budget file ({path}): unknown threshold "
+                    f"{k!r}; known: {sorted(METRIC_DIRECTION)}")
+            thresholds[k] = float(v)
+        kw = {k: (float(raw[k]) if raw.get(k) is not None else None)
+              for k in PerfBudget._ABS_KEYS if k in raw}
+        return PerfBudget(thresholds=thresholds,
+                          enforce=bool(raw.get("enforce", False)),
+                          source=path, **kw)
+
+
+# ---------------------------------------------------------------------------
+# record readers
+# ---------------------------------------------------------------------------
+
+def _bench_metric_record(rec: Dict[str, Any]) -> Optional[Dict[str, Any]]:
+    """Unwrap a driver-captured BENCH_r*.json ({"cmd", "rc", "tail",
+    "parsed"?}) into the inner {"metric", "value", "detail"} record;
+    raw metric records pass through."""
+    if "metric" in rec and "value" in rec:
+        return rec
+    if isinstance(rec.get("parsed"), dict) and "value" in rec["parsed"]:
+        return rec["parsed"]
+    tail = rec.get("tail")
+    if isinstance(tail, str):
+        lines = [ln for ln in tail.splitlines()
+                 if ln.startswith('{"metric"')]
+        if lines:
+            try:
+                return json.loads(lines[-1])
+            except ValueError:
+                return None
+    return None
+
+
+def extract_metrics(rec: Dict[str, Any]) -> Dict[str, float]:
+    """The comparable metrics of one record, whatever its shape:
+
+    * BENCH records (driver-wrapped or raw): measured `mfu` (value>0),
+      `estimated_mfu`, `step_time_s` (measured, else predicted, else
+      the analytic estimate), `comm_bytes` (comm_bytes_per_step),
+      `peak_hbm_bytes` (detail.profile).
+    * RunLog `profile` records (obs.hlo_profile.profile_record):
+      estimated step time / wire bytes / peak HBM.
+    * RunLog `compile` records: estimated_mfu / estimated_step_s /
+      comm_bytes.
+    * plain dicts already keyed by metric names pass through.
+
+    Missing fields are simply absent — the diff skips what it cannot
+    compare (and says so)."""
+    out: Dict[str, float] = {}
+
+    def put(key, val):
+        if val is not None:
+            try:
+                v = float(val)
+            except (TypeError, ValueError):
+                return
+            if v == v:  # not NaN
+                out[key] = v
+
+    kind = rec.get("kind")
+    if kind == "profile" or "profile_schema" in rec:
+        put("step_time_s", rec.get("estimated_step_s"))
+        put("comm_bytes", rec.get("total_wire_bytes"))
+        put("peak_hbm_bytes", rec.get("peak_hbm_bytes"))
+        put("estimated_mfu", rec.get("estimated_mfu"))
+        return out
+    if kind == "compile":
+        put("estimated_mfu", rec.get("estimated_mfu"))
+        put("step_time_s", rec.get("estimated_step_s"))
+        put("comm_bytes", rec.get("comm_bytes"))
+        return out
+
+    m = _bench_metric_record(rec)
+    if m is not None:
+        if m.get("value"):
+            put("mfu", m["value"])
+        detail = m.get("detail") or {}
+        put("estimated_mfu", detail.get("estimated_mfu"))
+        est = detail.get("estimate") or {}
+        put("step_time_s",
+            detail.get("step_time_s") or detail.get("predicted_step_s")
+            or est.get("estimated_step_s"))
+        put("comm_bytes", detail.get("comm_bytes_per_step"))
+        prof = detail.get("profile") or {}
+        put("peak_hbm_bytes", prof.get("peak_hbm_bytes"))
+        return out
+
+    # plain dict keyed by metric names
+    for k in METRIC_DIRECTION:
+        put(k, rec.get(k))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# checks
+# ---------------------------------------------------------------------------
+
+#: (metric key, PerfBudget attribute, "max"|"min") — ONE ceilings table
+#: shared by check_absolute and callers that report declared-but-
+#: uncheckable ceilings (the trainer's budget warning); adding a
+#: budgeted metric here reaches both
+ABSOLUTE_CEILINGS = (
+    ("step_time_s", "max_step_time_s", "max"),
+    ("comm_bytes", "max_comm_bytes", "max"),
+    ("peak_hbm_bytes", "max_peak_hbm_bytes", "max"),
+    ("estimated_mfu", "min_estimated_mfu", "min"),
+)
+
+
+def check_absolute(metrics: Dict[str, float], budget: PerfBudget
+                   ) -> List[Dict[str, Any]]:
+    """Breaches of the budget's absolute ceilings in one record's
+    metrics.  Each breach: {"metric", "value", "budget", "kind"}."""
+    breaches = []
+    for key, attr, kind in ABSOLUTE_CEILINGS:
+        limit = getattr(budget, attr)
+        if limit is None or key not in metrics:
+            continue
+        v = metrics[key]
+        if (kind == "max" and v > limit) or (kind == "min" and v < limit):
+            breaches.append({"metric": key, "value": v, "budget": limit,
+                             "kind": f"absolute_{kind}"})
+    return breaches
+
+
+def diff_metrics(old: Dict[str, float], new: Dict[str, float],
+                 budget: Optional[PerfBudget] = None) -> Dict[str, Any]:
+    """Round-over-round regression check.  Returns {"deltas": {metric:
+    {"old", "new", "rel"}}, "breaches": [...], "compared": [metrics],
+    "skipped": [metrics present on only one side]}.  A metric breaches
+    when it moved in its WORSE direction by more than the budget's
+    relative threshold."""
+    budget = budget or PerfBudget()
+    deltas: Dict[str, Any] = {}
+    breaches: List[Dict[str, Any]] = []
+    compared, skipped = [], []
+    for key, larger_is_worse in METRIC_DIRECTION.items():
+        o, n = old.get(key), new.get(key)
+        if o is None and n is None:
+            continue
+        if o is None or n is None or o == 0:
+            skipped.append(key)
+            continue
+        rel = (n - o) / abs(o)
+        deltas[key] = {"old": o, "new": n, "rel": rel}
+        compared.append(key)
+        thr = budget.thresholds.get(key, DEFAULT_THRESHOLDS.get(key, 0.1))
+        worse = rel > thr if larger_is_worse else rel < -thr
+        if worse:
+            breaches.append({"metric": key, "old": o, "new": n,
+                             "rel": rel, "threshold": thr,
+                             "kind": "regression"})
+    return {"deltas": deltas, "breaches": breaches,
+            "compared": compared, "skipped": skipped}
+
+
+def enforce(breaches: List[Dict[str, Any]],
+            budget: Optional[PerfBudget] = None) -> None:
+    """Fail loudly: raise BudgetError when there are breaches and the
+    budget declares `enforce`; otherwise return (callers report)."""
+    if breaches and budget is not None and budget.enforce:
+        raise BudgetError(
+            f"perf budget breached ({budget.source}): "
+            + "; ".join(f"{b['metric']} {b.get('kind')} "
+                        f"value={b.get('new', b.get('value'))}"
+                        for b in breaches))
+
+
+def summarize_breaches(breaches: List[Dict[str, Any]]) -> str:
+    """One human line per breach (the sentinel's stderr report)."""
+    lines = []
+    for b in breaches:
+        if b.get("kind") == "regression":
+            lines.append(
+                f"REGRESSION {b['metric']}: {b['old']:.6g} -> "
+                f"{b['new']:.6g} ({b['rel']:+.1%}, threshold "
+                f"{b['threshold']:.0%})")
+        else:
+            lines.append(
+                f"BUDGET {b['metric']}: value {b['value']:.6g} vs "
+                f"declared {b['budget']:.6g} ({b['kind']})")
+    return "\n".join(lines)

@@ -1,0 +1,702 @@
+"""Analytic step profiler: per-layer HLO attribution + peak-HBM accounting.
+
+The TPU tunnel being down must not stop perf attribution at coarse
+phases: this module walks the POST-OPTIMIZATION HLO of a compiled train
+step (any backend, incl. the 8-device CPU test mesh) and attributes
+FLOPs, HBM traffic (output bytes), and bytes-on-wire **per named
+layer/op-group** — the `jax.named_scope` names the model stack emits
+(`layer_3/attn`, `layer_3/mlp`, `embed`, `lm_head`, `optimizer`,
+`grad_sync`; scanned stacks collapse to one `layer/...` group whose
+while-loop trip count multiplies through).  Three measurements, one
+text walk:
+
+* **per-group attribution** (`layer_table`) — the same line scan
+  `utils.profiling.phase_breakdown` does, refined to full scope paths
+  and extended with parsed dot FLOPs (2 * out_elems * contraction from
+  each `dot(...)` line's operand shapes) and the ring wire bytes of any
+  collective in the group (`obs.comm`'s formulas — ONE byte model).
+  Sums reconcile with the coarse phases by construction: both walks
+  count the same `op_name=` lines (tested).
+
+* **roofline per group** (`layer_profile`) — each group bounded by
+  max(flops/compute_rate, out_bytes/hbm_rate) + wire_bytes/ici_rate
+  over the hardware profile, rendered as an **analytic flame graph**
+  (`flame_trace` — a Chrome-trace lane of predicted per-group times
+  next to the schedule traces obs.trace already draws).
+
+* **peak-HBM estimate** (`peak_hbm_estimate`) — a liveness sweep over
+  the HLO: every non-parameter instruction's output buffer is live from
+  its definition to its last use; while bodies contribute their own
+  internal peak (buffers REUSED across trips — which is exactly why a
+  remat'd scanned stack peaks at one layer's working set, not L of
+  them); fusion internals never materialize.  peak = entry argument
+  bytes (params + optimizer state + batch) + the sweep's max live set,
+  cross-checked against `compiled.memory_analysis()` when the backend
+  exposes it (the `search/calibrate.py` source of truth).  The analytic
+  twin (`analytic_peak_hbm`) prices params + Adam moments + grads +
+  remat-aware activations from a model config alone — the bench
+  fallback when nothing can even lower, and the cost model's
+  feasibility term (`search/cost_model.py` `fits_hbm`).
+
+Consumers: Trainer compile run-events (`HETU_TPU_PROFILE=1` -> a
+schema-versioned `profile` RunLog record, `profile_record`),
+`Trainer.profile_report`, bench.py (`detail.profile`: top-k groups +
+peak HBM), tools_obs_report.py (the `profile` section), and the
+regression sentinel (`obs/budget.py` + tools_bench_diff.py) that diffs
+these numbers across rounds against declared budgets.
+
+Known limits: GSPMD-inserted collectives (the implicit DP grad
+all-reduce) carry the scope of the op that PRODUCED their operand, so
+the explicit-comm paths (`grad_sync`) attribute exactly while implicit
+ones attribute to their producing layer; `dynamic_trip_count` loops
+count once (same caveat as obs/comm, surfaced in the report).
+"""
+from __future__ import annotations
+
+import re
+from typing import Any, Dict, List, Optional, Tuple
+
+from hetu_tpu.obs.comm import (COLLECTIVE_OPS, _cond_trip_count,
+                               _first_group, _payload_bytes,
+                               _split_computations, _wire_bytes)
+from hetu_tpu.utils.profiling import PHASES, _DTYPE_BYTES
+
+#: version stamp of the `profile` RunLog record / BENCH detail.profile
+#: payload (the same stability contract as obs.runlog.SCHEMA_VERSION:
+#: new optional fields may be added within a version, none renamed)
+PROFILE_SCHEMA = 1
+
+#: scope names that form an op-group on their own (next to the
+#: per-layer `layer_<i>` scopes and the model phases)
+EXTRA_GROUPS = ("optimizer", "grad_sync")
+
+_OP_PAT = re.compile(r'op_name="([^"]+)"')
+_SHAPE_PAT = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
+_OUT_PAT = re.compile(r'=\s*(.*?)\s*[a-z][a-z0-9_.-]*\(')
+_DEF_PAT = re.compile(r'%([\w.\-]+)\s*=\s*(.*?)\s*([a-z][a-z0-9_.-]*)\(')
+_REF_PAT = re.compile(r'%([\w.\-]+)')
+_LAYER_SEG_PAT = re.compile(r'^layer(_\d+)?$')
+_DOT_CONTRACT_PAT = re.compile(r'lhs_contracting_dims=\{([0-9,]*)\}')
+_TRANSFORM_PAT = re.compile(r'^[\w.\-]+\((.*)\)$')
+_CALLEE_PAT = re.compile(
+    r'(?:calls|body|condition|to_apply)=%?([\w.\-]+)')
+_BRANCH_PAT = re.compile(r'branch_computations=\{([^}]*)\}')
+_ENTRY_PAT = re.compile(r'^ENTRY\s+%?([\w.\-]+)', re.M)
+
+
+# ---------------------------------------------------------------------------
+# scope-path parsing
+# ---------------------------------------------------------------------------
+
+def scope_segments(op_name: str) -> List[str]:
+    """`jit(f)/jit(main)/transpose(jvp(layer_1))/attn/dot_general` ->
+    ["f", "main", "layer_1", "attn", "dot_general"]: each '/'-separated
+    token unwrapped of its transform wrappers (jvp/transpose/jit/remat
+    ...), so forward AND backward instructions land in the same group."""
+    out = []
+    for tok in op_name.split("/"):
+        while True:
+            m = _TRANSFORM_PAT.match(tok)
+            if m is None or not m.group(1):
+                break
+            tok = m.group(1)
+        if tok:
+            out.append(tok)
+    return out
+
+
+def group_of(op_name: str, phases: Tuple[str, ...] = PHASES) -> str:
+    """The attribution group of one instruction's scope path:
+    `layer_<i>/<phase>` when both a layer scope and a phase scope are
+    present, the layer alone, the phase alone (embed / lm_head /
+    optimizer / grad_sync live outside layers), else "other"."""
+    segs = scope_segments(op_name)
+    layer = next((s for s in reversed(segs)
+                  if _LAYER_SEG_PAT.match(s)), None)
+    known = (*phases, *EXTRA_GROUPS)
+    phase = next((s for s in reversed(segs) if s in known), None)
+    if layer and phase:
+        return f"{layer}/{phase}"
+    if layer:
+        return layer
+    if phase:
+        return phase
+    return "other"
+
+
+def _shape_bytes(section: str) -> int:
+    total = 0
+    for dt, dims in _SHAPE_PAT.findall(section):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def _dot_flops(line: str) -> float:
+    """FLOPs of one `dot(...)` line: 2 * out_elems * contraction size,
+    contraction parsed from the FIRST operand shape (inside the parens)
+    and `lhs_contracting_dims`.  0.0 when not statically parseable."""
+    om = _OUT_PAT.search(line)
+    if om is None:
+        return 0.0
+    out_elems = 0
+    for dt, dims in _SHAPE_PAT.findall(om.group(1)):
+        n = 1
+        for d in dims.split(","):
+            if d:
+                n *= int(d)
+        out_elems += n
+    paren = line.find(" dot(")
+    if paren < 0:
+        return 0.0
+    operands = line[paren + 5:]
+    lhs = _SHAPE_PAT.search(operands)
+    cm = _DOT_CONTRACT_PAT.search(line)
+    if lhs is None or cm is None:
+        return 0.0
+    lhs_dims = [int(d) for d in lhs.group(2).split(",") if d]
+    contract = 1
+    for idx in cm.group(1).split(","):
+        if idx and int(idx) < len(lhs_dims):
+            contract *= lhs_dims[int(idx)]
+    return 2.0 * out_elems * contract
+
+
+# ---------------------------------------------------------------------------
+# computation call graph (trip-count multipliers incl. fusions/calls)
+# ---------------------------------------------------------------------------
+
+def _call_multipliers(comps: Dict[str, List[str]]
+                      ) -> Dict[str, Tuple[float, bool]]:
+    """{computation: (execution multiplier, dynamic?)} — like obs.comm's
+    while-body multipliers but following EVERY call edge (fusion
+    `calls=`, `to_apply=`, conditional branches at x1; while bodies at
+    their resolved trip count), so a dot inside a fusion inside a
+    scanned layer still multiplies by the layer count."""
+    parent: Dict[str, Tuple[str, Optional[float]]] = {}
+    for cname, lines in comps.items():
+        for ln in lines:
+            is_while = " while(" in ln
+            trip: Optional[float] = 1.0
+            if is_while:
+                cm = re.search(r'condition=%?([\w.\-]+)', ln)
+                trip = None
+                if cm is not None and cm.group(1) in comps:
+                    t = _cond_trip_count(comps[cm.group(1)])
+                    trip = float(t) if t else None
+            for m in _CALLEE_PAT.finditer(ln):
+                callee = m.group(1)
+                if callee not in comps:
+                    continue
+                # while body multiplies by trip; its condition (and any
+                # plain call/fusion) executes with the caller's cadence
+                t = trip if (is_while and ln[m.start():m.start() + 4]
+                             == "body") else 1.0
+                # first caller wins; HLO computations have one caller
+                parent.setdefault(callee, (cname, t))
+            bm = _BRANCH_PAT.search(ln)
+            if bm:
+                for callee in _REF_PAT.findall(bm.group(1)):
+                    if callee in comps:
+                        parent.setdefault(callee, (cname, 1.0))
+
+    memo: Dict[str, Tuple[float, bool]] = {}
+
+    def mult(name: str, seen=()) -> Tuple[float, bool]:
+        if name in memo:
+            return memo[name]
+        if name not in parent or name in seen:
+            return (1.0, False)
+        pname, trip = parent[name]
+        pm, pdyn = mult(pname, seen + (name,))
+        out = (pm * (trip if trip else 1.0), pdyn or trip is None)
+        memo[name] = out
+        return out
+
+    return {name: mult(name) for name in comps}
+
+
+# ---------------------------------------------------------------------------
+# per-group attribution
+# ---------------------------------------------------------------------------
+
+def layer_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
+                default_world: int = 1,
+                apply_multipliers: bool = True
+                ) -> Dict[str, Dict[str, float]]:
+    """{group: {"instructions", "dots", "flops", "out_bytes",
+    "wire_bytes"}} over the optimized HLO, execution multipliers
+    applied (scanned layers count trip-count times).  Groups are
+    `group_of` keys; an extra "_meta" entry carries
+    {"dynamic_trip_count"} when some loop's trip was unresolvable.
+
+    apply_multipliers=False counts each instruction ONCE (static) —
+    exactly `utils.profiling.phase_breakdown`'s accounting (same lines,
+    same output-shape anchoring), so per-group sums reconcile with the
+    coarse per-phase totals; with multipliers on, wire-byte sums
+    reconcile with `obs.comm.collective_report` instead (which resolves
+    the same trip counts) — both are the attribution-consistency
+    contract the tests pin."""
+    txt = (compiled_or_text if isinstance(compiled_or_text, str)
+           else compiled_or_text.as_text())
+    comps = _split_computations(txt)
+    mults = (_call_multipliers(comps) if apply_multipliers
+             else {name: (1.0, False) for name in comps})
+    out: Dict[str, Dict[str, float]] = {}
+    dynamic = False
+    conv_unparsed = False
+
+    def new_row():
+        return {"instructions": 0.0, "dots": 0.0, "flops": 0.0,
+                "out_bytes": 0.0, "wire_bytes": 0.0}
+    for cname, lines in comps.items():
+        mult, dyn = mults.get(cname, (1.0, False))
+        for line in lines:
+            m = _OP_PAT.search(line)
+            if m is None:
+                # instructions without op_name metadata are outside the
+                # phase accounting (phase_breakdown skips them too — the
+                # static-sum contract), but a GSPMD-inserted collective
+                # without metadata still moves real bytes: count its
+                # wire bytes into "other" so wire sums reconcile with
+                # obs.comm.collective_report on EVERY program
+                wb = _line_wire_bytes(line, default_world)
+                if wb > 0:
+                    out.setdefault("other", new_row())["wire_bytes"] += \
+                        wb * mult
+                    dynamic = dynamic or dyn
+                continue
+            dynamic = dynamic or dyn
+            rec = out.setdefault(group_of(m.group(1), phases), new_row())
+            rec["instructions"] += mult
+            if " dot(" in line or " convolution(" in line:
+                rec["dots"] += mult
+                rec["flops"] += _dot_flops(line) * mult
+                if " convolution(" in line:
+                    # conv FLOPs are not statically parsed (no conv in
+                    # the model zoo today) — surface the undercount
+                    # instead of silently attributing 0
+                    conv_unparsed = True
+            om = _OUT_PAT.search(line)
+            if om is not None:
+                rec["out_bytes"] += _shape_bytes(om.group(1)) * mult
+            rec["wire_bytes"] += _line_wire_bytes(line, default_world) * mult
+    meta = {}
+    if dynamic:
+        meta["dynamic_trip_count"] = True
+    if conv_unparsed:
+        meta["conv_flops_unparsed"] = True
+    if meta:
+        out["_meta"] = meta
+    return out
+
+
+def _line_wire_bytes(line: str, default_world: int) -> float:
+    """Ring wire bytes of one instruction line (0 for non-collectives) —
+    the same opcode set and formulas obs.comm's collective_table uses."""
+    if ("all-" not in line and "reduce-scatter" not in line
+            and "collective-permute" not in line):
+        return 0.0
+    m = _DEF_PAT.search(line)
+    if m is None:
+        return 0.0
+    op = m.group(3)
+    if op.endswith("-done"):
+        return 0.0
+    is_start = op.endswith("-start")
+    base = op[:-6] if is_start else op
+    if base not in COLLECTIVE_OPS:
+        return 0.0
+    payload = _payload_bytes(m.group(2), is_start)
+    n, _ranks = _first_group(line, default_world)
+    return _wire_bytes(base, payload, n, is_start)
+
+
+def _layer_sort_key(group: str):
+    """Model order: embed, layer_0..layer_n (or the scanned "layer"),
+    lm_head, grad_sync, optimizer, unknown scopes, other."""
+    head = group.split("/")[0]
+    m = re.match(r'layer_(\d+)$', head)
+    if m:
+        return (1, int(m.group(1)), group)
+    if head == "layer":
+        return (1, -1, group)
+    order = {"embed": 0, "lm_head": 2, "grad_sync": 3,
+             "optimizer": 4, "other": 6}
+    return (order.get(head, 5), 0, group)
+
+
+def layer_profile(compiled_or_text, *, hw: Optional[Dict] = None,
+                  phases: Tuple[str, ...] = PHASES,
+                  default_world: int = 1) -> Dict[str, Any]:
+    """Roofline-price the per-group attribution: each group's predicted
+    time is max(flops/compute, out_bytes/hbm) + wire_bytes/ici over the
+    hardware profile's rates.  Returns {"groups": {group: {...,
+    "time_s", "bound"}}, "totals", "estimated_step_s", "top"} with
+    groups in model order (embed, layer_0..n / scanned layer, lm_head,
+    grad_sync, optimizer, other)."""
+    from hetu_tpu.obs.mfu import _rates, load_hardware_profile
+    hw = hw if hw is not None else load_hardware_profile()
+    compute, hbm, _peak = _rates(hw)
+    ici = float(hw.get("ici_allreduce_gbps", 45.0)) * 1e9
+    table = layer_table(compiled_or_text, phases=phases,
+                        default_world=default_world)
+    meta = table.pop("_meta", None)
+    groups: Dict[str, Dict[str, float]] = {}
+    totals = {"instructions": 0.0, "dots": 0.0, "flops": 0.0,
+              "out_bytes": 0.0, "wire_bytes": 0.0}
+    t_total = 0.0
+    for g in sorted(table, key=_layer_sort_key):
+        rec = dict(table[g])
+        t_c = rec["flops"] / compute
+        t_m = rec["out_bytes"] / hbm
+        t_w = rec["wire_bytes"] / ici
+        rec["time_s"] = max(t_c, t_m) + t_w
+        rec["bound"] = ("wire" if t_w > max(t_c, t_m)
+                        else "memory" if t_m > t_c else "compute")
+        groups[g] = rec
+        t_total += rec["time_s"]
+        for k in totals:
+            totals[k] += rec[k]
+    top = sorted(groups.items(), key=lambda kv: -kv[1]["time_s"])
+    report: Dict[str, Any] = {
+        "groups": groups,
+        "totals": totals,
+        "estimated_step_s": t_total,
+        "top": [{"group": g, "time_s": r["time_s"], "flops": r["flops"],
+                 "out_bytes": r["out_bytes"], "bound": r["bound"]}
+                for g, r in top],
+        "chip": hw.get("chip", "unknown"),
+    }
+    if meta:
+        report.update(meta)
+    return report
+
+
+# ---------------------------------------------------------------------------
+# peak-HBM accounting
+# ---------------------------------------------------------------------------
+
+#: opcodes whose output ALIASES their operands' storage 1:1 — counting
+#: them as new buffers would double every while carry (tuple in,
+#: get-tuple-element out) and inflate the liveness peak severalfold
+_ALIAS_OPS = ("get-tuple-element", "tuple", "bitcast", "while",
+              "optimization-barrier")
+
+
+def _comp_peak(comps: Dict[str, List[str]], name: str,
+               memo: Dict[str, float], seen: Tuple[str, ...] = (),
+               donated: bool = False) -> float:
+    """Liveness peak (bytes) of one computation's internal buffers —
+    the analytic twin of XLA buffer assignment's temp arena, which
+    packs buffers with disjoint live ranges into shared offsets:
+
+    * each real def is live [def line, last use of it or any alias];
+    * structural aliases (`_ALIAS_OPS` — gte/tuple/bitcast/while) add
+      no storage and extend their roots' lifetimes;
+    * in-place sharing: when a def's byte size equals a root that DIES
+      at that very line, XLA's elementwise/fusion in-place reuse writes
+      the output over the operand — modeled by extending the dying
+      root's lifetime instead of allocating; with `donated=True` (the
+      module declares input_output_alias) a dying entry PARAMETER's
+      storage is reusable the same way — how a donated train step
+      writes new params over old ones;
+    * a `while` line additionally holds its body's peak while it runs
+      (the body REUSES its buffers across trips — exactly why a
+      remat'd scanned stack peaks at ONE layer's working set, not L);
+      conditionals hold the max branch; fusion internals never
+      materialize."""
+    if name in memo:
+        return memo[name]
+    if name in seen or name not in comps:
+        return 0.0
+    lines = comps[name]
+    parsed: List[Optional[Tuple[str, int, str, List[str]]]] = []
+    roots: Dict[str, Tuple[str, ...]] = {}   # name -> storage roots
+    transient: Dict[int, float] = {}         # line -> callee peak bytes
+    persistent: Dict[str, int] = {}          # donated entry params
+
+    def root_of(nm: str) -> Tuple[str, ...]:
+        return roots.get(nm, (nm,))
+
+    for i, ln in enumerate(lines):
+        m = _DEF_PAT.search(ln)
+        if m is None:
+            parsed.append(None)
+            continue
+        nm, op = m.group(1), m.group(3)
+        operands = [r for r in _REF_PAT.findall(ln) if r != nm]
+        b = 0 if op in ("parameter",) + _ALIAS_OPS \
+            else _shape_bytes(m.group(2))
+        if op == "parameter" and donated:
+            persistent[nm] = _shape_bytes(m.group(2))
+        if op in _ALIAS_OPS:
+            rs: Tuple[str, ...] = ()
+            for o in operands:
+                rs += root_of(o)
+            roots[nm] = tuple(dict.fromkeys(rs)) or (nm,)
+        parsed.append((nm, b, op, operands))
+        if op == "while":
+            bm = re.search(r'body=%?([\w.\-]+)', ln)
+            if bm is not None:
+                transient[i] = _comp_peak(comps, bm.group(1), memo,
+                                          seen + (name,))
+        elif op == "conditional":
+            bm = _BRANCH_PAT.search(ln)
+            branches = (_REF_PAT.findall(bm.group(1)) if bm else [])
+            for cm in re.finditer(r'(?:true|false)_computation='
+                                  r'%?([\w.\-]+)', ln):
+                branches.append(cm.group(1))
+            if branches:
+                transient[i] = max(
+                    _comp_peak(comps, b_, memo, seen + (name,))
+                    for b_ in branches)
+        elif op in ("call", "custom-call"):
+            cm = re.search(r'to_apply=%?([\w.\-]+)', ln)
+            if cm is not None:
+                # the callee's ROOT buffer is the call's output — the
+                # caller already counts it as this def, so the callee
+                # peak contributes only its EXCESS over the output
+                transient[i] = max(
+                    _comp_peak(comps, cm.group(1), memo,
+                               seen + (name,)) - b, 0.0)
+
+    bytes_of: Dict[str, int] = {}
+    def_line: Dict[str, int] = {}
+    last_use: Dict[str, int] = {}
+    for i, rec in enumerate(parsed):
+        if rec is None:
+            continue
+        nm, b, op, operands = rec
+        if b > 0:
+            bytes_of[nm] = b
+            def_line[nm] = i
+            last_use[nm] = i
+        for o in operands:
+            for r in root_of(o):
+                last_use[r] = i
+
+    # sequential sweep with the in-place sharing heuristic
+    events: List[Tuple[int, float]] = []
+    for i, rec in enumerate(parsed):
+        if rec is None:
+            continue
+        nm, b, op, operands = rec
+        if b <= 0:
+            continue
+        reused = None
+        if op not in ("constant", "iota", "parameter"):
+            for o in operands:
+                for r in root_of(o):
+                    if ((bytes_of.get(r) == b or persistent.get(r) == b)
+                            and last_use.get(r) == i and r != nm):
+                        reused = r
+                        break
+                if reused:
+                    break
+        if reused is not None:
+            # output takes over the dying operand's storage: fold this
+            # def into the operand's buffer (alias) instead of a fresh
+            # allocation, and let the operand's lifetime carry on
+            roots[nm] = (reused,)
+            last_use[reused] = max(last_use.get(reused, i),
+                                   last_use.get(nm, i))
+            bytes_of.pop(nm, None)
+    for nm, b in bytes_of.items():
+        events.append((def_line[nm], float(b)))
+        events.append((last_use.get(nm, 0) + 1, -float(b)))
+    for i, b in transient.items():
+        if b > 0:
+            events.append((i, float(b)))
+            events.append((i + 1, -float(b)))
+    events.sort(key=lambda e: (e[0], -e[1]))
+    live = peak = 0.0
+    for _, d in events:
+        live += d
+        peak = max(peak, live)
+    memo[name] = peak
+    return peak
+
+
+def peak_hbm_estimate(compiled_or_text, *,
+                      hw: Optional[Dict] = None,
+                      text: Optional[str] = None) -> Dict[str, Any]:
+    """Liveness-based peak-HBM estimate of one compiled step.
+
+    peak_bytes = entry argument bytes (params + optimizer state + batch;
+    donated args alias outputs, so they are NOT double-counted) + the
+    liveness sweep's max concurrent non-parameter buffer set.  When the
+    executable exposes `memory_analysis()` the XLA buffer-assignment
+    numbers ride along as the cross-check (`xla_peak_bytes`,
+    `vs_xla` ratio — the acceptance gate pins it within 20% on the
+    tier-1 models).  `headroom_frac` prices the estimate against the
+    profile's `hbm_gbytes` (>1.0 = the step does not fit).  `text` lets
+    a caller that already materialized as_text() (profile_record) skip
+    a second stringification of a large module."""
+    txt = text if text is not None else (
+        compiled_or_text if isinstance(compiled_or_text, str)
+        else compiled_or_text.as_text())
+    comps = _split_computations(txt)
+    em = _ENTRY_PAT.search(txt)
+    entry = em.group(1) if em is not None else next(iter(comps), "")
+    args_bytes = 0.0
+    for ln in comps.get(entry, []):
+        m = _DEF_PAT.search(ln)
+        if m is not None and m.group(3) == "parameter":
+            args_bytes += _shape_bytes(m.group(2))
+    # a module that declares input_output_alias writes (some) outputs
+    # over its donated argument buffers — the entry sweep may model
+    # in-place reuse of dying parameter storage
+    donated = "input_output_alias" in txt
+    memo: Dict[str, float] = {}
+    temp_peak = _comp_peak(comps, entry, memo, donated=donated)
+    report: Dict[str, Any] = {
+        "args_bytes": args_bytes,
+        "temp_peak_bytes": temp_peak,
+        "peak_bytes": args_bytes + temp_peak,
+        "donated": donated,
+    }
+    ma = None
+    if not isinstance(compiled_or_text, str):
+        try:
+            ma = compiled_or_text.memory_analysis()
+        except Exception:
+            ma = None
+    if ma is not None:
+        try:
+            # XLA's live peak: arguments + the temp arena + outputs that
+            # do NOT alias (donate into) an argument buffer
+            xla_args = float(ma.argument_size_in_bytes)
+            xla_temp = float(ma.temp_size_in_bytes)
+            xla_out = float(getattr(ma, "output_size_in_bytes", 0.0) or 0.0)
+            xla_alias = float(getattr(ma, "alias_size_in_bytes", 0.0) or 0.0)
+            report["xla_args_bytes"] = xla_args
+            report["xla_temp_bytes"] = xla_temp
+            report["xla_peak_bytes"] = (xla_args + xla_temp
+                                        + max(xla_out - xla_alias, 0.0))
+            if report["xla_peak_bytes"] > 0:
+                report["vs_xla"] = (report["peak_bytes"]
+                                    / report["xla_peak_bytes"])
+        except Exception:
+            pass
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    hw = hw if hw is not None else load_hardware_profile()
+    hbm = float(hw.get("hbm_gbytes", 0.0) or 0.0) * 1e9
+    if hbm > 0:
+        report["hbm_gbytes"] = hw["hbm_gbytes"]
+        report["headroom_frac"] = report["peak_bytes"] / hbm
+    return report
+
+
+def analytic_peak_hbm(num_params: float, *, batch: int, seq: int,
+                      hidden: int, num_layers: int, vocab: int,
+                      dp: int = 1, tp: int = 1, pp: int = 1, cp: int = 1,
+                      zero: bool = False, remat: bool = True,
+                      sequence_parallel: bool = False,
+                      act_boundary_units: float = 1.0,
+                      act_full_units: float = 12.0,
+                      param_bytes: int = 4) -> Dict[str, float]:
+    """Jax-free per-device peak-HBM model: master params + grads at
+    `param_bytes` each (4 = the fp32-master default matching
+    `search/cost_model.py.per_device_memory`; 2 prices bf16-weight
+    training), Adam m/v always fp32 (dp-sharded under ZeRO),
+    remat-aware activations (boundary buffers only under remat, the
+    calibrated full working set otherwise) + fp32 logits.  This is the
+    bench fallback when nothing can even lower, and the term the
+    searcher's feasibility gate rejects OOM plans by."""
+    shard = max(tp * pp, 1)
+    params = float(param_bytes) * num_params / shard
+    opt = 8.0 * num_params / shard
+    if zero and dp > 1:
+        opt /= dp
+    grads = float(param_bytes) * num_params / shard
+    b_local = batch / max(dp * cp, 1)
+    seq_local = seq / max(cp, 1)
+    layers_local = num_layers / max(pp, 1)
+    act_per_layer = b_local * seq_local * hidden * 2.0
+    if sequence_parallel and tp > 1:
+        act_per_layer /= tp
+    units = act_boundary_units if remat else act_full_units
+    acts = act_per_layer * layers_local * units
+    logits = b_local * seq_local * vocab * 4.0 / max(tp, 1)
+    total = params + opt + grads + acts + logits
+    return {"params_bytes": params, "opt_state_bytes": opt,
+            "grads_bytes": grads, "activation_bytes": acts,
+            "logits_bytes": logits, "peak_bytes": total,
+            "param_bytes": float(param_bytes), "remat": bool(remat)}
+
+
+# ---------------------------------------------------------------------------
+# the schema-versioned profile record + the flame graph
+# ---------------------------------------------------------------------------
+
+def profile_record(compiled_or_text, *, hw: Optional[Dict] = None,
+                   top_k: int = 8, default_world: int = 1,
+                   profile: Optional[Dict[str, Any]] = None,
+                   text: Optional[str] = None) -> Dict[str, Any]:
+    """The `profile` RunLog payload (and BENCH `detail.profile` shape):
+    {"profile_schema": 1, "top": top-k groups by predicted time,
+    "groups": <count>, "estimated_step_s", "total_flops",
+    "total_wire_bytes", "peak_hbm_bytes", "peak_hbm_vs_xla",
+    "hbm_headroom_frac"} — small enough to ride every fresh compile.
+
+    The HLO text is materialized ONCE and shared by the attribution and
+    peak walks; callers that already hold a `layer_profile` report
+    and/or the text (the trainer's flame-graph path) pass them in to
+    skip the re-walk."""
+    txt = text if text is not None else (
+        compiled_or_text if isinstance(compiled_or_text, str)
+        else compiled_or_text.as_text())
+    prof = profile if profile is not None else layer_profile(
+        txt, hw=hw, default_world=default_world)
+    peak = peak_hbm_estimate(compiled_or_text, hw=hw, text=txt)
+    rec: Dict[str, Any] = {
+        "profile_schema": PROFILE_SCHEMA,
+        "groups": len(prof["groups"]),
+        "top": [
+            {k: (round(v, 9) if isinstance(v, float) else v)
+             for k, v in row.items()}
+            for row in prof["top"][:max(top_k, 1)]],
+        "estimated_step_s": prof["estimated_step_s"],
+        "total_flops": prof["totals"]["flops"],
+        "total_out_bytes": prof["totals"]["out_bytes"],
+        "total_wire_bytes": prof["totals"]["wire_bytes"],
+        "peak_hbm_bytes": peak["peak_bytes"],
+    }
+    for caveat in ("dynamic_trip_count", "conv_flops_unparsed"):
+        if prof.get(caveat):
+            rec[caveat] = True
+    if "vs_xla" in peak:
+        rec["peak_hbm_vs_xla"] = peak["vs_xla"]
+    if "headroom_frac" in peak:
+        rec["hbm_headroom_frac"] = peak["headroom_frac"]
+    return rec
+
+
+def flame_trace(profile: Dict[str, Any]) -> "ChromeTrace":
+    """Render a `layer_profile` report as an analytic flame graph: one
+    Chrome-trace lane of per-group predicted roofline times in model
+    order (compute/memory/wire bound in the args), openable next to the
+    schedule traces at https://ui.perfetto.dev."""
+    from hetu_tpu.obs.trace import ChromeTrace
+    tr = ChromeTrace()
+    pid = "analytic step"
+    tr.name_process(pid, "analytic step profile "
+                         f"({profile.get('chip', 'unknown')})")
+    tr.name_thread(pid, "roofline", "predicted per-group time")
+    t = 0.0
+    for g, rec in profile["groups"].items():
+        dur = float(rec.get("time_s", 0.0)) * 1e6
+        if dur <= 0:
+            continue
+        tr.add_complete(g, t, dur, pid=pid, tid="roofline",
+                        cat=rec.get("bound", ""),
+                        args={"flops": rec.get("flops"),
+                              "out_bytes": rec.get("out_bytes"),
+                              "wire_bytes": rec.get("wire_bytes"),
+                              "bound": rec.get("bound")})
+        t += dur
+    return tr

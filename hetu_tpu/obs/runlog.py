@@ -30,6 +30,15 @@ Kind fields:
                   queue_depth, slot_occupancy, page_util;
                   reshard: tier, strategy; report: requests, tokens,
                   elapsed_s, tokens_per_s
+    profile       name, plan, profile_schema, top (top-k layers/op-groups
+                  by predicted roofline time), estimated_step_s,
+                  total_flops, total_wire_bytes, peak_hbm_bytes,
+                  peak_hbm_vs_xla, hbm_headroom_frac — the per-compile
+                  analytic step profile (obs.hlo_profile,
+                  HETU_TPU_PROFILE=1)
+    budget        name, ok, breaches, budget — declared-perf-budget
+                  check per fresh compile (obs.budget,
+                  HETU_TPU_BUDGETS)
     rotated       segment, records — the size-cap rotation marker (the
                   last record of a rotated segment)
     summary       metrics (a MetricsRegistry snapshot), profiler summary

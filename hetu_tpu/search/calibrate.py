@@ -113,6 +113,46 @@ def apply_activation_calibration(cost: CostModel,
     return cost
 
 
+def apply_profile_calibration(cost: CostModel, profile: Dict,
+                              batch: int, seq: int, *,
+                              num_layers: Optional[int] = None,
+                              dot_recompute: float = 1.0) -> CostModel:
+    """Feed a compiled step's per-layer HLO profile
+    (obs.hlo_profile.layer_profile at batch x seq) back into the cost
+    model: the measured per-layer dot FLOPs replace the analytic
+    6N-based layer term (`measured_layer_flops_per_token`), so the
+    searcher prices compute from what the compiler actually emitted —
+    the Galvatron profiler->cost-model loop, hardware-free.
+
+    `dot_recompute` is the fraction of forward DOT FLOPs the PROFILED
+    program's backward re-runs: 1.0 for remat under the default
+    "nothing" policy (full recompute — a train step spends 4 forward
+    dot-units per layer instead of 3), 0.0 for no-remat and for the
+    dot-saving policies ("dots"/"dots_attn" SAVE dot outputs, so the
+    profile's dots are already the 3-unit no-recompute count).  The
+    measured rate is normalized to no-recompute units so `step_time`'s
+    own remat factor applies per candidate."""
+    groups = profile.get("groups", profile) or {}
+    layer_flops = sum(
+        float(rec.get("flops", 0.0)) for g, rec in groups.items()
+        if isinstance(rec, dict)
+        and (g == "layer" or g.startswith("layer/")
+             or g.startswith("layer_")))
+    if layer_flops <= 0:
+        logger.warning("profile calibration unavailable: no layer-scoped "
+                       "FLOPs in the profile (model lacks per-layer "
+                       "named scopes?); keeping the analytic rate")
+        return cost
+    layer_flops *= 3.0 / (3.0 + float(dot_recompute))
+    L = num_layers or cost.num_layers
+    tokens = float(batch) * seq
+    cost.measured_layer_flops_per_token = layer_flops / max(L, 1) / tokens
+    logger.info(f"calibrated per-layer compute: "
+                f"{cost.measured_layer_flops_per_token:.3e} "
+                f"FLOPs/token/layer (from {L} layers at {batch}x{seq})")
+    return cost
+
+
 def tp_efficiency_from_cost(cost: CostModel, tp: int = 2) -> float:
     """Per-doubling TP scaling efficiency implied by the (measured)
     compute/ICI numbers: eff = ideal_time / actual_time at one doubling.

@@ -121,6 +121,12 @@ class CostModel:
     # replaces them with XLA's compiled-memory analysis of the real block
     act_boundary_units: float = 1.0
     act_full_units: float = 12.0
+    # measured per-layer compute rate (FLOPs per token per layer,
+    # no-remat normalized) from a compiled step's per-layer HLO profile
+    # (obs.hlo_profile via calibrate.apply_profile_calibration) — when
+    # set, it replaces the analytic 6N-based per-layer term with what
+    # the compiler actually emitted for THIS model
+    measured_layer_flops_per_token: Optional[float] = None
 
     def __post_init__(self):
         # a saved hardware profile (bench.py writes act_* keys from the
@@ -140,6 +146,12 @@ class CostModel:
 
     # ---------------- compute ----------------
     def _flops_per_token(self) -> float:
+        if self.measured_layer_flops_per_token:
+            # profile-calibrated decoder layers + the analytic LM-head
+            # term (6 * vocab * hidden per token; embedding lookups are
+            # gather traffic, not MXU work)
+            return (self.measured_layer_flops_per_token * self.num_layers
+                    + 6.0 * self.vocab * self.hidden)
         return 6.0 * self.num_params + \
             12 * self.num_layers * self.hidden * self.seq_len
 
@@ -328,6 +340,26 @@ class CostModel:
             # block-major shard — _blk gathers, slices, discards)
             transient = 2.0 * self.num_params / max(self.num_layers, 1)
         return params + opt + grads + acts + logits + transient
+
+    def peak_hbm_bytes(self, c: StrategyCandidate) -> float:
+        """The candidate's predicted per-device peak HBM — the memory
+        term the feasibility gate prices (alias of per_device_memory,
+        named for what it means)."""
+        return self.per_device_memory(c)
+
+    def fits_hbm(self, c: StrategyCandidate,
+                 headroom: float = 0.9,
+                 mem: Optional[float] = None) -> bool:
+        """Peak-memory feasibility gate: does this candidate fit the
+        profiled chip's HBM (with headroom for XLA temp slack)?  False
+        = the plan would OOM — the searcher rejects it analytically
+        instead of discovering the OOM at compile time (the Hetis-style
+        footprint-visibility term; ROADMAP item 2).  `mem` takes a
+        per_device_memory value the caller already computed (the
+        searcher's evaluate() loop) instead of re-deriving it."""
+        if mem is None:
+            mem = self.per_device_memory(c)
+        return mem <= self.hw.hbm_gbytes * 1e9 * headroom
 
     def evaluate(self, c: StrategyCandidate):
         return self.step_time(c), self.per_device_memory(c)

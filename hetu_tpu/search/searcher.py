@@ -73,7 +73,6 @@ def search_strategy(cost: CostModel, num_devices: int,
     1f1b's O(pp) memory and mixed-mesh round penalty); or pin "gpipe" /
     "1f1b".  n_micro: pin the micro count (None = the 2*pp heuristic)."""
     from hetu_tpu.parallel.strategy import StrategyValidationError
-    hbm = cost.hw.hbm_gbytes * 1e9 * 0.9  # headroom
     results = []
     skipped = 0
     for dp, tp, pp, cp in _factorizations(num_devices):
@@ -104,7 +103,11 @@ def search_strategy(cost: CostModel, num_devices: int,
                         skipped += 1
                         continue
                     t, m = cost.evaluate(c)
-                    if m <= hbm:
+                    # the cost model's peak-memory feasibility gate:
+                    # candidates that would OOM the profiled chip are
+                    # rejected analytically (one definition, shared
+                    # with every other CostModel consumer)
+                    if cost.fits_hbm(c, mem=m):
                         results.append((c, t, m))
     if skipped:
         from hetu_tpu.utils.logging import get_logger

@@ -77,6 +77,27 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "hardware profile JSON for the MFU/roofline reporter (obs.mfu); "
          "default: repo-root hardware_profile_v5e.json, else built-in v5e "
          "constants"),
+    Flag("HETU_TPU_PROFILE", "bool", False,
+         "per-compile analytic step profile (obs.hlo_profile): per-layer "
+         "HLO attribution (FLOPs/HBM bytes/wire bytes per named "
+         "layer/op-group) + liveness-based peak-HBM estimate -> a "
+         "schema-versioned 'profile' RunLog record per fresh compile.  "
+         "Pure post-compile HLO-text analysis: the traced program is "
+         "byte-identical with the flag on or off"),
+    Flag("HETU_TPU_PROFILE_TOPK", "int", 8,
+         "how many top layers/op-groups (by predicted roofline time) the "
+         "'profile' RunLog record and BENCH detail.profile carry"),
+    Flag("HETU_TPU_PROFILE_TRACE", "str", "",
+         "write the analytic flame graph (obs.hlo_profile.flame_trace — "
+         "a Chrome-trace lane of predicted per-layer roofline times) to "
+         "this path on each fresh compile; open in Perfetto"),
+    Flag("HETU_TPU_BUDGETS", "str", "",
+         "declared perf-budget JSON (obs/budget.py PerfBudget: absolute "
+         "ceilings for step time / comm bytes / peak HBM / MFU plus "
+         "relative regression thresholds).  The trainer checks each "
+         "fresh compile's profile against it (budget RunLog events, "
+         "budget.breaches counter; 'enforce': true raises), and "
+         "tools_bench_diff.py diffs BENCH rounds with its thresholds"),
     Flag("HETU_TPU_COMM_ANALYZE", "bool", True,
          "per-compile bytes-on-wire analysis (obs.comm) in RunLog compile "
          "events; costs one as_text() of the optimized HLO per fresh "

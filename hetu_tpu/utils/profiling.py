@@ -162,6 +162,11 @@ def phase_breakdown(compiled_or_text, phases=PHASES):
     # path separators or transform parens
     seg_pats = {p: re.compile(r'(?:^|[/(])' + re.escape(p) + r'(?:[)/]|$)')
                 for p in phases}
+    # NOTE: hetu_tpu.obs.hlo_profile.layer_table is the per-LAYER
+    # refinement of this walk (full scope paths, parsed dot FLOPs, wire
+    # bytes, while-loop trip counts); with static counting its sums
+    # equal these phase totals exactly — a tested contract, so the two
+    # walks must not drift apart.
     out = {p: {"instructions": 0, "dots": 0, "out_bytes": 0}
            for p in (*phases, "other")}
     for line in txt.splitlines():

@@ -227,6 +227,45 @@ def test_int8_sync_cuts_dp_bytes_3_5x(monkeypatch):
     assert rep8["predicted_comm_s"] < rep32["predicted_comm_s"]
 
 
+def test_ef_residuals_rescale_on_loss_scale_change(monkeypatch):
+    """Known-limit fix (PR 2 docs): EF residuals live in SCALED-grad
+    units, so a dynamic loss-scale change must rescale them by
+    new/old — otherwise the next step's error feedback is off by the
+    ratio.  Two identical fp16 int8-ef trainers, one whose scaler GROWS
+    after the first finite step (growth_interval=1) and one whose scale
+    never moves: step 1's arithmetic is identical (the scale moves
+    AFTER the update), so the only difference in the stored residuals
+    must be exactly the growth factor."""
+    from hetu_tpu.optim.grad_scaler import GradScaler
+
+    def build(growth_interval):
+        monkeypatch.setenv("HETU_TPU_GRAD_COMPRESS", "int8-ef")
+        cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float16)
+        st = ParallelStrategy(mesh=MeshConfig(dp=4))
+        tc = TrainingConfig(global_batch_size=8, micro_batch_size=2,
+                            seq_len=64, lr=3e-3, warmup_steps=2,
+                            total_steps=40, log_every=1000)
+        tr = Trainer(LlamaLMHeadModel(cfg, st), tc, st)
+        assert tr._scaler is not None  # fp16 -> dynamic scaling on
+        tr._scaler = GradScaler(init_scale=2.0 ** 8,
+                                growth_interval=growth_interval)
+        return tr.build()
+
+    hb = _batch()
+    grow = build(1)
+    hold = build(10 ** 9)
+    mg = grow.train_step(hb)
+    mh = hold.train_step(hb)
+    assert float(mg["amp_skipped"]) == float(mh["amp_skipped"]) == 0.0
+    assert float(mg["loss_scale"]) == 2.0 * float(mh["loss_scale"])
+    leaves_g = jax.tree.leaves(grow.opt_state["ef"])
+    leaves_h = jax.tree.leaves(hold.opt_state["ef"])
+    assert any(float(jnp.abs(l).max()) > 0 for l in leaves_h)
+    for g, h in zip(leaves_g, leaves_h):
+        np.testing.assert_allclose(np.asarray(g), 2.0 * np.asarray(h),
+                                   rtol=1e-6)
+
+
 def test_int8_mode_without_ef_keeps_state_layout(monkeypatch):
     tr = _trainer("int8", monkeypatch)
     hb = _batch()

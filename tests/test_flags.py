@@ -65,6 +65,21 @@ def test_every_env_read_is_registered():
                  "HETU_TPU_SERVE_PAGE", "HETU_TPU_SERVE_MAX_LEN",
                  "HETU_TPU_SERVE_PREFILL_CHUNK", "HETU_TPU_SERVE_PAGES"):
         assert name in flags.REGISTRY
+    # the analytic step profiler + perf-budget surface
+    # (obs.hlo_profile / obs.budget, docs/observability.md)
+    for name in ("HETU_TPU_PROFILE", "HETU_TPU_PROFILE_TOPK",
+                 "HETU_TPU_PROFILE_TRACE", "HETU_TPU_BUDGETS"):
+        assert name in flags.REGISTRY
+
+
+def test_profile_flag_defaults_are_off_path():
+    """Profiler defaults: off, top-8, no trace path, no budget file —
+    and all of them are post-compile analysis only (the HLO
+    byte-identity half lives in tests/test_hlo_profile.py)."""
+    assert flags.bool_flag("HETU_TPU_PROFILE") is False
+    assert flags.int_flag("HETU_TPU_PROFILE_TOPK") == 8
+    assert flags.str_flag("HETU_TPU_PROFILE_TRACE") == ""
+    assert flags.str_flag("HETU_TPU_BUDGETS") == ""
 
 
 def test_serving_flag_defaults_are_off_path(monkeypatch):

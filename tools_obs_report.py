@@ -123,6 +123,38 @@ def summarize(records) -> dict:
             srv["finished_by"] = reasons
         out["serving"] = srv
 
+    # analytic step profiles (obs.hlo_profile, HETU_TPU_PROFILE=1): the
+    # newest profile record matches the plan the run actually stepped
+    # with — top-k layers by predicted time + peak HBM vs the chip
+    profiles = [r for r in records if r.get("kind") == "profile"]
+    budgets = [r for r in records if r.get("kind") == "budget"]
+    if profiles:
+        last = profiles[-1]
+        prof: dict = {"records": len(profiles)}
+        for k in ("estimated_step_s", "total_flops", "total_wire_bytes",
+                  "peak_hbm_bytes", "peak_hbm_vs_xla",
+                  "hbm_headroom_frac"):
+            if last.get(k) is not None:
+                prof[k] = last[k]
+        top = last.get("top") or []
+        if top:
+            prof["top_layers"] = [
+                {"group": t.get("group"), "time_s": t.get("time_s"),
+                 "bound": t.get("bound")} for t in top[:5]]
+        # peak-HBM vs the chip: hbm_headroom_frac was stamped at RECORD
+        # time against the profile the run actually used — re-deriving
+        # it from the report machine's hardware profile would let two
+        # keys for one quantity disagree
+        out["profile"] = prof
+    if budgets:
+        fails = [r for r in budgets if not r.get("ok")]
+        out["budget"] = {"checks": len(budgets), "failed": len(fails),
+                         "ok": not fails}
+        if fails:
+            last_breaches = fails[-1].get("breaches") or []
+            out["budget"]["last_breaches"] = [
+                b.get("metric") for b in last_breaches]
+
     times = sorted(float(r["step_time_s"]) for r in steps
                    if r.get("step_time_s"))
     if times:

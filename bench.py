@@ -189,6 +189,32 @@ def _hardware_free_profile(batch: int = 8, seq: int = 2048, cfg=None):
     return rec
 
 
+def _hardware_free_kernels(batch: int = 8, seq: int = 2048):
+    """Analytic per-kernel HBM-traffic record for the bench config
+    (ops/pallas/traffic.py + obs.mfu.kernel_roofline): fused vs unfused
+    byte counts and roofline times per Pallas kernel — the numbers
+    tools_bench_kernels.py prints and the acceptance gate pins
+    (residual+RMSNorm >= 3x at the config's bf16 activations).
+    Hardware-free like the comm/serving records (docs/kernels.md)."""
+    from hetu_tpu.obs.mfu import kernel_roofline, load_hardware_profile
+    from hetu_tpu.ops.pallas.traffic import report_for_config
+    cfg = _bench_config()
+    traffic = report_for_config(cfg, batch=batch, seq=seq)
+    roof = kernel_roofline(traffic, hw=load_hardware_profile())
+    rec = {}
+    for name, rt in traffic.items():
+        rr = roof[name]
+        rec[name] = {
+            "fused_bytes": round(rt["fused_bytes"], 1),
+            "unfused_bytes": round(rt["unfused_bytes"], 1),
+            "reduction": round(rt["reduction"], 3),
+            "fused_s": rr["fused_s"],
+            "unfused_s": rr["unfused_s"],
+            "per_step_multiplier": rt["per_step_multiplier"],
+        }
+    return rec
+
+
 def _hardware_free_serving(slots: int = 8, ctx: int = 2048):
     """Analytic serving record for the bench config: continuous-batching
     decode tokens/s (roofline over the profiled chip: params read once
@@ -310,6 +336,11 @@ def main():
                 detail["serving"] = _hardware_free_serving()
             except Exception as e:
                 print(f"# hardware-free serving estimate failed: {e!r}",
+                      file=sys.stderr)
+            try:
+                detail["kernels"] = _hardware_free_kernels()
+            except Exception as e:
+                print(f"# hardware-free kernel estimate failed: {e!r}",
                       file=sys.stderr)
             print(json.dumps({"metric": "llama_train_mfu", "value": 0.0,
                               "unit": "fraction_of_peak", "vs_baseline": 0.0,
@@ -455,6 +486,13 @@ def main():
         detail["serving"] = _hardware_free_serving()
     except Exception as e:
         print(f"# serving attach failed: {e!r}", file=sys.stderr)
+    try:
+        # analytic fused-kernel companion (ops/pallas/traffic.py):
+        # per-kernel fused-vs-unfused HBM bytes, one meaning across
+        # tunnel states (docs/kernels.md)
+        detail["kernels"] = _hardware_free_kernels(batch, seq)
+    except Exception as e:
+        print(f"# kernels attach failed: {e!r}", file=sys.stderr)
 
     # Second point: the largest model one 16G v5e fits.  fp32 Adam moments
     # bound it: p*(2 bf16 param + 8 fp32 m/v + 2 grad) + ~2G logits/acts

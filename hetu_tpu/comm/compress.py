@@ -58,6 +58,19 @@ def quantize_blockwise(x, block_size: int = DEFAULT_BLOCK, *,
         raise ValueError(f"buffer of {n} elements is not a multiple of "
                          f"block_size={block_size}; pad first "
                          f"(comm.bucketer does)")
+    if not stochastic:
+        # fused Pallas quantize (ops/pallas/quant — one pass instead of
+        # the abs/max/div/round/clip/cast chain) when the HETU_TPU_PALLAS
+        # routing and the kernel's shape gate allow; int payload
+        # bit-identical to the jnp path below, scales to 1 ulp (tested),
+        # so every consumer (grad sync, SP compress, ZeRO refresh, KV
+        # pages) inherits it transparently
+        from hetu_tpu.ops.pallas import resolve_route
+        from hetu_tpu.ops.pallas import quant as _pq
+        if resolve_route("quant", _pq.compatible(n, block_size, bits)):
+            with jax.named_scope("pallas_quantize"):
+                return _pq.quantize_blockwise_pallas(flat, block_size,
+                                                     bits=bits)
     blocks = flat.reshape(-1, block_size)
     scale = jnp.max(jnp.abs(blocks), axis=1) / qmax
     scale = jnp.maximum(scale, 1e-12)
@@ -77,28 +90,31 @@ def quantize_blockwise(x, block_size: int = DEFAULT_BLOCK, *,
 
 def dequantize_blockwise(q, scale) -> jnp.ndarray:
     """(q int8 [nb, bs], scales f32 [nb]) -> flat f32 [nb*bs]."""
+    from hetu_tpu.ops.pallas import resolve_route
+    from hetu_tpu.ops.pallas import quant as _pq
+    if resolve_route("quant",
+                     _pq.compatible(q.shape[0] * q.shape[1], q.shape[1])):
+        with jax.named_scope("pallas_dequantize"):
+            return _pq.dequantize_blockwise_pallas(q, scale)
     return (q.astype(jnp.float32) * scale[:, None]).reshape(-1)
 
 
 def pack_int4(q) -> jnp.ndarray:
     """int8 [nb, bs] with values in [-8, 7] -> uint8 [nb, bs//2]: two
     offset-binary nibbles per byte (value+8; even index rides the high
-    nibble).  The wire format of the int4 modes."""
-    if q.shape[-1] % 2:
-        raise ValueError(f"int4 packing needs an even block, got "
-                         f"{q.shape[-1]}")
+    nibble).  The wire format of the int4 modes.  Byte-shuffling is
+    delegated to `ops.quantization.pack_nibbles` — ONE packer shared
+    with the weight-storage format, so the two layouts are transposes
+    of a single implementation instead of cousins that can drift."""
+    from hetu_tpu.ops.quantization import pack_nibbles
     u = (q.astype(jnp.int32) + 8).astype(jnp.uint8)
-    hi = u[..., 0::2]
-    lo = u[..., 1::2]
-    return (hi << 4) | lo
+    return pack_nibbles(u, even_high=True)
 
 
 def unpack_int4(p) -> jnp.ndarray:
     """uint8 [nb, bs//2] -> int8 [nb, bs] (inverse of `pack_int4`)."""
-    hi = ((p >> 4) & 0xF).astype(jnp.int8) - 8
-    lo = (p & 0xF).astype(jnp.int8) - 8
-    return jnp.stack([hi, lo], axis=-1).reshape(p.shape[:-1] +
-                                                (2 * p.shape[-1],))
+    from hetu_tpu.ops.quantization import unpack_nibbles
+    return unpack_nibbles(p, even_high=True).astype(jnp.int8) - 8
 
 
 def ef_quantize(x, residual, block_size: int = DEFAULT_BLOCK, *,

@@ -262,8 +262,7 @@ def decode_step_slots(model, params, tokens, cache, positions):
         q = qkv[..., : att.group, :].reshape(b, 1, att.n_q, c.head_dim)
         k = qkv[..., att.group, :]
         v = qkv[..., att.group + 1, :]
-        q = ops.apply_rotary(q, cos, sin, pos_ids)
-        k = ops.apply_rotary(k, cos, sin, pos_ids)
+        q, k = ops.apply_rotary_qk(q, k, cos, sin, pos_ids)
         kt, vt = k[:, 0], v[:, 0]                       # [b, n_kv, hd]
         ck = _cache_write_token(ck, k, positions, uniform)
         cv = _cache_write_token(cv, v, positions, uniform)
@@ -292,6 +291,116 @@ def decode_step(model, params, token, cache, pos):
     logits, new_cache, _ = decode_step_slots(
         model, params, token, cache, jnp.asarray(pos, jnp.int32))
     return logits, new_cache
+
+
+def _paged_write(pool, table, positions, t):
+    """Scatter one token's K (or V) [S, n_kv, hd] into ONE layer's page
+    array [P, ps, n_kv, hd] at each slot's (table[pos // ps], pos % ps).
+    Inactive slots' tables point at the null page (id 0) — their write
+    lands there harmlessly (serving/kv_pool.py)."""
+    ps = pool.shape[1]
+    S = positions.shape[0]
+    page = table[jnp.arange(S), positions // ps]
+    return pool.at[page, positions % ps].set(t.astype(pool.dtype))
+
+
+def _decode_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
+                           positions):
+    from hetu_tpu.ops.pallas.paged_attention import paged_attention
+    c = model.config
+    mp_ = params["model"]
+    b = tokens.shape[0]
+    x = _gpt_embed(model, mp_, tokens[:, None], positions[:, None])
+    block = model.model.block
+    att = block.attn
+    nh, hd = c.num_attention_heads, c.head_dim
+    scale = hd ** -0.5
+
+    def body(h, xs):
+        lp, kp, vp = xs
+        hn = block.ln1(lp["ln1"], h)
+        qkv = jnp.einsum("bsh,hngd->bsngd", hn,
+                         lp["attn"]["wqkv"].astype(h.dtype)) \
+            + lp["attn"]["bqkv"].astype(h.dtype)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        kp = _paged_write(kp, table, positions, k[:, 0])
+        vp = _paged_write(vp, table, positions, v[:, 0])
+        with jax.named_scope("pallas_paged_attention"):
+            attn = paged_attention(q[:, 0], kp, vp, table, positions,
+                                   softmax_scale=scale)
+        h = h + att.o_proj(lp["attn"]["o_proj"],
+                           attn.reshape(b, 1, nh * hd))
+        h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
+        return h, (kp, vp)
+
+    x, (new_k, new_v) = lax.scan(body, x, (mp_["blocks"], k_pool, v_pool))
+    hidden = model.model.final_ln(mp_["final_ln"], x)
+    logits = model.logits(params, hidden)[:, 0, :]
+    return logits, new_k, new_v
+
+
+def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
+                      positions):
+    """One decode step attending DIRECTLY over a paged KV pool — the
+    gather-free form of `decode_step_slots` (ops/pallas/paged_attention;
+    serving engine's HETU_TPU_PALLAS decode program).
+
+    k_pool/v_pool: [L, P, page_size, n_kv, hd] (page 0 = the null page);
+    table: [S, max_pages] int32; positions: [S] int32 — slot s's current
+    token sits at positions[s] and attends over everything at or before
+    it.  This step's K/V are scattered into each slot's page BEFORE the
+    kernel runs (so the token sees itself, exactly like the dense path's
+    write-then-attend), and the updated pools are returned:
+    (logits [S, vocab], new_k_pool, new_v_pool).  Exact fp pages only —
+    the engine keeps the gather path for quantized pools."""
+    from hetu_tpu.ops.pallas.paged_attention import paged_attention
+    c = model.config
+    if not c.use_scan:
+        raise ValueError("generation requires use_scan=True (stacked layer "
+                         "params)")
+    positions = positions.astype(jnp.int32)
+    table = table.astype(jnp.int32)
+    if _is_gpt(model):
+        return _decode_step_paged_gpt(model, params, tokens, k_pool,
+                                      v_pool, table, positions)
+    mp_ = params["model"]
+    b = tokens.shape[0]
+    x = model.model.embed(mp_["embed"], tokens[:, None]).astype(
+        c.compute_dtype)
+    cos, sin = ops.build_rope_cache(c.max_position_embeddings, c.head_dim,
+                                    c.rope_theta)
+    block = model.model.layers.block
+    att = block.attn
+    scale = c.head_dim ** -0.5
+
+    def body(h, xs):
+        layer_params, kp, vp = xs
+        hn = block.input_norm(layer_params["input_norm"], h)
+        qkv = jnp.einsum("bsh,hkgd->bskgd", hn,
+                         layer_params["attn"]["wqkv"].astype(h.dtype))
+        q = qkv[..., : att.group, :].reshape(b, 1, att.n_q, c.head_dim)
+        k = qkv[..., att.group, :]
+        v = qkv[..., att.group + 1, :]
+        q, k = ops.apply_rotary_qk(q, k, cos, sin, positions[:, None])
+        kp = _paged_write(kp, table, positions, k[:, 0])
+        vp = _paged_write(vp, table, positions, v[:, 0])
+        with jax.named_scope("pallas_paged_attention"):
+            attn = paged_attention(q[:, 0], kp, vp, table, positions,
+                                   softmax_scale=scale)
+        h = h + att.o_proj(layer_params["attn"]["o_proj"],
+                           attn.reshape(b, 1, att.n_q * c.head_dim))
+        mlp_out = block.mlp(layer_params["mlp"],
+                            block.post_norm(layer_params["post_norm"], h))
+        if isinstance(mlp_out, tuple):  # MoE
+            mlp_out = mlp_out[0]
+        h = h + mlp_out
+        return h, (kp, vp)
+
+    x, (new_k, new_v) = lax.scan(
+        body, x, (mp_["layers"]["layers"], k_pool, v_pool))
+    hidden = model.model.final_norm(mp_["final_norm"], x)
+    logits = model.logits(params, hidden)[:, 0, :]
+    return logits, new_k, new_v
 
 
 def _extend_cache_gpt(model, params, tokens, cache, start):
@@ -368,8 +477,7 @@ def extend_cache(model, params, tokens, cache, start):
         q = qkv[..., : att.group, :].reshape(b, C, att.n_q, c.head_dim)
         k = qkv[..., att.group, :]
         v = qkv[..., att.group + 1, :]
-        q = ops.apply_rotary(q, cos, sin, qpos)
-        k = ops.apply_rotary(k, cos, sin, qpos)
+        q, k = ops.apply_rotary_qk(q, k, cos, sin, qpos)
         ck = ck.at[rows[:, None], qpos].set(k.astype(ck.dtype))
         cv = cv.at[rows[:, None], qpos].set(v.astype(cv.dtype))
         attn = _attend_cached_chunk(q, ck, cv, start, scale)

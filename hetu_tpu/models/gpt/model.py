@@ -190,9 +190,12 @@ class GPTBlock(Module):
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 2),
                             deterministic)
-        x = x + h
         with jax.named_scope("mlp"):
-            h = self.mlp(params["mlp"], self.ln2(params["ln2"], x))
+            # residual-add + ln2 as ONE fused Pallas pass when routed
+            # (nn/parallel.ParallelLayerNorm.residual; fallback = the
+            # seed composition `x = x + h; ln2(x)`)
+            normed, x = self.ln2.residual(params["ln2"], x, h)
+            h = self.mlp(params["mlp"], normed)
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 3),
                             deterministic)

@@ -74,8 +74,9 @@ class LlamaAttention(Module):
         k = qkv[..., self.group, :]
         v = qkv[..., self.group + 1, :]
 
-        q = ops.apply_rotary(q, cos, sin, position_ids)
-        k = ops.apply_rotary(k, cos, sin, position_ids)
+        # one fused Pallas pass over q AND k when routed
+        # (HETU_TPU_PALLAS; fallback = the seed's two apply_rotary calls)
+        q, k = ops.apply_rotary_qk(q, k, cos, sin, position_ids)
 
         use_attn_dropout = (c.attention_dropout > 0.0 and not deterministic
                             and rng is not None)
@@ -181,17 +182,21 @@ class LlamaBlock(Module):
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 2),
                             deterministic)
-        x = x + h
+        # the residual-add + post-norm pair fuses into ONE Pallas pass
+        # when routed (nn/parallel.ParallelRMSNorm.residual); the
+        # fallback is exactly the seed composition `x = x + h; norm(x)`
         aux = jnp.zeros((), jnp.float32)
         if c.num_experts > 0:
             with jax.named_scope("moe"):
-                h, aux = self.mlp(params["mlp"],
-                                  self.post_norm(params["post_norm"], x),
+                normed, x = self.post_norm.residual(params["post_norm"],
+                                                    x, h)
+                h, aux = self.mlp(params["mlp"], normed,
                                   token_ids=token_ids)
         else:
             with jax.named_scope("mlp"):
-                h = self.mlp(params["mlp"],
-                             self.post_norm(params["post_norm"], x))
+                normed, x = self.post_norm.residual(params["post_norm"],
+                                                    x, h)
+                h = self.mlp(params["mlp"], normed)
         if not deterministic and rng is not None:
             h = ops.dropout(h, c.hidden_dropout, jax.random.fold_in(rng, 3),
                             deterministic)

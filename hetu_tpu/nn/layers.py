@@ -67,6 +67,11 @@ class RMSNorm(Module):
     def forward(self, params, x):
         return ops.rms_norm(x, params["weight"], self.eps)
 
+    def residual(self, params, x, h):
+        """(norm(x + h), x + h) — one fused Pallas pass when
+        HETU_TPU_PALLAS routes it (ops.residual_rms_norm)."""
+        return ops.residual_rms_norm(x, h, params["weight"], self.eps)
+
 
 class LayerNorm(Module):
     def __init__(self, dim: int, eps: float = 1e-5, bias: bool = True,
@@ -80,6 +85,13 @@ class LayerNorm(Module):
     def forward(self, params, x):
         return ops.layer_norm(x, params["weight"],
                               params["bias"] if self.use_bias else None, self.eps)
+
+    def residual(self, params, x, h):
+        """(layer_norm(x + h), x + h) — one fused Pallas pass when
+        HETU_TPU_PALLAS routes it (ops.residual_layer_norm)."""
+        return ops.residual_layer_norm(
+            x, h, params["weight"],
+            params["bias"] if self.use_bias else None, self.eps)
 
 
 class Dropout(Module):

@@ -131,6 +131,16 @@ class ParallelRMSNorm(Module):
             y = self.strategy.constrain(y, self.strategy.act_hidden())
         return y
 
+    def residual(self, params, x, h):
+        """Fused residual-add + norm (the pre-norm block's pair):
+        returns (norm(x + h), x + h).  Routes to the Pallas fused_norm
+        kernel under HETU_TPU_PALLAS; the fallback is exactly the seed
+        composition `s = x + h; forward(s)`, same constrain."""
+        y, s = ops.residual_rms_norm(x, h, params["weight"], self.eps)
+        if x.ndim == 3:
+            y = self.strategy.constrain(y, self.strategy.act_hidden())
+        return y, s
+
 
 class ParallelLayerNorm(Module):
     def __init__(self, dim: int, strategy: ParallelStrategy, eps: float = 1e-5,
@@ -149,3 +159,13 @@ class ParallelLayerNorm(Module):
         if x.ndim == 3:
             y = self.strategy.constrain(y, self.strategy.act_hidden())
         return y
+
+    def residual(self, params, x, h):
+        """Fused residual-add + LayerNorm pair — see
+        ParallelRMSNorm.residual."""
+        y, s = ops.residual_layer_norm(
+            x, h, params["weight"],
+            params["bias"] if self.use_bias else None, self.eps)
+        if x.ndim == 3:
+            y = self.strategy.constrain(y, self.strategy.act_hidden())
+        return y, s

@@ -70,6 +70,14 @@ PROFILE_SCHEMA = 1
 #: per-layer `layer_<i>` scopes and the model phases)
 EXTRA_GROUPS = ("optimizer", "grad_sync")
 
+#: every dispatcher in the fused-kernel layer enters its Pallas call
+#: under a `pallas_<kernel>` named scope (ops/pallas, docs/kernels.md);
+#: instructions under one — the custom-call on TPU, the interpreted
+#: kernel body on the CPU test mesh — are attributed to that kernel
+#: group: `layer_3/attn/pallas_flash_attention` rows in `layer_table`,
+#: aggregated across groups by `kernel_table`
+KERNEL_SCOPE_PREFIX = "pallas_"
+
 _OP_PAT = re.compile(r'op_name="([^"]+)"')
 _SHAPE_PAT = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
 _OUT_PAT = re.compile(r'=\s*(.*?)\s*[a-z][a-z0-9_.-]*\(')
@@ -109,19 +117,29 @@ def group_of(op_name: str, phases: Tuple[str, ...] = PHASES) -> str:
     """The attribution group of one instruction's scope path:
     `layer_<i>/<phase>` when both a layer scope and a phase scope are
     present, the layer alone, the phase alone (embed / lm_head /
-    optimizer / grad_sync live outside layers), else "other"."""
+    optimizer / grad_sync live outside layers), else "other".  A
+    `pallas_<kernel>` scope (the fused-kernel layer's dispatchers)
+    appends its kernel name, so the kernel's instructions form their own
+    row WITHIN their layer/phase (`layer_0/attn/pallas_flash_attention`)
+    instead of blending into the surrounding group."""
     segs = scope_segments(op_name)
     layer = next((s for s in reversed(segs)
                   if _LAYER_SEG_PAT.match(s)), None)
     known = (*phases, *EXTRA_GROUPS)
     phase = next((s for s in reversed(segs) if s in known), None)
+    kernel = next((s for s in reversed(segs)
+                   if s.startswith(KERNEL_SCOPE_PREFIX)), None)
     if layer and phase:
-        return f"{layer}/{phase}"
-    if layer:
-        return layer
-    if phase:
-        return phase
-    return "other"
+        base = f"{layer}/{phase}"
+    elif layer:
+        base = layer
+    elif phase:
+        base = phase
+    elif kernel:
+        return kernel
+    else:
+        return "other"
+    return f"{base}/{kernel}" if kernel else base
 
 
 def _shape_bytes(section: str) -> int:
@@ -313,6 +331,34 @@ def _line_wire_bytes(line: str, default_world: int) -> float:
     payload = _payload_bytes(m.group(2), is_start)
     n, _ranks = _first_group(line, default_world)
     return _wire_bytes(base, payload, n, is_start)
+
+
+def kernel_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
+                 default_world: int = 1) -> Dict[str, Dict[str, float]]:
+    """Aggregate `layer_table` rows by Pallas kernel: every group whose
+    path carries a `pallas_<kernel>` segment contributes to that
+    kernel's totals ({kernel: {"instructions", "dots", "flops",
+    "out_bytes", "wire_bytes", "groups"}}).  Empty when the program has
+    no routed Pallas kernels — e.g. with HETU_TPU_PALLAS=0, which is
+    exactly what the flag-off identity test leans on."""
+    table = layer_table(compiled_or_text, phases=phases,
+                        default_world=default_world)
+    out: Dict[str, Dict[str, float]] = {}
+    for group, row in table.items():
+        if group == "_meta":
+            continue
+        kern = next((seg for seg in group.split("/")
+                     if seg.startswith(KERNEL_SCOPE_PREFIX)), None)
+        if kern is None:
+            continue
+        rec = out.setdefault(kern, {"instructions": 0.0, "dots": 0.0,
+                                    "flops": 0.0, "out_bytes": 0.0,
+                                    "wire_bytes": 0.0, "groups": []})
+        for k in ("instructions", "dots", "flops", "out_bytes",
+                  "wire_bytes"):
+            rec[k] += row[k]
+        rec["groups"].append(group)
+    return out
 
 
 def _layer_sort_key(group: str):

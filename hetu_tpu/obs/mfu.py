@@ -234,6 +234,35 @@ def estimate_mfu(flops_per_step: float, *,
     return report
 
 
+def kernel_roofline(traffic: Dict[str, Dict[str, Any]], *,
+                    hw: Optional[Dict[str, Any]] = None
+                    ) -> Dict[str, Dict[str, Any]]:
+    """Per-kernel roofline attribution for the fused-kernel layer: price
+    each kernel's fused vs unfused analytic HBM bytes
+    (ops/pallas/traffic.py) at the profiled chip's HBM rate.
+
+    These chains are memory-bound by construction (elementwise /
+    reduction work per byte is far below the ridge point), so the
+    roofline time IS bytes / hbm_rate and the per-kernel efficiency win
+    is the byte reduction itself: `speedup` = unfused_s / fused_s.
+    Hardware-free like every bench claim while the tunnel is down."""
+    hw = hw if hw is not None else load_hardware_profile()
+    _, hbm, _ = _rates(hw)
+    out: Dict[str, Dict[str, Any]] = {}
+    for name, rec in traffic.items():
+        fused_s = rec["fused_bytes"] / hbm
+        unfused_s = rec["unfused_bytes"] / hbm
+        out[name] = {
+            "fused_bytes": rec["fused_bytes"],
+            "unfused_bytes": rec["unfused_bytes"],
+            "fused_s": fused_s,
+            "unfused_s": unfused_s,
+            "speedup": unfused_s / fused_s if fused_s else float("inf"),
+            "bound": "memory",
+        }
+    return out
+
+
 def estimate_from_compiled(compiled, *, hw: Optional[Dict] = None,
                            with_phases: bool = True,
                            measured_step_s: Optional[float] = None

@@ -8,8 +8,8 @@ functional forms plus the hand-written Pallas kernels for the hot ops
 (ring attention, vocab-parallel CE).
 """
 from hetu_tpu.ops.activations import gelu, silu, swiglu, relu, leaky_relu, mish, softplus, hardswish, sigmoid, dropout
-from hetu_tpu.ops.norms import rms_norm, layer_norm
-from hetu_tpu.ops.rotary import build_rope_cache, apply_rotary
+from hetu_tpu.ops.norms import rms_norm, layer_norm, residual_rms_norm, residual_layer_norm
+from hetu_tpu.ops.rotary import build_rope_cache, apply_rotary, apply_rotary_qk
 from hetu_tpu.ops.losses import (
     softmax_cross_entropy,
     softmax_cross_entropy_sparse,
@@ -23,5 +23,5 @@ from hetu_tpu.ops.attention import attention, flash_attention
 from hetu_tpu.ops import tensor
 from hetu_tpu.ops.quantization import (
     quantize_int8, dequantize_int8, quantize_int4, dequantize_int4,
-    quantized_matmul_int8,
+    quantized_matmul_int8, pack_nibbles, unpack_nibbles,
 )

@@ -28,8 +28,21 @@ def sigmoid(x):
     return jax.nn.sigmoid(x)
 
 
-def swiglu(gate, up):
-    """SwiGLU combine (reference: ops/SwiGLU.cc): silu(gate) * up."""
+def swiglu(gate, up, use_pallas=None):
+    """SwiGLU combine (reference: ops/SwiGLU.cc): silu(gate) * up.
+
+    Routes to the fused Pallas kernel (ops/pallas/swiglu — one pass,
+    custom-vjp backward) under HETU_TPU_PALLAS; the jnp composition is
+    the exact fallback."""
+    if use_pallas is None:
+        from hetu_tpu.ops.pallas import resolve_route
+        from hetu_tpu.ops.pallas import swiglu as _sw
+        use_pallas = resolve_route(
+            "swiglu", _sw.compatible(gate.shape, up.shape))
+    if use_pallas:
+        from hetu_tpu.ops.pallas.swiglu import fused_swiglu
+        with jax.named_scope("pallas_swiglu"):
+            return fused_swiglu(gate, up)
     return silu(gate) * up
 
 

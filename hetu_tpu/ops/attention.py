@@ -57,17 +57,13 @@ except ImportError:  # pallas kernel not built yet / not importable on CPU
 
 
 def _pallas_compatible(q, k) -> bool:
-    """Mirror the Pallas kernel's shape gates (clamped block divisibility,
-    lane-aligned head dim) so the auto path can fall back instead of raising
-    mid-trace."""
-    from hetu_tpu.ops.pallas.flash_attention import (DEFAULT_BLOCK_K,
-                                                     DEFAULT_BLOCK_Q,
-                                                     fit_block)
-    sq, sk, d = q.shape[1], k.shape[1], q.shape[-1]
-    bq, bk = fit_block(DEFAULT_BLOCK_Q, sq), fit_block(DEFAULT_BLOCK_K, sk)
-    return ((bq >= 128 or bq == min(DEFAULT_BLOCK_Q, sq))
-            and (bk >= 128 or bk == min(DEFAULT_BLOCK_K, sk))
-            and d % 128 == 0)
+    """The auto path's shape gate.  Delegates to the kernel module's own
+    `compatible` — which is implemented AS the entry validation
+    (flash_attention.check_default_shapes), so the gate's verdict and
+    what the kernel actually accepts can never silently diverge (the
+    drift test in tests/test_pallas_kernels.py pins the contract)."""
+    from hetu_tpu.ops.pallas.flash_attention import compatible
+    return compatible(q.shape, k.shape)
 
 
 def flash_attention(q, k, v, *, causal: bool = True,
@@ -78,13 +74,12 @@ def flash_attention(q, k, v, *, causal: bool = True,
     running on TPU with compatible shapes; XLA composition otherwise."""
     if use_pallas is None:
         # HETU_TPU_PALLAS=1/0 force-routes; "auto" keeps the shape gate
-        # (reference: the HETU_PARALLEL_ATTN env family, GetExecEnvs)
-        from hetu_tpu.utils import flags
-        forced = flags.str_flag("HETU_TPU_PALLAS")
-        if forced == "1":
-            use_pallas = True
-        elif forced == "0":
-            use_pallas = False
+        # (reference: the HETU_PARALLEL_ATTN env family, GetExecEnvs);
+        # HETU_TPU_PALLAS_KERNELS can exclude just this kernel
+        from hetu_tpu.ops.pallas import kernel_enabled
+        forced = kernel_enabled("flash")
+        if forced is not None:
+            use_pallas = forced
         else:
             use_pallas = (jax.default_backend() == "tpu"
                           and _pallas_fa is not None
@@ -92,7 +87,10 @@ def flash_attention(q, k, v, *, causal: bool = True,
     if use_pallas:
         if _pallas_fa is None:
             raise RuntimeError("use_pallas=True but the Pallas kernel is unavailable")
-        return _pallas_fa(q, k, v, causal=causal, segment_ids=segment_ids,
-                          softmax_scale=softmax_scale)
+        # named so obs.hlo_profile attributes the custom-call to its
+        # kernel group (layer_table `.../pallas_flash_attention` rows)
+        with jax.named_scope("pallas_flash_attention"):
+            return _pallas_fa(q, k, v, causal=causal, segment_ids=segment_ids,
+                              softmax_scale=softmax_scale)
     return attention(q, k, v, causal=causal, segment_ids=segment_ids,
                      softmax_scale=softmax_scale)

@@ -3,8 +3,14 @@
 Computed in float32 regardless of input dtype (the reference's fused kernels
 accumulate in fp32), cast back to the input dtype at the end; XLA fuses the
 whole body into one VPU loop so a Pallas kernel is only warranted when fusing
-across op boundaries (see ops/pallas for the fused residual+norm variant).
+across op boundaries — which is exactly what `residual_rms_norm` /
+`residual_layer_norm` do: the residual-add + norm pair the transformer
+blocks emit fuses into ONE pass (ops/pallas/fused_norm) behind the
+HETU_TPU_PALLAS routing, with this module's composition as the fallback.
 """
+from typing import Optional
+
+import jax
 import jax.numpy as jnp
 
 
@@ -26,3 +32,39 @@ def layer_norm(x, weight, bias, eps: float = 1e-5):
     if bias is not None:
         y = y + bias.astype(jnp.float32)
     return y.astype(dtype)
+
+
+def residual_rms_norm(x, h, weight, eps: float = 1e-5,
+                      use_pallas: Optional[bool] = None):
+    """Fused residual-add + RMSNorm: returns (rms_norm(x + h) * weight,
+    x + h) — the pre-norm block's pair, one Pallas pass when routed
+    (HETU_TPU_PALLAS auto/1/0 + the `norm` kernel gate), the exact seed
+    composition otherwise."""
+    if use_pallas is None:
+        from hetu_tpu.ops.pallas import resolve_route
+        from hetu_tpu.ops.pallas import fused_norm as _fn
+        use_pallas = resolve_route(
+            "norm", _fn.compatible(x.shape, h.shape, weight.shape))
+    if use_pallas:
+        from hetu_tpu.ops.pallas.fused_norm import fused_residual_rmsnorm
+        with jax.named_scope("pallas_residual_rmsnorm"):
+            return fused_residual_rmsnorm(x, h, weight, eps)
+    s = x + h
+    return rms_norm(s, weight, eps), s
+
+
+def residual_layer_norm(x, h, weight, bias, eps: float = 1e-5,
+                        use_pallas: Optional[bool] = None):
+    """Fused residual-add + LayerNorm: returns (layer_norm(x + h), x + h).
+    Same routing contract as `residual_rms_norm`."""
+    if use_pallas is None:
+        from hetu_tpu.ops.pallas import resolve_route
+        from hetu_tpu.ops.pallas import fused_norm as _fn
+        use_pallas = resolve_route(
+            "norm", _fn.compatible(x.shape, h.shape, weight.shape))
+    if use_pallas:
+        from hetu_tpu.ops.pallas.fused_norm import fused_residual_layernorm
+        with jax.named_scope("pallas_residual_layernorm"):
+            return fused_residual_layernorm(x, h, weight, bias, eps)
+    s = x + h
+    return layer_norm(s, weight, bias, eps), s

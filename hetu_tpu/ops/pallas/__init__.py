@@ -1,3 +1,88 @@
 """Hand-written Pallas TPU kernels for the hot ops
 (reference: hetu/impl/kernel/*.cu — the ~10% of kernels XLA fusion does not
-already cover; SURVEY.md §2.5 item 2)."""
+already cover; SURVEY.md §2.5 item 2).
+
+The fused-kernel layer (docs/kernels.md):
+
+  * flash_attention  — online-softmax attention (FlashAttention.cu)
+  * fused_norm       — residual-add + RMSNorm / LayerNorm, one pass
+                       (FusedLayerNorm/RMSNorm.cu)
+  * swiglu           — silu(gate) * up combine (SwiGLU.cu)
+  * rotary           — RoPE applied to q AND k in one kernel (rotary.cu)
+  * quant            — blockwise int8/int4 quantize/dequantize feeding the
+                       compressed collectives (quantization.cu, EQuARX)
+  * paged_attention  — decode attention directly over the serving KV
+                       pool's page tables (gather-free decode)
+
+Every kernel follows the flash-attention pattern: a shape gate that
+EXACTLY mirrors the kernel's own entry validation (`compatible()` /
+ValueError — the drift tests in tests/test_pallas_kernels.py pin the two
+together), an XLA fallback the dispatcher in `hetu_tpu/ops` routes to
+when the gate rejects or the flag says off, `interpret=_interpret()` on
+the CPU test mesh, and a custom_vjp backward so training paths get the
+fused bytes too.
+
+Routing: `HETU_TPU_PALLAS` (auto/1/0) gates the WHOLE layer the way it
+always gated flash attention; `HETU_TPU_PALLAS_KERNELS` restricts which
+kernels participate (comma list / all / none) so one kernel can be
+bisected out without losing the rest.
+"""
+from __future__ import annotations
+
+from typing import FrozenSet, Optional
+
+#: every routable kernel name (the HETU_TPU_PALLAS_KERNELS vocabulary)
+KERNEL_NAMES = ("flash", "norm", "swiglu", "rotary", "quant", "paged_attn")
+
+
+def _interpret() -> bool:
+    """CPU (the virtual test mesh) runs kernels in interpret mode — one
+    definition shared by every kernel module."""
+    import jax
+    return jax.default_backend() == "cpu"
+
+
+def _selected_kernels() -> FrozenSet[str]:
+    from hetu_tpu.utils import flags
+    raw = flags.str_flag("HETU_TPU_PALLAS_KERNELS").strip()
+    if raw in ("", "all"):
+        return frozenset(KERNEL_NAMES)
+    if raw == "none":
+        return frozenset()
+    names = frozenset(t.strip() for t in raw.split(",") if t.strip())
+    unknown = names - frozenset(KERNEL_NAMES)
+    if unknown:
+        raise ValueError(
+            f"HETU_TPU_PALLAS_KERNELS names unknown kernels {sorted(unknown)}; "
+            f"known: {list(KERNEL_NAMES)} (or 'all'/'none')")
+    return names
+
+
+def kernel_enabled(name: str) -> Optional[bool]:
+    """Resolve the flag surface for one kernel: False = off (use the XLA
+    fallback), True = forced on (the kernel's own validation raises on
+    unsupported shapes — loud, per the flash-attention contract), None =
+    auto (TPU backend + the kernel's shape gate decide)."""
+    if name not in KERNEL_NAMES:
+        raise ValueError(f"unknown pallas kernel {name!r}; "
+                         f"known: {list(KERNEL_NAMES)}")
+    from hetu_tpu.utils import flags
+    mode = flags.str_flag("HETU_TPU_PALLAS")
+    if mode == "0":
+        return False
+    if name not in _selected_kernels():
+        return False
+    if mode == "1":
+        return True
+    return None
+
+
+def resolve_route(name: str, compatible: bool) -> bool:
+    """The one auto-routing rule (mirrors ops.attention.flash_attention):
+    forced flags win; auto takes the kernel only on a TPU backend with a
+    passing shape gate."""
+    en = kernel_enabled(name)
+    if en is not None:
+        return en
+    import jax
+    return jax.default_backend() == "tpu" and compatible

@@ -142,6 +142,40 @@ def _transpose_mask(mask: BlockMask) -> BlockMask:
     return tuple(zip(*mask))
 
 
+def check_default_shapes(sq: int, sk: int, d: int,
+                         block_q: int = DEFAULT_BLOCK_Q,
+                         block_k: int = DEFAULT_BLOCK_K):
+    """The public entry's shape validation under the DEFAULT block
+    geometry — raises ValueError exactly when `compatible` says False
+    (the drift-test contract; tests/test_pallas_kernels.py).  Returns
+    the fitted (block_q, block_k)."""
+    bq0, bk0 = min(block_q, sq), min(block_k, sk)
+    bq = fit_block(block_q, sq)
+    bk = fit_block(block_k, sk)
+    if (bq != bq0 and bq < 128) or (bk != bk0 and bk < 128):
+        raise ValueError(f"seq lens ({sq},{sk}) fit no lane-aligned block "
+                         f"ladder (best: q={bq}, k={bk}); pad via "
+                         f"the bucket ladder or pass block_q/block_k "
+                         f"explicitly")
+    if d % 128:
+        raise ValueError(f"head dim {d} is not lane-aligned (% 128); "
+                         f"pass block_q/block_k explicitly to opt out of "
+                         f"the default geometry")
+    return bq, bk
+
+
+def compatible(q_shape, k_shape) -> bool:
+    """Will the public entry accept these [b, s, h, d] shapes under the
+    DEFAULT block geometry?  Implemented AS the entry validation so the
+    auto-route gate (`ops.attention._pallas_compatible`) can never drift
+    from what the kernel accepts."""
+    try:
+        check_default_shapes(q_shape[1], k_shape[1], q_shape[-1])
+        return True
+    except ValueError:
+        return False
+
+
 def _mask(s, q_pos, k_pos, q_seg, k_seg, causal):
     """Combined causal+segment mask for one (Bq, Bk) score tile."""
     m = None
@@ -564,20 +598,18 @@ def flash_attention(q, k, v, *, causal: bool = True,
     tiles. Returns [b, s, hq, d]."""
     b, sq, hq, d = q.shape
     sk = k.shape[1]
-    bq0, bk0 = min(block_q, sq), min(block_k, sk)
     default_blocks = block_q == DEFAULT_BLOCK_Q and block_k == DEFAULT_BLOCK_K
-    block_q = fit_block(block_q, sq)
-    block_k = fit_block(block_k, sk)
-    # under the DEFAULT ladder, a shrink below lane alignment means the seq
-    # len fits no reasonable tile — reject and point at the bucket ladder.
-    # An EXPLICIT caller block choice is honored at whatever divisor
-    # fit_block lands on (the caller opted out of the default geometry).
-    if default_blocks and ((block_q != bq0 and block_q < 128)
-                           or (block_k != bk0 and block_k < 128)):
-        raise ValueError(f"seq lens ({sq},{sk}) fit no lane-aligned block "
-                         f"ladder (best: q={block_q}, k={block_k}); pad via "
-                         f"the bucket ladder or pass block_q/block_k "
-                         f"explicitly")
+    if default_blocks:
+        # under the DEFAULT ladder, a shrink below lane alignment (or an
+        # unaligned head dim) means the shape fits no reasonable tile —
+        # reject via the shared validation (`check_default_shapes`, the
+        # same predicate the auto-route gate evaluates).  An EXPLICIT
+        # caller block choice is honored at whatever divisor fit_block
+        # lands on (the caller opted out of the default geometry).
+        block_q, block_k = check_default_shapes(sq, sk, d)
+    else:
+        block_q = fit_block(block_q, sq)
+        block_k = fit_block(block_k, sk)
     scale = softmax_scale if softmax_scale is not None else d ** -0.5
     # contiguous positions on both sides -> tiles above the diagonal are
     # never scheduled (the causal 2x), fwd AND bwd

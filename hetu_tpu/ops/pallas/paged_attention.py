@@ -1,0 +1,157 @@
+"""Paged-attention decode Pallas kernel: attend directly over the serving
+KV pool's page tables (gather-free decode).
+
+The serving engine's decode step (PR 7 follow-up, closed here) used to
+GATHER every slot's pages into a dense [S, max_len, n_kv, hd] view per
+layer before attending — three passes over the cache bytes (gather read,
+dense write, attention read), most of them over DEAD tail positions.
+This kernel walks each slot's page list via scalar-prefetched block
+index maps (the splash-attention technique the flash kernel already
+uses for its live-pair tables): grid (slot, page_slot), with the K/V
+BlockSpec index maps reading `table[s, p]` so each grid step DMAs ONE
+page straight from the pool.  Pages past the slot's live length are
+scheduled but compute-skipped (`pl.when`); the null page (id 0) that
+inactive slots point at is masked the same way the dense path masks it
+(position mask over the global key index).
+
+Online-softmax accumulation across a slot's pages mirrors the flash
+forward; GQA folds grouped q heads against the pool's kv heads via an
+in-VMEM reshape (no materialized repeat).  Decode is forward-only — no
+vjp (the training path keeps flash attention).
+
+Shape contract (drift-tested against `compatible`): hd % 128, q heads
+divide by kv heads, table/positions/q agree on the slot count."""
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+from hetu_tpu.ops.pallas import _interpret
+
+NEG_INF = -1e30
+
+
+def _check_shapes(q_shape, pool_shape, table_shape, pos_shape
+                  ) -> Tuple[int, int, int, int, int, int]:
+    if len(q_shape) != 3 or len(pool_shape) != 4:
+        raise ValueError(f"expected q [S, nq, hd] and pool [P, ps, n_kv, "
+                         f"hd], got {q_shape} / {pool_shape}")
+    S, nq, hd = q_shape
+    P, ps, n_kv, hd_p = pool_shape
+    if hd_p != hd:
+        raise ValueError(f"head dim mismatch: q {hd} vs pool {hd_p}")
+    if nq % n_kv:
+        raise ValueError(f"q heads {nq} must divide by kv heads {n_kv}")
+    if len(table_shape) != 2 or table_shape[0] != S:
+        raise ValueError(f"table {table_shape} must be [S={S}, max_pages]")
+    if tuple(pos_shape) != (S,):
+        raise ValueError(f"positions {pos_shape} must be [S={S}]")
+    if hd % 128:
+        raise ValueError(f"head dim {hd} is not lane-aligned (% 128); "
+                         f"the gather fallback handles it")
+    return S, nq, hd, P, ps, n_kv
+
+
+def compatible(q_shape, pool_shape, table_shape, pos_shape) -> bool:
+    try:
+        _check_shapes(q_shape, pool_shape, table_shape, pos_shape)
+        return True
+    except ValueError:
+        return False
+
+
+def _kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
+            m_scr, l_scr, acc_scr, *, scale, ps, n_kv, group, mp):
+    s_idx = pl.program_id(0)
+    p = pl.program_id(1)
+
+    @pl.when(p == 0)
+    def _init():
+        m_scr[:] = jnp.full_like(m_scr, NEG_INF)
+        l_scr[:] = jnp.zeros_like(l_scr)
+        acc_scr[:] = jnp.zeros_like(acc_scr)
+
+    pos = pos_ref[s_idx]
+
+    # page p holds global positions [p*ps, (p+1)*ps); skip the compute
+    # body for wholly-future pages (they are scheduled — the grid is
+    # static — but move no math; their DMA reads the null page)
+    @pl.when(p * ps <= pos)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)               # [nq, hd]
+        k = k_ref[0].astype(jnp.float32)               # [ps, n_kv, hd]
+        v = v_ref[0].astype(jnp.float32)
+        nq, hd = q.shape
+        qg = q.reshape(n_kv, group, hd)
+        s = jax.lax.dot_general(
+            qg, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32) * scale  # [n_kv, g, ps]
+        kpos = p * ps + jax.lax.broadcasted_iota(jnp.int32, (1, 1, ps), 2)
+        s = jnp.where(kpos <= pos, s, NEG_INF)
+        sf = s.reshape(nq, ps)
+
+        m_prev = m_scr[:]                               # [nq, 1]
+        m_new = jnp.maximum(m_prev, jnp.max(sf, axis=1, keepdims=True))
+        p_ = jnp.exp(sf - m_new)                        # [nq, ps]
+        corr = jnp.exp(m_prev - m_new)
+        l_scr[:] = l_scr[:] * corr + jnp.sum(p_, axis=1, keepdims=True)
+        pv = jax.lax.dot_general(
+            p_.reshape(n_kv, group, ps), v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32)          # [n_kv, g, hd]
+        acc_scr[:] = acc_scr[:] * corr + pv.reshape(nq, hd)
+        m_scr[:] = m_new
+
+    @pl.when(p == mp - 1)
+    def _fin():
+        l = l_scr[:]
+        l_safe = jnp.where(l == 0.0, 1.0, l)
+        o_ref[0] = (acc_scr[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, k_pool, v_pool, table, positions, *,
+                    softmax_scale: Optional[float] = None):
+    """Decode attention over paged KV.  q: [S, nq, hd] (one token per
+    slot); k_pool/v_pool: [P, page_size, n_kv, hd] (page 0 = the null
+    page); table: [S, max_pages] int32 page ids; positions: [S] int32 —
+    slot s attends over global positions <= positions[s].  Returns
+    [S, nq, hd].  Raises ValueError on shapes outside `compatible` (the
+    dense-gather fallback in models/generation handles those)."""
+    S, nq, hd, P, ps, n_kv = _check_shapes(
+        q.shape, k_pool.shape, table.shape, positions.shape)
+    mp = table.shape[1]
+    group = nq // n_kv
+    scale = softmax_scale if softmax_scale is not None else hd ** -0.5
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(S, mp),
+        in_specs=[
+            pl.BlockSpec((1, nq, hd), lambda s, p, tab, pos: (s, 0, 0)),
+            pl.BlockSpec((1, ps, n_kv, hd),
+                         lambda s, p, tab, pos: (tab[s, p], 0, 0, 0)),
+            pl.BlockSpec((1, ps, n_kv, hd),
+                         lambda s, p, tab, pos: (tab[s, p], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, nq, hd),
+                               lambda s, p, tab, pos: (s, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((nq, 1), jnp.float32),
+            pltpu.VMEM((nq, 1), jnp.float32),
+            pltpu.VMEM((nq, hd), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(_kernel, scale=scale, ps=ps, n_kv=n_kv,
+                          group=group, mp=mp),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((S, nq, hd), q.dtype),
+        compiler_params=pltpu.CompilerParams(
+            dimension_semantics=("parallel", "arbitrary")),
+        interpret=_interpret(),
+    )(table.astype(jnp.int32), positions.astype(jnp.int32), q, k_pool,
+      v_pool)

@@ -33,8 +33,39 @@ def dequantize_int8(q, scale, shape) -> jnp.ndarray:
     return blocks.reshape(shape)
 
 
+def pack_nibbles(u, *, even_high: bool) -> jnp.ndarray:
+    """THE int4 nibble packer — one implementation shared by both wire
+    formats so they can never silently diverge (the cross-format
+    regression test in tests/test_pallas_kernels.py pins both layouts).
+
+    `u`: unsigned nibble values in [0, 15], even last dim.  Two adjacent
+    values pack into one byte; `even_high=True` puts the EVEN index in
+    the high nibble (`comm/compress.pack_int4`'s offset-binary wire
+    format), `even_high=False` puts it in the low nibble (this module's
+    storage format)."""
+    if u.shape[-1] % 2:
+        raise ValueError(f"int4 packing needs an even trailing dim, got "
+                         f"{u.shape[-1]}")
+    u = u.astype(jnp.uint8)
+    even = u[..., 0::2]
+    odd = u[..., 1::2]
+    return ((even << 4) | odd) if even_high else (even | (odd << 4))
+
+
+def unpack_nibbles(p, *, even_high: bool) -> jnp.ndarray:
+    """Inverse of `pack_nibbles`: uint8 [..., n] -> values [..., 2n] in
+    [0, 15] (uint8)."""
+    hi = ((p >> 4) & 0xF).astype(jnp.uint8)
+    lo = (p & 0xF).astype(jnp.uint8)
+    even, odd = (hi, lo) if even_high else (lo, hi)
+    out = jnp.stack([even, odd], axis=-1)
+    return out.reshape(p.shape[:-1] + (2 * p.shape[-1],))
+
+
 def quantize_int4(x, block_size: int = 64) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Block-wise absmax int4, two nibbles packed per int8."""
+    """Block-wise absmax int4, two nibbles packed per int8 (even index in
+    the LOW nibble — the storage layout; `comm/compress.pack_int4` uses
+    the transposed even-high wire layout, both via `pack_nibbles`)."""
     flat = x.reshape(-1)
     n = flat.shape[0]
     assert n % block_size == 0 and block_size % 2 == 0
@@ -42,16 +73,12 @@ def quantize_int4(x, block_size: int = 64) -> Tuple[jnp.ndarray, jnp.ndarray]:
     scale = jnp.max(jnp.abs(blocks), axis=1, keepdims=True) / 7.0
     scale = jnp.maximum(scale, 1e-12)
     q = jnp.clip(jnp.round(blocks / scale), -7, 7).astype(jnp.int8) + 8
-    lo = q[:, 0::2]
-    hi = q[:, 1::2]
-    packed = (lo | (hi << 4)).astype(jnp.uint8)
+    packed = pack_nibbles(q, even_high=False)
     return packed, scale[:, 0]
 
 
 def dequantize_int4(packed, scale, shape) -> jnp.ndarray:
-    lo = (packed & 0xF).astype(jnp.int32) - 8
-    hi = ((packed >> 4) & 0xF).astype(jnp.int32) - 8
-    blocks = jnp.stack([lo, hi], axis=2).reshape(packed.shape[0], -1)
+    blocks = unpack_nibbles(packed, even_high=False).astype(jnp.int32) - 8
     return (blocks.astype(jnp.float32) * scale[:, None]).reshape(shape)
 
 

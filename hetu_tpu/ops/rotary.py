@@ -8,6 +8,7 @@ replaces the cu_seqlens offset logic.
 """
 from typing import Optional
 
+import jax
 import jax.numpy as jnp
 
 
@@ -38,3 +39,34 @@ def apply_rotary(x, cos, sin, position_ids: Optional[jnp.ndarray] = None):
     out1 = xf1 * cos_t - xf2 * sin_t
     out2 = xf2 * cos_t + xf1 * sin_t
     return jnp.concatenate([out1, out2], axis=-1).astype(x.dtype)
+
+
+def apply_rotary_qk(q, k, cos, sin, position_ids: Optional[jnp.ndarray] = None,
+                    use_pallas: Optional[bool] = None):
+    """Apply RoPE to q [b, s, nq, hd] AND k [b, s, nk, hd] in one fused
+    Pallas pass (ops/pallas/rotary — the tables are gathered once and
+    both tensors rotate in VMEM; the rotation's vjp is the same kernel
+    with -sin).  Falls back to two `apply_rotary` calls — the exact seed
+    composition — when the kernel is gated off or the shape gate
+    rejects.  Returns (q_rotated, k_rotated)."""
+    if use_pallas is None:
+        from hetu_tpu.ops.pallas import resolve_route
+        from hetu_tpu.ops.pallas import rotary as _pr
+        use_pallas = resolve_route(
+            "rotary", q.ndim == 4 and k.ndim == 4
+            and _pr.compatible(q.shape, k.shape))
+    if use_pallas:
+        from hetu_tpu.ops.pallas.rotary import fused_rotary_qk
+        b, s = q.shape[0], q.shape[1]
+        d2 = cos.shape[-1]
+        if position_ids is None:
+            cos_t = jnp.broadcast_to(cos[:s][None], (b, s, d2))
+            sin_t = jnp.broadcast_to(sin[:s][None], (b, s, d2))
+        else:
+            cos_t = jnp.broadcast_to(cos[position_ids], (b, s, d2))
+            sin_t = jnp.broadcast_to(sin[position_ids], (b, s, d2))
+        with jax.named_scope("pallas_rotary"):
+            return fused_rotary_qk(q, k, cos_t.astype(jnp.float32),
+                                   sin_t.astype(jnp.float32))
+    return (apply_rotary(q, cos, sin, position_ids),
+            apply_rotary(k, cos, sin, position_ids))

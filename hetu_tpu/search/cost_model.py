@@ -66,6 +66,13 @@ class StrategyCandidate:
     # that SPANS slices at the slow inter rate — which is exactly why
     # the searcher will prefer two_level on multi-slice dp.
     comm_topology: str = "flat"
+    # Pallas fused-kernel layer (HETU_TPU_PALLAS, ops/pallas,
+    # docs/kernels.md): prices the per-layer elementwise chains
+    # (residual+norm, SwiGLU, rotary) at their FUSED analytic HBM bytes
+    # instead of the XLA op-chain bytes (ops/pallas/traffic.py via
+    # CostModel.kernel_fusion_factors) — the searcher sees the byte cut
+    # the flag buys, the same way grad_compress exposes its wire factor.
+    pallas: bool = False
 
     @property
     def num_devices(self):
@@ -93,6 +100,8 @@ class StrategyCandidate:
             bits.append("zr" + self.zero_refresh.replace("int", ""))
         if self.comm_topology != "flat":
             bits.append("2lvl")
+        if self.pallas:
+            bits.append("pk")
         return "x".join(bits) or "single"
 
     @property
@@ -127,6 +136,9 @@ class CostModel:
     # set, it replaces the analytic 6N-based per-layer term with what
     # the compiler actually emitted for THIS model
     measured_layer_flops_per_token: Optional[float] = None
+    # head geometry for the per-kernel fusion factors (rotary/flash
+    # traffic scales with heads); 0 = derive heads from hidden/head_dim
+    head_dim: int = 128
 
     def __post_init__(self):
         # a saved hardware profile (bench.py writes act_* keys from the
@@ -154,6 +166,47 @@ class CostModel:
                     + 6.0 * self.vocab * self.hidden)
         return 6.0 * self.num_params + \
             12 * self.num_layers * self.hidden * self.seq_len
+
+    # ---------------- fused-kernel layer ----------------
+    def kernel_fusion_factors(self) -> dict:
+        """Per-kernel analytic byte-reduction factors for THIS model
+        shape (ops/pallas/traffic.py): {kernel: {fused_bytes,
+        unfused_bytes, reduction}} for one forward pass of the full
+        batch.  The HETU_TPU_PALLAS trade surfaced to the searcher the
+        same way wire_factor surfaces the compression flags.  Depends
+        only on the model shape, not the candidate, so the report is
+        memoized — the searcher calls step_time per candidate."""
+        cached = self.__dict__.get("_kff_memo")
+        if cached is not None:
+            return cached
+        from hetu_tpu.ops.pallas.traffic import kernel_traffic_report
+        heads = max(self.hidden // max(self.head_dim, 1), 1)
+        rep = kernel_traffic_report(
+            batch=max(self.global_batch, 1), seq=self.seq_len,
+            hidden=self.hidden, intermediate=self.intermediate,
+            num_layers=self.num_layers, q_heads=heads, kv_heads=heads,
+            head_dim=self.head_dim)
+        out = {name: {"fused_bytes": r["fused_bytes"],
+                      "unfused_bytes": r["unfused_bytes"],
+                      "reduction": r["reduction"]}
+               for name, r in rep.items()}
+        self.__dict__["_kff_memo"] = out
+        return out
+
+    def _elementwise_hbm_s(self, c: StrategyCandidate) -> float:
+        """HBM seconds of the per-layer elementwise chains the fused
+        kernels target (norm pairs, SwiGLU, rotary) — fused bytes under
+        c.pallas, XLA op-chain bytes otherwise; x2 for fwd+bwd; spread
+        across devices.  Small next to the MXU term (sub-1% for the
+        validated configs) but it is exactly the term fusion removes,
+        so pallas candidates rank on it."""
+        factors = self.kernel_fusion_factors()
+        key = "fused_bytes" if c.pallas else "unfused_bytes"
+        per_layer = sum(factors[k][key] for k in ("norm", "swiglu",
+                                                  "rotary"))
+        hbm = (self.hw.measured.get("hbm_gbps")
+               or self.hw.hbm_gbps) * 1e9
+        return 2.0 * per_layer / (c.num_devices * hbm)
 
     def step_time(self, c: StrategyCandidate) -> float:
         tokens = self.global_batch * self.seq_len
@@ -290,6 +343,9 @@ class CostModel:
                     + t_dp)
         else:
             busy = compute + t_comm + t_dp
+        # elementwise-chain HBM time (same additive term either way; the
+        # fused-kernel candidate pays the smaller byte count)
+        busy += self._elementwise_hbm_s(c)
         if c.pp > 1:
             m = max(c.n_micro, c.pp)
             if c.pp_schedule == "1f1b" and not c.pp_only:

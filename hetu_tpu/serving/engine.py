@@ -18,7 +18,12 @@ one compile each, no shape-bucket churn):
                       `decode_step_slots` over the full slot batch with
                       per-slot positions, scatter the new token K/V back
                       into the pool, argmax.  Inactive slots ride along
-                      pointing at the null page.
+                      pointing at the null page.  Under HETU_TPU_PALLAS
+                      (exact fp pages + passing shape gate) the program
+                      is the GATHER-FREE form instead: the Pallas
+                      paged-attention kernel walks the page tables
+                      directly (`models/generation.decode_step_paged`,
+                      ops/pallas/paged_attention, docs/kernels.md).
 
 Between device steps the host-side `Scheduler` admits/evicts at token
 granularity and the engine stamps SLO metrics into the `obs` registry
@@ -152,16 +157,51 @@ class ServingEngine:
         self._build_programs()
 
     # ------------------------------------------------------------ build
+    def _use_paged_kernel(self) -> bool:
+        """Route the decode program through the gather-free Pallas
+        paged-attention kernel (ops/pallas/paged_attention) when the
+        HETU_TPU_PALLAS surface and the kernel's shape gate allow.
+        Exact fp pages only — the int8 page mode keeps the gather path
+        (pages dequantize during the gather).  Evaluated once at build:
+        the decision is static, like every other program shape."""
+        if self.pool.quant != "none":
+            return False
+        from hetu_tpu.ops.pallas import paged_attention as _pa
+        from hetu_tpu.ops.pallas import resolve_route
+        c = self.model.config
+        S = self.config.num_slots
+        q_shape = (S, c.num_attention_heads, c.head_dim)
+        pool_shape = (self.config.num_pages + 1, self.config.page_size,
+                      self.pool.num_kv_heads, self.pool.head_dim)
+        ok = _pa.compatible(q_shape, pool_shape,
+                            (S, self.scheduler.max_pages), (S,))
+        return resolve_route("paged_attn", ok)
+
     def _build_programs(self):
         model, pool = self.model, self.pool
+        self.decode_paged = self._use_paged_kernel()
 
-        def decode_fn(params, pool_tree, table, tokens, positions):
-            ck, cv = pool.gather(pool_tree, table)
-            logits, _, (kt, vt) = decode_step_slots(
-                model, params, tokens, (ck, cv), positions)
-            new_tree = pool.write_token(pool_tree, table, positions, kt, vt)
-            nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            return nxt, new_tree
+        if self.decode_paged:
+            from hetu_tpu.models.generation import decode_step_paged
+
+            def decode_fn(params, pool_tree, table, tokens, positions):
+                # gather-free: the kernel walks the page table directly;
+                # this token's K/V are scattered inside the step (the
+                # write_token scatter is folded into the program)
+                logits, nk, nv = decode_step_paged(
+                    model, params, tokens, pool_tree[0], pool_tree[1],
+                    table, positions)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, (nk, nv)
+        else:
+            def decode_fn(params, pool_tree, table, tokens, positions):
+                ck, cv = pool.gather(pool_tree, table)
+                logits, _, (kt, vt) = decode_step_slots(
+                    model, params, tokens, (ck, cv), positions)
+                new_tree = pool.write_token(pool_tree, table, positions,
+                                            kt, vt)
+                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                return nxt, new_tree
 
         def chunk_fn(params, chunk, cache, start):
             return extend_cache(model, params, chunk, cache, start)

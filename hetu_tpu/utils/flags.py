@@ -166,9 +166,18 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "usable KV pages in the pool; 0 (default) = full reservation "
          "(slots * max_len / page), i.e. admission never waits on pages"),
     Flag("HETU_TPU_PALLAS", "str", "auto",
-         "flash-attention kernel routing: auto (shape-gated), 1 (force "
-         "Pallas), 0 (force the XLA composition)",
+         "Pallas fused-kernel layer routing (ops/pallas: flash attention, "
+         "residual+RMS/LayerNorm, SwiGLU, rotary, blockwise quantize, "
+         "paged-attention decode — docs/kernels.md): auto (shape-gated, "
+         "TPU only), 1 (force the kernels; unsupported shapes raise), "
+         "0 (force the XLA compositions — byte-identical to the seed "
+         "lowering, tested)",
          choices=("auto", "1", "0")),
+    Flag("HETU_TPU_PALLAS_KERNELS", "str", "",
+         "restrict WHICH Pallas kernels participate in HETU_TPU_PALLAS "
+         "routing: comma list over {flash, norm, swiglu, rotary, quant, "
+         "paged_attn}, or 'all' (default: empty = all) / 'none' — lets "
+         "one kernel be bisected out without losing the rest"),
     Flag("HETU_TPU_CP_SPLIT", "str", "sym",
          "default context-parallel split pattern "
          "(reference: HETU_PARALLEL_ATTN_SPLIT_PATTERN SYM/STRIPE/NORMAL)",

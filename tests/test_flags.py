@@ -70,6 +70,10 @@ def test_every_env_read_is_registered():
     for name in ("HETU_TPU_PROFILE", "HETU_TPU_PROFILE_TOPK",
                  "HETU_TPU_PROFILE_TRACE", "HETU_TPU_BUDGETS"):
         assert name in flags.REGISTRY
+    # the fused-kernel layer's routing knobs (ops/pallas,
+    # docs/kernels.md): the whole-layer switch + the per-kernel bisect
+    for name in ("HETU_TPU_PALLAS", "HETU_TPU_PALLAS_KERNELS"):
+        assert name in flags.REGISTRY
 
 
 def test_profile_flag_defaults_are_off_path():

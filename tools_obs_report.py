@@ -202,6 +202,10 @@ def main(argv=None) -> int:
     ap.add_argument("--trace", metavar="OUT.json", default=None,
                     help="also render the run as Chrome-trace JSON "
                          "(open in Perfetto / chrome://tracing)")
+    ap.add_argument("--kernels", action="store_true",
+                    help="attach the analytic Pallas fused-kernel "
+                         "traffic section (tools_bench_kernels.py's "
+                         "byte model — the bench detail.kernels record)")
     args = ap.parse_args(argv)
 
     from hetu_tpu.obs.runlog import RunLog
@@ -209,7 +213,11 @@ def main(argv=None) -> int:
     if not records:
         print(f"no records in {args.runlog}", file=sys.stderr)
         return 1
-    print(json.dumps(summarize(records), indent=2))
+    out = summarize(records)
+    if args.kernels:
+        from tools_bench_kernels import kernel_section
+        out["kernels"] = kernel_section()
+    print(json.dumps(out, indent=2))
 
     if args.trace:
         from hetu_tpu.obs.trace import trace_from_runlog

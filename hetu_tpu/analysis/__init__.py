@@ -1,0 +1,31 @@
+"""Graph-contract linter: static analysis over lowered HLO + repo AST
+proving the distributed invariants the repo used to spot-check by hand.
+
+Two front ends, one finding/report model (docs/static_analysis.md):
+
+* HLO lints (`hlo_lints.py`) — donation, replica-groups,
+  replication, dtype-drift, scope-coverage over a compiled program's
+  post-optimization text, plus the flag-identity sweep
+  (`flag_identity.py`) enforcing every `identity=` contract registered
+  in utils/flags.py against the canonical programs (`programs.py`).
+* AST lints (`ast_lints.py`) — env-bypass, vjp-signature,
+  shardmap-constraints, unseeded-rng over the repo's own Python.
+
+Sinks: tools_lint.py (CLI: exit codes, --json, allowlist), the
+HETU_TPU_LINT per-compile trainer hook (`lint` RunLog events + lint.*
+counters), and tools_obs_report.py's lint section.
+"""
+from hetu_tpu.analysis.findings import (Allowlist,  # noqa: F401
+                                        AllowlistEntry, ERROR, Finding,
+                                        INFO, SEVERITIES, WARNING,
+                                        counts_by_lint,
+                                        counts_by_severity, lint_record)
+from hetu_tpu.analysis.hlo_lints import (lint_donation,  # noqa: F401
+                                         lint_dtype_drift, lint_hlo,
+                                         lint_replica_groups,
+                                         lint_replication,
+                                         lint_scope_coverage)
+from hetu_tpu.analysis.ast_lints import (lint_file,  # noqa: F401
+                                         lint_repo)
+from hetu_tpu.analysis.flag_identity import (identity_sweep,  # noqa: F401
+                                             fingerprint)

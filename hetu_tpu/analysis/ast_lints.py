@@ -1,0 +1,308 @@
+"""AST lints: repo conventions that have each caused a real past bug,
+enforced statically over the project's own Python.
+
+* **env-bypass** (error) — a read of a ``HETU_TPU_*`` environment
+  variable through ``os.environ[...]`` / ``os.environ.get`` /
+  ``os.getenv`` anywhere but ``utils/flags.py``: a bypassed registry
+  read is invisible to ``flags.describe()``, skips choice validation,
+  and dodges the flag-audit test (the PR 4 strays were exactly this).
+  Writes (launcher worker-env injection) are fine — only loads count.
+
+* **vjp-signature** (error) — a ``jax.custom_vjp`` whose ``defvjp(fwd,
+  bwd)`` functions disagree with the primal's signature: fwd must take
+  the primal's positional arguments; bwd must take ``len(nondiff_
+  argnums) + 2`` (the nondiff args, the residuals, the cotangent).
+  jax only raises at TRACE time, deep inside a jit — the static check
+  fails in review instead.
+
+* **shardmap-constraints** (error) — a module that builds ``shard_map``
+  regions AND touches the GSPMD constraint machinery (``.constrain(`` /
+  ``with_sharding_constraint``) without ever referencing
+  ``dstates.suppress_constraints``: constraints are illegal inside a
+  fully-manual region (the PR 2 grad-sync bug), so any module mixing
+  the two must show it knows the escape hatch.
+
+* **unseeded-rng** (error) — library code drawing from unseeded
+  randomness: ``random.Random()`` with no seed, module-level
+  ``random.<fn>()`` calls, or legacy ``np.random.<fn>`` global-state
+  draws.  Reproducibility is load-bearing here (seeded chaos schedules,
+  golden tests); intentional exceptions (rpc backoff jitter) carry an
+  allowlist entry with the reason spelled out.
+
+Scope: ``hetu_tpu/`` + the repo-root ``tools_*.py`` / ``bench.py`` —
+the same surface the flag-audit test walks.  Tests are exempt (they
+monkeypatch env and fabricate randomness on purpose).
+"""
+from __future__ import annotations
+
+import ast
+import os
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from hetu_tpu.analysis.findings import ERROR, WARNING, Finding
+
+#: the one module allowed to read HETU_TPU_* env vars directly
+FLAGS_MODULE = os.path.join("utils", "flags.py")
+
+_RANDOM_MODULE_FNS = frozenset((
+    "random", "randint", "uniform", "choice", "choices", "shuffle",
+    "sample", "randrange", "gauss", "normalvariate", "betavariate",
+    "expovariate", "triangular", "getrandbits", "randbytes"))
+_NP_RANDOM_OK = frozenset((
+    "default_rng", "Generator", "SeedSequence", "RandomState",
+    "BitGenerator", "PCG64", "Philox", "MT19937", "SFC64"))
+
+
+def _rel(path: str, root: Optional[str]) -> str:
+    if root and os.path.commonprefix([os.path.abspath(path),
+                                      os.path.abspath(root)]):
+        return os.path.relpath(path, root)
+    return path
+
+
+def _dotted(node: ast.AST) -> str:
+    """`jax.custom_vjp` -> "jax.custom_vjp"; "" when not a plain chain."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _pos_argcount(fn) -> Optional[int]:
+    """Positional parameter count of a FunctionDef/Lambda; None when the
+    signature is open (*args) and a count check would be meaningless."""
+    a = fn.args
+    if a.vararg is not None:
+        return None
+    return len(a.posonlyargs) + len(a.args)
+
+
+# ---------------------------------------------------------------------------
+# per-file lints
+# ---------------------------------------------------------------------------
+
+def _lint_env_reads(tree: ast.AST, rel: str) -> List[Finding]:
+    if rel.replace(os.sep, "/").endswith(FLAGS_MODULE.replace(os.sep, "/")):
+        return []
+    findings = []
+
+    def _key_of(call_args) -> Optional[str]:
+        if call_args and isinstance(call_args[0], ast.Constant) \
+                and isinstance(call_args[0].value, str):
+            return call_args[0].value
+        return None
+
+    for node in ast.walk(tree):
+        key = None
+        if isinstance(node, ast.Subscript) \
+                and isinstance(node.ctx, ast.Load) \
+                and _dotted(node.value) == "os.environ" \
+                and isinstance(node.slice, ast.Constant) \
+                and isinstance(node.slice.value, str):
+            key = node.slice.value
+        elif isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            if fn in ("os.environ.get", "os.getenv"):
+                key = _key_of(node.args)
+        if key and key.startswith("HETU_TPU_"):
+            findings.append(Finding(
+                "env-bypass", ERROR, f"{rel}:{node.lineno}",
+                f"direct os.environ read of {key} bypasses the flag "
+                f"registry — use hetu_tpu.utils.flags "
+                f"(bool_flag/str_flag/int_flag)",
+                {"flag": key}))
+    return findings
+
+
+def _lint_vjp_signatures(tree: ast.AST, rel: str) -> List[Finding]:
+    defs: Dict[str, ast.AST] = {}
+    primals: Dict[str, Tuple[ast.AST, Tuple[int, ...]]] = {}
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        defs.setdefault(node.name, node)
+        for dec in node.decorator_list:
+            nondiff: Optional[Tuple[int, ...]] = None
+            if _dotted(dec) == "jax.custom_vjp":
+                nondiff = ()
+            elif isinstance(dec, ast.Call) \
+                    and _dotted(dec.func) == "functools.partial" \
+                    and dec.args and _dotted(dec.args[0]) == "jax.custom_vjp":
+                nondiff = ()
+                for kw in dec.keywords:
+                    if kw.arg == "nondiff_argnums":
+                        try:
+                            nondiff = tuple(ast.literal_eval(kw.value))
+                        except (ValueError, SyntaxError):
+                            nondiff = None
+            if nondiff is not None:
+                primals[node.name] = (node, nondiff)
+
+    findings = []
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "defvjp"
+                and isinstance(node.func.value, ast.Name)
+                and len(node.args) >= 2):
+            continue
+        primal_name = node.func.value.id
+        if primal_name not in primals:
+            continue
+        primal, nondiff = primals[primal_name]
+        n_primal = _pos_argcount(primal)
+
+        def _nargs(fn_node) -> Tuple[Optional[int], str]:
+            if isinstance(fn_node, ast.Lambda):
+                return _pos_argcount(fn_node), "<lambda>"
+            if isinstance(fn_node, ast.Name) and fn_node.id in defs:
+                return _pos_argcount(defs[fn_node.id]), fn_node.id
+            return None, _dotted(fn_node) or "<?>"
+
+        n_fwd, fwd_name = _nargs(node.args[0])
+        n_bwd, bwd_name = _nargs(node.args[1])
+        if n_primal is not None and n_fwd is not None \
+                and n_fwd != n_primal:
+            findings.append(Finding(
+                "vjp-signature", ERROR, f"{rel}:{node.lineno}",
+                f"custom_vjp fwd {fwd_name} takes {n_fwd} positional "
+                f"args but primal {primal_name} takes {n_primal} — jax "
+                f"raises only at trace time, deep inside a jit",
+                {"primal": primal_name, "fwd": fwd_name}))
+        want_bwd = len(nondiff) + 2
+        if n_bwd is not None and n_bwd != want_bwd:
+            findings.append(Finding(
+                "vjp-signature", ERROR, f"{rel}:{node.lineno}",
+                f"custom_vjp bwd {bwd_name} takes {n_bwd} positional "
+                f"args but primal {primal_name} with "
+                f"{len(nondiff)} nondiff_argnums needs {want_bwd} "
+                f"(nondiff..., residuals, cotangent)",
+                {"primal": primal_name, "bwd": bwd_name}))
+    return findings
+
+
+def _lint_shardmap_constraints(tree: ast.AST, src: str, rel: str
+                               ) -> List[Finding]:
+    """Constraint calls LEXICALLY INSIDE a shard_map region function.
+
+    A constraint outside the region (pipeline modules shard_map only
+    the pp axis and let TP/SP constraints compose via GSPMD) is legal;
+    one inside the region fn runs in manual context where it is illegal
+    or vacuous — unless the module shows it knows the escape hatch
+    (references suppress_constraints, which neutralizes DS.constrain
+    for the region's trace)."""
+    if "suppress_constraints" in src:
+        return []
+    fn_defs: Dict[str, ast.AST] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            fn_defs.setdefault(node.name, node)
+
+    def _constrains(region: ast.AST) -> Optional[int]:
+        for sub in ast.walk(region):
+            if isinstance(sub, ast.Call):
+                fn = _dotted(sub.func)
+                if fn.endswith("with_sharding_constraint") \
+                        or fn.endswith(".constrain"):
+                    return sub.lineno
+        return None
+
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        if not (fn == "shard_map" or fn.endswith(".shard_map")):
+            continue
+        if not node.args:
+            continue
+        region = node.args[0]
+        if isinstance(region, ast.Name):
+            region = fn_defs.get(region.id)
+        if region is None or not isinstance(
+                region, (ast.Lambda, ast.FunctionDef,
+                         ast.AsyncFunctionDef)):
+            continue
+        hit = _constrains(region)
+        if hit is not None:
+            findings.append(Finding(
+                "shardmap-constraints", ERROR, f"{rel}:{hit}",
+                f"GSPMD sharding constraint inside the shard_map region "
+                f"traced at line {node.lineno} — constraints are illegal "
+                f"or vacuous in a fully-manual region; wrap the region's "
+                f"trace in dstates.suppress_constraints() (see "
+                f"engine/trainer.py _compressed_grads)",
+                {"shard_map_line": node.lineno}))
+    return findings
+
+
+def _lint_unseeded_rng(tree: ast.AST, rel: str) -> List[Finding]:
+    findings = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        fn = _dotted(node.func)
+        msg = None
+        if fn in ("random.Random", "Random") and not node.args \
+                and not node.keywords:
+            msg = "random.Random() with no seed"
+        elif fn.startswith("random.") \
+                and fn.split(".", 1)[1] in _RANDOM_MODULE_FNS:
+            msg = f"module-level {fn}() draws from the unseeded global RNG"
+        elif fn.startswith(("np.random.", "numpy.random.")):
+            attr = fn.rsplit(".", 1)[1]
+            if attr not in _NP_RANDOM_OK:
+                msg = (f"legacy {fn}() draws from numpy's global RNG — "
+                       f"use np.random.default_rng(seed)")
+        if msg:
+            findings.append(Finding(
+                "unseeded-rng", ERROR, f"{rel}:{node.lineno}",
+                f"{msg}; library code must be reproducible (seeded "
+                f"chaos schedules and golden tests depend on it)", {}))
+    return findings
+
+
+def lint_file(path: str, *, root: Optional[str] = None) -> List[Finding]:
+    """All AST lints over one source file."""
+    rel = _rel(path, root)
+    try:
+        src = open(path, encoding="utf-8").read()
+        tree = ast.parse(src, filename=path)
+    except (OSError, SyntaxError) as e:
+        return [Finding("parse", WARNING, rel,
+                        f"could not parse: {e}", {})]
+    out: List[Finding] = []
+    out += _lint_env_reads(tree, rel)
+    out += _lint_vjp_signatures(tree, rel)
+    out += _lint_shardmap_constraints(tree, src, rel)
+    out += _lint_unseeded_rng(tree, rel)
+    return out
+
+
+def default_sources(root: str) -> List[str]:
+    """The lintable surface: hetu_tpu/**.py + repo-root tools_*.py +
+    bench.py (the flag-audit test's walk, tests exempt)."""
+    import glob
+    out = sorted(glob.glob(os.path.join(root, "hetu_tpu", "**", "*.py"),
+                           recursive=True))
+    out += sorted(glob.glob(os.path.join(root, "tools_*.py")))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        out.append(bench)
+    return out
+
+
+def lint_repo(root: Optional[str] = None,
+              files: Optional[Sequence[str]] = None) -> List[Finding]:
+    """AST lints over the repo (tools_lint.py --self)."""
+    if root is None:
+        root = os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+    out: List[Finding] = []
+    for path in (files if files is not None else default_sources(root)):
+        out += lint_file(path, root=root)
+    return out

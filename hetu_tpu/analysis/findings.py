@@ -1,0 +1,191 @@
+"""The graph-contract linter's finding/report model — ONE shape shared
+by every front end (HLO lints, AST lints, the flag-identity sweep) and
+every sink (tools_lint.py exit codes / --json, the HETU_TPU_LINT
+per-compile RunLog record, tools_obs_report.py's lint section).
+
+Severity semantics (docs/static_analysis.md):
+
+* ``error``   — a broken invariant: CI fails (tools_lint exits nonzero)
+  unless an allowlist entry WITH A REASON covers it.
+* ``warning`` — a probable inefficiency or smell worth a human look;
+  reported, counted, never fails the build.
+* ``info``    — accounting output (coverage fractions, sweep results
+  that passed); kept so reports stay diffable across rounds.
+
+Allowlist contract: an entry must carry ``lint`` (the finding id it
+covers), ``match`` (substring of the finding's location), and a
+non-empty ``reason`` — a reasonless entry is itself an error finding
+(``allowlist-reason``), and an entry that suppressed nothing surfaces as
+``allowlist-unused`` so dead waivers rot loudly instead of silently.
+"""
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+ERROR = "error"
+WARNING = "warning"
+INFO = "info"
+SEVERITIES = (ERROR, WARNING, INFO)
+
+
+@dataclasses.dataclass
+class Finding:
+    """One lint finding.
+
+    lint      — stable lint id ("donation", "replica-groups", ...;
+                docs/static_analysis.md has the inventory)
+    severity  — "error" | "warning" | "info"
+    location  — where ("path/to/file.py:12", "train_step HLO",
+                "flag HETU_TPU_PALLAS/decode")
+    message   — one human sentence; the CLI table and RunLog carry it
+    data      — structured detail for --json consumers (byte counts,
+                fingerprints, parameter numbers...)
+    """
+    lint: str
+    severity: str
+    location: str
+    message: str
+    data: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.severity not in SEVERITIES:
+            raise ValueError(
+                f"severity {self.severity!r} not in {SEVERITIES}")
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {"lint": self.lint, "severity": self.severity,
+               "location": self.location, "message": self.message}
+        if self.data:
+            out["data"] = self.data
+        return out
+
+
+def counts_by_severity(findings: Sequence[Finding]) -> Dict[str, int]:
+    out = {s: 0 for s in SEVERITIES}
+    for f in findings:
+        out[f.severity] += 1
+    return out
+
+
+def counts_by_lint(findings: Sequence[Finding]) -> Dict[str, int]:
+    out: Dict[str, int] = {}
+    for f in findings:
+        out[f.lint] = out.get(f.lint, 0) + 1
+    return out
+
+
+def lint_record(findings: Sequence[Finding],
+                max_messages: int = 8) -> Dict[str, Any]:
+    """The compact `lint` RunLog payload (and the shape tools_obs_report
+    summarizes): severity counts, per-lint counts, and the first few
+    error/warning messages — small enough to ride every fresh compile."""
+    sev = counts_by_severity(findings)
+    rec: Dict[str, Any] = {
+        "findings": len(findings),
+        "errors": sev[ERROR],
+        "warnings": sev[WARNING],
+        "lints": counts_by_lint(findings),
+    }
+    worst = [f for f in findings if f.severity == ERROR]
+    worst += [f for f in findings if f.severity == WARNING]
+    if worst:
+        rec["messages"] = [f"[{f.lint}] {f.location}: {f.message}"
+                           for f in worst[:max_messages]]
+    return rec
+
+
+# ---------------------------------------------------------------------------
+# allowlist
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class AllowlistEntry:
+    lint: str
+    match: str
+    reason: str
+    used: bool = False
+
+    def covers(self, f: Finding) -> bool:
+        return f.lint == self.lint and self.match in f.location
+
+
+class Allowlist:
+    """Loaded allowlist + the policy around it.  File format::
+
+        {"entries": [
+          {"lint": "unseeded-rng", "match": "hetu_tpu/rpc/client.py",
+           "reason": "backoff jitter must differ across workers"}
+        ]}
+
+    `apply` suppresses covered findings and APPENDS policy findings:
+    one `allowlist-reason` ERROR per reasonless entry (a waiver nobody
+    justified is worse than the finding it hides) and one
+    `allowlist-unused` WARNING per entry that suppressed nothing."""
+
+    def __init__(self, entries: Optional[List[AllowlistEntry]] = None,
+                 path: str = "<none>"):
+        self.entries = entries or []
+        self.path = path
+
+    @classmethod
+    def load(cls, path: Optional[str]) -> "Allowlist":
+        """Load from JSON; a missing/None path is an empty allowlist (the
+        common case — the repo aims to carry few waivers), but a present
+        file that fails to parse raises loudly: a torn allowlist must
+        not silently re-arm every suppressed finding as a CI failure
+        NOR silently keep suppressing."""
+        if not path:
+            return cls()
+        try:
+            with open(path) as fh:
+                raw = json.load(fh)
+        except FileNotFoundError:
+            return cls(path=path)
+        entries = []
+        for e in raw.get("entries", []):
+            entries.append(AllowlistEntry(
+                lint=str(e.get("lint", "")),
+                match=str(e.get("match", "")),
+                reason=str(e.get("reason", "") or "")))
+        return cls(entries, path=path)
+
+    def apply(self, findings: Sequence[Finding],
+              executed: Optional[Sequence[str]] = None
+              ) -> Tuple[List[Finding], List[Finding]]:
+        """(kept, suppressed) — kept includes the policy findings.
+
+        `executed` names the lint ids this run actually executed: an
+        entry whose lint did not run cannot be judged stale, so its
+        `allowlist-unused` warning is withheld (a fixture-only
+        tools_lint run must not call the repo's standing waivers
+        stale).  None (default) = judge every entry."""
+        kept: List[Finding] = []
+        suppressed: List[Finding] = []
+        for f in findings:
+            entry = next((e for e in self.entries if e.covers(f)), None)
+            if entry is not None and entry.reason.strip():
+                entry.used = True
+                suppressed.append(f)
+            elif entry is not None:
+                # a reasonless entry matches but DOES NOT suppress —
+                # the finding stays and the entry itself is flagged
+                entry.used = True
+                kept.append(f)
+            else:
+                kept.append(f)
+        for e in self.entries:
+            if not e.reason.strip():
+                kept.append(Finding(
+                    "allowlist-reason", ERROR, self.path,
+                    f"allowlist entry (lint={e.lint!r}, match={e.match!r}) "
+                    f"carries no reason — every waiver must say why",
+                    {"lint": e.lint, "match": e.match}))
+            elif not e.used and (executed is None or e.lint in executed):
+                kept.append(Finding(
+                    "allowlist-unused", WARNING, self.path,
+                    f"allowlist entry (lint={e.lint!r}, match={e.match!r}) "
+                    f"suppressed nothing — remove it or fix the match",
+                    {"lint": e.lint, "match": e.match}))
+        return kept, suppressed

@@ -1,0 +1,96 @@
+"""The flag-identity pass: systematic enforcement of every registered
+byte-identity contract.
+
+The contract table is DECLARATIVE and lives where the flags live:
+`utils/flags.py` registers `identity=<value>` on each flag whose
+contract is "setting it to <value> lowers the canonical programs to
+exactly what an unset environment lowers" (routing flags at their
+neutral value, post-compile analysis flags at "1").  This pass replaced
+the ~10 hand-written per-flag byte-identity tests of PRs 2/6/8/9: a new
+flag gets enforcement by REGISTERING its contract, not by writing a
+test.
+
+Mechanics: lower the canonical train step and serving decode
+(analysis/programs.py) once with every contracted flag UNSET — the
+baseline fingerprints — then once per (flag, program) with exactly that
+flag set to its identity value, and compare sha256 fingerprints of the
+traced module text.  Every contract acts at build/trace time, so
+trace-level identity implies compiled identity (and costs no XLA
+compile, which is what makes sweeping the whole table per CI run
+affordable).
+
+A mismatch is an ERROR finding carrying both fingerprints; the sweep
+also returns its coverage rows so the acceptance test can assert 100%
+of `flags.identity_flags()` ran against BOTH programs.
+"""
+from __future__ import annotations
+
+import hashlib
+from typing import Any, Dict, List, Optional, Sequence
+
+from hetu_tpu.analysis.findings import ERROR, INFO, Finding
+from hetu_tpu.analysis.programs import PROGRAMS, scoped_env
+
+
+def fingerprint(text: str) -> str:
+    return hashlib.sha256(text.encode()).hexdigest()[:16]
+
+
+def identity_sweep(only_flags: Optional[Sequence[str]] = None,
+                   programs: Optional[Sequence[str]] = None
+                   ) -> Dict[str, Any]:
+    """Run the sweep; returns {"baseline", "rows", "findings"}.
+
+    rows: one {"flag", "value", "program", "fingerprint", "ok"} per
+    (contracted flag, program) pair — the coverage record.  findings:
+    one ERROR per broken contract + one INFO summarizing the sweep.
+    `only_flags` restricts the table (tools_lint --flags <name> for
+    bisection); coverage claims are only made for what actually ran.
+    """
+    from hetu_tpu.utils import flags as _flags
+    table = _flags.identity_flags()
+    if only_flags:
+        unknown = sorted(set(only_flags) - set(table))
+        if unknown:
+            raise ValueError(
+                f"no identity contract registered for {unknown}; "
+                f"contracted flags: {sorted(table)}")
+        table = {k: v for k, v in table.items() if k in only_flags}
+    prog_names = list(programs if programs is not None else PROGRAMS)
+
+    # every contracted flag is held UNSET for the baseline and for the
+    # other flags' variants — one variant differs from baseline by
+    # exactly one variable
+    all_unset = {name: None for name in _flags.identity_flags()}
+
+    baseline: Dict[str, str] = {}
+    with scoped_env(**all_unset):
+        for prog in prog_names:
+            baseline[prog] = fingerprint(PROGRAMS[prog]())
+
+    rows: List[Dict[str, Any]] = []
+    findings: List[Finding] = []
+    for name, value in sorted(table.items()):
+        for prog in prog_names:
+            with scoped_env(**{**all_unset, name: value}):
+                fp = fingerprint(PROGRAMS[prog]())
+            ok = fp == baseline[prog]
+            rows.append({"flag": name, "value": value, "program": prog,
+                         "fingerprint": fp, "ok": ok})
+            if not ok:
+                findings.append(Finding(
+                    "flag-identity", ERROR, f"flag:{name}/{prog}",
+                    f"{name}={value} must lower the {prog} program "
+                    f"byte-identical to an unset environment, but the "
+                    f"fingerprint moved ({baseline[prog]} -> {fp}) — "
+                    f"the flag's neutral value is not neutral",
+                    {"flag": name, "value": value, "program": prog,
+                     "baseline": baseline[prog], "got": fp}))
+    n_bad = sum(1 for r in rows if not r["ok"])
+    findings.append(Finding(
+        "flag-identity", INFO, "flag:sweep",
+        f"{len(table)} contracted flags x {len(prog_names)} programs: "
+        f"{len(rows) - n_bad}/{len(rows)} identities hold",
+        {"flags": sorted(table), "programs": prog_names,
+         "violations": n_bad}))
+    return {"baseline": baseline, "rows": rows, "findings": findings}

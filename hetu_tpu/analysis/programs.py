@@ -1,0 +1,133 @@
+"""Canonical programs the linter judges: ONE train step and ONE serving
+decode, built the same way every time.
+
+The flag-identity sweep (flag_identity.py) lowers these under each
+contracted flag value and diffs fingerprints against an unset
+environment; tools_lint.py --hlo compiles the train step once and runs
+the HLO lints over its post-optimization text.  Both front ends share
+these builders so "the canonical program" means exactly one thing.
+
+Shapes are tiny on purpose (the sweep lowers the train step a dozen
+times): a 2-layer scanned llama on the dp=4 virtual CPU mesh — the same
+configuration the per-flag byte-identity tests used before the sweep
+replaced them — and the 8-slot serving decode program at page 8 /
+max_len 32.
+
+Every flag under contract acts at Trainer/ServingEngine BUILD time or
+at trace time, so the builders construct FRESH objects per call: the
+caller scopes the environment (``scoped_env``), then builds, then
+lowers.
+"""
+from __future__ import annotations
+
+import contextlib
+import os
+from typing import Dict, Iterator, Optional
+
+import numpy as np
+
+
+@contextlib.contextmanager
+def scoped_env(**vals: Optional[str]) -> Iterator[None]:
+    """Set (value) or unset (None) env vars for the duration."""
+    saved = {k: os.environ.get(k) for k in vals}
+    try:
+        for k, v in vals.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def canonical_batch(n: int = 8, seq: int = 64,
+                    seed: int = 0) -> Dict[str, np.ndarray]:
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, 250, size=(n, seq)).astype(np.int32)
+    return {"input_ids": ids, "labels": ids.copy()}
+
+
+def canonical_trainer(dp: int = 4, zero: bool = False):
+    """The canonical train-step owner: tiny scanned llama, homogeneous
+    dp=4 — reads every training-side flag at build()."""
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+    cfg = LlamaConfig.tiny(remat=False, use_scan=True)
+    st = ParallelStrategy(mesh=MeshConfig(dp=dp), zero=zero)
+    tc = TrainingConfig(global_batch_size=8, micro_batch_size=8 // dp,
+                        seq_len=64, lr=1e-3, warmup_steps=2,
+                        total_steps=10, log_every=1000)
+    return Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+
+
+def canonical_compute_dtype() -> Optional[str]:
+    """The canonical model's declared compute dtype as the dtype-drift
+    lint's token ("bf16"/"f16", None for full-precision) — what
+    tools_lint --hlo defaults --expected-dtype to, through the same
+    `dtype_token` mapping the HETU_TPU_LINT trainer hook applies to
+    model.config."""
+    from hetu_tpu.analysis.hlo_lints import dtype_token
+    from hetu_tpu.models.llama import LlamaConfig
+    return dtype_token(
+        LlamaConfig.tiny(remat=False, use_scan=True).compute_dtype)
+
+
+def train_step_text(*, optimized: bool = False, dp: int = 4,
+                    zero: bool = False) -> str:
+    """Lowered text of the canonical train step under the CURRENT
+    environment (traced module by default; post-optimization HLO with
+    optimized=True — the HLO lints' input)."""
+    tr = canonical_trainer(dp=dp, zero=zero)
+    try:
+        return tr.lowered_step(canonical_batch(), optimized=optimized)
+    finally:
+        tr.close()
+
+
+def serving_decode_text(*, optimized: bool = False) -> str:
+    """Lowered text of the canonical serving decode program under the
+    CURRENT environment (flags read through ServeConfig.from_flags and
+    the engine's build-time kernel routing).  optimized=True pays one
+    XLA compile and returns the post-optimization HLO (the lints'
+    input); the default traced text is the sweep's fingerprint
+    surface."""
+    import jax.numpy as jnp
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.serving import ServeConfig, ServingEngine
+    cfg = LlamaConfig(vocab_size=256, hidden_size=64,
+                      num_hidden_layers=2, num_attention_heads=4,
+                      num_key_value_heads=2, max_position_embeddings=256,
+                      use_flash_attention=False, remat=False,
+                      use_scan=True)
+    model = LlamaLMHeadModel(cfg)
+    import jax
+    params = model.init(jax.random.key(0))
+    eng = ServingEngine(model, params, ServeConfig.from_flags(
+        page_size=8, max_len=32, prefill_chunk=8))
+    try:
+        slots = eng.scheduler.num_slots
+        table = jnp.zeros((slots, eng.scheduler.max_pages), jnp.int32)
+        toks = jnp.zeros(slots, jnp.int32)
+        pos = jnp.zeros(slots, jnp.int32)
+        lowered = eng._decode_jit.lower(
+            params, eng.pool.arrays.tree(), table, toks, pos)
+        return (lowered.compile().as_text() if optimized
+                else lowered.as_text())
+    finally:
+        eng.close()
+
+
+#: program name -> builder of its (unoptimized) lowered text — the
+#: sweep's program axis
+PROGRAMS = {
+    "train": train_step_text,
+    "decode": serving_decode_text,
+}

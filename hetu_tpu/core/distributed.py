@@ -11,7 +11,6 @@ ride our CoordinationServer/Client on top.  One call wires both.
 """
 from __future__ import annotations
 
-import os
 from typing import Optional, Tuple
 
 from hetu_tpu.utils.logging import get_logger
@@ -36,13 +35,16 @@ def distributed_init(coordinator_address: Optional[str] = None,
     """
     import jax
 
-    coordinator_address = coordinator_address or os.environ.get(
-        "HETU_TPU_COORDINATOR")
-    if num_processes is None and os.environ.get("HETU_TPU_NUM_PROCESSES"):
-        num_processes = int(os.environ["HETU_TPU_NUM_PROCESSES"])
-    if process_id is None and os.environ.get("HETU_TPU_PROCESS_ID"):
-        process_id = int(os.environ["HETU_TPU_PROCESS_ID"])
-    control_address = control_address or os.environ.get("HETU_TPU_CONTROL")
+    from hetu_tpu.utils import flags
+    coordinator_address = (coordinator_address
+                           or flags.str_flag("HETU_TPU_COORDINATOR") or None)
+    env_set = flags.active()
+    if num_processes is None and env_set.get("HETU_TPU_NUM_PROCESSES"):
+        num_processes = flags.int_flag("HETU_TPU_NUM_PROCESSES")
+    if process_id is None and env_set.get("HETU_TPU_PROCESS_ID"):
+        process_id = flags.int_flag("HETU_TPU_PROCESS_ID")
+    control_address = (control_address
+                       or flags.str_flag("HETU_TPU_CONTROL") or None)
 
     if coordinator_address and (num_processes or 1) > 1:
         jax.distributed.initialize(

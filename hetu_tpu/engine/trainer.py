@@ -425,6 +425,13 @@ class Trainer:
         if prof is not None and self.run_log is not None:
             self.run_log.log("profile", name=pool_name,
                              plan=str(key)[:500], **prof)
+        # graph-contract lints (HETU_TPU_LINT): donation / replication /
+        # dtype / scope-coverage over this plan's optimized HLO — same
+        # shared as_text, pure post-compile analysis
+        lint_rec = self._maybe_lint(pool_name, _hlo_text)
+        if lint_rec is not None and self.run_log is not None:
+            self.run_log.log("lint", name=pool_name,
+                             plan=str(key)[:500], **lint_rec)
         self._check_budgets(pool_name, prof, est, comm)
 
     def _maybe_profile(self, plan, hlo_text_fn=None):
@@ -459,6 +466,41 @@ class Trainer:
             return prof
         except Exception as e:
             logger.warning(f"per-compile profile failed: {e!r}")
+            return None
+
+    def _maybe_lint(self, pool_name, hlo_text_fn):
+        """The flag-gated per-compile graph-contract lint record
+        (hetu_tpu/analysis/hlo_lints over this plan's optimized HLO), or
+        None.  Error findings log loudly and count `lint.errors` but
+        NEVER fail the step — tools_lint.py / the tier-1 acceptance test
+        are the enforcing surfaces; a training run only observes.  Pure
+        post-compile HLO-text analysis: the traced program is identical
+        with the flag on or off (identity contract in utils/flags.py)."""
+        from hetu_tpu.utils import flags as _flags
+        if not _flags.bool_flag("HETU_TPU_LINT"):
+            return None
+        try:
+            from hetu_tpu.analysis.findings import lint_record
+            from hetu_tpu.analysis.hlo_lints import dtype_token, lint_hlo
+            expected = dtype_token(getattr(
+                getattr(self.model, "config", None), "compute_dtype", None))
+            findings = lint_hlo(hlo_text_fn(), expected_dtype=expected,
+                                program=pool_name)
+            rec = lint_record(findings)
+            if rec["findings"]:
+                self._registry.inc("lint.findings", rec["findings"],
+                                   pool=pool_name)
+            if rec["errors"]:
+                self._registry.inc("lint.errors", rec["errors"],
+                                   pool=pool_name)
+                for msg in rec.get("messages", []):
+                    logger.warning(f"lint ({pool_name}): {msg}")
+            if rec["warnings"]:
+                self._registry.inc("lint.warnings", rec["warnings"],
+                                   pool=pool_name)
+            return rec
+        except Exception as e:
+            logger.warning(f"per-compile lint failed: {e!r}")
             return None
 
     def _check_budgets(self, pool_name, prof, est, comm):
@@ -835,12 +877,19 @@ class Trainer:
             out[k] = jax.device_put(v, self._batch_sharding(v.ndim))
         return out
 
+    @staticmethod
+    def _shape_key(host_batch):
+        """THE per-batch-shape cache key — one construction shared by
+        _memo_by_shape and lowered_step so the report caches and the
+        linter's compiled-text path can never diverge."""
+        return tuple(sorted((k, tuple(np.asarray(v).shape))
+                            for k, v in host_batch.items()))
+
     def _memo_by_shape(self, attr: str, host_batch, compute):
         """Per-batch-shape memo shared by the report surfaces (memory/
         phase/mfu): ONE key construction so the three caches can never
         diverge.  `compute(key)` runs on miss."""
-        key = tuple(sorted((k, tuple(v.shape))
-                           for k, v in host_batch.items()))
+        key = self._shape_key(host_batch)
         cache = self.__dict__.setdefault(attr, {})
         if key not in cache:
             cache[key] = compute(key)
@@ -867,6 +916,27 @@ class Trainer:
                                     + out.get("temp_size", 0))
             return out
         return self._memo_by_shape("_memory_reports", host_batch, compute)
+
+    def lowered_step(self, host_batch, *, optimized: bool = False) -> str:
+        """The train step's lowered module text for this batch shape.
+
+        optimized=False (default) returns the TRACED pre-optimization
+        module — one trace, no XLA compile: the flag-identity sweep's
+        fingerprint surface (hetu_tpu/analysis/flag_identity.py; every
+        flag contract acts at trace/build time, so trace-level identity
+        implies compiled identity).  optimized=True returns the
+        post-optimization text of the AOT compile, shared with
+        memory_report/phase_report via the per-shape memo — what the
+        HLO lints (tools_lint.py --hlo) walk."""
+        if optimized:
+            return self._compiled_for_shape(
+                host_batch, self._shape_key(host_batch)).as_text()
+        batches = self.prepare_batch(host_batch)
+        rng = jax.random.key(0)
+        with use_mesh(self.mesh), self._declared():
+            return self._step_fn.lower(
+                self.params, self.opt_state, batches, rng,
+                self.scaler_state).as_text()
 
     def _compiled_for_shape(self, host_batch, key):
         """AOT lower().compile() of the step for this batch shape — ONE

@@ -17,6 +17,13 @@ the wire per participant under the standard ring algorithms:
 cross-validation test pins the two together).  `n` is parsed from each
 op's replica_groups.
 
+ALL text parsing lives in `hetu_tpu.obs.hlo_text` — the one tokenizer
+shared with the step profiler (obs/hlo_profile.py) and the
+graph-contract linter (hetu_tpu/analysis/): line anatomy, payload
+resolution (sync vs async "-start" forms), replica_groups (explicit and
+iota), and the while-trip machinery below.  This module owns only the
+aggregation and the topology-aware pricing.
+
 Scanned layers: a collective inside a `while` body (scan-over-layers,
 grad-accumulation) executes TRIP-COUNT times per step, not once.  The
 analyzer resolves each while's trip count from its condition computation
@@ -44,199 +51,14 @@ and per-path tables), and the ZeRO-1 HLO-assertion test (reduce-scatter
 """
 from __future__ import annotations
 
-import re
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Dict, List, Optional
 
 from hetu_tpu.comm.wire import analytic_dp_sync  # noqa: F401  (re-export)
-
-#: collective opcodes we account (async "-start" forms fold into these)
-COLLECTIVE_OPS = ("all-reduce", "reduce-scatter", "all-gather",
-                  "all-to-all", "collective-permute")
-
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1,
-                "f8e5m2": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4,
-                "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1, "c64": 8,
-                "c128": 16}
-
-# `%x = <shapes> opcode(...)` — same output-section anchoring as
-# utils.profiling.phase_breakdown: shapes AFTER '=' and BEFORE the opcode
-# token; operand shapes (inside the parens) must not count
-_LINE_PAT = re.compile(r'=\s*(?P<out>.*?)\s*(?P<op>[a-z][a-z0-9_.-]*)\(')
-_SHAPE_PAT = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
-_GROUPS_PAT = re.compile(r'replica_groups=\{(\{[0-9,{} ]*\})\}')
-_IOTA_GROUPS_PAT = re.compile(
-    r'replica_groups=\[(\d+),(\d+)\]<=(?:\[[\d,]+\])(T\([\d,]+\))?')
-
-# computation structure (while-loop trip counts)
-_COMP_HEAD_PAT = re.compile(
-    r'^\s*(?:ENTRY\s+)?%?([\w.\-]+)\s*\(.*\)\s*->\s*.*\{')
-_WHILE_PAT = re.compile(r'=\s*[^=]*\bwhile\(')
-_COND_REF_PAT = re.compile(r'condition=%?([\w.\-]+)')
-_BODY_REF_PAT = re.compile(r'body=%?([\w.\-]+)')
-_CONST_PAT = re.compile(
-    r'%?([\w.\-]+)\s*=\s*[su]\d+\[\]\s+constant\((\d+)\)')
-_COMPARE_PAT = re.compile(
-    r'compare\(\s*\S+\s+%?([\w.\-]+),\s*\S+\s+%?([\w.\-]+)\s*\)')
-_DIRECTION_PAT = re.compile(r'direction=(\w+)')
-
-
-def _component_bytes(section: str):
-    out = []
-    for dt, dims in _SHAPE_PAT.findall(section):
-        numel = 1
-        for d in dims.split(","):
-            if d:
-                numel *= int(d)
-        out.append(numel * _DTYPE_BYTES.get(dt, 4))
-    return out
-
-
-def _payload_bytes(section: str, is_start: bool) -> int:
-    """Payload of one collective from its output-shape section.
-
-    Sync forms: the output IS the payload (sum tuple components — a tuple
-    all-to-all's components add up to the local buffer).  Async "-start"
-    forms output a tuple carrying the OPERAND buffer(s) too —
-    (operand, result, context...) — so summing would double-count; the
-    largest component is the full transfer buffer for every async
-    collective (result for all-gather, operand for reduce-scatter, either
-    for all-reduce/permute), and `_wire_bytes` applies full-buffer
-    formulas for starts."""
-    comps = _component_bytes(section)
-    if not comps:
-        return 0
-    return max(comps) if is_start else sum(comps)
-
-
-def _first_group(line: str, default_world: int
-                 ) -> Tuple[int, Optional[Tuple[int, ...]]]:
-    """(group size, first group's rank list when recoverable) of a
-    collective instruction."""
-    m = _GROUPS_PAT.search(line)
-    if m:
-        first = m.group(1).split("}")[0].lstrip("{")
-        ranks = tuple(int(t) for t in first.split(",") if t.strip())
-        return max(len(ranks), 1), (ranks or None)
-    m = _IOTA_GROUPS_PAT.search(line)
-    if m:  # iota form [num_groups, group_size]<=[world](T(perm))?
-        g, s = int(m.group(1)), int(m.group(2))
-        if m.group(3):  # transposed iota: group 0 strides by num_groups
-            ranks = tuple(range(0, g * s, g))[:s]
-        else:           # contiguous iota: group 0 = [0, s)
-            ranks = tuple(range(s))
-        return max(s, 1), ranks
-    return max(default_world, 1), None
-
-
-def _wire_bytes(op: str, payload: int, n: int, is_start: bool) -> float:
-    """Per-participant ring wire bytes.  `payload` is the output-section
-    payload (_payload_bytes): for sync reduce-scatter that is the SHARD
-    (output), for async starts it is the FULL buffer — hence the two
-    reduce-scatter formulas."""
-    if op == "collective-permute":
-        # point-to-point: one hop, group size does not apply (the op
-        # carries source_target_pairs, not replica_groups)
-        return float(payload)
-    if n <= 1:
-        return 0.0
-    if op == "all-reduce":
-        return 2.0 * (n - 1) / n * payload
-    if op == "all-gather":
-        return (n - 1) / n * payload
-    if op == "reduce-scatter":
-        if is_start:  # payload = full input buffer
-            return (n - 1) / n * payload
-        return float(n - 1) * payload  # payload = the output shard
-    if op == "all-to-all":
-        return (n - 1) / n * payload
-    return 0.0
-
-
-# ---------------------------------------------------------------------------
-# computation structure: while-loop trip counts
-# ---------------------------------------------------------------------------
-
-def _split_computations(txt: str) -> Dict[str, List[str]]:
-    """HLO text -> {computation name: its instruction lines}.  Text with
-    no computation headers (synthetic snippets) maps to one anonymous
-    computation holding every line."""
-    comps: Dict[str, List[str]] = {}
-    cur: Optional[str] = None
-    loose: List[str] = []
-    for line in txt.splitlines():
-        m = _COMP_HEAD_PAT.match(line)
-        if m:
-            cur = m.group(1)
-            comps[cur] = []
-            continue
-        if line.strip() == "}":
-            cur = None
-            continue
-        (comps[cur] if cur is not None else loose).append(line)
-    if loose:
-        comps[""] = loose
-    return comps
-
-
-def _cond_trip_count(lines: List[str]) -> Optional[int]:
-    """Trip count from a while condition computation: the
-    `compare(induction, constant), direction=LT` form lax.scan lowers to
-    (0-based, unit step).  Non-zero-start loops (fori_loop(2, 10, ...))
-    are safe too: XLA's while canonicalization rebases the induction to
-    0 and folds the start into the bound BEFORE the post-optimization
-    text this module parses (regression-pinned in test_comm).  None =
-    not statically recoverable."""
-    consts = {name: int(val)
-              for name, val in (_CONST_PAT.search(ln).groups()
-                                for ln in lines if _CONST_PAT.search(ln))}
-    for ln in lines:
-        cm = _COMPARE_PAT.search(ln)
-        if cm is None:
-            continue
-        dm = _DIRECTION_PAT.search(ln)
-        direction = dm.group(1) if dm else ""
-        lhs, rhs = cm.group(1), cm.group(2)
-        if direction == "LT" and rhs in consts:
-            return consts[rhs]
-        if direction == "GT" and lhs in consts:
-            return consts[lhs]
-    return None
-
-
-def _comp_multipliers(comps: Dict[str, List[str]]
-                      ) -> Dict[str, Tuple[int, bool]]:
-    """{computation: (effective trip multiplier, dynamic?)} — body
-    computations inherit their parent's multiplier times their while's
-    trip count; nested whiles compose.  dynamic=True marks an enclosing
-    while whose trip could not be resolved (multiplier stays 1 for it)."""
-    parent: Dict[str, Tuple[str, Optional[int]]] = {}
-    for cname, lines in comps.items():
-        for ln in lines:
-            if " while(" not in ln and not _WHILE_PAT.search(ln):
-                continue
-            bm = _BODY_REF_PAT.search(ln)
-            cm = _COND_REF_PAT.search(ln)
-            if bm is None:
-                continue
-            trip = None
-            if cm is not None and cm.group(1) in comps:
-                trip = _cond_trip_count(comps[cm.group(1)])
-            parent[bm.group(1)] = (cname, trip)
-
-    memo: Dict[str, Tuple[int, bool]] = {}
-
-    def mult(name: str, seen=()) -> Tuple[int, bool]:
-        if name in memo:
-            return memo[name]
-        if name not in parent or name in seen:
-            return (1, False)
-        pname, trip = parent[name]
-        pm, pdyn = mult(pname, seen + (name,))
-        out = (pm * (trip if trip else 1), pdyn or trip is None)
-        memo[name] = out
-        return out
-
-    return {name: mult(name) for name in comps}
+from hetu_tpu.obs.hlo_text import (COLLECTIVE_OPS,  # noqa: F401 (re-export)
+                                   as_hlo_text, first_group,
+                                   maybe_collective, payload_bytes,
+                                   ring_wire_bytes, split_computations,
+                                   while_multipliers)
 
 
 # ---------------------------------------------------------------------------
@@ -250,35 +72,24 @@ def collective_table(compiled_or_text, default_world: int = 1
     group_ranks, line}.  wire_bytes is PER EXECUTION; multiply by
     trip_count for per-step totals (collective_report does).  Accepts a
     compiled executable (as_text()) or the HLO text itself."""
-    txt = (compiled_or_text if isinstance(compiled_or_text, str)
-           else compiled_or_text.as_text())
-    comps = _split_computations(txt)
-    mults = _comp_multipliers(comps)
+    txt = as_hlo_text(compiled_or_text)
+    comps = split_computations(txt)
+    mults = while_multipliers(comps)
     rows = []
     for cname, lines in comps.items():
         trip, dynamic = mults.get(cname, (1, False))
         for line in lines:
-            # cheap prefilter before the regex work
-            if "all-" not in line and "reduce-scatter" not in line \
-                    and "collective-permute" not in line:
+            found = maybe_collective(line)
+            if found is None:
                 continue
-            m = _LINE_PAT.search(line)
-            if m is None:
-                continue
-            op = m.group("op")
-            if op.endswith("-done"):
-                continue  # the -start carries the payload
-            is_start = op.endswith("-start")
-            base = op[:-6] if is_start else op
-            if base not in COLLECTIVE_OPS:
-                continue
-            out_bytes = _payload_bytes(m.group("out"), is_start)
-            n, ranks = _first_group(line, default_world)
+            base, is_start, m = found
+            out_bytes = payload_bytes(m.group("out"), is_start)
+            n, ranks = first_group(line, default_world)
             rows.append({
                 "op": base,
                 "out_bytes": out_bytes,
                 "group_size": n,
-                "wire_bytes": _wire_bytes(base, out_bytes, n, is_start),
+                "wire_bytes": ring_wire_bytes(base, out_bytes, n, is_start),
                 "trip_count": trip,
                 "dynamic_trip": dynamic,
                 "group_ranks": ranks,

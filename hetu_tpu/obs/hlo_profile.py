@@ -24,6 +24,13 @@ text walk:
   (`flame_trace` — a Chrome-trace lane of predicted per-group times
   next to the schedule traces obs.trace already draws).
 
+ALL HLO-text parsing primitives (line anatomy, shapes, collectives,
+while-trip/call-graph multipliers, dot FLOPs, donation contracts) live
+in `hetu_tpu.obs.hlo_text` — one tokenizer shared with the bytes-on-wire
+analyzer (obs/comm.py) and the graph-contract linter
+(hetu_tpu/analysis/).  This module owns only the attribution, roofline
+and liveness ACCOUNTING layered on top.
+
 * **peak-HBM estimate** (`peak_hbm_estimate`) — a liveness sweep over
   the HLO: every non-parameter instruction's output buffer is live from
   its definition to its last use; while bodies contribute their own
@@ -56,10 +63,12 @@ from __future__ import annotations
 import re
 from typing import Any, Dict, List, Optional, Tuple
 
-from hetu_tpu.obs.comm import (COLLECTIVE_OPS, _cond_trip_count,
-                               _first_group, _payload_bytes,
-                               _split_computations, _wire_bytes)
-from hetu_tpu.utils.profiling import PHASES, _DTYPE_BYTES
+from hetu_tpu.obs.hlo_text import (BRANCH_PAT, DEF_PAT, OP_NAME_PAT,
+                                   OUT_PAT, REF_PAT, as_hlo_text,
+                                   call_multipliers, dot_flops,
+                                   entry_computation, line_wire_bytes,
+                                   shape_bytes, split_computations)
+from hetu_tpu.utils.profiling import PHASES
 
 #: version stamp of the `profile` RunLog record / BENCH detail.profile
 #: payload (the same stability contract as obs.runlog.SCHEMA_VERSION:
@@ -78,18 +87,10 @@ EXTRA_GROUPS = ("optimizer", "grad_sync")
 #: aggregated across groups by `kernel_table`
 KERNEL_SCOPE_PREFIX = "pallas_"
 
-_OP_PAT = re.compile(r'op_name="([^"]+)"')
-_SHAPE_PAT = re.compile(r'\b([a-z][a-z0-9]*)\[([0-9,]*)\]')
-_OUT_PAT = re.compile(r'=\s*(.*?)\s*[a-z][a-z0-9_.-]*\(')
-_DEF_PAT = re.compile(r'%([\w.\-]+)\s*=\s*(.*?)\s*([a-z][a-z0-9_.-]*)\(')
-_REF_PAT = re.compile(r'%([\w.\-]+)')
+# scope-path patterns (the profiler's own layer — everything below the
+# line/shape level comes from obs.hlo_text)
 _LAYER_SEG_PAT = re.compile(r'^layer(_\d+)?$')
-_DOT_CONTRACT_PAT = re.compile(r'lhs_contracting_dims=\{([0-9,]*)\}')
 _TRANSFORM_PAT = re.compile(r'^[\w.\-]+\((.*)\)$')
-_CALLEE_PAT = re.compile(
-    r'(?:calls|body|condition|to_apply)=%?([\w.\-]+)')
-_BRANCH_PAT = re.compile(r'branch_computations=\{([^}]*)\}')
-_ENTRY_PAT = re.compile(r'^ENTRY\s+%?([\w.\-]+)', re.M)
 
 
 # ---------------------------------------------------------------------------
@@ -142,101 +143,6 @@ def group_of(op_name: str, phases: Tuple[str, ...] = PHASES) -> str:
     return f"{base}/{kernel}" if kernel else base
 
 
-def _shape_bytes(section: str) -> int:
-    total = 0
-    for dt, dims in _SHAPE_PAT.findall(section):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        total += n * _DTYPE_BYTES.get(dt, 4)
-    return total
-
-
-def _dot_flops(line: str) -> float:
-    """FLOPs of one `dot(...)` line: 2 * out_elems * contraction size,
-    contraction parsed from the FIRST operand shape (inside the parens)
-    and `lhs_contracting_dims`.  0.0 when not statically parseable."""
-    om = _OUT_PAT.search(line)
-    if om is None:
-        return 0.0
-    out_elems = 0
-    for dt, dims in _SHAPE_PAT.findall(om.group(1)):
-        n = 1
-        for d in dims.split(","):
-            if d:
-                n *= int(d)
-        out_elems += n
-    paren = line.find(" dot(")
-    if paren < 0:
-        return 0.0
-    operands = line[paren + 5:]
-    lhs = _SHAPE_PAT.search(operands)
-    cm = _DOT_CONTRACT_PAT.search(line)
-    if lhs is None or cm is None:
-        return 0.0
-    lhs_dims = [int(d) for d in lhs.group(2).split(",") if d]
-    contract = 1
-    for idx in cm.group(1).split(","):
-        if idx and int(idx) < len(lhs_dims):
-            contract *= lhs_dims[int(idx)]
-    return 2.0 * out_elems * contract
-
-
-# ---------------------------------------------------------------------------
-# computation call graph (trip-count multipliers incl. fusions/calls)
-# ---------------------------------------------------------------------------
-
-def _call_multipliers(comps: Dict[str, List[str]]
-                      ) -> Dict[str, Tuple[float, bool]]:
-    """{computation: (execution multiplier, dynamic?)} — like obs.comm's
-    while-body multipliers but following EVERY call edge (fusion
-    `calls=`, `to_apply=`, conditional branches at x1; while bodies at
-    their resolved trip count), so a dot inside a fusion inside a
-    scanned layer still multiplies by the layer count."""
-    parent: Dict[str, Tuple[str, Optional[float]]] = {}
-    for cname, lines in comps.items():
-        for ln in lines:
-            is_while = " while(" in ln
-            trip: Optional[float] = 1.0
-            if is_while:
-                cm = re.search(r'condition=%?([\w.\-]+)', ln)
-                trip = None
-                if cm is not None and cm.group(1) in comps:
-                    t = _cond_trip_count(comps[cm.group(1)])
-                    trip = float(t) if t else None
-            for m in _CALLEE_PAT.finditer(ln):
-                callee = m.group(1)
-                if callee not in comps:
-                    continue
-                # while body multiplies by trip; its condition (and any
-                # plain call/fusion) executes with the caller's cadence
-                t = trip if (is_while and ln[m.start():m.start() + 4]
-                             == "body") else 1.0
-                # first caller wins; HLO computations have one caller
-                parent.setdefault(callee, (cname, t))
-            bm = _BRANCH_PAT.search(ln)
-            if bm:
-                for callee in _REF_PAT.findall(bm.group(1)):
-                    if callee in comps:
-                        parent.setdefault(callee, (cname, 1.0))
-
-    memo: Dict[str, Tuple[float, bool]] = {}
-
-    def mult(name: str, seen=()) -> Tuple[float, bool]:
-        if name in memo:
-            return memo[name]
-        if name not in parent or name in seen:
-            return (1.0, False)
-        pname, trip = parent[name]
-        pm, pdyn = mult(pname, seen + (name,))
-        out = (pm * (trip if trip else 1.0), pdyn or trip is None)
-        memo[name] = out
-        return out
-
-    return {name: mult(name) for name in comps}
-
-
 # ---------------------------------------------------------------------------
 # per-group attribution
 # ---------------------------------------------------------------------------
@@ -258,10 +164,9 @@ def layer_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
     reconcile with `obs.comm.collective_report` instead (which resolves
     the same trip counts) — both are the attribution-consistency
     contract the tests pin."""
-    txt = (compiled_or_text if isinstance(compiled_or_text, str)
-           else compiled_or_text.as_text())
-    comps = _split_computations(txt)
-    mults = (_call_multipliers(comps) if apply_multipliers
+    txt = as_hlo_text(compiled_or_text)
+    comps = split_computations(txt)
+    mults = (call_multipliers(comps) if apply_multipliers
              else {name: (1.0, False) for name in comps})
     out: Dict[str, Dict[str, float]] = {}
     dynamic = False
@@ -273,7 +178,7 @@ def layer_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
     for cname, lines in comps.items():
         mult, dyn = mults.get(cname, (1.0, False))
         for line in lines:
-            m = _OP_PAT.search(line)
+            m = OP_NAME_PAT.search(line)
             if m is None:
                 # instructions without op_name metadata are outside the
                 # phase accounting (phase_breakdown skips them too — the
@@ -281,7 +186,7 @@ def layer_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
                 # without metadata still moves real bytes: count its
                 # wire bytes into "other" so wire sums reconcile with
                 # obs.comm.collective_report on EVERY program
-                wb = _line_wire_bytes(line, default_world)
+                wb = line_wire_bytes(line, default_world)
                 if wb > 0:
                     out.setdefault("other", new_row())["wire_bytes"] += \
                         wb * mult
@@ -292,16 +197,16 @@ def layer_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
             rec["instructions"] += mult
             if " dot(" in line or " convolution(" in line:
                 rec["dots"] += mult
-                rec["flops"] += _dot_flops(line) * mult
+                rec["flops"] += dot_flops(line) * mult
                 if " convolution(" in line:
                     # conv FLOPs are not statically parsed (no conv in
                     # the model zoo today) — surface the undercount
                     # instead of silently attributing 0
                     conv_unparsed = True
-            om = _OUT_PAT.search(line)
+            om = OUT_PAT.search(line)
             if om is not None:
-                rec["out_bytes"] += _shape_bytes(om.group(1)) * mult
-            rec["wire_bytes"] += _line_wire_bytes(line, default_world) * mult
+                rec["out_bytes"] += shape_bytes(om.group(1)) * mult
+            rec["wire_bytes"] += line_wire_bytes(line, default_world) * mult
     meta = {}
     if dynamic:
         meta["dynamic_trip_count"] = True
@@ -310,27 +215,6 @@ def layer_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
     if meta:
         out["_meta"] = meta
     return out
-
-
-def _line_wire_bytes(line: str, default_world: int) -> float:
-    """Ring wire bytes of one instruction line (0 for non-collectives) —
-    the same opcode set and formulas obs.comm's collective_table uses."""
-    if ("all-" not in line and "reduce-scatter" not in line
-            and "collective-permute" not in line):
-        return 0.0
-    m = _DEF_PAT.search(line)
-    if m is None:
-        return 0.0
-    op = m.group(3)
-    if op.endswith("-done"):
-        return 0.0
-    is_start = op.endswith("-start")
-    base = op[:-6] if is_start else op
-    if base not in COLLECTIVE_OPS:
-        return 0.0
-    payload = _payload_bytes(m.group(2), is_start)
-    n, _ranks = _first_group(line, default_world)
-    return _wire_bytes(base, payload, n, is_start)
 
 
 def kernel_table(compiled_or_text, *, phases: Tuple[str, ...] = PHASES,
@@ -469,16 +353,16 @@ def _comp_peak(comps: Dict[str, List[str]], name: str,
         return roots.get(nm, (nm,))
 
     for i, ln in enumerate(lines):
-        m = _DEF_PAT.search(ln)
+        m = DEF_PAT.search(ln)
         if m is None:
             parsed.append(None)
             continue
         nm, op = m.group(1), m.group(3)
-        operands = [r for r in _REF_PAT.findall(ln) if r != nm]
+        operands = [r for r in REF_PAT.findall(ln) if r != nm]
         b = 0 if op in ("parameter",) + _ALIAS_OPS \
-            else _shape_bytes(m.group(2))
+            else shape_bytes(m.group(2))
         if op == "parameter" and donated:
-            persistent[nm] = _shape_bytes(m.group(2))
+            persistent[nm] = shape_bytes(m.group(2))
         if op in _ALIAS_OPS:
             rs: Tuple[str, ...] = ()
             for o in operands:
@@ -491,8 +375,8 @@ def _comp_peak(comps: Dict[str, List[str]], name: str,
                 transient[i] = _comp_peak(comps, bm.group(1), memo,
                                           seen + (name,))
         elif op == "conditional":
-            bm = _BRANCH_PAT.search(ln)
-            branches = (_REF_PAT.findall(bm.group(1)) if bm else [])
+            bm = BRANCH_PAT.search(ln)
+            branches = (REF_PAT.findall(bm.group(1)) if bm else [])
             for cm in re.finditer(r'(?:true|false)_computation='
                                   r'%?([\w.\-]+)', ln):
                 branches.append(cm.group(1))
@@ -585,14 +469,13 @@ def peak_hbm_estimate(compiled_or_text, *,
     txt = text if text is not None else (
         compiled_or_text if isinstance(compiled_or_text, str)
         else compiled_or_text.as_text())
-    comps = _split_computations(txt)
-    em = _ENTRY_PAT.search(txt)
-    entry = em.group(1) if em is not None else next(iter(comps), "")
+    comps = split_computations(txt)
+    entry = entry_computation(txt, comps)
     args_bytes = 0.0
     for ln in comps.get(entry, []):
-        m = _DEF_PAT.search(ln)
+        m = DEF_PAT.search(ln)
         if m is not None and m.group(3) == "parameter":
-            args_bytes += _shape_bytes(m.group(2))
+            args_bytes += shape_bytes(m.group(2))
     # a module that declares input_output_alias writes (some) outputs
     # over its donated argument buffers — the entry sweep may model
     # in-place reuse of dying parameter storage

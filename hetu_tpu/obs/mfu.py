@@ -126,7 +126,8 @@ def load_hardware_profile(path: Optional[str] = None) -> Dict[str, Any]:
     candidates = []
     if path:
         candidates.append(path)
-    env = os.environ.get("HETU_TPU_HW_PROFILE")
+    from hetu_tpu.utils import flags
+    env = flags.str_flag("HETU_TPU_HW_PROFILE")
     if env:
         candidates.append(env)
     root = os.path.dirname(os.path.dirname(os.path.dirname(

@@ -39,6 +39,11 @@ Kind fields:
     budget        name, ok, breaches, budget — declared-perf-budget
                   check per fresh compile (obs.budget,
                   HETU_TPU_BUDGETS)
+    lint          name, plan, findings, errors, warnings, lints (per-lint
+                  counts), messages (first error/warning lines) — the
+                  per-compile graph-contract lint record
+                  (hetu_tpu/analysis, HETU_TPU_LINT=1,
+                  docs/static_analysis.md)
     rotated       segment, records — the size-cap rotation marker (the
                   last record of a rotated segment)
     summary       metrics (a MetricsRegistry snapshot), profiler summary

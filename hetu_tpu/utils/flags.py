@@ -28,17 +28,29 @@ class Flag:
     default: object
     doc: str
     choices: Optional[Tuple[str, ...]] = None
+    #: the BYTE-IDENTITY contract, declared where the flag lives: setting
+    #: the flag to this value must lower the canonical train-step AND
+    #: serving-decode programs to exactly the text an unset environment
+    #: lowers (for routing flags that is the neutral value — "none",
+    #: "flat", "0"; for post-compile analysis flags it is "1": turning the
+    #: analysis ON must not perturb the traced program).  None = no such
+    #: contract (the flag legitimately changes shapes/routing).  Enforced
+    #: systematically by the graph-contract linter's flag-identity sweep
+    #: (hetu_tpu/analysis/flag_identity.py, tools_lint.py --flags), which
+    #: replaced the per-flag hand-written byte-identity tests.
+    identity: Optional[str] = None
 
 
 REGISTRY: Dict[str, Flag] = {f.name: f for f in [
     # -- profiling / observability (reference: HETU_EVENT_TIMING,
     #    HETU_MEMORY_PROFILE, profiler.h) --------------------------------
     Flag("HETU_TPU_EVENT_TIMING", "bool", False,
-         "log per-step wall time from the trainer loop"),
+         "log per-step wall time from the trainer loop", identity="1"),
     Flag("HETU_TPU_TRACE_DIR", "str", "",
          "capture a jax.profiler trace of a step window into this dir"),
     Flag("HETU_TPU_MEMORY_PROFILE", "bool", False,
-         "log per-step device memory stats + compiled-plan memory analysis"),
+         "log per-step device memory stats + compiled-plan memory analysis",
+         identity="1"),
     Flag("HETU_TPU_SWITCH_PROFILE", "bool", False,
          "per-hot-switch byte accounting (ProfileRunningDetails analog); "
          "off by default — the tree walk costs host time per switch"),
@@ -72,7 +84,7 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "grads, grad-norm blowups, step-time regressions and data-pipeline "
          "stalls -> health.* counters + 'anomaly' RunLog events.  Costs a "
          "per-step device sync for loss/grad_norm; off (default) = zero "
-         "per-step work"),
+         "per-step work", identity="1"),
     Flag("HETU_TPU_HW_PROFILE", "str", "",
          "hardware profile JSON for the MFU/roofline reporter (obs.mfu); "
          "default: repo-root hardware_profile_v5e.json, else built-in v5e "
@@ -83,7 +95,7 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "layer/op-group) + liveness-based peak-HBM estimate -> a "
          "schema-versioned 'profile' RunLog record per fresh compile.  "
          "Pure post-compile HLO-text analysis: the traced program is "
-         "byte-identical with the flag on or off"),
+         "byte-identical with the flag on or off", identity="1"),
     Flag("HETU_TPU_PROFILE_TOPK", "int", 8,
          "how many top layers/op-groups (by predicted roofline time) the "
          "'profile' RunLog record and BENCH detail.profile carry"),
@@ -102,7 +114,16 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "per-compile bytes-on-wire analysis (obs.comm) in RunLog compile "
          "events; costs one as_text() of the optimized HLO per fresh "
          "compile — set 0 on very large programs where stringifying the "
-         "module is noticeable next to the compile itself"),
+         "module is noticeable next to the compile itself", identity="0"),
+    Flag("HETU_TPU_LINT", "bool", False,
+         "per-compile graph-contract lints (hetu_tpu/analysis/hlo_lints): "
+         "run the donation / replication / dtype-drift / scope-coverage "
+         "lints over each fresh compile's optimized HLO -> a 'lint' "
+         "RunLog record + lint.* counters (error findings log loudly but "
+         "never fail the step — tools_lint.py is the enforcing surface).  "
+         "Pure post-compile HLO-text analysis: the traced program is "
+         "byte-identical with the flag on or off; see "
+         "docs/static_analysis.md", identity="1"),
     Flag("HETU_TPU_MAX_PLANS", "int", 8,
          "max compiled train-step plans per strategy (one per batch-shape "
          "bucket); a new shape past the cap is a loud error instead of a "
@@ -115,7 +136,8 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "int4 = packed two-per-byte (~7.8x fewer bytes), -ef variants "
          "carry error-feedback residuals in the optimizer state; see "
          "docs/comm_compression.md",
-         choices=("none", "int8", "int8-ef", "int4", "int4-ef")),
+         choices=("none", "int8", "int8-ef", "int4", "int4-ef"),
+         identity="none"),
     Flag("HETU_TPU_SP_COMPRESS", "str", "none",
          "quantized SP/TP activation collectives (comm/collectives.py): "
          "the explicit shard_map paths' all-gathers/reduce-scatters/"
@@ -123,7 +145,7 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "blockwise int8/int4 + f32 scales instead of full-width floats; "
          "backward transports quantize too (custom_vjp transpose).  none "
          "(default) is HLO-byte-identical to unset",
-         choices=("none", "int8", "int4")),
+         choices=("none", "int8", "int4"), identity="none"),
     Flag("HETU_TPU_ZERO_COMPRESS", "str", "none",
          "quantized ZeRO-1/2 param refresh (optim/zero_refresh.py): the "
          "optimizer update runs on dp-sharded state inside a shard_map "
@@ -131,7 +153,7 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "of GSPMD's f32 param all-gather (~3.9x/7.8x fewer refresh "
          "bytes).  Same homogeneous-DP envelope as GRAD_COMPRESS; none "
          "(default) is HLO-byte-identical to unset",
-         choices=("none", "int8", "int4")),
+         choices=("none", "int8", "int4"), identity="none"),
     Flag("HETU_TPU_COMM_TOPOLOGY", "str", "flat",
          "collective routing over the hardware profile's `topology` "
          "section (comm/topology.py): two_level runs the DP grad sync "
@@ -139,7 +161,7 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "exchange of the 1/slice shard -> intra-slice all-gather, "
          "HetCCL-style) so inter-slice links move slice_devices-fold "
          "fewer bytes.  flat (default) is HLO-byte-identical to unset",
-         choices=("flat", "two_level")),
+         choices=("flat", "two_level"), identity="flat"),
     # -- serving (hetu_tpu/serving, docs/serving.md) ---------------------
     Flag("HETU_TPU_KV_QUANT", "str", "none",
          "paged-KV-cache page mode (serving/kv_pool.py): int8 stores "
@@ -148,7 +170,7 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "cache at hd=128, ~1.9x vs bf16).  none (default) stores exact "
          "pages in the model compute dtype — byte-identical semantics to "
          "models/generation.init_cache",
-         choices=("none", "int8")),
+         choices=("none", "int8"), identity="none"),
     Flag("HETU_TPU_SERVE_SLOTS", "int", 8,
          "serving engine decode-slot count (the static batch dimension "
          "of the continuous-batching decode program)"),
@@ -172,12 +194,13 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "TPU only), 1 (force the kernels; unsupported shapes raise), "
          "0 (force the XLA compositions — byte-identical to the seed "
          "lowering, tested)",
-         choices=("auto", "1", "0")),
+         choices=("auto", "1", "0"), identity="0"),
     Flag("HETU_TPU_PALLAS_KERNELS", "str", "",
          "restrict WHICH Pallas kernels participate in HETU_TPU_PALLAS "
          "routing: comma list over {flash, norm, swiglu, rotary, quant, "
          "paged_attn}, or 'all' (default: empty = all) / 'none' — lets "
-         "one kernel be bisected out without losing the rest"),
+         "one kernel be bisected out without losing the rest",
+         identity="all"),
     Flag("HETU_TPU_CP_SPLIT", "str", "sym",
          "default context-parallel split pattern "
          "(reference: HETU_PARALLEL_ATTN_SPLIT_PATTERN SYM/STRIPE/NORMAL)",
@@ -245,6 +268,17 @@ def int_flag(name: str) -> int:
     f = _lookup(name)
     raw = os.environ.get(name)
     return int(raw) if raw else int(f.default)
+
+
+def identity_flags() -> Dict[str, str]:
+    """{flag name: identity value} for every registered flag carrying a
+    byte-identity contract — THE declarative contract table the
+    flag-identity sweep (hetu_tpu/analysis/flag_identity.py) enforces
+    against the canonical train-step and serving-decode programs.
+    Registering a flag with `identity=` here is all it takes to put it
+    under systematic enforcement; there are no per-flag tests to write."""
+    return {f.name: f.identity for f in REGISTRY.values()
+            if f.identity is not None}
 
 
 def describe() -> str:

@@ -4,7 +4,6 @@ way the reference prefixes device ids."""
 from __future__ import annotations
 
 import logging
-import os
 import sys
 
 _FMT = "[%(asctime)s %(name)s %(levelname).1s] %(message)s"
@@ -13,9 +12,10 @@ _FMT = "[%(asctime)s %(name)s %(levelname).1s] %(message)s"
 def get_logger(name: str = "hetu_tpu") -> logging.Logger:
     logger = logging.getLogger(f"hetu_tpu.{name}")
     if not logger.handlers:
+        from hetu_tpu.utils import flags
         h = logging.StreamHandler(sys.stderr)
         h.setFormatter(logging.Formatter(_FMT, datefmt="%H:%M:%S"))
         logger.addHandler(h)
-        logger.setLevel(os.environ.get("HETU_TPU_LOG_LEVEL", "INFO"))
+        logger.setLevel(flags.str_flag("HETU_TPU_LOG_LEVEL"))
         logger.propagate = False
     return logger

@@ -16,7 +16,6 @@ provides step-level timing + trace capture:
 from __future__ import annotations
 
 import contextlib
-import os
 import time
 from typing import Dict, Optional
 
@@ -50,9 +49,10 @@ class StepProfiler:
     """Step-level timing/trace hooks for the trainer loop."""
 
     def __init__(self):
-        self.event_timing = os.environ.get("HETU_TPU_EVENT_TIMING") == "1"
-        self.trace_dir = os.environ.get("HETU_TPU_TRACE_DIR")
-        self.mem_profile = os.environ.get("HETU_TPU_MEMORY_PROFILE") == "1"
+        from hetu_tpu.utils import flags
+        self.event_timing = flags.bool_flag("HETU_TPU_EVENT_TIMING")
+        self.trace_dir = flags.str_flag("HETU_TPU_TRACE_DIR") or None
+        self.mem_profile = flags.bool_flag("HETU_TPU_MEMORY_PROFILE")
         self._trace_active = False
         self._trace_done = False
         self._first_step: Optional[int] = None
@@ -126,10 +126,10 @@ class StepProfiler:
 
 PHASES = ("embed", "attn", "moe", "mlp", "lm_head", "ring")
 
-_DTYPE_BYTES = {"f64": 8, "f32": 4, "bf16": 2, "f16": 2, "f8e4m3fn": 1,
-                "f8e5m2": 1, "s64": 8, "u64": 8, "s32": 4, "u32": 4,
-                "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1, "c64": 8,
-                "c128": 16}
+# ONE byte-pricing table for every HLO text walker (obs/hlo_text.py is
+# its home so a dtype addition lands once); imported here — after PHASES
+# — because obs.hlo_profile imports PHASES from this module.
+from hetu_tpu.obs.hlo_text import DTYPE_BYTES as _DTYPE_BYTES  # noqa: E402
 
 
 def phase_breakdown(compiled_or_text, phases=PHASES):

@@ -74,6 +74,61 @@ def test_every_env_read_is_registered():
     # docs/kernels.md): the whole-layer switch + the per-kernel bisect
     for name in ("HETU_TPU_PALLAS", "HETU_TPU_PALLAS_KERNELS"):
         assert name in flags.REGISTRY
+    # the graph-contract linter's per-compile hook
+    # (hetu_tpu/analysis, docs/static_analysis.md)
+    assert "HETU_TPU_LINT" in flags.REGISTRY
+
+
+def test_identity_contract_table():
+    """The declarative byte-identity table (docs/static_analysis.md):
+    each entry's value must be a LEGAL value of its flag (a contract on
+    an unsettable value would sweep vacuously), routing flags carry
+    their neutral value, analysis flags carry "1", and the known
+    contracted surface never silently shrinks — the flag-identity sweep
+    (tests/test_lint.py) enforces the semantics; this pins the table."""
+    table = flags.identity_flags()
+    for name, value in table.items():
+        f = flags.REGISTRY[name]
+        if f.choices:
+            assert value in f.choices, (name, value)
+        if f.kind == "bool":
+            assert value in ("0", "1"), (name, value)
+    assert table["HETU_TPU_GRAD_COMPRESS"] == "none"
+    assert table["HETU_TPU_COMM_TOPOLOGY"] == "flat"
+    assert table["HETU_TPU_PALLAS"] == "0"
+    assert table["HETU_TPU_PROFILE"] == "1"
+    assert table["HETU_TPU_LINT"] == "1"
+    assert len(table) >= 13
+    # flags with NO contract must stay contract-free: these genuinely
+    # change program shapes, so an identity entry would be a lie the
+    # sweep turns into a tier-1 failure
+    for name in ("HETU_TPU_SERVE_SLOTS", "HETU_TPU_SERVE_MAX_LEN",
+                 "HETU_TPU_MAX_PLANS", "HETU_TPU_RUNLOG"):
+        assert name not in table
+
+
+def test_doc_flag_drift():
+    """Doc-drift gate: every HETU_TPU_* name in docs/*.md + README
+    exists in the registry (docs naming dead flags fail loudly) and
+    every registered flag is documented somewhere a reader can find it
+    (README flag reference / the subsystem docs)."""
+    import pathlib
+    import re
+
+    root = pathlib.Path(flags.__file__).resolve().parents[2]
+    docs = sorted((root / "docs").glob("*.md")) + [root / "README.md"]
+    assert len(docs) >= 6, "doc-drift walked the wrong root"
+    pat = re.compile(r"HETU_TPU_[A-Z0-9_]+")
+    mentioned: dict = {}
+    for d in docs:
+        for name in pat.findall(d.read_text()):
+            mentioned.setdefault(name, d.name)
+    dead = {n: f for n, f in mentioned.items() if n not in flags.REGISTRY}
+    assert not dead, f"docs mention unregistered flags: {dead}"
+    undocumented = sorted(set(flags.REGISTRY) - set(mentioned))
+    assert not undocumented, (
+        f"registered flags documented nowhere in docs/*.md or README: "
+        f"{undocumented}")
 
 
 def test_profile_flag_defaults_are_off_path():

@@ -1,0 +1,189 @@
+#!/usr/bin/env python
+"""Graph-contract linter CLI (hetu_tpu/analysis, docs/static_analysis.md).
+
+Modes (combinable; with none given, --self runs — the cheap CI gate):
+
+  --self          AST lints over the repo's own Python (hetu_tpu/ +
+                  tools_*.py + bench.py): env-bypass, vjp-signature,
+                  shardmap-constraints, unseeded-rng.
+  --hlo           compile the canonical train step (and serving decode)
+                  and run the HLO lints over the post-optimization text:
+                  donation, replica-groups, replication, dtype-drift,
+                  scope-coverage.  Needs jax; pays one XLA compile per
+                  program.
+  --flags         the flag-identity sweep: every `identity=` contract in
+                  utils/flags.py, canonical train step + serving decode,
+                  traced-text fingerprints vs an unset environment.
+                  `--flags-only NAME` (repeatable) bisects the table.
+  --hlo-file F    run the HLO lints over an HLO text file (repeatable —
+                  the fixture acceptance path and the escape hatch for
+                  linting a dumped module from anywhere).
+
+Exit status: nonzero iff any ERROR-severity finding survives the
+allowlist.  Warnings and infos report but never fail.
+
+Allowlist: --allowlist PATH (default: repo-root lint_allowlist.json when
+present).  Entries are {"lint", "match", "reason"}; the reason is
+MANDATORY — a reasonless entry does not suppress and is itself an error
+— and entries that suppress nothing surface as warnings so stale
+waivers rot loudly.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+DEFAULT_ALLOWLIST = os.path.join(REPO_ROOT, "lint_allowlist.json")
+
+#: lint ids each mode executes — what allowlist staleness is judged
+#: against (an entry for a lint that did not run is not "unused")
+AST_LINTS = ("env-bypass", "vjp-signature", "shardmap-constraints",
+             "unseeded-rng", "parse")
+HLO_LINTS = ("donation", "replica-groups", "replication", "dtype-drift",
+             "scope-coverage")
+
+
+def _findings_self(args):
+    from hetu_tpu.analysis.ast_lints import lint_repo
+    return lint_repo(REPO_ROOT)
+
+
+def _findings_hlo(args):
+    from hetu_tpu.analysis.hlo_lints import lint_hlo
+    from hetu_tpu.analysis.programs import (canonical_compute_dtype,
+                                            serving_decode_text,
+                                            train_step_text)
+    expected = args.expected_dtype
+    if expected is None:
+        # match the HETU_TPU_LINT trainer hook: the canonical model
+        # declares its compute dtype, so the drift lint runs by default
+        expected = canonical_compute_dtype()
+    out = lint_hlo(train_step_text(optimized=True),
+                   expected_dtype=expected,
+                   min_bytes=args.min_bytes,
+                   coverage_floor=args.coverage_floor,
+                   program="train_step")
+    if not args.skip_decode:
+        out += lint_hlo(serving_decode_text(optimized=True),
+                        expected_dtype=expected,
+                        min_bytes=args.min_bytes,
+                        coverage_floor=args.coverage_floor,
+                        program="serving_decode")
+    return out
+
+
+def _findings_flags(args):
+    from hetu_tpu.analysis.flag_identity import identity_sweep
+    sweep = identity_sweep(only_flags=args.flags_only or None)
+    return sweep["findings"]
+
+
+def _findings_files(args):
+    from hetu_tpu.analysis.hlo_lints import lint_hlo
+    out = []
+    for path in args.hlo_file:
+        with open(path) as fh:
+            txt = fh.read()
+        out += lint_hlo(txt, expected_dtype=args.expected_dtype,
+                        min_bytes=args.min_bytes,
+                        coverage_floor=args.coverage_floor,
+                        program=os.path.basename(path))
+    return out
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Static graph-contract lints over lowered HLO and "
+                    "the repo's own AST (docs/static_analysis.md).")
+    ap.add_argument("--self", dest="self_", action="store_true",
+                    help="AST lints over the repo (the tier-1 CI gate)")
+    ap.add_argument("--hlo", action="store_true",
+                    help="HLO lints over the canonical compiled programs")
+    ap.add_argument("--flags", action="store_true",
+                    help="flag-identity sweep over every registered "
+                         "identity contract")
+    ap.add_argument("--flags-only", action="append", metavar="NAME",
+                    help="restrict --flags to this flag (repeatable)")
+    ap.add_argument("--hlo-file", action="append", default=[],
+                    metavar="F", help="HLO text file to lint (repeatable)")
+    ap.add_argument("--skip-decode", action="store_true",
+                    help="--hlo: lint only the train step (skip the "
+                         "serving-decode compile)")
+    ap.add_argument("--expected-dtype", default=None,
+                    help="declare the model compute dtype (bf16/f16) so "
+                         "the dtype-drift lint can fire; --hlo derives "
+                         "it from the canonical model config when unset "
+                         "(--hlo-file stays off by default — synthetic "
+                         "files declare nothing)")
+    ap.add_argument("--min-bytes", type=int, default=None,
+                    help="donation/replication size floor (default 64KiB)")
+    ap.add_argument("--coverage-floor", type=float, default=0.90,
+                    help="scope-coverage warning threshold (default 0.90)")
+    ap.add_argument("--allowlist", default=None, metavar="PATH",
+                    help="allowlist JSON (default: repo-root "
+                         "lint_allowlist.json when present)")
+    ap.add_argument("--json", dest="as_json", action="store_true",
+                    help="machine-readable report on stdout")
+    args = ap.parse_args(argv)
+    if args.min_bytes is None:
+        from hetu_tpu.analysis.hlo_lints import MIN_BYTES
+        args.min_bytes = MIN_BYTES
+
+    modes = []
+    executed = set()
+    if args.self_:
+        modes.append(_findings_self)
+        executed.update(AST_LINTS)
+    if args.hlo:
+        modes.append(_findings_hlo)
+        executed.update(HLO_LINTS)
+    if args.flags or args.flags_only:
+        modes.append(_findings_flags)
+        executed.add("flag-identity")
+    if args.hlo_file:
+        # deliberately NOT added to `executed`: a fixture-only run must
+        # not call the repo's standing HLO waivers stale (the allowlist
+        # staleness exemption findings.Allowlist.apply documents)
+        modes.append(_findings_files)
+    if not modes:
+        modes = [_findings_self]
+        executed.update(AST_LINTS)
+
+    findings = []
+    for fn in modes:
+        findings += fn(args)
+
+    from hetu_tpu.analysis.findings import Allowlist, counts_by_severity
+    allow_path = args.allowlist
+    if allow_path is None and os.path.exists(DEFAULT_ALLOWLIST):
+        allow_path = DEFAULT_ALLOWLIST
+    allow = Allowlist.load(allow_path)
+    kept, suppressed = allow.apply(findings, executed=executed)
+    sev = counts_by_severity(kept)
+
+    if args.as_json:
+        print(json.dumps({
+            "findings": [f.to_dict() for f in kept],
+            "suppressed": [f.to_dict() for f in suppressed],
+            "errors": sev["error"], "warnings": sev["warning"],
+            "allowlist": allow_path,
+        }, indent=2))
+    else:
+        order = {"error": 0, "warning": 1, "info": 2}
+        for f in sorted(kept, key=lambda f: (order[f.severity],
+                                             f.lint, f.location)):
+            print(f"{f.severity.upper():7s} [{f.lint}] "
+                  f"{f.location}: {f.message}")
+        if suppressed:
+            print(f"# {len(suppressed)} finding(s) suppressed by "
+                  f"{allow_path}")
+        print(f"# {sev['error']} error(s), {sev['warning']} warning(s), "
+              f"{sev['info']} info")
+    return 1 if sev["error"] else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

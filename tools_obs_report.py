@@ -155,6 +155,25 @@ def summarize(records) -> dict:
             out["budget"]["last_breaches"] = [
                 b.get("metric") for b in last_breaches]
 
+    # per-compile graph-contract lints (hetu_tpu/analysis,
+    # HETU_TPU_LINT=1): totals across the run + the latest record's
+    # per-lint counts and first messages — a run that compiled a plan
+    # with an error-severity finding is visible from the summary alone
+    lints = [r for r in records if r.get("kind") == "lint"]
+    if lints:
+        last = lints[-1]
+        lint_sec: dict = {
+            "records": len(lints),
+            "findings": sum(int(r.get("findings") or 0) for r in lints),
+            "errors": sum(int(r.get("errors") or 0) for r in lints),
+            "warnings": sum(int(r.get("warnings") or 0) for r in lints),
+        }
+        if last.get("lints"):
+            lint_sec["last_by_lint"] = last["lints"]
+        if last.get("messages"):
+            lint_sec["last_messages"] = last["messages"][:5]
+        out["lint"] = lint_sec
+
     times = sorted(float(r["step_time_s"]) for r in steps
                    if r.get("step_time_s"))
     if times:

@@ -332,6 +332,83 @@ def run_chaos_demo(workdir: str, plan: FaultPlan, num_steps: int = 36,
     }
 
 
+def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
+                           requests: int = 18, rate: float = 60.0,
+                           burst: int = 6, num_slots: int = 2,
+                           num_pages: int = 10,
+                           seed: int = 0) -> Dict[str, Any]:
+    """The serving chaos scenario (the PR 7 follow-up): a seeded
+    burst-arrival trace through the REAL continuous-batching engine
+    (tiny llama on CPU) while the plan's ``slow_worker`` spec inflates
+    engine steps — a decode slowdown under bursty load.  Two SLO classes
+    ride the trace (``gold`` with tight targets, ``bulk`` uncontracted),
+    the flight recorder traces every request, and the serving health
+    detectors watch the run.
+
+    The recovery report carries the per-class SLO attainment / goodput /
+    stall-attribution sections from `serving/slo_report.py` — the same
+    report path `tools_serving_report.py` renders — plus the injected
+    summary and fired-detector counts, so "what did the slowdown cost,
+    and who paid" is answerable per class."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu import serving
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.obs.health import ServingHealthMonitor
+    from hetu_tpu.obs.metrics import MetricsRegistry
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.serving import slo_report
+
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(seed))
+
+    classes = [serving.SLOClass("gold", ttft_s=0.5, token_gap_s=0.25),
+               serving.SLOClass("bulk")]
+    arrivals = serving.bursty_arrivals(requests, rate, burst=burst,
+                                       seed=seed)
+    reqs = serving.synthetic_requests(
+        requests, vocab_size=cfg.vocab_size, prompt_lens=(3, 16),
+        max_new=(3, 8), arrivals=arrivals, slo_classes=classes, seed=seed)
+
+    registry = MetricsRegistry()
+    log_path = os.path.join(workdir, "serve_chaos.jsonl")
+    run_log = RunLog(log_path)
+    tracer = serving.RequestTracer(run_log=run_log, registry=registry)
+    health = ServingHealthMonitor(runlog=run_log, registry=registry,
+                                  warmup=3, cooldown_steps=4)
+    eng = serving.ServingEngine(
+        model, params,
+        serving.ServeConfig(num_slots=num_slots, page_size=8, max_len=32,
+                            prefill_chunk=8, num_pages=num_pages),
+        registry=registry, run_log=run_log, tracer=tracer, health=health)
+    eng.warmup()
+
+    # the engine's own run() loop with the slow-decode injection hooked
+    # at each step boundary (inside the timed window): the sleep
+    # inflates the virtual clock exactly like a straggling decode step
+    # would, so spans/TTFT/detectors all see it
+    results = eng.run(reqs,
+                      on_step=lambda idx: maybe_slow_step(plan, 0, idx))
+    run_log.close()
+
+    records = RunLog.read(log_path)
+    report = slo_report.serving_report(records)
+    snap = registry.snapshot()
+    detectors = {r["name"]: r["value"] for r in snap["counters"]
+                 if r["name"].startswith("health.")}
+    return {
+        "completed": len(results) == len(reqs),
+        "requests": len(results),
+        "engine_steps": eng.steps_done,
+        "injected": plan.summary(),
+        "detectors": detectors,
+        "slo": report,
+        "runlog": log_path,
+    }
+
+
 # ------------------------------------------------------------ schedules
 def named_plan(name: str, **kw) -> FaultPlan:
     """Built-in schedules for the replay CLI and the acceptance test."""
@@ -367,6 +444,16 @@ def named_plan(name: str, **kw) -> FaultPlan:
                       count=kw.get("count", 10_000),
                       delay_s=kw.get("delay_s", 0.15)),
         ])
+    if name == "serve-burst":
+        # the serving scenario (run_serving_chaos_demo): a burst-arrival
+        # trace with a slow-decode window injected mid-run — per-class
+        # SLO attainment shows who the slowdown cost
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="slow_worker", rank=0,
+                      at_step=kw.get("at_step", 8),
+                      count=kw.get("count", 12),
+                      delay_s=kw.get("delay_s", 0.25)),
+        ])
     if name == "stall":
         # a heartbeat stall longer than the server timeout: the classic
         # long-XLA-compile false positive — the stalled worker is declared
@@ -377,4 +464,4 @@ def named_plan(name: str, **kw) -> FaultPlan:
         ])
     raise ValueError(f"unknown schedule {name!r}; known: "
                      "kill-partition-corrupt, partition, corrupt, stall, "
-                     "slow")
+                     "slow, serve-burst")

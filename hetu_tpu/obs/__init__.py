@@ -37,7 +37,9 @@ from hetu_tpu.obs.hlo_profile import (PROFILE_SCHEMA,  # noqa: F401
                                       layer_profile, layer_table,
                                       peak_hbm_estimate, profile_record)
 from hetu_tpu.obs.health import (HealthMonitor,  # noqa: F401
-                                 maybe_health_monitor)
+                                 ServingHealthMonitor,
+                                 maybe_health_monitor,
+                                 maybe_serving_health_monitor)
 from hetu_tpu.obs.metrics import (Histogram, MetricsRegistry,  # noqa: F401
                                   get_registry)
 from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
@@ -45,16 +47,19 @@ from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
                               flops_of_compiled, load_hardware_profile)
 from hetu_tpu.obs.runlog import (SCHEMA_VERSION, RunLog,  # noqa: F401
                                  default_runlog_path)
+from hetu_tpu.obs.spans import (SPAN_SCHEMA, RequestTrace,  # noqa: F401
+                                Span, collect_traces)
 from hetu_tpu.obs.trace import (ChromeTrace,  # noqa: F401
                                 merge_runlogs, pipeline_schedule_trace,
-                                schedule_bubble_fraction,
+                                schedule_bubble_fraction, serving_trace,
                                 trace_from_runlog)
 
 __all__ = [
     "MetricsRegistry", "Histogram", "get_registry",
     "RunLog", "SCHEMA_VERSION", "default_runlog_path",
     "ChromeTrace", "pipeline_schedule_trace", "schedule_bubble_fraction",
-    "trace_from_runlog", "merge_runlogs",
+    "trace_from_runlog", "merge_runlogs", "serving_trace",
+    "Span", "RequestTrace", "collect_traces", "SPAN_SCHEMA",
     "estimate_mfu", "estimate_from_compiled", "flops_of_compiled",
     "analytic_transformer_estimate", "load_hardware_profile",
     "collective_report", "collective_table",
@@ -67,4 +72,5 @@ __all__ = [
     "TelemetryPusher", "straggler_report", "snapshot_straggler_hook",
     "merge_offsets",
     "HealthMonitor", "maybe_health_monitor",
+    "ServingHealthMonitor", "maybe_serving_health_monitor",
 ]

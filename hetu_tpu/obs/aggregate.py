@@ -51,9 +51,11 @@ from hetu_tpu.utils.logging import get_logger
 logger = get_logger("obs.aggregate")
 
 #: RunLog kinds worth shipping cluster-wide (step records travel on the
-#: dedicated ``steps`` channel; raw per-step records would dwarf the push)
+#: dedicated ``steps`` channel; raw per-step records would dwarf the
+#: push — and so would per-request ``span`` records, which stay local:
+#: serving workers ship their serve events + serve.* counter deltas)
 EVENT_KINDS = ("compile", "anomaly", "straggler", "fault", "elastic_epoch",
-               "switch")
+               "switch", "serve")
 
 _boot_counter = itertools.count()
 
@@ -465,6 +467,22 @@ class ClusterAggregator:
                     "counters": dict(st.counters),
                     "gauges": dict(st.gauges),
                 }
+                # serving workers (serve.* series in the pushed deltas):
+                # the dashboard-facing digest, so tools_cluster.py shows
+                # a serving worker's load next to training workers
+                if any(k.startswith("serve.") for k in st.counters) or \
+                        any(k.startswith("serve.") for k in st.gauges):
+                    workers[str(rank)]["serving"] = {
+                        "requests_done":
+                            st.counters.get("serve.requests_done", 0.0),
+                        "tokens_out":
+                            st.counters.get("serve.tokens_out", 0.0),
+                        "queue_depth":
+                            st.gauges.get("serve.queue_depth"),
+                        "page_util": st.gauges.get("serve.page_util"),
+                        "slot_occupancy":
+                            st.gauges.get("serve.slot_occupancy"),
+                    }
         for rank, gap in (heartbeats or {}).items():
             workers.setdefault(str(rank), {})["heartbeat_gap_s"] = gap
         return ClusterSnapshot(t=now, window_s=w, workers=workers)

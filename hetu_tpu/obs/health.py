@@ -1,8 +1,8 @@
-"""Training health monitor: online anomaly detection in the trainer loop.
+"""Health monitors: online anomaly detection for training AND serving.
 
 The reference's observability surface stops at recording costs; nothing
-watches the run.  ``HealthMonitor`` closes that: per-step EWMA+MAD
-detectors for the failure shapes that silently waste TPU-days —
+watches the run.  ``HealthMonitor`` closes that for training: per-step
+EWMA+MAD detectors for the failure shapes that silently waste TPU-days —
 
     loss_spike             loss jumps far above its EWMA baseline
     nan_loss / nan_grad    non-finite loss / grad norm (an AMP overflow
@@ -13,19 +13,34 @@ detectors for the failure shapes that silently waste TPU-days —
     data_stall             the gap BETWEEN steps (host/input time) blows
                            up — the data pipeline, not the device
 
+``ServingHealthMonitor`` is the serving engine's twin (same EWMA
+machinery, same ``anomaly`` record shape, same ``HETU_TPU_HEALTH``
+gate), watching the failure shapes of a continuous-batching front end:
+
+    ttft_regression            TTFT far above its EWMA baseline (a
+                               compile storm, a straggling reshard, a
+                               saturated prefill path)
+    queue_depth_blowup         the admission queue grows far past its
+                               baseline — arrival rate has outrun
+                               decode throughput
+    page_exhaustion_imminent   KV page-pool utilization pinned at the
+                               high watermark while requests queue —
+                               the next admissions will all stall on
+                               ``no_pages``
+
 Each firing increments a ``health.<kind>`` counter, emits an ``anomaly``
 RunLog event, rides the telemetry push to the coordinator (via the
-TelemetrySource, when one is attached), and — for the severe kinds —
-can invoke the emergency-checkpoint hook (PR 3's bank-state-now path) so
-a dying run leaves a fresh checkpoint behind.
+TelemetrySource, when one is attached), and — for the severe training
+kinds — can invoke the emergency-checkpoint hook (PR 3's bank-state-now
+path) so a dying run leaves a fresh checkpoint behind.
 
 Detectors use an EWMA mean plus an EWMA absolute deviation (the online
 stand-in for median/MAD — robust enough for thresholds, O(1) state) and
 fire only after ``warmup`` observations; a per-kind cooldown stops one
 regime shift from spamming hundreds of events while the EWMA
-re-baselines.  Gated by ``HETU_TPU_HEALTH`` (unset = the trainer does
-zero per-step health work); thresholds are constructor knobs, documented
-in docs/observability.md.
+re-baselines.  Gated by ``HETU_TPU_HEALTH`` (unset = the trainer/engine
+does zero per-step health work); thresholds are constructor knobs,
+documented in docs/observability.md.
 """
 from __future__ import annotations
 
@@ -63,49 +78,25 @@ class Ewma:
         self.n += 1
 
 
-class HealthMonitor:
-    """Per-step anomaly detection for a training loop.
-
-    Call :meth:`observe_step` once per completed step.  Returns the list
-    of anomalies fired on that step (empty almost always) — the caller
-    never needs to look at it; counters/RunLog carry the signal.
-
-    ``emergency_hook`` (no-arg callable, e.g. a bound ``save``) runs on
-    kinds in ``emergency_kinds`` — best-effort, never raises into the
-    training loop.
-    """
-
-    KINDS = ("loss_spike", "nan_loss", "nan_grad", "grad_blowup",
-             "step_time_regression", "data_stall")
+class _MonitorBase:
+    """The shared detector chassis: EWMA spike rule, per-kind cooldown,
+    and the one firing path (counter + ``anomaly`` RunLog record +
+    telemetry event + optional emergency hook) both the training and
+    serving monitors use — one record shape, one counter namespace."""
 
     def __init__(self, runlog=None, registry=None, source=None,
-                 emergency_hook=None,
-                 emergency_kinds=("nan_loss", "nan_grad"),
-                 warmup: int = 8, alpha: float = 0.1,
-                 loss_k: float = 6.0, grad_k: float = 8.0,
-                 step_time_k: float = 6.0, step_time_ratio: float = 2.0,
-                 stall_ratio: float = 5.0, stall_min_s: float = 1.0,
-                 cooldown_steps: int = 16):
+                 warmup: int = 8, cooldown_steps: int = 16):
         from hetu_tpu.obs.metrics import get_registry
         self.runlog = runlog
         self.registry = registry if registry is not None else get_registry()
         self.source = source          # optional obs.aggregate.TelemetrySource
-        self.emergency_hook = emergency_hook
-        self.emergency_kinds = frozenset(emergency_kinds)
         self.warmup = warmup
-        self.loss_k, self.grad_k = loss_k, grad_k
-        self.step_time_k, self.step_time_ratio = step_time_k, step_time_ratio
-        self.stall_ratio, self.stall_min_s = stall_ratio, stall_min_s
         self.cooldown_steps = cooldown_steps
-        self._loss = Ewma(alpha)
-        self._grad = Ewma(alpha)
-        self._step_time = Ewma(alpha)
-        self._fetch = Ewma(alpha)
-        self._last_t: Optional[float] = None
+        self.emergency_hook = None
+        self.emergency_kinds: frozenset = frozenset()
         self._cooldown_until: Dict[str, int] = {}
         self.anomalies_total = 0
 
-    # ------------------------------------------------------------------
     def _spike(self, ewma: Ewma, v: float, k: float,
                ratio: Optional[float] = None) -> bool:
         """v far above the EWMA baseline.  Two independent rules, either
@@ -149,6 +140,43 @@ class HealthMonitor:
             except Exception as e:   # telemetry never kills a step
                 self.registry.inc("health.emergency_save_failures")
                 logger.error(f"emergency hook for {kind} failed: {e!r}")
+
+
+class HealthMonitor(_MonitorBase):
+    """Per-step anomaly detection for a training loop.
+
+    Call :meth:`observe_step` once per completed step.  Returns the list
+    of anomalies fired on that step (empty almost always) — the caller
+    never needs to look at it; counters/RunLog carry the signal.
+
+    ``emergency_hook`` (no-arg callable, e.g. a bound ``save``) runs on
+    kinds in ``emergency_kinds`` — best-effort, never raises into the
+    training loop.
+    """
+
+    KINDS = ("loss_spike", "nan_loss", "nan_grad", "grad_blowup",
+             "step_time_regression", "data_stall")
+
+    def __init__(self, runlog=None, registry=None, source=None,
+                 emergency_hook=None,
+                 emergency_kinds=("nan_loss", "nan_grad"),
+                 warmup: int = 8, alpha: float = 0.1,
+                 loss_k: float = 6.0, grad_k: float = 8.0,
+                 step_time_k: float = 6.0, step_time_ratio: float = 2.0,
+                 stall_ratio: float = 5.0, stall_min_s: float = 1.0,
+                 cooldown_steps: int = 16):
+        super().__init__(runlog=runlog, registry=registry, source=source,
+                         warmup=warmup, cooldown_steps=cooldown_steps)
+        self.emergency_hook = emergency_hook
+        self.emergency_kinds = frozenset(emergency_kinds)
+        self.loss_k, self.grad_k = loss_k, grad_k
+        self.step_time_k, self.step_time_ratio = step_time_k, step_time_ratio
+        self.stall_ratio, self.stall_min_s = stall_ratio, stall_min_s
+        self._loss = Ewma(alpha)
+        self._grad = Ewma(alpha)
+        self._step_time = Ewma(alpha)
+        self._fetch = Ewma(alpha)
+        self._last_t: Optional[float] = None
 
     # ------------------------------------------------------------------
     def observe_step(self, step: int, step_time_s: float, *,
@@ -209,3 +237,94 @@ def maybe_health_monitor(runlog=None, source=None, emergency_hook=None,
         return None
     return HealthMonitor(runlog=runlog, source=source,
                          emergency_hook=emergency_hook, **kw)
+
+
+class ServingHealthMonitor(_MonitorBase):
+    """Per-engine-step anomaly detection for the serving front end.
+
+    The engine calls :meth:`observe_ttft` once per first token and
+    :meth:`observe_step` once per engine step (docs/serving.md); all
+    clocks are the DRIVER's (virtual in replayed traces), so detector
+    firings are deterministic under a simulated timeline.
+
+    Detectors (thresholds are constructor knobs):
+
+    * ``ttft_regression`` — TTFT above the EWMA additive threshold OR
+      ``ttft_ratio`` x baseline (the same two-rule spike the training
+      step-time detector uses).
+    * ``queue_depth_blowup`` — queue depth >= ``queue_min`` AND above
+      baseline by the spike rule with ``queue_ratio``: arrivals have
+      outrun decode throughput, latency is compounding.
+    * ``page_exhaustion_imminent`` — page-pool utilization at or above
+      ``page_high`` for ``page_streak`` consecutive steps while
+      requests queue: the next admissions will all stall ``no_pages``.
+    """
+
+    KINDS = ("ttft_regression", "queue_depth_blowup",
+             "page_exhaustion_imminent")
+
+    def __init__(self, runlog=None, registry=None, source=None,
+                 warmup: int = 8, alpha: float = 0.2,
+                 ttft_k: float = 6.0, ttft_ratio: float = 3.0,
+                 queue_k: float = 8.0, queue_ratio: float = 4.0,
+                 queue_min: int = 4,
+                 page_high: float = 0.95, page_streak: int = 4,
+                 cooldown_steps: int = 16):
+        super().__init__(runlog=runlog, registry=registry, source=source,
+                         warmup=warmup, cooldown_steps=cooldown_steps)
+        self.ttft_k, self.ttft_ratio = ttft_k, ttft_ratio
+        self.queue_k, self.queue_ratio = queue_k, queue_ratio
+        self.queue_min = queue_min
+        self.page_high, self.page_streak = page_high, page_streak
+        self._ttft = Ewma(alpha)
+        self._queue = Ewma(alpha)
+        self._page_hot = 0
+
+    # ------------------------------------------------------------------
+    def observe_ttft(self, ttft_s: float, *, step: int,
+                     t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one request's TTFT (engine-step `step` for cooldown)."""
+        t = time.time() if t is None else t
+        fired: List[Dict[str, Any]] = []
+        if self._spike(self._ttft, ttft_s, self.ttft_k,
+                       ratio=self.ttft_ratio):
+            self._fire("ttft_regression", step, ttft_s, self._ttft.mean,
+                       t, fired)
+        self._ttft.update(ttft_s)
+        return fired
+
+    def observe_step(self, step: int, *, queue_depth: int,
+                     page_util: float,
+                     t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one completed engine step's load signals."""
+        t = time.time() if t is None else t
+        fired: List[Dict[str, Any]] = []
+        if queue_depth >= self.queue_min and self._spike(
+                self._queue, float(queue_depth), self.queue_k,
+                ratio=self.queue_ratio):
+            self._fire("queue_depth_blowup", step, float(queue_depth),
+                       self._queue.mean, t, fired)
+        self._queue.update(float(queue_depth))
+
+        # exhaustion-imminent is a level rule, not a spike rule: a pool
+        # DESIGNED to run hot only fires when the queue shows demand the
+        # pool can no longer absorb
+        if page_util >= self.page_high and queue_depth > 0:
+            self._page_hot += 1
+            if self._page_hot >= self.page_streak:
+                self._fire("page_exhaustion_imminent", step,
+                           float(page_util), self.page_high, t, fired)
+        else:
+            self._page_hot = 0
+        return fired
+
+
+def maybe_serving_health_monitor(runlog=None, source=None, **kw
+                                 ) -> Optional[ServingHealthMonitor]:
+    """A ServingHealthMonitor when HETU_TPU_HEALTH is set, else None —
+    the serving engine's single-None-check gate (same flag as training:
+    one switch turns the whole health surface on)."""
+    from hetu_tpu.utils import flags
+    if not flags.bool_flag("HETU_TPU_HEALTH"):
+        return None
+    return ServingHealthMonitor(runlog=runlog, source=source, **kw)

@@ -24,12 +24,29 @@ Kind fields:
     straggler     stragglers (flagged ranks), workers (per-rank
                   ratio/z) — the cluster straggler report transitions
     serve         event (admit | done | reshard | report) + the serving
-                  SLO fields (hetu_tpu/serving, docs/serving.md):
-                  admit: req, slot, prompt_len, chunks, ttft_s;
+                  SLO fields (hetu_tpu/serving, docs/serving.md); every
+                  event also stamps `now` (driver-clock seconds — the
+                  engine's virtual clock, matching span t0/t1):
+                  admit: req, slot, prompt_len, chunks, ttft_s,
+                  queue_wait_s, slo_class, queue_depth, page_util;
                   done: req, reason, tokens, ttft_s, e2e_s, tokens_per_s,
-                  queue_depth, slot_occupancy, page_util;
-                  reshard: tier, strategy; report: requests, tokens,
-                  elapsed_s, tokens_per_s
+                  slo_class, slo_ttft_s, slo_token_gap_s, queue_depth,
+                  slot_occupancy, page_util;
+                  reshard: tier, strategy, pause_s; report: requests,
+                  tokens, elapsed_s, tokens_per_s
+    span          the serving flight recorder (HETU_TPU_SERVE_TRACE,
+                  hetu_tpu/serving/tracing.py, schema owned by
+                  obs/spans.py): span_schema (version), span (queued |
+                  prefill | decode | reshard_pause | done | evicted),
+                  trace (trace id), req, slot, slo_class, t0, t1
+                  (driver-clock seconds; spans of one request tile
+                  [arrival, done] — durations sum to its e2e_s), plus
+                  per-kind attrs: queued carries reason
+                  (none|no_slot|no_pages — the scheduler's
+                  reserve-on-admit stall attribution), prefill carries
+                  chunk (+ last on the TTFT chunk), decode carries
+                  tokens/segment/end, reshard_pause carries tier, the
+                  zero-duration terminals carry reason/tokens/e2e_s
     profile       name, plan, profile_schema, top (top-k layers/op-groups
                   by predicted roofline time), estimated_step_s,
                   total_flops, total_wire_bytes, peak_hbm_bytes,

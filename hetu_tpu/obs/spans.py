@@ -1,0 +1,221 @@
+"""Request-scoped span model: the serving flight recorder's data shape.
+
+A serving request's lifecycle is a sequence of typed **spans** that tile
+the driver-clock interval from arrival to completion, the way Hetu's
+RunLog gives every training step a record:
+
+    queued         arrival -> admission, carrying the stall-attribution
+                   reason the scheduler's reserve-on-admit decision
+                   produced (``none`` = admitted without waiting,
+                   ``no_slot`` = every decode slot was live,
+                   ``no_pages`` = the full page reservation was short)
+    prefill        one span per prefill chunk (the disaggregated chunk
+                   program); the last chunk's span ends at TTFT
+    decode         a decode segment — split at evictions and reshard
+                   pauses, so batch-composition changes are visible as
+                   segment boundaries; carries the tokens emitted in it
+    reshard_pause  the window a LoadAdaptiveMesh reshard froze decode
+    done/evicted   the zero-duration terminal span (exactly one per
+                   request): ``done`` carries the finish reason and
+                   token count, ``evicted`` marks a preemption
+
+Spans are recorded as schema-versioned ``span`` RunLog records
+(``span_schema`` field; see obs/runlog.py) by
+`serving/tracing.RequestTracer` under the ``HETU_TPU_SERVE_TRACE``
+flag.  Timestamps ``t0``/``t1`` are **driver-clock** seconds (virtual
+in `ServingEngine.run`/tests, wall in a live server), so a replayed
+trace is deterministic; the standard RunLog ``t`` wall stamp rides
+along for cross-log merging.
+
+Because consecutive spans share boundaries (each opens where the
+previous closed), the span durations of a finished request sum to its
+recorded ``e2e_s`` — `reconcile()` checks that, and the tier-1 property
+test holds every request to within one engine-step quantum.
+
+This module is pure host-side bookkeeping: no jax, no serving imports —
+the one span vocabulary `serving/tracing.py` (writer),
+`serving/slo_report.py` (reader) and `obs/trace.py` (renderer) share.
+"""
+from __future__ import annotations
+
+import dataclasses
+import itertools
+from typing import Any, Dict, Iterable, List, Optional
+
+#: bump when the `span` record shape changes incompatibly
+SPAN_SCHEMA = 1
+
+SPAN_KINDS = ("queued", "prefill", "decode", "reshard_pause",
+              "done", "evicted")
+TERMINAL_KINDS = ("done", "evicted")
+STALL_REASONS = ("none", "no_slot", "no_pages")
+
+#: span-record fields that are structure, not attrs
+_CORE_FIELDS = ("schema", "kind", "t", "span_schema", "span", "trace",
+                "req", "slot", "slo_class", "t0", "t1")
+
+_trace_counter = itertools.count()
+
+
+def new_trace_id(rid: int) -> str:
+    """A process-unique trace id for request `rid` (stable ordering, no
+    RNG — deterministic under a replayed virtual clock)."""
+    return f"tr{next(_trace_counter):x}.{rid}"
+
+
+@dataclasses.dataclass
+class Span:
+    """One typed interval of a request's lifecycle (driver-clock secs)."""
+    kind: str
+    t0: float
+    t1: float
+    rid: int
+    trace: str
+    slot: Optional[int] = None
+    slo_class: str = "default"
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+
+    def __post_init__(self):
+        if self.kind not in SPAN_KINDS:
+            raise ValueError(f"unknown span kind {self.kind!r}; "
+                             f"known: {SPAN_KINDS}")
+
+    @property
+    def dur_s(self) -> float:
+        return self.t1 - self.t0
+
+    def record(self) -> Dict[str, Any]:
+        """The RunLog ``span`` record payload (everything but the
+        writer-stamped schema/kind/t)."""
+        out = {"span_schema": SPAN_SCHEMA, "span": self.kind,
+               "trace": self.trace, "req": self.rid, "slot": self.slot,
+               "slo_class": self.slo_class,
+               "t0": self.t0, "t1": self.t1}
+        out.update(self.attrs)
+        return out
+
+    @staticmethod
+    def from_record(rec: Dict[str, Any]) -> "Span":
+        attrs = {k: v for k, v in rec.items() if k not in _CORE_FIELDS}
+        return Span(kind=rec["span"], t0=float(rec["t0"]),
+                    t1=float(rec["t1"]), rid=int(rec["req"]),
+                    trace=str(rec.get("trace", "")),
+                    slot=rec.get("slot"),
+                    slo_class=str(rec.get("slo_class", "default")),
+                    attrs=attrs)
+
+
+@dataclasses.dataclass
+class RequestTrace:
+    """All spans of one request, in emission order."""
+    rid: int
+    trace: str
+    slo_class: str = "default"
+    spans: List[Span] = dataclasses.field(default_factory=list)
+
+    # ------------------------------------------------------------ views
+    def by_kind(self, kind: str) -> List[Span]:
+        return [s for s in self.spans if s.kind == kind]
+
+    @property
+    def terminal(self) -> Optional[Span]:
+        term = [s for s in self.spans if s.kind in TERMINAL_KINDS]
+        return term[-1] if term else None
+
+    @property
+    def stall_reason(self) -> Optional[str]:
+        q = self.by_kind("queued")
+        return q[0].attrs.get("reason") if q else None
+
+    def duration_s(self, kind: str) -> float:
+        return sum(s.dur_s for s in self.by_kind(kind))
+
+    @property
+    def total_s(self) -> float:
+        """Sum of all non-terminal span durations — should reconcile
+        with the request's recorded ``e2e_s``."""
+        return sum(s.dur_s for s in self.spans
+                   if s.kind not in TERMINAL_KINDS)
+
+    @property
+    def tokens(self) -> Optional[int]:
+        t = self.terminal
+        return t.attrs.get("tokens") if t is not None else None
+
+    # ------------------------------------------------------- invariants
+    def validate(self, *, eps: float = 1e-9):
+        """The span-event contract the fuzz test drives:
+
+        * at least one span, the first being ``queued`` with a
+          stall-attribution reason from STALL_REASONS,
+        * exactly one terminal span (done | evicted), and it is last,
+        * spans are ordered and non-overlapping: each span opens no
+          earlier than the previous closed (shared boundaries allowed),
+        * every span has t1 >= t0 and carries this trace's ids.
+
+        Raises AssertionError naming the violated invariant."""
+        if not self.spans:
+            raise AssertionError(f"request {self.rid}: empty trace")
+        first = self.spans[0]
+        if first.kind != "queued":
+            raise AssertionError(
+                f"request {self.rid}: first span is {first.kind!r}, "
+                "not 'queued'")
+        if first.attrs.get("reason") not in STALL_REASONS:
+            raise AssertionError(
+                f"request {self.rid}: queued span carries stall reason "
+                f"{first.attrs.get('reason')!r}, not one of "
+                f"{STALL_REASONS}")
+        terms = [s for s in self.spans if s.kind in TERMINAL_KINDS]
+        if len(terms) != 1:
+            raise AssertionError(
+                f"request {self.rid}: {len(terms)} terminal spans "
+                f"({[s.kind for s in terms]}); want exactly one")
+        if self.spans[-1].kind not in TERMINAL_KINDS:
+            raise AssertionError(
+                f"request {self.rid}: terminal span is not last "
+                f"(last is {self.spans[-1].kind!r})")
+        prev_t1 = None
+        for s in self.spans:
+            if s.rid != self.rid or s.trace != self.trace:
+                raise AssertionError(
+                    f"request {self.rid}: span {s.kind} carries foreign "
+                    f"ids (req={s.rid}, trace={s.trace!r})")
+            if s.t1 < s.t0 - eps:
+                raise AssertionError(
+                    f"request {self.rid}: span {s.kind} runs backwards "
+                    f"({s.t0} -> {s.t1})")
+            if prev_t1 is not None and s.t0 < prev_t1 - eps:
+                raise AssertionError(
+                    f"request {self.rid}: span {s.kind} at {s.t0} "
+                    f"overlaps the previous span ending {prev_t1}")
+            prev_t1 = s.t1
+
+    def reconcile(self, e2e_s: Optional[float]) -> Optional[float]:
+        """Residual between the span tiling and the recorded end-to-end
+        latency: ``|sum(span durations) - e2e_s|``.  None when either
+        side is missing.  The acceptance property holds this within one
+        engine-step quantum."""
+        if e2e_s is None or self.terminal is None:
+            return None
+        return abs(self.total_s - float(e2e_s))
+
+
+def collect_traces(records: Iterable[Dict[str, Any]]
+                   ) -> Dict[int, RequestTrace]:
+    """Group RunLog ``span`` records into per-request RequestTraces
+    (rid-keyed, spans in record order) — THE reader every consumer
+    (slo_report, trace renderer, tests) shares."""
+    out: Dict[int, RequestTrace] = {}
+    for rec in records:
+        if rec.get("kind") != "span" or "span" not in rec:
+            continue
+        sp = Span.from_record(rec)
+        tr = out.get(sp.rid)
+        if tr is None or tr.trace != sp.trace:
+            # a rid reused across engine incarnations starts a fresh
+            # trace; the latest wins (report surfaces completed ones)
+            tr = out[sp.rid] = RequestTrace(rid=sp.rid, trace=sp.trace,
+                                           slo_class=sp.slo_class)
+        tr.spans.append(sp)
+    return out

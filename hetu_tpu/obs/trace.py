@@ -1,6 +1,6 @@
 """Chrome-trace (chrome://tracing / Perfetto JSON) exporter.
 
-Two sources feed one timeline format:
+Three sources feed one timeline format:
 
 1. **Schedule renders** — the pipeline engines' own schedule structures
    (`parallel.pipeline_1f1b.schedule_validity`,
@@ -10,12 +10,19 @@ Two sources feed one timeline format:
    from its per-op event records, SURVEY §5.1).
 2. **Run events** — RunLog records (steps, hot-switch phases, elastic
    re-mesh epochs) converted into wall-clock spans.
+3. **Serving flight-recorder traces** — `span` RunLog records
+   (HETU_TPU_SERVE_TRACE, obs/spans.py) rendered as one lane per decode
+   slot showing request occupancy, a queue lane, counter lanes for
+   queue depth / page utilization, and instants for
+   admissions/evictions/reshards (`serving_trace`).  Serving records
+   also ride `merge_runlogs`, so a serving worker's lifecycle merges
+   into the same cluster timeline as training RunLogs.
 
 Open the saved JSON at https://ui.perfetto.dev or chrome://tracing.
 
 Format: the Trace Event JSON array form — each event carries at least
 `name`, `ph`, `ts` (microseconds), `pid`; complete events ("ph": "X") add
-`dur`; instant events use "ph": "i".
+`dur`; instant events use "ph": "i"; counter lanes use "ph": "C".
 """
 from __future__ import annotations
 
@@ -55,6 +62,15 @@ class ChromeTrace:
         if args:
             ev["args"] = args
         self.events.append(ev)
+
+    def add_counter(self, name: str, ts_us: float, values: Dict[str, float],
+                    *, pid: Any = 0):
+        """Counter event ("ph": "C") — Perfetto draws each series of
+        `values` as a stacked area lane under `name`."""
+        self.events.append({"name": name, "ph": "C", "ts": float(ts_us),
+                            "pid": pid,
+                            "args": {k: float(v)
+                                     for k, v in values.items()}})
 
     def name_thread(self, pid: Any, tid: Any, name: str):
         self.events.append({"name": "thread_name", "ph": "M", "pid": pid,
@@ -182,19 +198,43 @@ def schedule_bubble_fraction(pp: int, n_micro: int,
 # run-event conversion (RunLog -> timeline)
 # ---------------------------------------------------------------------------
 
-def _name_run_lanes(tr: ChromeTrace, pid: Any, title: str):
+def _name_run_lanes(tr: ChromeTrace, pid: Any, title: str,
+                    serving: bool = False):
     tr.name_process(pid, title)
     tr.name_thread(pid, "train", "train steps")
     tr.name_thread(pid, "switch", "hot switches")
     tr.name_thread(pid, "elastic", "elastic epochs")
     tr.name_thread(pid, "health", "anomalies / faults / stragglers")
+    if serving:
+        tr.name_thread(pid, "serving", "serving requests / spans")
 
 
-def _emit_run_events(tr: ChromeTrace, recs: Iterable[Dict[str, Any]],
+def _has_serving(recs: Iterable[Dict[str, Any]]) -> bool:
+    return any(r.get("kind") in ("serve", "span") for r in recs)
+
+
+def _driver_to_wall_offset(recs: List[Dict[str, Any]]) -> Optional[float]:
+    """Wall seconds to add to a serving record's DRIVER-clock stamp
+    (`span` t0/t1, `serve` now) to land it on this log's wall timeline.
+    Estimated once from the first stamped record: the engine's virtual
+    clock idle-skips and compresses wall time, so per-record anchoring
+    would overlap spans — one run-level offset keeps the serving lane
+    internally consistent (and exact for live servers, where the driver
+    clock IS wall time)."""
+    for r in recs:
+        if r.get("kind") == "span" and r.get("t1") is not None:
+            return float(r["t"]) - float(r["t1"])
+        if r.get("kind") == "serve" and r.get("now") is not None:
+            return float(r["t"]) - float(r["now"])
+    return None
+
+
+def _emit_run_events(tr: ChromeTrace, recs: List[Dict[str, Any]],
                      pid: Any, t0: float, offset_s: float = 0.0):
     """Draw RunLog records into `tr` under process `pid`; each record's
     wall time is shifted by `offset_s` (a worker-clock -> reference-clock
     correction) before being made relative to `t0`."""
+    drv_off = _driver_to_wall_offset(recs)
     for r in recs:
         ts = (float(r["t"]) + offset_s - t0) * 1e6
         kind = r.get("kind")
@@ -238,6 +278,36 @@ def _emit_run_events(tr: ChromeTrace, recs: Iterable[Dict[str, Any]],
             tr.add_instant("straggler report", ts, pid=pid, tid="health",
                            cat="straggler",
                            args={"stragglers": r.get("stragglers")})
+        elif kind == "span" and r.get("t0") is not None \
+                and drv_off is not None:
+            # serving flight-recorder spans in the MERGED view: driver
+            # stamps mapped onto the wall timeline through the ONE
+            # run-level offset (per-record anchoring would overlap
+            # spans whenever the virtual clock idle-skipped).  The
+            # per-slot driver-clock picture is `serving_trace`'s job.
+            s0 = (float(r["t0"]) + drv_off + offset_s - t0) * 1e6
+            dur = max(0.0, float(r.get("t1", r["t0"]))
+                      - float(r["t0"])) * 1e6
+            tr.add_complete(f"r{r.get('req')} {r.get('span')}", s0,
+                            dur, pid=pid, tid="serving",
+                            cat=f"span:{r.get('span')}",
+                            args={k: r[k] for k in
+                                  ("slot", "slo_class", "reason",
+                                   "tokens", "chunk", "segment")
+                                  if r.get(k) is not None})
+        elif kind == "serve":
+            ev = r.get("event")
+            if ev in ("admit", "done", "reshard"):
+                if r.get("now") is not None and drv_off is not None:
+                    ts = (float(r["now"]) + drv_off + offset_s - t0) * 1e6
+                tr.add_instant(f"serve {ev} r{r.get('req')}"
+                               if r.get("req") is not None
+                               else f"serve {ev}", ts, pid=pid,
+                               tid="serving", cat=f"serve:{ev}",
+                               args={k: r[k] for k in
+                                     ("slot", "reason", "tier",
+                                      "queue_depth", "page_util")
+                                     if r.get(k) is not None})
 
 
 def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
@@ -251,7 +321,7 @@ def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
         return tr
     t0 = min(float(r["t"]) for r in recs)
     pid = "run"
-    _name_run_lanes(tr, pid, "training run")
+    _name_run_lanes(tr, pid, "training run", serving=_has_serving(recs))
     _emit_run_events(tr, recs, pid, t0)
     return tr
 
@@ -284,6 +354,78 @@ def merge_runlogs(runlogs: Dict[Any, Iterable[Dict[str, Any]]],
     for worker in sorted(per, key=str):
         off = float(offsets.get(worker, 0.0))
         pid = f"worker {worker}"
-        _name_run_lanes(tr, pid, f"worker {worker}")
+        _name_run_lanes(tr, pid, f"worker {worker}",
+                        serving=_has_serving(per[worker]))
         _emit_run_events(tr, per[worker], pid, t0, offset_s=off)
+    return tr
+
+
+# ---------------------------------------------------------------------------
+# serving flight-recorder render (span records -> per-slot lanes)
+# ---------------------------------------------------------------------------
+
+def serving_trace(records: Iterable[Dict[str, Any]], *,
+                  pid: Any = "serving") -> ChromeTrace:
+    """Render a serving run's flight-recorder records as the per-slot
+    timeline (driver-clock basis, so a replayed virtual-clock run draws
+    deterministically):
+
+    * one lane per decode slot — each request's prefill chunks, decode
+      segments and reshard pauses drawn where the slot actually spent
+      its time (`r<rid> <kind>` complete events, cat = span kind),
+    * a ``queue`` lane with every request's queued span (args carry the
+      no_slot/no_pages stall attribution),
+    * an ``events`` lane with admission / eviction(done) / reshard
+      instants (from the ``serve`` events' driver-clock ``now`` stamp),
+    * counter lanes ``queue_depth`` and ``page_util`` sampled at every
+      serve event.
+
+    Open at https://ui.perfetto.dev.  Records come straight from
+    ``RunLog.read``; non-serving records are ignored, so a mixed log
+    renders its serving slice."""
+    from hetu_tpu.obs.spans import collect_traces
+    recs = [r for r in records if isinstance(r, dict)]
+    traces = collect_traces(recs)
+    tr = ChromeTrace()
+    tr.name_process(pid, "serving engine")
+    tr.name_thread(pid, "queue", "queue (stall attribution)")
+    tr.name_thread(pid, "events", "admissions / evictions / reshards")
+    slots = sorted({s.slot for t in traces.values() for s in t.spans
+                    if s.slot is not None})
+    for s in slots:
+        tr.name_thread(pid, f"slot {s}", f"decode slot {s}")
+
+    for rid in sorted(traces):
+        t = traces[rid]
+        for sp in t.spans:
+            args = dict(sp.attrs, slo_class=sp.slo_class, trace=sp.trace)
+            ts, dur = sp.t0 * 1e6, sp.dur_s * 1e6
+            if sp.kind == "queued":
+                tr.add_complete(f"r{rid} queued", ts, dur, pid=pid,
+                                tid="queue", cat="queued", args=args)
+            elif sp.kind in ("prefill", "decode", "reshard_pause"):
+                tid = f"slot {sp.slot}" if sp.slot is not None else "queue"
+                tr.add_complete(f"r{rid} {sp.kind}", ts, dur, pid=pid,
+                                tid=tid, cat=sp.kind, args=args)
+            else:   # terminal: a zero-duration marker on the slot lane
+                tid = f"slot {sp.slot}" if sp.slot is not None else "events"
+                tr.add_instant(f"r{rid} {sp.kind}", ts, pid=pid, tid=tid,
+                               cat=sp.kind, args=args)
+
+    for r in recs:
+        if r.get("kind") != "serve" or r.get("now") is None:
+            continue
+        ts = float(r["now"]) * 1e6
+        ev = r.get("event")
+        if ev in ("admit", "done", "reshard"):
+            tr.add_instant(f"{ev} r{r.get('req')}"
+                           if r.get("req") is not None else ev,
+                           ts, pid=pid, tid="events", cat=f"serve:{ev}",
+                           args={k: r[k] for k in
+                                 ("slot", "reason", "tier", "slo_class")
+                                 if r.get(k) is not None})
+        counters = {k: r[k] for k in ("queue_depth", "page_util")
+                    if r.get(k) is not None}
+        for name, v in counters.items():
+            tr.add_counter(name, ts, {name: v}, pid=pid)
     return tr

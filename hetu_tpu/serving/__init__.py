@@ -9,25 +9,32 @@ training stack (docs/serving.md).
 
 Deliberately NOT imported from the package root: training paths never
 pay for (or lower differently because of) the serving stack — the
-serving flags (HETU_TPU_KV_QUANT + the serve-shape flags) are read
-only inside this package, so leaving them unset cannot perturb any
-training program.
+serving flags (HETU_TPU_KV_QUANT, HETU_TPU_SERVE_TRACE + the
+serve-shape flags) are read only inside this package, so leaving them
+unset cannot perturb any training program.
 """
 from hetu_tpu.serving.engine import ServeConfig, ServingEngine  # noqa: F401
 from hetu_tpu.serving.kv_pool import (PagePool,  # noqa: F401
                                       PoolArrays, kv_bytes_per_token)
-from hetu_tpu.serving.request import (Request,  # noqa: F401
-                                      RequestResult, RequestStats)
+from hetu_tpu.serving.request import (DEFAULT_SLO, Request,  # noqa: F401
+                                      RequestResult, RequestStats,
+                                      SLOClass)
 from hetu_tpu.serving.reshard import LoadAdaptiveMesh  # noqa: F401
 from hetu_tpu.serving.scheduler import Scheduler, SlotState  # noqa: F401
+from hetu_tpu.serving.slo_report import (serving_report,  # noqa: F401
+                                         render_text)
 from hetu_tpu.serving.traces import (bursty_arrivals,  # noqa: F401
                                      poisson_arrivals, synthetic_requests)
+from hetu_tpu.serving.tracing import (RequestTracer,  # noqa: F401
+                                      maybe_tracer)
 
 __all__ = [
     "ServingEngine", "ServeConfig",
     "PagePool", "PoolArrays", "kv_bytes_per_token",
-    "Request", "RequestResult", "RequestStats",
+    "Request", "RequestResult", "RequestStats", "SLOClass", "DEFAULT_SLO",
     "Scheduler", "SlotState",
     "LoadAdaptiveMesh",
+    "RequestTracer", "maybe_tracer",
+    "serving_report", "render_text",
     "poisson_arrivals", "bursty_arrivals", "synthetic_requests",
 ]

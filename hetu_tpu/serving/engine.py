@@ -53,11 +53,13 @@ import numpy as np
 
 from hetu_tpu.models.generation import (_check_context_length,
                                         decode_step_slots, extend_cache)
+from hetu_tpu.obs.health import maybe_serving_health_monitor
 from hetu_tpu.obs.metrics import MetricsRegistry, get_registry
 from hetu_tpu.obs.runlog import RunLog, default_runlog_path
 from hetu_tpu.serving.kv_pool import PagePool, PoolArrays
 from hetu_tpu.serving.request import Request, RequestResult
 from hetu_tpu.serving.scheduler import Scheduler
+from hetu_tpu.serving.tracing import maybe_tracer
 from hetu_tpu.utils.logging import get_logger
 
 logger = get_logger("serving.engine")
@@ -121,7 +123,8 @@ class ServingEngine:
     def __init__(self, model, params, config: Optional[ServeConfig] = None,
                  *, run_log: Optional[RunLog] = None,
                  registry: Optional[MetricsRegistry] = None,
-                 reshard=None):
+                 reshard=None, tracer=None, health=None,
+                 telemetry=None):
         self.model = model
         self.params = params
         self.config = config or ServeConfig.from_flags()
@@ -146,6 +149,19 @@ class ServingEngine:
         else:
             self._owns_runlog = False
         self.run_log = run_log
+        # the flight recorder (HETU_TPU_SERVE_TRACE) and the serving
+        # health detectors (HETU_TPU_HEALTH) — both host-side only, both
+        # a single None check when their flag is unset; explicit
+        # instances win over the flag gates (tests, tools)
+        self.tracer = tracer if tracer is not None else \
+            maybe_tracer(run_log=self.run_log, registry=self._registry)
+        self.health = health if health is not None else \
+            maybe_serving_health_monitor(runlog=self.run_log,
+                                         registry=self._registry)
+        #: optional obs.aggregate.TelemetrySource: serve events ride the
+        #: cluster telemetry push so tools_cluster.py sees this worker
+        self.telemetry = telemetry
+        self.steps_done = 0
 
         # per-request prefill scratch: a dense [L, 1, max_len] cache the
         # chunk program advances; template zeros reused (functionally)
@@ -248,6 +264,21 @@ class ServingEngine:
             req.arrival_t = now
         self.scheduler.submit(req)
         self._registry.inc("serve.requests_submitted")
+        self._registry.inc("serve.requests_submitted_class",
+                           slo_class=req.slo.name)
+        if self.tracer is not None:
+            self.tracer.on_submit(req)
+
+    def _log_serve(self, **fields):
+        """One serve event to every attached sink: the RunLog and (when
+        a TelemetrySource rides along) the cluster telemetry push."""
+        rec = None
+        if self.run_log is not None:
+            rec = self.run_log.log("serve", **fields)
+        if self.telemetry is not None:
+            if rec is None:
+                rec = dict(fields, kind="serve", t=time.time())
+            self.telemetry.note_event(rec)
 
     # ------------------------------------------------------------- step
     def step(self, now: float) -> List[RequestResult]:
@@ -265,12 +296,25 @@ class ServingEngine:
 
         finished: List[RequestResult] = []
         while True:
-            adm = self.scheduler.admit_next(clock())
+            t_adm = clock()
+            adm = self.scheduler.admit_next(t_adm)
             if adm is None:
                 break
             slot_idx, st = adm
             st.prefilling = True
             st.prefill_cache = self._scratch
+            if self.tracer is not None:
+                self.tracer.on_admit(st.request, slot_idx, t_adm)
+        if self.scheduler.queue:
+            # admission declined with work queued: count the stall and
+            # stamp the scheduler's reserve-on-admit attribution on
+            # every waiting request (the counter must not depend on the
+            # tracing flag — it is the registry's stall signal)
+            reason = self.scheduler.last_stall or "none"
+            self._registry.inc("serve.admission_stalls", reason=reason)
+            if self.tracer is not None:
+                self.tracer.on_stall(
+                    [r.rid for r in self.scheduler.queue], reason)
 
         for i in self.scheduler.active_slots():
             st = self.scheduler.slots[i]
@@ -309,30 +353,55 @@ class ServingEngine:
             self._registry.observe("serve.token_cost_s",
                                    decode_wall / len(active))
             tnow = clock()
+            n_done0 = len(finished)
             for i in active:
                 st = self.scheduler.slots[i]
                 tok = int(nxt[i])
                 st.generated.append(tok)
                 st.pos += 1
                 self._registry.inc("serve.tokens_out")
+                if self.tracer is not None:
+                    self.tracer.on_token(st.request, tnow)
                 self._maybe_finish(i, st, tok, tnow, finished)
+            if self.tracer is not None and len(finished) > n_done0:
+                # an eviction changed the batch composition: split the
+                # survivors' decode segments so the boundary is visible
+                survivors = [self.scheduler.slots[i].request.rid
+                             for i in self.scheduler.active_slots()
+                             if not self.scheduler.slots[i].prefilling]
+                if survivors:
+                    self.tracer.on_split(survivors, tnow, "evict")
 
+        self.steps_done += 1
         self._registry.set_gauge("serve.queue_depth",
                                  self.scheduler.queue_depth)
         self._registry.set_gauge("serve.slot_occupancy",
                                  self.scheduler.occupancy)
         self._registry.set_gauge("serve.page_util", self.pool.utilization)
+        if self.health is not None:
+            self.health.observe_step(
+                self.steps_done, queue_depth=self.scheduler.queue_depth,
+                page_util=self.pool.utilization, t=clock())
 
         if self.reshard is not None:
             tier = self.reshard.observe(self.scheduler.queue_depth)
             if tier is not None:
+                t_pause0 = clock()
                 with self._registry.timer("serve.reshard_s"):
                     self.params = self.reshard.reshard(self.params, tier)
+                t_pause1 = clock()
                 self._registry.inc("serve.reshards")
-                if self.run_log is not None:
-                    self.run_log.log("serve", event="reshard", tier=tier,
-                                     strategy=self.reshard.describe(tier),
-                                     queue_depth=self.scheduler.queue_depth)
+                if self.tracer is not None:
+                    paused = [self.scheduler.slots[i].request.rid
+                              for i in self.scheduler.active_slots()
+                              if not self.scheduler.slots[i].prefilling]
+                    self.tracer.on_pause(paused, t_pause0, t_pause1,
+                                         tier=tier)
+                self._log_serve(event="reshard", tier=tier,
+                                strategy=self.reshard.describe(tier),
+                                now=t_pause1,
+                                pause_s=t_pause1 - t_pause0,
+                                queue_depth=self.scheduler.queue_depth)
         return finished
 
     # ---------------------------------------------------------- prefill
@@ -355,6 +424,8 @@ class ServingEngine:
         st.stats.prefill_chunks += 1
         self._registry.inc("serve.prefill_chunks")
         if s + C < padded:
+            if self.tracer is not None:
+                self.tracer.on_chunk(req, clock(), st.chunks_done)
             return                        # more chunks: next engine step
         # first generated token: argmax at the last VALID prompt position
         # of the final chunk (padding tail positions carry garbage)
@@ -377,16 +448,24 @@ class ServingEngine:
         st.stats.first_token_t = tnow
         ttft = st.stats.ttft_s
         self._registry.observe("serve.ttft_s", ttft)
+        self._registry.observe("serve.ttft_s_class", ttft,
+                               slo_class=req.slo.name)
         if st.stats.queue_wait_s is not None:
             self._registry.observe("serve.queue_wait_s",
                                    st.stats.queue_wait_s)
         self._registry.inc("serve.tokens_out")
-        if self.run_log is not None:
-            self.run_log.log("serve", event="admit", req=req.rid,
-                             slot=slot_idx, prompt_len=plen,
-                             chunks=st.stats.prefill_chunks, ttft_s=ttft,
-                             queue_depth=self.scheduler.queue_depth,
-                             page_util=self.pool.utilization)
+        if self.tracer is not None:
+            self.tracer.on_first_token(req, slot_idx, tnow,
+                                       chunk=st.chunks_done)
+        if self.health is not None:
+            self.health.observe_ttft(ttft, step=self.steps_done, t=tnow)
+        self._log_serve(event="admit", req=req.rid,
+                        slot=slot_idx, prompt_len=plen,
+                        chunks=st.stats.prefill_chunks, ttft_s=ttft,
+                        queue_wait_s=st.stats.queue_wait_s, now=tnow,
+                        slo_class=req.slo.name,
+                        queue_depth=self.scheduler.queue_depth,
+                        page_util=self.pool.utilization)
         self._maybe_finish(slot_idx, st, t1, tnow, finished)
 
     # ----------------------------------------------------------- finish
@@ -405,30 +484,46 @@ class ServingEngine:
                             finished_reason=reason, stats=st.stats)
         self.scheduler.release(slot_idx)
         self._registry.inc("serve.requests_done")
+        self._registry.inc("serve.requests_done_class",
+                           slo_class=req.slo.name)
         if st.stats.e2e_s is not None:
             self._registry.observe("serve.e2e_s", st.stats.e2e_s)
-        if self.run_log is not None:
-            self.run_log.log(
-                "serve", event="done", req=req.rid, slot=slot_idx,
-                reason=reason, tokens=len(res.tokens),
-                ttft_s=st.stats.ttft_s, e2e_s=st.stats.e2e_s,
-                tokens_per_s=res.tokens_per_s,
-                queue_depth=self.scheduler.queue_depth,
-                slot_occupancy=self.scheduler.occupancy,
-                page_util=self.pool.utilization)
+            self._registry.observe("serve.e2e_s_class", st.stats.e2e_s,
+                                   slo_class=req.slo.name)
+        if self.tracer is not None:
+            self.tracer.on_finish(req, slot_idx, reason, tnow,
+                                  tokens=len(res.tokens),
+                                  e2e_s=st.stats.e2e_s)
+        self._log_serve(
+            event="done", req=req.rid, slot=slot_idx,
+            reason=reason, tokens=len(res.tokens),
+            ttft_s=st.stats.ttft_s, e2e_s=st.stats.e2e_s,
+            tokens_per_s=res.tokens_per_s, now=tnow,
+            slo_class=req.slo.name,
+            slo_ttft_s=req.slo.ttft_s, slo_token_gap_s=req.slo.token_gap_s,
+            queue_depth=self.scheduler.queue_depth,
+            slot_occupancy=self.scheduler.occupancy,
+            page_util=self.pool.utilization)
         finished.append(res)
 
     # -------------------------------------------------------------- run
-    def run(self, requests: Sequence[Request], *, start: float = 0.0
-            ) -> List[RequestResult]:
+    def run(self, requests: Sequence[Request], *, start: float = 0.0,
+            on_step=None) -> List[RequestResult]:
         """Drive the engine over a request trace to completion under a
         virtual clock: arrivals come from each request's `arrival_t`,
         and time advances by the real wall cost of each engine step —
-        deterministic token output, realistic latency accounting."""
+        deterministic token output, realistic latency accounting.
+
+        ``on_step(step_index)`` (optional) runs at each step boundary
+        INSIDE the timed window, so any wall time it spends (a chaos
+        slow-decode injection, a host-side stall) inflates the virtual
+        clock exactly like a slow engine step would — the hook the
+        chaos harness drives instead of forking this loop."""
         pending = sorted(requests, key=lambda r: (r.arrival_t, r.rid))
         now = start
         results: List[RequestResult] = []
         i = 0
+        step_idx = 0
         while True:
             while i < len(pending) and pending[i].arrival_t <= now + 1e-12:
                 self.submit(pending[i])
@@ -439,15 +534,18 @@ class ServingEngine:
                 now = max(now, pending[i].arrival_t)   # idle-skip to next
                 continue
             t0 = time.perf_counter()
+            if on_step is not None:
+                on_step(step_idx)
             results.extend(self.step(now))
             now += time.perf_counter() - t0
-        if self.run_log is not None:
+            step_idx += 1
+        if self.run_log is not None or self.telemetry is not None:
             n_tokens = sum(len(r.tokens) for r in results)
             elapsed = max(now - start, 1e-9)
-            self.run_log.log("serve", event="report",
-                             requests=len(results), tokens=n_tokens,
-                             elapsed_s=elapsed,
-                             tokens_per_s=n_tokens / elapsed)
+            self._log_serve(event="report",
+                            requests=len(results), tokens=n_tokens,
+                            elapsed_s=elapsed, now=now,
+                            tokens_per_s=n_tokens / elapsed)
         return sorted(results, key=lambda r: r.rid)
 
     def close(self):

@@ -4,6 +4,12 @@ A `Request` is one user sequence: prompt ids + a decode budget.  The
 engine stamps the SLO-relevant timeline into `RequestStats` using the
 DRIVER'S clock (virtual in tests, wall in tools_serving.py) so TTFT /
 e2e latency percentiles are deterministic under a simulated timeline.
+
+Every request belongs to an `SLOClass` — a named latency contract
+(TTFT target + per-token-gap target).  The default single class carries
+no targets, so class-free callers see exactly the old behavior; classed
+traffic gets per-class labeled histograms, attainment and goodput in
+`serving/slo_report.py` (docs/serving.md).
 """
 from __future__ import annotations
 
@@ -11,6 +17,57 @@ import dataclasses
 from typing import List, Optional
 
 import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class SLOClass:
+    """A named latency contract.  Targets are optional: None means the
+    dimension is uncontracted (always attained); the default class has
+    no targets at all — classless traffic reports attainment 1.0 and
+    its tokens all count toward goodput."""
+    name: str = "default"
+    ttft_s: Optional[float] = None       # arrival -> first token target
+    token_gap_s: Optional[float] = None  # mean inter-token gap target
+
+    def __post_init__(self):
+        if not self.name:
+            raise ValueError("SLO class needs a name")
+        for fld in ("ttft_s", "token_gap_s"):
+            v = getattr(self, fld)
+            if v is not None and v <= 0:
+                raise ValueError(f"SLO class {self.name!r}: {fld} must "
+                                 f"be positive, got {v}")
+
+    def to_dict(self) -> dict:
+        return {"name": self.name, "ttft_s": self.ttft_s,
+                "token_gap_s": self.token_gap_s}
+
+    @staticmethod
+    def parse(spec: str) -> "SLOClass":
+        """``name[:ttft_s[:token_gap_s]]`` (empty/'-' = no target) —
+        the CLI surface: ``--slo-class gold:0.2:0.05``.  Extra fields
+        and non-numeric targets are loud errors: a silently dropped
+        field would run a different contract than the user typed."""
+        parts = spec.split(":")
+        if not parts[0] or len(parts) > 3:
+            raise ValueError(f"bad SLO class spec {spec!r}; want "
+                             "name[:ttft_s[:token_gap_s]]")
+
+        def num(i, what):
+            if len(parts) <= i or parts[i] in ("", "-"):
+                return None
+            try:
+                return float(parts[i])
+            except ValueError:
+                raise ValueError(
+                    f"bad SLO class spec {spec!r}: {what} "
+                    f"{parts[i]!r} is not a number (use '-' for no "
+                    "target)") from None
+        return SLOClass(parts[0], num(1, "ttft_s"),
+                        num(2, "token_gap_s"))
+
+
+DEFAULT_SLO = SLOClass()
 
 
 @dataclasses.dataclass
@@ -21,6 +78,7 @@ class Request:
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     arrival_t: float = 0.0
+    slo: SLOClass = DEFAULT_SLO
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -62,6 +62,11 @@ class Scheduler:
         self.page_table = np.zeros((num_slots, self.max_pages), np.int32)
         self.admitted = 0
         self.released = 0
+        #: why the LAST failed admission attempt stalled (the
+        #: reserve-on-admit attribution the flight recorder reads):
+        #: "no_slot" = every decode slot live, "no_pages" = the queue
+        #: head's full reservation was short; None = no stall observed
+        self.last_stall: Optional[str] = None
 
     # ----------------------------------------------------------- queue
     def submit(self, req: Request):
@@ -100,14 +105,18 @@ class Scheduler:
         are available; FIFO — a large head request blocks the queue
         rather than starving (head-of-line policy, documented limit)."""
         if not self.queue:
+            self.last_stall = None
             return None
         free = self.free_slots()
         if not free:
+            self.last_stall = "no_slot"
             return None
         req = self.queue[0]
         pages = self.pool.alloc(self.pool.pages_for(req.total_len))
         if pages is None:
+            self.last_stall = "no_pages"
             return None
+        self.last_stall = None
         self.queue.popleft()
         slot_idx = free[0]
         st = SlotState(request=req, pages=pages, pos=0,

@@ -1,0 +1,318 @@
+"""SLO-class analytics over a serving RunLog: THE one reader.
+
+Everything downstream of a serving run's RunLog — `tools_serving_report.py`
+(the dedicated CLI), `tools_obs_report.py`'s serving section, and the
+chaos harness's serving recovery report — parses ``serve`` events and
+``span`` records through this module, so there is exactly one place that
+knows the record schemas (no second RunLog parser, the PR 10
+one-tokenizer discipline applied to serving telemetry).
+
+The report answers the questions aggregate histograms cannot:
+
+* **per-class percentiles** — TTFT / e2e / queue wait / mean token gap
+  split by `SLOClass` (serving/request.py),
+* **SLO attainment** — the fraction of each class's finished requests
+  that met their TTFT and token-gap targets (a dimension without a
+  target is vacuously attained; the default class attains 1.0),
+* **goodput** — tokens/s counted only from requests that finished
+  within their class SLO (the Hetis-style metric: violating traffic
+  produces load, not goodput),
+* **stall attribution** — how queue time divides between ``no_slot``
+  and ``no_pages`` (the scheduler's reserve-on-admit decision, read
+  from the queued spans),
+* **reconciliation** — per request, queued + prefill + decode + pause
+  span durations vs the recorded ``e2e_s`` (the acceptance property:
+  within one engine-step quantum; exact by the tracer's tiling
+  construction).
+
+Span-derived fields degrade gracefully: with ``HETU_TPU_SERVE_TRACE``
+unset there are no span records, and the report still renders the
+per-class percentile/attainment tables from the ``done`` events alone
+(token-gap attainment then uses e2e-derived mean gaps).
+
+Pure host-side record munging — no jax, no device contact.
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from hetu_tpu.obs.metrics import percentile_of_sorted
+from hetu_tpu.obs.spans import RequestTrace, collect_traces
+
+#: bump when the report dict shape changes incompatibly (pinned by the
+#: CLI smoke tests)
+REPORT_SCHEMA = 1
+
+
+# ---------------------------------------------------------------------------
+# the one reader
+# ---------------------------------------------------------------------------
+
+def collect(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """Group a RunLog's serving records: ``serve`` events by kind plus
+    the per-request span traces.  Every serving-report consumer starts
+    here."""
+    records = list(records)
+    serves = [r for r in records if r.get("kind") == "serve"]
+    return {
+        "admits": [r for r in serves if r.get("event") == "admit"],
+        "dones": [r for r in serves if r.get("event") == "done"],
+        "reshards": [r for r in serves if r.get("event") == "reshard"],
+        "reports": [r for r in serves if r.get("event") == "report"],
+        "traces": collect_traces(records),
+        "anomalies": [r for r in records if r.get("kind") == "anomaly"],
+    }
+
+
+def request_rows(collected: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """One row per finished request: the ``done`` event's SLO timeline
+    joined with its span trace (when one was recorded).  ``*_ok``
+    fields judge the class targets; ``residual_s`` is the
+    span-vs-e2e reconciliation gap (None without spans)."""
+    traces: Dict[int, RequestTrace] = collected["traces"]
+    admits = {a.get("req"): a for a in collected["admits"]}
+    rows = []
+    for d in collected["dones"]:
+        rid = d.get("req")
+        ttft = d.get("ttft_s")
+        e2e = d.get("e2e_s")
+        tokens = d.get("tokens") or 0
+        ttft_target = d.get("slo_ttft_s")
+        gap_target = d.get("slo_token_gap_s")
+        tr = traces.get(rid)
+        row: Dict[str, Any] = {
+            "rid": rid,
+            "slo_class": str(d.get("slo_class", "default")),
+            "ttft_s": ttft, "e2e_s": e2e, "tokens": tokens,
+            "reason": d.get("reason"),
+            "ttft_target_s": ttft_target, "token_gap_target_s": gap_target,
+        }
+        if tr is not None and tr.terminal is not None:
+            row["queued_s"] = tr.duration_s("queued")
+            row["prefill_s"] = tr.duration_s("prefill")
+            row["decode_s"] = tr.duration_s("decode")
+            row["pause_s"] = tr.duration_s("reshard_pause")
+            row["stall_reason"] = tr.stall_reason
+            row["segments"] = len(tr.by_kind("decode"))
+            row["residual_s"] = tr.reconcile(e2e)
+            # mean USER-VISIBLE gap: pauses count (a reshard freeze is
+            # latency the user sits through), so the traced number
+            # equals the spanless fallback's (e2e-ttft)/(n-1) and
+            # attainment cannot change with the tracing flag
+            row["token_gap_s"] = ((row["decode_s"] + row["pause_s"])
+                                  / (tokens - 1) if tokens > 1 else None)
+        else:
+            admit = admits.get(rid, {})
+            row["queued_s"] = admit.get("queue_wait_s")
+            row["stall_reason"] = None
+            row["residual_s"] = None
+            row["token_gap_s"] = ((e2e - ttft) / (tokens - 1)
+                                  if (e2e is not None and ttft is not None
+                                      and tokens > 1) else None)
+        row["ttft_ok"] = (ttft_target is None or
+                          (ttft is not None and ttft <= ttft_target))
+        # no measurable gap (single-token request, or a spanless log
+        # missing the timeline) is vacuous attainment, not a miss —
+        # there is no inter-token gap to violate
+        row["gap_ok"] = (gap_target is None
+                         or row["token_gap_s"] is None
+                         or row["token_gap_s"] <= gap_target)
+        row["slo_ok"] = row["ttft_ok"] and row["gap_ok"]
+        rows.append(row)
+    return rows
+
+
+# ---------------------------------------------------------------------------
+# aggregation
+# ---------------------------------------------------------------------------
+
+def _pcts(vals: List[float]) -> Optional[Dict[str, float]]:
+    vals = sorted(v for v in vals if v is not None)
+    if not vals:
+        return None
+    return {"p50": percentile_of_sorted(vals, 50),
+            "p95": percentile_of_sorted(vals, 95),
+            "max": vals[-1]}
+
+
+def _elapsed_s(collected: Dict[str, Any],
+               rows: List[Dict[str, Any]]) -> Optional[float]:
+    """The run's driver-clock span: the final ``report`` event when the
+    run wrote one, else [earliest arrival, latest done] from the done
+    events' ``now`` stamps."""
+    if collected["reports"]:
+        v = collected["reports"][-1].get("elapsed_s")
+        if v:
+            return float(v)
+    ends = [d.get("now") for d in collected["dones"]
+            if d.get("now") is not None]
+    starts = [d["now"] - d["e2e_s"] for d in collected["dones"]
+              if d.get("now") is not None and d.get("e2e_s") is not None]
+    if not ends or not starts:
+        return None
+    return max(1e-9, max(ends) - min(starts))
+
+
+def class_report(rows: List[Dict[str, Any]],
+                 elapsed_s: Optional[float]) -> Dict[str, Dict[str, Any]]:
+    """Per-class table: counts, latency percentiles, attainment
+    fractions, goodput."""
+    by_cls: Dict[str, List[Dict[str, Any]]] = {}
+    for row in rows:
+        by_cls.setdefault(row["slo_class"], []).append(row)
+    out: Dict[str, Dict[str, Any]] = {}
+    for cls in sorted(by_cls):
+        rs = by_cls[cls]
+        n = len(rs)
+        tokens = sum(r["tokens"] for r in rs)
+        good_tokens = sum(r["tokens"] for r in rs if r["slo_ok"])
+        sec: Dict[str, Any] = {
+            "requests": n,
+            "tokens_out": tokens,
+            "targets": {"ttft_s": rs[0]["ttft_target_s"],
+                        "token_gap_s": rs[0]["token_gap_target_s"]},
+            "ttft_s": _pcts([r["ttft_s"] for r in rs]),
+            "e2e_s": _pcts([r["e2e_s"] for r in rs]),
+            "queue_wait_s": _pcts([r.get("queued_s") for r in rs]),
+            "token_gap_s": _pcts([r.get("token_gap_s") for r in rs]),
+            "attainment": {
+                "ttft": sum(r["ttft_ok"] for r in rs) / n,
+                "token_gap": sum(r["gap_ok"] for r in rs) / n,
+                "slo": sum(r["slo_ok"] for r in rs) / n,
+            },
+            "goodput_tokens": good_tokens,
+        }
+        if elapsed_s:
+            sec["goodput_tokens_per_s"] = good_tokens / elapsed_s
+            sec["tokens_per_s"] = tokens / elapsed_s
+        out[cls] = sec
+    return out
+
+
+def stall_breakdown(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """How queued time attributes across the scheduler's stall reasons
+    (span-traced runs only): request counts and total queued seconds per
+    reason."""
+    traced = [r for r in rows if r.get("stall_reason") is not None]
+    if not traced:
+        return None
+    counts: Dict[str, int] = {}
+    waited: Dict[str, float] = {}
+    for r in traced:
+        reason = r["stall_reason"]
+        counts[reason] = counts.get(reason, 0) + 1
+        waited[reason] = waited.get(reason, 0.0) + (r.get("queued_s") or 0.0)
+    return {"requests": counts,
+            "queued_s": {k: round(v, 6) for k, v in waited.items()}}
+
+
+def reconciliation(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    """The acceptance property's summary: span tiling vs recorded e2e
+    across every traced request."""
+    residuals = [r["residual_s"] for r in rows
+                 if r.get("residual_s") is not None]
+    if not residuals:
+        return None
+    return {"requests": len(residuals),
+            "max_residual_s": max(residuals),
+            "mean_residual_s": sum(residuals) / len(residuals)}
+
+
+def serving_report(records: Iterable[Dict[str, Any]], *,
+                   per_request: bool = False,
+                   collected: Optional[Dict[str, Any]] = None
+                   ) -> Dict[str, Any]:
+    """The full SLO-class report over a RunLog's records.  Pass a
+    pre-built ``collected`` (from :func:`collect`) to skip re-scanning
+    the records — callers that already grouped them (tools_obs_report)
+    must not pay the span-grouping walk twice."""
+    if collected is None:
+        collected = collect(records)
+    rows = request_rows(collected)
+    elapsed = _elapsed_s(collected, rows)
+    tokens = sum(r["tokens"] for r in rows)
+    good = sum(r["tokens"] for r in rows if r["slo_ok"])
+    out: Dict[str, Any] = {
+        "report_schema": REPORT_SCHEMA,
+        "requests": len(rows),
+        "tokens_out": tokens,
+        "elapsed_s": elapsed,
+        "classes": class_report(rows, elapsed),
+        "slo_attainment": (sum(r["slo_ok"] for r in rows) / len(rows)
+                           if rows else None),
+        "goodput_tokens": good,
+        "spans_recorded": sum(len(t.spans)
+                              for t in collected["traces"].values()),
+        "reshards": len(collected["reshards"]),
+    }
+    if elapsed:
+        out["tokens_per_s"] = tokens / elapsed
+        out["goodput_tokens_per_s"] = good / elapsed
+    stalls = stall_breakdown(rows)
+    if stalls is not None:
+        out["stall_breakdown"] = stalls
+    rec = reconciliation(rows)
+    if rec is not None:
+        out["reconciliation"] = rec
+    if collected["anomalies"]:
+        by_kind: Dict[str, int] = {}
+        for a in collected["anomalies"]:
+            k = str(a.get("anomaly", "unknown"))
+            by_kind[k] = by_kind.get(k, 0) + 1
+        out["anomalies"] = by_kind
+    if per_request:
+        out["per_request"] = rows
+    return out
+
+
+# ---------------------------------------------------------------------------
+# text rendering
+# ---------------------------------------------------------------------------
+
+def _fmt(v, scale=1.0, digits=4) -> str:
+    if v is None:
+        return "-"
+    return f"{v * scale:.{digits}g}"
+
+
+def render_text(report: Dict[str, Any]) -> str:
+    """The report as a fixed-width table (tools_serving_report.py's
+    default output)."""
+    lines = [
+        f"serving report: {report['requests']} requests, "
+        f"{report['tokens_out']} tokens"
+        + (f", {report['tokens_per_s']:.1f} tok/s"
+           if report.get("tokens_per_s") else "")
+        + (f", goodput {report['goodput_tokens_per_s']:.1f} tok/s"
+           if report.get("goodput_tokens_per_s") is not None else "")]
+    hdr = (f"{'class':>10} {'reqs':>5} {'ttft p50':>9} {'ttft p95':>9} "
+           f"{'e2e p95':>9} {'gap p95':>9} {'attain':>7} {'goodput':>8}")
+    lines.append(hdr)
+    lines.append("-" * len(hdr))
+    for cls, sec in report.get("classes", {}).items():
+        def pct(key, p):
+            d = sec.get(key)
+            return _fmt(d.get(p) if d else None)
+        lines.append(
+            f"{cls:>10} {sec['requests']:>5} "
+            f"{pct('ttft_s', 'p50'):>9} {pct('ttft_s', 'p95'):>9} "
+            f"{pct('e2e_s', 'p95'):>9} {pct('token_gap_s', 'p95'):>9} "
+            f"{sec['attainment']['slo']:>7.0%} "
+            f"{_fmt(sec.get('goodput_tokens_per_s'), digits=3):>8}")
+    stalls = report.get("stall_breakdown")
+    if stalls:
+        parts = [f"{k}={v}" for k, v in sorted(stalls["requests"].items())]
+        lines.append("stall attribution (requests): " + ", ".join(parts))
+        parts = [f"{k}={v:.4g}s" for k, v in sorted(stalls["queued_s"].items())]
+        lines.append("stall attribution (queued time): " + ", ".join(parts))
+    rec = report.get("reconciliation")
+    if rec:
+        lines.append(
+            f"span reconciliation: {rec['requests']} traced requests, "
+            f"max |spans - e2e| = {rec['max_residual_s']:.3g}s")
+    if report.get("anomalies"):
+        lines.append("anomalies: " + ", ".join(
+            f"{k}={n}" for k, n in sorted(report["anomalies"].items())))
+    if report.get("reshards"):
+        lines.append(f"reshards: {report['reshards']}")
+    return "\n".join(lines)

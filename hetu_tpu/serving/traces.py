@@ -7,11 +7,11 @@ batching and the load-adaptive reshard hook earn their keep.
 """
 from __future__ import annotations
 
-from typing import List, Optional
+from typing import List, Optional, Sequence
 
 import numpy as np
 
-from hetu_tpu.serving.request import Request
+from hetu_tpu.serving.request import DEFAULT_SLO, Request, SLOClass
 
 
 def poisson_arrivals(n: int, rate_per_s: float, *, seed: int = 0
@@ -51,9 +51,12 @@ def bursty_arrivals(n: int, rate_per_s: float, *, burst: int = 4,
 def synthetic_requests(n: int, *, vocab_size: int, prompt_lens=(4, 24),
                        max_new=(4, 12), eos_token_id: Optional[int] = None,
                        arrivals: Optional[np.ndarray] = None,
+                       slo_classes: Optional[Sequence[SLOClass]] = None,
                        seed: int = 0) -> List[Request]:
     """n seeded requests with uniform prompt lengths / decode budgets and
-    the given arrival times (default: all at t=0)."""
+    the given arrival times (default: all at t=0).  ``slo_classes``
+    assigns latency classes round-robin (deterministic — request i gets
+    class i % len); None keeps every request in the default class."""
     rng = np.random.default_rng(seed)
     if arrivals is None:
         arrivals = np.zeros(n)
@@ -63,9 +66,11 @@ def synthetic_requests(n: int, *, vocab_size: int, prompt_lens=(4, 24),
     for i in range(n):
         plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
         mnew = int(rng.integers(max_new[0], max_new[1] + 1))
+        slo = (slo_classes[i % len(slo_classes)] if slo_classes
+               else DEFAULT_SLO)
         reqs.append(Request(
             rid=i,
             prompt=rng.integers(0, vocab_size, size=plen).astype(np.int32),
             max_new_tokens=mnew, eos_token_id=eos_token_id,
-            arrival_t=float(arrivals[i])))
+            arrival_t=float(arrivals[i]), slo=slo))
     return reqs

@@ -1,0 +1,221 @@
+"""Request-scoped span tracer: the serving engine's flight recorder.
+
+`RequestTracer` turns the engine's host-side lifecycle callbacks into
+the typed spans of `obs/spans.py`, recorded as schema-versioned ``span``
+RunLog records.  Timestamps are the DRIVER's clock (the same virtual
+clock `ServingEngine.run` advances), so a replayed trace is
+deterministic and span durations reconcile with the SLO timeline in
+`RequestStats` exactly.
+
+Tiling contract: every span of a request opens where the previous one
+closed —
+
+    queued   [arrival_t, admit_t]                (reason: none|no_slot|no_pages)
+    prefill  [prev_end, chunk_end]               one per chunk; last ends at TTFT
+    decode   [prev_end, boundary]                split at evictions/reshard pauses
+    reshard_pause [pause_t0, pause_t1]
+    done/evicted  [t, t]                         zero-duration terminal
+
+so ``sum(durations) == done_t - arrival_t == e2e_s`` by construction
+(`slo_report` property-tests the reconciliation).
+
+Gated by ``HETU_TPU_SERVE_TRACE`` (`maybe_tracer`): unset means the
+engine holds no tracer and does zero per-step tracing work — a single
+None check, the `maybe_health_monitor` discipline.  The tracer itself
+never touches the device: enabling it cannot perturb any compiled
+program (enforced by the flag's registered identity contract).
+"""
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, List, Optional
+
+from hetu_tpu.obs.spans import (SPAN_SCHEMA, RequestTrace, Span,
+                                new_trace_id)
+
+
+class _Open:
+    """Per-request open state between span boundaries."""
+
+    __slots__ = ("rid", "trace", "slo_class", "slot", "phase", "last_t",
+                 "stall_reason", "seg_tokens", "seg_index", "chunks")
+
+    def __init__(self, rid: int, trace: str, slo_class: str,
+                 arrival_t: float):
+        self.rid = rid
+        self.trace = trace
+        self.slo_class = slo_class
+        self.slot: Optional[int] = None
+        self.phase = "queued"
+        self.last_t = arrival_t          # where the next span opens
+        self.stall_reason = "none"       # reserve-on-admit attribution
+        self.seg_tokens = 0              # tokens in the open decode seg
+        self.seg_index = 0
+        self.chunks = 0
+
+
+class RequestTracer:
+    """Records request lifecycle spans; one instance per engine.
+
+    ``run_log`` receives one ``span`` record per closed span; with
+    ``keep=True`` (the default when no run_log is given) completed
+    traces are also held in memory (``traces``) for direct inspection —
+    tests and the fuzz harness read them without a disk round-trip.
+    """
+
+    def __init__(self, run_log=None, registry=None,
+                 keep: Optional[bool] = None, max_kept: int = 4096):
+        self.run_log = run_log
+        self.registry = registry
+        self.keep = (run_log is None) if keep is None else keep
+        self.max_kept = max_kept
+        self._open: Dict[int, _Open] = {}
+        #: completed RequestTraces by rid (keep=True only; bounded to
+        #: the newest ``max_kept`` so a long-lived runlog-less engine
+        #: cannot grow without limit)
+        self.traces: Dict[int, RequestTrace] = {}
+        self._kept: Dict[int, RequestTrace] = {}
+        self.spans_emitted = 0
+
+    # ------------------------------------------------------------- emit
+    def _emit(self, st: _Open, kind: str, t0: float, t1: float,
+              **attrs: Any):
+        span = Span(kind=kind, t0=t0, t1=t1, rid=st.rid, trace=st.trace,
+                    slot=st.slot, slo_class=st.slo_class, attrs=attrs)
+        self.spans_emitted += 1
+        if self.registry is not None:
+            self.registry.inc("serve.spans", span=kind)
+        if self.run_log is not None:
+            self.run_log.log("span", **span.record())
+        if self.keep:
+            tr = self._kept.get(st.rid)
+            if tr is None or tr.trace != st.trace:
+                tr = self._kept[st.rid] = RequestTrace(
+                    rid=st.rid, trace=st.trace, slo_class=st.slo_class)
+            tr.spans.append(span)
+
+    # -------------------------------------------------------- lifecycle
+    def on_submit(self, req) -> str:
+        """A request entered the queue; opens the queued span at its
+        arrival time.  Returns the assigned trace id."""
+        trace = new_trace_id(req.rid)
+        slo = getattr(req, "slo", None)
+        self._open[req.rid] = _Open(
+            req.rid, trace, slo.name if slo is not None else "default",
+            float(req.arrival_t))
+        return trace
+
+    def on_stall(self, rids: Iterable[int], reason: str):
+        """The scheduler declined admission this step; stamp the
+        reserve-on-admit reason on every still-queued request (the
+        LAST observed reason wins — it names what the request was
+        actually waiting on when it finally mattered)."""
+        for rid in rids:
+            st = self._open.get(rid)
+            if st is not None and st.phase == "queued":
+                st.stall_reason = reason
+
+    def on_admit(self, req, slot: int, now: float):
+        st = self._open.get(req.rid)
+        if st is None:
+            return
+        st.slot = slot
+        self._emit(st, "queued", st.last_t, now, reason=st.stall_reason)
+        st.phase = "prefill"
+        st.last_t = now
+
+    def on_chunk(self, req, now: float, chunk: int):
+        """A non-final prefill chunk landed; the span absorbs any
+        inter-step wait since the previous boundary (tiling)."""
+        st = self._open.get(req.rid)
+        if st is None:
+            return
+        st.chunks = chunk
+        self._emit(st, "prefill", st.last_t, now, chunk=chunk)
+        st.last_t = now
+
+    def on_first_token(self, req, slot: int, now: float, *, chunk: int):
+        """The final prefill chunk landed and the first token was
+        emitted (TTFT); closes prefill and opens the decode segment."""
+        st = self._open.get(req.rid)
+        if st is None:
+            return
+        st.slot = slot
+        st.chunks = chunk
+        self._emit(st, "prefill", st.last_t, now, chunk=chunk, last=True)
+        st.phase = "decode"
+        st.last_t = now
+        st.seg_tokens = 0
+        st.seg_index = 0
+
+    def on_token(self, req, now: float):
+        st = self._open.get(req.rid)
+        if st is not None and st.phase == "decode":
+            st.seg_tokens += 1
+
+    def _close_segment(self, st: _Open, now: float, end: str):
+        if st.phase != "decode":
+            return
+        if now > st.last_t or st.seg_tokens:
+            self._emit(st, "decode", st.last_t, now,
+                       tokens=st.seg_tokens, segment=st.seg_index,
+                       end=end)
+            st.seg_index += 1
+        st.last_t = now
+        st.seg_tokens = 0
+
+    def on_split(self, rids: Iterable[int], now: float, why: str):
+        """A batch-composition change (an eviction) at `now`: close the
+        survivors' decode segments so the boundary is visible."""
+        for rid in rids:
+            st = self._open.get(rid)
+            if st is not None:
+                self._close_segment(st, now, end=why)
+
+    def on_pause(self, rids: Iterable[int], t0: float, t1: float,
+                 **attrs: Any):
+        """A reshard froze decode over [t0, t1]: split segments at t0,
+        record the pause, and reopen at t1."""
+        for rid in rids:
+            st = self._open.get(rid)
+            if st is None or st.phase != "decode":
+                continue
+            self._close_segment(st, t0, end="reshard")
+            self._emit(st, "reshard_pause", t0, t1, **attrs)
+            st.last_t = t1
+
+    def on_finish(self, req, slot: int, reason: str, now: float, *,
+                  tokens: Optional[int] = None, e2e_s=None,
+                  evicted: bool = False):
+        """Terminal: close the open decode segment and emit the
+        zero-duration ``done`` (or ``evicted``) span."""
+        st = self._open.pop(req.rid, None)
+        if st is None:
+            return
+        st.slot = slot
+        self._close_segment(st, now, end="finish")
+        kind = "evicted" if evicted else "done"
+        self._emit(st, kind, now, now, reason=reason, tokens=tokens,
+                   e2e_s=e2e_s, chunks=st.chunks)
+        if self.keep and st.rid in self._kept:
+            self.traces[st.rid] = self._kept.pop(st.rid)
+            while len(self.traces) > self.max_kept:
+                # dicts iterate in insertion order: drop the oldest
+                self.traces.pop(next(iter(self.traces)))
+
+    # ------------------------------------------------------------ debug
+    def open_requests(self) -> List[int]:
+        return sorted(self._open)
+
+
+def maybe_tracer(run_log=None, registry=None,
+                 **kw) -> Optional[RequestTracer]:
+    """A RequestTracer when HETU_TPU_SERVE_TRACE is set, else None —
+    the one gate the engine uses, so 'flag unset' provably means zero
+    per-request tracing work (a single None check)."""
+    from hetu_tpu.utils import flags
+    if not flags.bool_flag("HETU_TPU_SERVE_TRACE"):
+        return None
+    return RequestTracer(run_log=run_log, registry=registry, **kw)
+
+
+__all__ = ["RequestTracer", "maybe_tracer", "SPAN_SCHEMA"]

@@ -187,6 +187,16 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
     Flag("HETU_TPU_SERVE_PAGES", "int", 0,
          "usable KV pages in the pool; 0 (default) = full reservation "
          "(slots * max_len / page), i.e. admission never waits on pages"),
+    Flag("HETU_TPU_SERVE_TRACE", "bool", False,
+         "serving flight recorder (serving/tracing.py): record every "
+         "request's lifecycle as schema-versioned 'span' RunLog records "
+         "— queued (with the scheduler's no_slot/no_pages stall "
+         "attribution), one span per prefill chunk, decode segments "
+         "split at evictions/reshard pauses, terminal done/evicted — "
+         "under the driver's virtual clock, so replayed traces are "
+         "deterministic.  Pure host-side bookkeeping: the compiled "
+         "prefill/decode programs are byte-identical with the flag on "
+         "or off (registered identity contract)", identity="1"),
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "Pallas fused-kernel layer routing (ops/pallas: flash attention, "
          "residual+RMS/LayerNorm, SwiGLU, rotary, blockwise quantize, "

@@ -63,7 +63,8 @@ def test_every_env_read_is_registered():
     # the serving surface (hetu_tpu/serving, docs/serving.md)
     for name in ("HETU_TPU_KV_QUANT", "HETU_TPU_SERVE_SLOTS",
                  "HETU_TPU_SERVE_PAGE", "HETU_TPU_SERVE_MAX_LEN",
-                 "HETU_TPU_SERVE_PREFILL_CHUNK", "HETU_TPU_SERVE_PAGES"):
+                 "HETU_TPU_SERVE_PREFILL_CHUNK", "HETU_TPU_SERVE_PAGES",
+                 "HETU_TPU_SERVE_TRACE"):
         assert name in flags.REGISTRY
     # the analytic step profiler + perf-budget surface
     # (obs.hlo_profile / obs.budget, docs/observability.md)
@@ -98,7 +99,10 @@ def test_identity_contract_table():
     assert table["HETU_TPU_PALLAS"] == "0"
     assert table["HETU_TPU_PROFILE"] == "1"
     assert table["HETU_TPU_LINT"] == "1"
-    assert len(table) >= 13
+    # the serving flight recorder is host-side only: ON must be a no-op
+    # for the compiled programs
+    assert table["HETU_TPU_SERVE_TRACE"] == "1"
+    assert len(table) >= 14
     # flags with NO contract must stay contract-free: these genuinely
     # change program shapes, so an identity entry would be a lie the
     # sweep turns into a tier-1 failure

@@ -83,35 +83,72 @@ def test_quantize_heads_roundtrip_error_bound():
 
 # ----------------------------------------------------------- scheduler
 def test_scheduler_admit_evict_fuzz_invariants():
-    """Randomized arrival/EOS churn: invariants (no page aliasing, exact
-    live+free partition, table mirrors) hold after every transition."""
+    """Randomized arrival/EOS churn: the memory invariants (no page
+    aliasing, exact live+free partition, table mirrors) hold after every
+    transition — AND so do the flight recorder's span-event invariants
+    (a RequestTracer rides the same churn): every admitted request ends
+    with exactly one terminal span, spans are ordered/non-overlapping,
+    and queued spans carry a reserve-on-admit stall reason."""
+    from hetu_tpu.serving.tracing import RequestTracer
     rng = np.random.default_rng(7)
     pool = _pool(num_pages=10, page_size=4)
     sched = Scheduler(num_slots=3, pool=pool, max_len=16)
+    tracer = RequestTracer()
     rid = 0
+    admits = 0
+    now = 0.0
     for _ in range(400):
+        now += 0.01                      # strictly monotone fake clock
         op = rng.random()
         if op < 0.45:
             plen = int(rng.integers(1, 10))
             mnew = int(rng.integers(1, 16 - plen + 1))
-            sched.submit(Request(rid=rid, prompt=np.ones(plen, np.int32),
-                                 max_new_tokens=mnew))
+            req = Request(rid=rid, prompt=np.ones(plen, np.int32),
+                          max_new_tokens=mnew, arrival_t=now)
+            sched.submit(req)
+            tracer.on_submit(req)
             rid += 1
         elif op < 0.8:
-            adm = sched.admit_next(now=0.0)
+            adm = sched.admit_next(now=now)
             if adm is not None:
-                _, st = adm
+                slot_idx, st = adm
                 st.pos = st.request.prompt_len   # prefill done
+                tracer.on_admit(st.request, slot_idx, now)
+                tracer.on_first_token(st.request, slot_idx, now, chunk=1)
+                admits += 1
+            elif sched.queue:
+                assert sched.last_stall in ("no_slot", "no_pages")
+                tracer.on_stall([r.rid for r in sched.queue],
+                                sched.last_stall)
         else:
             live = sched.active_slots()
             if live:
-                sched.release(int(rng.choice(live)))   # random EOS evict
+                i = int(rng.choice(live))        # random EOS evict
+                st = sched.slots[i]
+                tracer.on_token(st.request, now)
+                sched.release(i)
+                tracer.on_finish(st.request, i, "eos", now,
+                                 tokens=1, e2e_s=now - st.request.arrival_t)
         sched.check_invariants()
     # drain: everything releasable, pool fully recovered
+    now += 0.01
     for i in sched.active_slots():
+        st = sched.slots[i]
         sched.release(i)
+        tracer.on_finish(st.request, i, "eos", now,
+                         tokens=0, e2e_s=now - st.request.arrival_t)
     sched.check_invariants()
     assert pool.free_count == pool.num_pages
+
+    # span-event invariants over the whole churn
+    assert len(tracer.traces) == admits, \
+        "every admit must end in exactly one terminal span"
+    for tr in tracer.traces.values():
+        tr.validate()        # ordered, non-overlapping, queued reason,
+        #                      exactly one terminal
+        assert tr.reconcile(tr.terminal.attrs["e2e_s"]) <= 1e-9
+    # still-queued requests hold open queued spans, not traces
+    assert set(tracer.open_requests()) == {r.rid for r in sched.queue}
 
 
 def test_scheduler_rejects_impossible_requests():

@@ -7,12 +7,20 @@ recovery report.
     python tools_chaos.py --steps 48 --workers 2 --json report.json
 
 Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
-partition, corrupt, stall, slow.  A path argument loads a FaultPlan JSON
-(docs/fault_tolerance.md has the schema — the same format the
-HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs with
-HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster straggler
-detector: the report then carries the coordinator's ClusterSnapshot and
-straggler verdict (`cluster` / `straggler` keys).
+partition, corrupt, stall, slow, serve-burst.  A path argument loads a
+FaultPlan JSON (docs/fault_tolerance.md has the schema — the same format
+the HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs
+with HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster
+straggler detector: the report then carries the coordinator's
+ClusterSnapshot and straggler verdict (`cluster` / `straggler` keys).
+
+`--schedule serve-burst` runs the SERVING scenario instead: a seeded
+burst-arrival trace through the real continuous-batching engine (tiny
+llama, CPU) with a slow-decode window injected mid-run — the flight
+recorder traces every request and the report's `slo` key carries the
+per-class SLO attainment / goodput / stall attribution from
+`serving/slo_report.py` (the `tools_serving_report.py` path), plus the
+fired serving health detectors.
 
 The demo run is CPU-only and model-free (StubTrainer checkpoints real
 bytes through orbax; the control plane — reconnecting rpc client,
@@ -39,8 +47,16 @@ def main(argv=None) -> int:
     ap.add_argument("--schedule", default="kill-partition-corrupt",
                     help="named schedule or path to a FaultPlan JSON")
     ap.add_argument("--steps", type=int, default=48,
-                    help="training steps the demo cluster must complete")
-    ap.add_argument("--workers", type=int, default=2)
+                    help="training steps the demo cluster must complete "
+                         "(training schedules only)")
+    ap.add_argument("--workers", type=int, default=2,
+                    help="demo cluster size (training schedules only)")
+    ap.add_argument("--requests", type=int, default=18,
+                    help="serve-burst: requests in the arrival trace")
+    ap.add_argument("--rate", type=float, default=60.0,
+                    help="serve-burst: mean arrival rate, requests/s")
+    ap.add_argument("--burst", type=int, default=6,
+                    help="serve-burst: requests per burst")
     ap.add_argument("--workdir", default=None,
                     help="where checkpoints land (default: a tmp dir)")
     ap.add_argument("--json", dest="json_out", default=None,
@@ -48,7 +64,8 @@ def main(argv=None) -> int:
     args = ap.parse_args(argv)
 
     from hetu_tpu.chaos import FaultPlan
-    from hetu_tpu.chaos.harness import named_plan, run_chaos_demo
+    from hetu_tpu.chaos.harness import (named_plan, run_chaos_demo,
+                                        run_serving_chaos_demo)
 
     if os.path.exists(args.schedule):
         plan = FaultPlan.load(args.schedule)
@@ -56,8 +73,15 @@ def main(argv=None) -> int:
         plan = named_plan(args.schedule)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="hetu_chaos_")
-    report = run_chaos_demo(workdir, plan, num_steps=args.steps,
-                            workers=args.workers)
+    if args.schedule == "serve-burst":
+        # the serving scenario has its own knobs; the training demo's
+        # --steps/--workers do not apply to it
+        report = run_serving_chaos_demo(workdir, plan,
+                                        requests=args.requests,
+                                        rate=args.rate, burst=args.burst)
+    else:
+        report = run_chaos_demo(workdir, plan, num_steps=args.steps,
+                                workers=args.workers)
     report["schedule"] = (args.schedule
                           if os.path.exists(args.schedule)
                           else {"name": args.schedule,

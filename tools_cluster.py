@@ -60,6 +60,23 @@ def render_dashboard(snapshot: dict, straggler: dict) -> str:
             f"{anoms:>5} "
             f"{_fmt(s.get('ratio'), digits=3):>7} "
             f"{'YES' if s.get('straggler') else '':>4}")
+    serving = {r: w["serving"] for r, w in workers.items()
+               if w.get("serving")}
+    if serving:
+        shdr = (f"{'rank':>4} {'reqs':>6} {'tokens':>8} {'queue':>6} "
+                f"{'pages':>6} {'occ':>5}")
+        lines.append("serving workers:")
+        lines.append(shdr)
+        lines.append("-" * len(shdr))
+        for rank_s in sorted(serving,
+                             key=lambda r: int(r) if r.isdigit() else r):
+            s = serving[rank_s]
+            lines.append(
+                f"{rank_s:>4} {int(s.get('requests_done') or 0):>6} "
+                f"{int(s.get('tokens_out') or 0):>8} "
+                f"{_fmt(s.get('queue_depth'), digits=3):>6} "
+                f"{_fmt(s.get('page_util'), digits=2):>6} "
+                f"{_fmt(s.get('slot_occupancy'), digits=2):>5}")
     flagged = (straggler or {}).get("stragglers") or []
     if flagged:
         lines.append(f"stragglers flagged: {flagged}")

@@ -86,13 +86,16 @@ def summarize(records) -> dict:
             out["stragglers"]["top_ratio"] = top_ratio
             out["stragglers"]["top_rank"] = top_rank
 
-    # serving runs (hetu_tpu/serving `serve` events): per-request SLO
-    # percentiles + aggregate throughput, so a serving run is inspectable
-    # with the same tooling as a training run
+    # serving runs (hetu_tpu/serving `serve` events + `span` records):
+    # per-request SLO percentiles, the per-class attainment/goodput
+    # table and stall attribution — all read through the ONE serving
+    # RunLog reader (hetu_tpu/serving/slo_report.py; no second parser)
     if serves:
-        dones = [r for r in serves if r.get("event") == "done"]
-        reshards = [r for r in serves if r.get("event") == "reshard"]
-        reports = [r for r in serves if r.get("event") == "report"]
+        from hetu_tpu.serving import slo_report as _slo
+        collected = _slo.collect(records)
+        dones = collected["dones"]
+        reshards = collected["reshards"]
+        reports = collected["reports"]
         srv: dict = {"events": len(serves), "requests_done": len(dones)}
         ttfts = sorted(float(r["ttft_s"]) for r in dones
                        if r.get("ttft_s") is not None)
@@ -121,6 +124,14 @@ def summarize(records) -> dict:
             reasons[k] = reasons.get(k, 0) + 1
         if reasons:
             srv["finished_by"] = reasons
+        if dones:
+            rep = _slo.serving_report(records, collected=collected)
+            srv["classes"] = rep["classes"]
+            srv["slo_attainment"] = rep["slo_attainment"]
+            for k in ("goodput_tokens_per_s", "stall_breakdown",
+                      "reconciliation"):
+                if rep.get(k) is not None:
+                    srv[k] = rep[k]
         out["serving"] = srv
 
     # analytic step profiles (obs.hlo_profile, HETU_TPU_PROFILE=1): the

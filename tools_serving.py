@@ -4,13 +4,21 @@ synthetic arrival trace and print an SLO report.
     JAX_PLATFORMS=cpu python tools_serving.py --requests 16 --rate 20
     python tools_serving.py --trace bursty --burst 6 --quant int8
     python tools_serving.py --requests 32 --runlog /tmp/serve.jsonl
+    python tools_serving.py --trace poisson --requests 16 \
+        --slo-class gold:0.2:0.05 --slo-class bulk \
+        --runlog /tmp/serve.jsonl --chrome-trace /tmp/serve_trace.json
 
 Seeded and CPU-safe (tiny LLaMA by default): the same trace replays to
 the same tokens every run.  The report is one JSON object — request
 count, TTFT / e2e latency percentiles, tokens/s, slot occupancy and
 cache-page utilization — plus RunLog ``serve`` events when --runlog is
 given (summarize those with `python tools_obs_report.py <runlog>`).
-See docs/serving.md.
+
+`--slo-class name[:ttft_s[:token_gap_s]]` (repeatable) assigns latency
+classes round-robin; per-class attainment/goodput come from
+`python tools_serving_report.py <runlog>`.  `--chrome-trace OUT.json`
+turns on the flight recorder (the HETU_TPU_SERVE_TRACE path) and
+renders the per-slot span timeline for Perfetto.  See docs/serving.md.
 """
 from __future__ import annotations
 
@@ -108,6 +116,15 @@ def main(argv=None) -> int:
                     help="per-request EOS token id")
     ap.add_argument("--runlog", default=None,
                     help="also write RunLog `serve` events here")
+    ap.add_argument("--slo-class", action="append", default=None,
+                    metavar="NAME[:TTFT_S[:GAP_S]]",
+                    help="SLO class spec, repeatable; classes assign "
+                         "round-robin over the request stream ('-' or "
+                         "empty target = uncontracted)")
+    ap.add_argument("--chrome-trace", metavar="OUT.json", default=None,
+                    help="record request spans (the HETU_TPU_SERVE_TRACE "
+                         "flight recorder) and render the per-slot "
+                         "timeline here (open in Perfetto)")
     ap.add_argument("--per-request", action="store_true",
                     help="include the per-request table in the report")
     args = ap.parse_args(argv)
@@ -115,6 +132,7 @@ def main(argv=None) -> int:
     from hetu_tpu import serving
     from hetu_tpu.obs.metrics import MetricsRegistry
     from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.utils import flags as _flags
 
     model, params = build_model(args.model)
     n = args.requests
@@ -129,10 +147,12 @@ def main(argv=None) -> int:
         raise SystemExit(f"unknown --trace {args.trace!r}")
     lo, hi = (int(x) for x in args.prompt_lens.split(","))
     mlo, mhi = (int(x) for x in args.max_new.split(","))
+    slo_classes = ([serving.SLOClass.parse(s) for s in args.slo_class]
+                   if args.slo_class else None)
     reqs = serving.synthetic_requests(
         n, vocab_size=model.config.vocab_size, prompt_lens=(lo, hi),
         max_new=(mlo, mhi), eos_token_id=args.eos, arrivals=arrivals,
-        seed=args.seed)
+        slo_classes=slo_classes, seed=args.seed)
 
     cfg_kw = dict(num_slots=args.slots, page_size=args.page,
                   max_len=args.max_len, prefill_chunk=args.chunk,
@@ -142,9 +162,17 @@ def main(argv=None) -> int:
     cfg = serving.ServeConfig.from_flags(**cfg_kw)
 
     registry = MetricsRegistry()
-    run_log = RunLog(args.runlog) if args.runlog else None
+    runlog_path = args.runlog
+    if args.chrome_trace and not runlog_path:
+        # the span renderer reads records back from a RunLog; without an
+        # explicit one, record into a scratch file next to the trace
+        runlog_path = args.chrome_trace + ".runlog.jsonl"
+    run_log = RunLog(runlog_path) if runlog_path else None
+    tracer = None
+    if args.chrome_trace or _flags.bool_flag("HETU_TPU_SERVE_TRACE"):
+        tracer = serving.RequestTracer(run_log=run_log, registry=registry)
     eng = serving.ServingEngine(model, params, cfg, registry=registry,
-                                run_log=run_log)
+                                run_log=run_log, tracer=tracer)
     print(f"# warmup (compiling {args.model} prefill/decode programs)...",
           file=sys.stderr)
     eng.warmup()
@@ -153,18 +181,27 @@ def main(argv=None) -> int:
     rep = slo_report(results, registry)
     rep["trace"] = args.trace
     rep["kv_quant"] = cfg.kv_quant
+    if slo_classes:
+        rep["slo_classes"] = [c.to_dict() for c in slo_classes]
     if args.per_request:
         rep["per_request"] = [
             {"rid": r.rid, "tokens": len(r.tokens),
-             "reason": r.finished_reason,
+             "reason": r.finished_reason, "slo_class": reqs[r.rid].slo.name,
              "ttft_s": r.stats.ttft_s, "e2e_s": r.stats.e2e_s}
             for r in results]
     print(json.dumps(rep, indent=2))
     if run_log is not None:
         run_log.close()
-        print(f"# serve events written to {args.runlog} "
-              f"(summarize: python tools_obs_report.py {args.runlog})",
-              file=sys.stderr)
+        print(f"# serve events written to {runlog_path} "
+              f"(summarize: python tools_obs_report.py {runlog_path}; "
+              f"per-class SLO: python tools_serving_report.py "
+              f"{runlog_path})", file=sys.stderr)
+    if args.chrome_trace:
+        from hetu_tpu.obs.trace import serving_trace
+        records = RunLog.read(runlog_path)
+        serving_trace(records).save(args.chrome_trace)
+        print(f"# per-slot span timeline written to {args.chrome_trace} "
+              f"(open at https://ui.perfetto.dev)", file=sys.stderr)
     return 0 if len(results) == len(reqs) else 1
 
 

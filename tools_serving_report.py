@@ -1,0 +1,72 @@
+"""SLO-class serving report: per-class latency percentiles, SLO
+attainment, goodput and stall attribution from a serving RunLog.
+
+    python tools_serving.py --requests 32 --runlog /tmp/serve.jsonl \
+        --slo-class gold:0.2:0.05 --slo-class bulk
+    python tools_serving_report.py /tmp/serve.jsonl
+    python tools_serving_report.py /tmp/serve.jsonl --json
+    python tools_serving_report.py /tmp/serve.jsonl --per-request --json
+
+Reads the ``serve`` events (admit/done/reshard/report) and — when the
+run traced with ``HETU_TPU_SERVE_TRACE`` — the ``span`` records, all
+through the ONE reader in `hetu_tpu/serving/slo_report.py` (the same
+module `tools_obs_report.py`'s serving section uses; there is no second
+RunLog parser).  With spans present the report adds stall attribution
+(`no_slot` vs `no_pages` queue time) and the span-vs-e2e reconciliation
+check; without them it degrades to the done-event percentile and
+attainment tables.
+
+Pure host-side file munging: no device contact, safe when the TPU
+tunnel is down.  See docs/serving.md (SLO classes) and
+docs/observability.md (span schema).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        description="Per-class SLO report (attainment, goodput, stall "
+                    "attribution, span reconciliation) over a serving "
+                    "RunLog.")
+    ap.add_argument("runlog", help="path to a runlog.jsonl with serve "
+                                   "events (tools_serving.py --runlog)")
+    ap.add_argument("--json", action="store_true",
+                    help="print the full report as JSON instead of the "
+                         "text table")
+    ap.add_argument("--per-request", action="store_true",
+                    help="include the per-request rows (implies detail "
+                         "in --json; appended as a table otherwise)")
+    args = ap.parse_args(argv)
+
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.serving import slo_report
+
+    records = RunLog.read(args.runlog)
+    if not any(r.get("kind") in ("serve", "span") for r in records):
+        print(f"no serving records in {args.runlog}", file=sys.stderr)
+        return 1
+    rep = slo_report.serving_report(records, per_request=args.per_request)
+    if args.json:
+        print(json.dumps(rep, indent=2))
+        return 0
+    rows = rep.pop("per_request", None)
+    print(slo_report.render_text(rep))
+    if rows:
+        hdr = (f"{'rid':>5} {'class':>10} {'ttft':>8} {'e2e':>8} "
+               f"{'toks':>5} {'stall':>9} {'slo':>4}")
+        print(hdr)
+        print("-" * len(hdr))
+        for r in rows:
+            print(f"{r['rid']:>5} {r['slo_class']:>10} "
+                  f"{(r['ttft_s'] or 0):>8.4f} {(r['e2e_s'] or 0):>8.4f} "
+                  f"{r['tokens']:>5} {str(r.get('stall_reason') or '-'):>9} "
+                  f"{'ok' if r['slo_ok'] else 'MISS':>4}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,5 +1,5 @@
-"""Canonical programs the linter judges: ONE train step and ONE serving
-decode, built the same way every time.
+"""Canonical programs the linter judges: ONE train step, ONE serving
+decode, and ONE MoE forward+backward, built the same way every time.
 
 The flag-identity sweep (flag_identity.py) lowers these under each
 contracted flag value and diffs fingerprints against an unset
@@ -10,8 +10,9 @@ these builders so "the canonical program" means exactly one thing.
 Shapes are tiny on purpose (the sweep lowers the train step a dozen
 times): a 2-layer scanned llama on the dp=4 virtual CPU mesh — the same
 configuration the per-flag byte-identity tests used before the sweep
-replaced them — and the 8-slot serving decode program at page 8 /
-max_len 32.
+replaced them — the 8-slot serving decode program at page 8 /
+max_len 32, and a one-block unrolled MoE train step (single device) so
+the sweep's identity claims also cover the routing/dispatch code path.
 
 Every flag under contract acts at Trainer/ServingEngine BUILD time or
 at trace time, so the builders construct FRESH objects per call: the
@@ -47,9 +48,10 @@ def scoped_env(**vals: Optional[str]) -> Iterator[None]:
 
 
 def canonical_batch(n: int = 8, seq: int = 64,
-                    seed: int = 0) -> Dict[str, np.ndarray]:
+                    seed: int = 0, vocab: int = 250
+                    ) -> Dict[str, np.ndarray]:
     rng = np.random.default_rng(seed)
-    ids = rng.integers(1, 250, size=(n, seq)).astype(np.int32)
+    ids = rng.integers(1, vocab, size=(n, seq)).astype(np.int32)
     return {"input_ids": ids, "labels": ids.copy()}
 
 
@@ -92,6 +94,45 @@ def train_step_text(*, optimized: bool = False, dp: int = 4,
         tr.close()
 
 
+def canonical_moe_trainer():
+    """The canonical MoE train-step owner: one UNROLLED MoE llama block
+    (sort dispatch, 4 experts, top-2) on a single device — tiny because
+    the sweep lowers it once per contracted flag, unrolled because the
+    numerics observatory's router taps live at the loss-trace level
+    (scanned layer bodies cannot hand values out; documented in
+    docs/observability.md)."""
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+    cfg = LlamaConfig.tiny(
+        remat=False, use_scan=False, num_experts=4, moe_top_k=2,
+        num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+        vocab_size=128, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, moe_capacity_factor=1.0)
+    st = ParallelStrategy(mesh=MeshConfig(dp=1))
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=4,
+                        seq_len=16, lr=1e-3, warmup_steps=2,
+                        total_steps=10, log_every=1000)
+    return Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+
+
+def canonical_moe_batch(seed: int = 0) -> Dict[str, np.ndarray]:
+    return canonical_batch(n=4, seq=16, seed=seed, vocab=120)
+
+
+def moe_step_text(*, optimized: bool = False) -> str:
+    """Lowered text of the canonical MoE forward+backward step under the
+    CURRENT environment — the sweep's third program, covering the MoE
+    code path (routing, sort dispatch, expert einsums, aux losses) that
+    neither the dense train step nor the serving decode exercises."""
+    tr = canonical_moe_trainer()
+    try:
+        return tr.lowered_step(canonical_moe_batch(), optimized=optimized)
+    finally:
+        tr.close()
+
+
 def serving_decode_text(*, optimized: bool = False) -> str:
     """Lowered text of the canonical serving decode program under the
     CURRENT environment (flags read through ServeConfig.from_flags and
@@ -130,4 +171,5 @@ def serving_decode_text(*, optimized: bool = False) -> str:
 PROGRAMS = {
     "train": train_step_text,
     "decode": serving_decode_text,
+    "moe": moe_step_text,
 }

@@ -100,6 +100,16 @@ def _sync_bucket(flat, axis_name: str, dp: int, block_size: int,
     # peer i receives every replica's piece of shard i
     q, s, new_a2a = ef_quantize(
         flat, None if ef_a2a is None else ef_a2a[0], block_size, bits=bits)
+    # numerics SNR tap (obs/numerics.py): ef_quantize's residual IS the
+    # exact quantization error of this stage — measured against the
+    # buffer actually quantized (grads + carried EF residual in the -ef
+    # modes, so the SNR reads wire fidelity; residual GROWTH has its own
+    # `ef` scope + detector and must not alias into this one).  Costs
+    # two power reductions, only traced when a collector is active.
+    from hetu_tpu.obs import numerics as _numerics
+    if _numerics.active():
+        sig = flat if ef_a2a is None else flat + ef_a2a[0]
+        _numerics.tap_quant_error("grad_sync/a2a", sig, new_a2a)
     if ef_a2a is not None:
         new_a2a = new_a2a[None]                      # keep the [1, L] lane
     q = _maybe_pack(q.reshape(dp, nblk, block_size), bits)
@@ -111,6 +121,9 @@ def _sync_bucket(flat, axis_name: str, dp: int, block_size: int,
 
     # stage 2: re-quantize the reduced shard, gather everyone's shard
     q2, s2, new_ag = ef_quantize(shard, ef_ag, block_size, bits=bits)
+    if _numerics.active():
+        sig2 = shard if ef_ag is None else shard + ef_ag
+        _numerics.tap_quant_error("grad_sync/ag", sig2, new_ag)
     qg = lax.all_gather(_maybe_pack(q2, bits), axis_name, axis=0)
     sg = lax.all_gather(s2, axis_name, axis=0)       # [dp, nblk]
     qg = _maybe_unpack(qg, bits)
@@ -134,8 +147,15 @@ def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
     nblk_c = chunk // block_size
     nblk_s = sub // block_size
 
+    from hetu_tpu.obs import numerics as _numerics
+
     def q_rows(x, rows, nblk):
         q, s = quantize_blockwise(x, block_size, bits=bits)
+        if _numerics.active():
+            # the hierarchical schedule's four quantize points accumulate
+            # into ONE scope (the per-point split is a wire detail)
+            _numerics.tap_quant_error(
+                "grad_sync/two_level", x, x - dequantize_blockwise(q, s))
         return (_maybe_pack(q.reshape(rows, nblk, block_size), bits),
                 s.reshape(rows, nblk))
 
@@ -159,6 +179,10 @@ def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
                        axis_index_groups=inter)
     sub_sum = dq_sum(q, s)                            # [sub], globally summed
     q2, s2 = quantize_blockwise(sub_sum, block_size, bits=bits)
+    if _numerics.active():
+        _numerics.tap_quant_error(
+            "grad_sync/two_level", sub_sum,
+            sub_sum - dequantize_blockwise(q2, s2))
     qg = lax.all_gather(_maybe_pack(q2, bits), axis_name, axis=0,
                         axis_index_groups=inter)
     sg = lax.all_gather(s2, axis_name, axis=0, axis_index_groups=inter)
@@ -167,6 +191,10 @@ def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
 
     # stage 3: intra-slice all-gather of the finished shard (fast links)
     q3, s3 = quantize_blockwise(shard_full, block_size, bits=bits)
+    if _numerics.active():
+        _numerics.tap_quant_error(
+            "grad_sync/two_level", shard_full,
+            shard_full - dequantize_blockwise(q3, s3))
     qg = lax.all_gather(_maybe_pack(q3.reshape(nblk_c, block_size), bits),
                         axis_name, axis=0, axis_index_groups=intra)
     sg = lax.all_gather(s3, axis_name, axis=0, axis_index_groups=intra)

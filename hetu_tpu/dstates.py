@@ -357,16 +357,30 @@ def convert(x, src: DistributedStates, dst: DistributedStates):
     """
     from hetu_tpu.comm import collectives as qc
     mode = qc.sp_mode()
+
+    def _probe(op: str, payload):
+        # numerics SNR probe (obs/numerics.py): the quantized collectives
+        # are custom_vjp-wrapped, so their internal (q, scales) pair
+        # cannot escape their own trace — measure the identical
+        # quantize->dequantize roundtrip at THIS call site instead
+        # (same primitives, deterministic, only traced when a numerics
+        # collector is active and a frame is open in this trace).
+        from hetu_tpu.obs import numerics as _numerics
+        if _numerics.active() and qc.eligible(payload, mode):
+            _numerics.tap_quant_roundtrip(f"sp/{op}", payload, mode)
+
     for plan in deduce_comm(src, dst):
         if plan.kind is CommType.NONE:
             continue
         elif plan.kind is CommType.ALL_REDUCE:
             if mode != "none":
+                _probe("all_reduce", x)
                 x = qc.all_reduce_q(x, plan.axis, mode=mode)
             else:
                 x = lax.psum(x, plan.axis)
         elif plan.kind is CommType.REDUCE_SCATTER:
             if mode != "none":
+                _probe("reduce_scatter", x)
                 x = qc.reduce_scatter_q(x, plan.axis,
                                         scatter_dimension=plan.dst_dim,
                                         tiled=True, mode=mode)
@@ -374,12 +388,14 @@ def convert(x, src: DistributedStates, dst: DistributedStates):
                 x = lax.psum_scatter(x, plan.axis, scatter_dimension=plan.dst_dim, tiled=True)
         elif plan.kind is CommType.ALL_GATHER:
             if mode != "none":
+                _probe("all_gather", x)
                 x = qc.all_gather_q(x, plan.axis, axis=plan.src_dim,
                                     tiled=True, mode=mode)
             else:
                 x = lax.all_gather(x, plan.axis, axis=plan.src_dim, tiled=True)
         elif plan.kind is CommType.ALL_TO_ALL:
             if mode != "none":
+                _probe("all_to_all", x)
                 x = qc.all_to_all_q(x, plan.axis, split_axis=plan.dst_dim,
                                     concat_axis=plan.src_dim, mode=mode)
             else:

@@ -224,6 +224,24 @@ class Trainer:
             runlog=self.run_log,
             emergency_hook=(self._health_emergency_save
                             if self._ckpt is not None else None))
+        # -- numerics observatory (obs/numerics.py, HETU_TPU_NUMERICS):
+        # read ONCE at build — the identity contract is that unset means
+        # the step wrapper never runs and the traced program is
+        # byte-identical to the seed.  The numerics health detectors
+        # (underflow_creep, quant_snr_collapse, ef_residual_blowup,
+        # router_collapse) ride the same HETU_TPU_HEALTH gate as the
+        # scalar monitor above.
+        from hetu_tpu.obs.numerics import numerics_enabled, record_every
+        self._numerics = numerics_enabled()
+        self._numerics_every = record_every()
+        from hetu_tpu.obs.health import maybe_numerics_health_monitor
+        self._num_health = (maybe_numerics_health_monitor(
+            runlog=self.run_log) if self._numerics else None)
+        # loss-scale transition tracking (scaler RunLog events +
+        # scaler.loss_scale gauge — active whenever AMP is, numerics or
+        # not: scale dynamics were previously unobservable)
+        self._last_loss_scale = None
+        self._pending_scale = None
         c = config
         self.optimizer = optim.AdamW(
             lr=optim.cosine_schedule(c.lr, c.warmup_steps, c.total_steps,
@@ -580,6 +598,28 @@ class Trainer:
             loss_reduction="sum", labels_shifted=self._labels_shifted)
 
     def _train_step(self, params, opt_state, batches, rng, scaler_state):
+        """The traced step the PlanPool jits.  With HETU_TPU_NUMERICS on
+        it wraps the real step in a numerics collector: taps anywhere in
+        the step's trace accumulate into an auxiliary stats pytree that
+        rides out under ``metrics["numerics"]`` (donation-safe — metrics
+        are never donated; host-fetched only on record boundaries).
+        Flag unset: the wrapper never runs, the trace is byte-identical
+        (registered identity contract, swept by tools_lint --flags)."""
+        if not self._numerics:
+            return self._train_step_impl(params, opt_state, batches, rng,
+                                         scaler_state)
+        from hetu_tpu.obs import numerics as _numerics
+        with _numerics.collecting() as col:
+            params, opt_state, metrics, scaler_state = \
+                self._train_step_impl(params, opt_state, batches, rng,
+                                      scaler_state)
+            stats = col.finalize()
+            if stats:
+                metrics = dict(metrics, numerics=stats)
+        return params, opt_state, metrics, scaler_state
+
+    def _train_step_impl(self, params, opt_state, batches, rng,
+                         scaler_state):
         """batches: pytree with leading micro-batch dim [n_micro, mb, seq]."""
         c = self.config
         lead = jax.tree.leaves(batches)[0]
@@ -642,12 +682,25 @@ class Trainer:
                 params, batches, keys, scale, ef_state)
         else:
             keys = jax.random.split(rng, n_micro)
-            grads, lsum, csum = self._accumulate_grads(
+            grads, lsum, csum, mstats = self._accumulate_grads(
                 params, batches, keys, scale)
+            if mstats:
+                # model-scope taps drained inside the micro scan, stacked
+                # [n_micro, ...] by its ys — fold per stat rule and hand
+                # to the ambient collector (no-op when numerics is off)
+                from hetu_tpu.obs import numerics as _numerics
+                _numerics.merge(_numerics.reduce_stacked(mstats))
 
         denom = jnp.maximum(csum, 1.0)
         # fold the unscale into the token normalize (one pass over grads)
         grads = jax.tree.map(lambda g: g / (denom * scale), grads)
+        if self._numerics:
+            from hetu_tpu.obs import numerics as _numerics
+            _numerics.tap_tree("params", params)
+            _numerics.tap_tree("grads", grads)
+            if self._scaler is not None:
+                _numerics.tap_stats("scaler",
+                                    scale=scaler_state["scale"])
         grads_sharded = False
         if getattr(self.strategy, "zero_stage", 1) >= 2 and self.strategy.dp > 1:
             # ZeRO-2: keep grads dp-sharded through clip+update (GSPMD turns
@@ -725,29 +778,39 @@ class Trainer:
 
     # ------------------------------------------------------------------
     def _accumulate_grads(self, params, batches, keys, scale):
-        """The micro-batch grad-accumulation scan -> (sum-grads, loss sum,
-        token count).  ONE definition shared by the GSPMD path and the
-        compressed shard_map body — fp32/int8 loss parity is defined by
-        these being the same arithmetic, so they must not drift apart."""
+        """The micro-batch grad-accumulation scan -> (sum-grads, loss
+        sum, token count, per-micro numerics stats).  ONE definition
+        shared by the GSPMD path and the compressed shard_map body —
+        fp32/int8 loss parity is defined by these being the same
+        arithmetic, so they must not drift apart.
+
+        The stats frame opens INSIDE the grad-traced loss so the model's
+        boundary taps (embed/hidden/logits, MoE router) can escape the
+        transform legally via value_and_grad's aux channel; the scan
+        stacks them [n_micro, ...] into its ys (an empty pytree — and an
+        unchanged trace — when numerics is off)."""
+        from hetu_tpu.obs import numerics as _numerics
+
         def micro(acc, xs):
             batch, key = xs
 
             def scaled_loss(p):
-                l, count = self._loss_fn(p, batch, key)
-                return l.astype(jnp.float32) * scale, (l, count)
+                with _numerics.frame() as nf:
+                    l, count = self._loss_fn(p, batch, key)
+                return l.astype(jnp.float32) * scale, (l, count, nf.stats)
 
-            (_, (l, count)), g = jax.value_and_grad(
+            (_, (l, count, ns)), g = jax.value_and_grad(
                 scaled_loss, has_aux=True)(params)
             acc_g, acc_l, acc_c = acc
             return (jax.tree.map(jnp.add, acc_g, g), acc_l + l,
-                    acc_c + count), None
+                    acc_c + count), ns
 
         zero_g = jax.tree.map(lambda p: jnp.zeros(p.shape, jnp.float32),
                               params)
         zero = jnp.zeros((), jnp.float32)
-        (grads, lsum, csum), _ = jax.lax.scan(
+        (grads, lsum, csum), mstats = jax.lax.scan(
             micro, (zero_g, zero, zero), (batches, keys))
-        return grads, lsum, csum
+        return grads, lsum, csum, mstats
 
     def _compressed_grads(self, params, batches, keys, scale, ef_state):
         """Per-replica grad accumulation + quantized DP sync, as ONE
@@ -765,23 +828,35 @@ class Trainer:
         from jax.experimental.shard_map import shard_map
         from hetu_tpu.comm.grad_sync import (ef_specs, per_replica_keys,
                                              quantized_grad_sync)
+        from hetu_tpu.obs import numerics as _numerics
         dp = self.strategy.dp
 
         def body(params, batches, keys, scale, ef_state):
             keys = per_replica_keys(keys, "dp")
-            grads, lsum, csum = self._accumulate_grads(
+            grads, lsum, csum, mstats = self._accumulate_grads(
                 params, batches, keys, scale)
             # "grad_sync" scope: the explicit quantized collectives are
             # individually attributable in the per-layer HLO profile
             # (the GSPMD path's implicit all-reduce cannot be scoped —
             # it inherits its producing layer's scope; documented limit)
             with jax.named_scope("grad_sync"):
-                grads, new_ef = quantized_grad_sync(
-                    grads, "dp", dp, self._bucket_plan,
-                    self._grad_compress, ef_state,
-                    topology=self._comm_topology)
+                with _numerics.frame() as nf:
+                    grads, new_ef = quantized_grad_sync(
+                        grads, "dp", dp, self._bucket_plan,
+                        self._grad_compress, ef_state,
+                        topology=self._comm_topology)
+            nstats = {}
+            if _numerics.active():
+                # micro-stacked model stats + the sync's SNR taps + EF
+                # residual norms, folded across dp inside the manual
+                # region so the body can return replicated stats
+                nstats = dict(_numerics.reduce_stacked(mstats))
+                nstats.update(nf.stats)
+                if new_ef:
+                    nstats["ef"] = _numerics.tree_stats(new_ef)
+                nstats = _numerics.reduce_axis(nstats, "dp")
             return (grads, jax.lax.psum(lsum, "dp"),
-                    jax.lax.psum(csum, "dp"), new_ef)
+                    jax.lax.psum(csum, "dp"), new_ef, nstats)
 
         batch_specs = jax.tree.map(
             lambda v: P(*([None, "dp"] + [None] * (v.ndim - 2))), batches)
@@ -789,7 +864,7 @@ class Trainer:
         fn = shard_map(
             body, mesh=self.mesh,
             in_specs=(P(), batch_specs, P(), P(), especs),
-            out_specs=(P(), P(), P(), especs),
+            out_specs=(P(), P(), P(), especs, P()),
             # the gathered grads ARE replicated over dp but the checker
             # cannot infer that through all-to-all
             check_rep=False)
@@ -797,7 +872,10 @@ class Trainer:
         with suppress_constraints():
             # the model's activation constraints (strategy.constrain) are
             # illegal AND vacuous inside the fully-manual region
-            return fn(params, batches, keys, scale, ef_state)
+            grads, lsum, csum, new_ef, nstats = fn(
+                params, batches, keys, scale, ef_state)
+        _numerics.merge(nstats)
+        return grads, lsum, csum, new_ef
 
     # ------------------------------------------------------------------
     def _batch_sharding(self, ndim: int):
@@ -1028,6 +1106,12 @@ class Trainer:
             self._registry.observe("trainer.step_time_s", step_s)
             log_boundary = (self.global_step % c.log_every) == 0
             loss = None
+            self._note_scaler(metrics)
+            nstats = (metrics.pop("numerics", None)
+                      if isinstance(metrics, dict) else None)
+            if (nstats is not None
+                    and self.global_step % self._numerics_every == 0):
+                self._record_numerics(nstats)
             if self._health is not None:
                 # the monitor needs loss/grad_norm PER STEP — a device
                 # sync the HETU_TPU_HEALTH flag explicitly opts into
@@ -1066,9 +1150,65 @@ class Trainer:
                     plan=self._plan_fingerprint(host_batch))
             if self._ckpt and (self.global_step % c.ckpt_every) == 0:
                 self.save()
+        self._flush_scaler()
         self.profiler.close()
         self._obs_summary()
         return metrics
+
+    def _note_scaler(self, metrics):
+        """Loss-scale observability (docs/observability.md): with AMP on,
+        every step updates the ``scaler.loss_scale`` gauge and every
+        growth/backoff transition leaves ONE ``scaler`` RunLog event +
+        a ``scaler.growth``/``scaler.backoff`` counter.
+
+        The loop's hot-path invariant (per-step device syncs need an
+        explicit opt-in) is preserved by reading each step's scale one
+        step LATE: the device scalar is stashed here and converted on
+        the next call — by then the producing step has long finished
+        (the device queue is serial), so float() never blocks the host
+        out of its overlap with the running step.  train() flushes the
+        last pending scale at loop exit."""
+        if self._scaler is None or "loss_scale" not in metrics:
+            return
+        self._flush_scaler()
+        self._pending_scale = (self.global_step, metrics["loss_scale"])
+
+    def _flush_scaler(self):
+        """Convert-and-record the stashed loss scale (no-op when none)."""
+        if self._pending_scale is None:
+            return
+        step, dev_scale = self._pending_scale
+        self._pending_scale = None
+        try:
+            scale = float(dev_scale)
+        except Exception:   # telemetry never kills a step
+            return
+        self._registry.set_gauge("scaler.loss_scale", scale)
+        from hetu_tpu.optim.grad_scaler import classify_transition
+        event = classify_transition(self._last_loss_scale, scale)
+        if event is not None:
+            self._registry.inc(f"scaler.{event}")
+            if self.run_log is not None:
+                self.run_log.log("scaler", event=event, scale=scale,
+                                 prev=self._last_loss_scale, step=step)
+        self._last_loss_scale = scale
+
+    def _record_numerics(self, stats):
+        """Host-fetch one step's numerics pytree (a handful of scalars,
+        every HETU_TPU_NUMERICS_EVERY steps) and fan it out through the
+        one sink: RunLog `numerics` record, numerics.* gauges (riding
+        the cluster telemetry push), moe.* gauges/counters, and the
+        numerics health detectors when HETU_TPU_HEALTH is on."""
+        from hetu_tpu.obs import numerics as _numerics
+        try:
+            host = jax.device_get(stats)
+        except Exception as e:   # telemetry never kills a step
+            logger.warning(f"numerics fetch failed: {e!r}")
+            return
+        _numerics.record(host, step=self.global_step,
+                         registry=self._registry, runlog=self.run_log)
+        if self._num_health is not None:
+            self._num_health.observe(self.global_step, host)
 
     def _plan_fingerprint(self, host_batch) -> str:
         """Stable id of (strategy, batch shapes) — which compiled plan a

@@ -371,6 +371,13 @@ class LlamaModel(Module):
         with jax.named_scope("embed"):
             x = self.embed(params["embed"], input_ids).astype(c.compute_dtype)
             x = st.constrain(x, st.act_hidden())
+            # numerics tap (obs/numerics.py, HETU_TPU_NUMERICS): no-op —
+            # and zero traced ops — unless a collector is active.  Taps
+            # sit at model BOUNDARIES (embed/hidden/logits), not inside
+            # the scanned layer stack, so their values can always escape
+            # to the step's auxiliary stats pytree.
+            from hetu_tpu.obs import numerics as _numerics
+            _numerics.tap_tree("embed", x)
         cos, sin = ops.build_rope_cache(
             c.max_position_embeddings, c.head_dim, c.rope_theta,
             dtype=jnp.float32)
@@ -379,7 +386,10 @@ class LlamaModel(Module):
                              segment_ids=segment_ids,
                              rng=rng, deterministic=deterministic,
                              n_micro=n_micro, token_ids=input_ids)
-        return self.final_norm(params["final_norm"], x), aux
+        hidden = self.final_norm(params["final_norm"], x)
+        from hetu_tpu.obs import numerics as _numerics
+        _numerics.tap_tree("hidden", hidden)
+        return hidden, aux
 
 
 class LlamaLMHeadModel(Module):
@@ -414,8 +424,11 @@ class LlamaLMHeadModel(Module):
             else:
                 w = params["lm_head"].astype(hidden.dtype)
             logits = hidden @ w
-            return self.strategy.constrain(logits,
-                                           self.strategy.act_logits())
+            logits = self.strategy.constrain(logits,
+                                             self.strategy.act_logits())
+            from hetu_tpu.obs import numerics as _numerics
+            _numerics.tap_tree("logits", logits)
+            return logits
 
     def forward(self, params, input_ids, labels=None, *, position_ids=None,
                 segment_ids=None, rng=None, deterministic=True,

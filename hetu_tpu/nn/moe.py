@@ -187,6 +187,31 @@ def aux_losses(logits, expert_idx, moe: MoEConfig):
     return aux
 
 
+def _numerics_active() -> bool:
+    """Is a numerics collector installed (host-level check, static
+    during one trace)?  Lazy import keeps nn free of obs at load."""
+    from hetu_tpu.obs import numerics
+    return numerics.active()
+
+
+def _router_stats(logits, load_counts, dropped):
+    """Router-health stats for the numerics observatory: per-expert load
+    (fraction of TOKENS carrying each expert — sums to ~k, so a
+    collapsed router reads load_max -> 1.0 whatever k is), its max,
+    mean token routing entropy (nats), and capacity drops.
+    ``load_counts``: [E] int assignment counts; ``dropped``: scalar
+    int.  Only traced when a collector is active."""
+    probs = _router_probs(logits)
+    tokens = jnp.asarray(float(max(logits.shape[0], 1)), jnp.float32)
+    load = load_counts.astype(jnp.float32) / tokens
+    pairs = jnp.maximum(jnp.sum(load_counts).astype(jnp.float32), 1.0)
+    entropy = jnp.mean(
+        -jnp.sum(probs * jnp.log(probs + 1e-9), axis=-1))
+    return {"load": load, "load_max": jnp.max(load), "entropy": entropy,
+            "dropped": dropped.astype(jnp.float32),
+            "drop_frac": dropped.astype(jnp.float32) / pairs}
+
+
 def sort_routing(expert_idx, gate_vals, num_experts: int, capacity: int):
     """Sort-based routing plan with O(T·k) index tensors.
 
@@ -198,6 +223,10 @@ def sort_routing(expert_idx, gate_vals, num_experts: int, capacity: int):
       tok:  source token index
       gate: combine weight
       keep: survived capacity
+    plus the routing-plan telemetry (the live expert-load/capacity-drop
+    surface ROADMAP item 1 names — free here, the counts already exist):
+      load:    [E] int32 routed (pre-drop) assignments per expert
+      dropped: scalar int32 count of capacity-dropped (token, slot) pairs
     """
     T, k = expert_idx.shape
     TK = T * k
@@ -211,7 +240,9 @@ def sort_routing(expert_idx, gate_vals, num_experts: int, capacity: int):
     keep = pos < capacity
     dest = jnp.where(keep, e_s * capacity + pos, num_experts * capacity)
     tok = order % T                         # slot-major: f = slot*T + t
-    return {"dest": dest, "tok": tok, "gate": g_flat[order], "keep": keep}
+    return {"dest": dest, "tok": tok, "gate": g_flat[order], "keep": keep,
+            "load": counts,
+            "dropped": TK - jnp.sum(keep.astype(jnp.int32))}
 
 
 def scatter_to_experts(xt, plan, num_experts: int, capacity: int):
@@ -247,8 +278,10 @@ def sort_dispatch_combine(xt, plan, expert_fn, num_experts: int,
 
 def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
     """DENSE routing (parity/ablation path): returns (dispatch [T, E, C]
-    bool, combine [T, E, C] f32, aux_loss).  Memory O(T·E·C) — use
-    dispatch="sort" beyond toy sizes."""
+    bool, combine [T, E, C] f32, aux_loss, dropped) where ``dropped`` is
+    the scalar int32 count of capacity-dropped (token, slot) pairs —
+    the same accounting ``sort_routing`` carries in its plan.  Memory
+    O(T·E·C) — use dispatch="sort" beyond toy sizes."""
     T, E = logits.shape
     expert_idx, gate_vals = select_experts(logits, ids, moe)
     k = expert_idx.shape[1]
@@ -270,7 +303,8 @@ def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
         combine = combine + upd * gate_vals[:, slot][:, None, None]
         fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
 
-    return dispatch, combine, aux_losses(logits, expert_idx, moe)
+    dropped = T * k - jnp.sum(fill)
+    return dispatch, combine, aux_losses(logits, expert_idx, moe), dropped
 
 
 class MoELayer(Module):
@@ -353,9 +387,20 @@ class MoELayer(Module):
             expert_idx, gate_vals = select_experts(logits, ids, moe)
             plan = sort_routing(expert_idx, gate_vals, E, capacity)
             aux = aux_losses(logits, expert_idx, moe)
-            return scatter_to_experts(xt, plan, E, capacity), plan, aux
+            # router telemetry (obs.numerics): only COMPUTED when a
+            # collector is active, so the unset-flag trace is untouched
+            rstats = (_router_stats(logits, plan["load"], plan["dropped"])
+                      if _numerics_active() else {})
+            return scatter_to_experts(xt, plan, E, capacity), plan, aux, \
+                rstats
 
-        buf, plan, aux = jax.vmap(route_one)(xg, ig)   # [G, E, C, h]
+        buf, plan, aux, rstats = jax.vmap(route_one)(xg, ig)  # [G, E, C, h]
+        if rstats:
+            # per-group stats stacked [G, ...] by vmap -> reduce with
+            # each stat's own rule, tap under the "moe" scope (repeated
+            # MoE layers accumulate into the same scope)
+            from hetu_tpu.obs import numerics as _numerics
+            _numerics.merge(_numerics.reduce_stacked({"moe": rstats}))
         ep_spec = {1: "ep"} if st.ep > 1 else {}
         if group_axes or ep_spec:
             buf = DS.make(4, {0: group_axes, **ep_spec}).constrain(buf)
@@ -383,7 +428,19 @@ class MoELayer(Module):
         logits = xt.astype(jnp.float32) @ params["router"]
         ids = (token_ids.reshape(T) if token_ids is not None
                else jnp.arange(T, dtype=jnp.int32))
-        dispatch, combine, aux = topk_routing(logits, ids, moe, capacity)
+        dispatch, combine, aux, dropped = topk_routing(logits, ids, moe,
+                                                       capacity)
+        if _numerics_active():
+            from hetu_tpu.obs import numerics as _numerics
+            # PRE-drop routing intent, same definition as the sort
+            # plan's `load` (post-drop counts would both understate a
+            # collapsed router's load_max and push drop_frac past 1).
+            # select_experts runs a second time here, but it is pure on
+            # identical inputs — XLA CSEs the duplicate — and only
+            # traced when the numerics flag opted in.
+            e_idx, _gv = select_experts(logits, ids, moe)
+            counts = jnp.zeros((E,), jnp.int32).at[e_idx.reshape(-1)].add(1)
+            _numerics.merge({"moe": _router_stats(logits, counts, dropped)})
 
         buf = jnp.einsum("th,tec->ech", xt, dispatch.astype(x.dtype))
         if st.ep > 1:

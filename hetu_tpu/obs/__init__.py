@@ -17,6 +17,7 @@ One import surface:
     obs.diff_metrics(old, new, obs.PerfBudget.load())["breaches"]
     obs.straggler_report(snapshot)["stragglers"]     # cluster scope
     obs.HealthMonitor(runlog=log).observe_step(1, 0.42, loss=2.3)
+    obs.summarize_numerics(obs.RunLog.read(path))["worst"]  # numerics
 
 See docs/observability.md for the env flags, the RunLog schema, the
 telemetry-push wire format and the ClusterSnapshot fields;
@@ -37,9 +38,13 @@ from hetu_tpu.obs.hlo_profile import (PROFILE_SCHEMA,  # noqa: F401
                                       layer_profile, layer_table,
                                       peak_hbm_estimate, profile_record)
 from hetu_tpu.obs.health import (HealthMonitor,  # noqa: F401
+                                 NumericsHealthMonitor,
                                  ServingHealthMonitor,
                                  maybe_health_monitor,
+                                 maybe_numerics_health_monitor,
                                  maybe_serving_health_monitor)
+from hetu_tpu.obs.numerics import (NUMERICS_SCHEMA,  # noqa: F401
+                                   summarize_numerics, tree_stats)
 from hetu_tpu.obs.metrics import (Histogram, MetricsRegistry,  # noqa: F401
                                   get_registry)
 from hetu_tpu.obs.mfu import (analytic_transformer_estimate,  # noqa: F401
@@ -50,7 +55,8 @@ from hetu_tpu.obs.runlog import (SCHEMA_VERSION, RunLog,  # noqa: F401
 from hetu_tpu.obs.spans import (SPAN_SCHEMA, RequestTrace,  # noqa: F401
                                 Span, collect_traces)
 from hetu_tpu.obs.trace import (ChromeTrace,  # noqa: F401
-                                merge_runlogs, pipeline_schedule_trace,
+                                merge_runlogs, numerics_trace,
+                                pipeline_schedule_trace,
                                 schedule_bubble_fraction, serving_trace,
                                 trace_from_runlog)
 
@@ -73,4 +79,7 @@ __all__ = [
     "merge_offsets",
     "HealthMonitor", "maybe_health_monitor",
     "ServingHealthMonitor", "maybe_serving_health_monitor",
+    "NumericsHealthMonitor", "maybe_numerics_health_monitor",
+    "NUMERICS_SCHEMA", "summarize_numerics", "tree_stats",
+    "numerics_trace",
 ]

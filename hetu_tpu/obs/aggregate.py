@@ -50,12 +50,16 @@ from hetu_tpu.utils.logging import get_logger
 
 logger = get_logger("obs.aggregate")
 
-#: RunLog kinds worth shipping cluster-wide (step records travel on the
-#: dedicated ``steps`` channel; raw per-step records would dwarf the
-#: push — and so would per-request ``span`` records, which stay local:
-#: serving workers ship their serve events + serve.* counter deltas)
+#: RunLog kinds that ride the telemetry push as EVENTS.  Deliberately
+#: excludes high-rate kinds whose signal already travels another way —
+#: ``step`` (the dedicated steps channel + registry series), ``span``
+#: (per-request records stay local; serving workers ship serve events
+#: + serve.* counter deltas), ``numerics`` (the per-scope numerics.*
+#: gauges) — pushing those verbatim would multiply the wire cost for
+#: data the coordinator already has.  ``scaler`` transitions are rare
+#: and rich, so they ride.
 EVENT_KINDS = ("compile", "anomaly", "straggler", "fault", "elastic_epoch",
-               "switch", "serve")
+               "switch", "serve", "scaler")
 
 _boot_counter = itertools.count()
 

@@ -13,6 +13,12 @@ EWMA+MAD detectors for the failure shapes that silently waste TPU-days —
     data_stall             the gap BETWEEN steps (host/input time) blows
                            up — the data pipeline, not the device
 
+``NumericsHealthMonitor`` watches the numerics observatory's per-step
+stats pytree (obs/numerics.py, HETU_TPU_NUMERICS) for the failure
+shapes of aggressive precision reduction — underflow_creep,
+quant_snr_collapse, ef_residual_blowup, router_collapse — all invisible
+to the scalar monitor until the loss diverges.
+
 ``ServingHealthMonitor`` is the serving engine's twin (same EWMA
 machinery, same ``anomaly`` record shape, same ``HETU_TPU_HEALTH``
 gate), watching the failure shapes of a continuous-batching front end:
@@ -112,6 +118,21 @@ class _MonitorBase:
                                 + 1e-3 * abs(ewma.mean) + 1e-12):
             return True
         return ratio is not None and v > ewma.mean * ratio
+
+    def _sag(self, ewma: Ewma, v: float, k: float,
+             floor: Optional[float] = None) -> bool:
+        """v far BELOW the EWMA baseline — the mirror of :meth:`_spike`
+        for signals whose failure direction is down (quantization SNR,
+        router entropy).  Fires on the additive `mean - k*MAD-sigma`
+        rule OR on crossing an absolute `floor` (a level no healthy run
+        should visit, baseline notwithstanding); both wait out
+        ``warmup`` so the first observations can't self-fire."""
+        if ewma.n < self.warmup or ewma.mean is None:
+            return False
+        if v < ewma.mean - k * (_MAD_SIGMA * ewma.dev
+                                + 1e-3 * abs(ewma.mean) + 1e-12):
+            return True
+        return floor is not None and v < floor
 
     def _fire(self, kind: str, step: int, value: float,
               baseline: Optional[float], t: float,
@@ -317,6 +338,141 @@ class ServingHealthMonitor(_MonitorBase):
         else:
             self._page_hot = 0
         return fired
+
+
+class NumericsHealthMonitor(_MonitorBase):
+    """Detectors over the numerics observatory's per-step stats pytree
+    (obs/numerics.py, HETU_TPU_NUMERICS) — the failure shapes of
+    aggressive precision reduction, caught while the loss still looks
+    healthy:
+
+    * ``underflow_creep`` — a scope's bf16-underflow fraction is both
+      above ``underflow_min`` AND spiking vs its own EWMA baseline
+      (weights/grads/activations sliding below the smallest normal:
+      silent signal loss long before NaNs).
+    * ``quant_snr_collapse`` — a compressed path's measured SNR sags
+      far below its baseline or under ``snr_floor_db`` (a bad scale, a
+      distribution shift the int8 grid can no longer represent).
+    * ``ef_residual_blowup`` — the error-feedback residual RMS spikes
+      (the compressor is systematically behind; convergence is next).
+    * ``router_collapse`` — max expert load at/above
+      ``router_load_max`` for ``router_streak`` consecutive records, or
+      router entropy sagging below baseline (one expert is eating the
+      batch; the rest are dying).
+
+    Same chassis as the other monitors: per-kind cooldown, health.*
+    counters, ``anomaly`` RunLog events, telemetry ride-along — and the
+    same ``HETU_TPU_HEALTH`` gate (one switch, whole health surface).
+
+    Call :meth:`observe` once per recorded numerics step with the
+    (host-fetched) ``{scope: {stat: value}}`` dict.
+    """
+
+    KINDS = ("underflow_creep", "quant_snr_collapse",
+             "ef_residual_blowup", "router_collapse")
+
+    def __init__(self, runlog=None, registry=None, source=None,
+                 warmup: int = 8, alpha: float = 0.2,
+                 underflow_min: float = 0.05, underflow_k: float = 6.0,
+                 snr_k: float = 6.0, snr_floor_db: float = 10.0,
+                 ef_k: float = 8.0,
+                 router_load_max: float = 0.7, router_streak: int = 2,
+                 entropy_k: float = 6.0,
+                 cooldown_steps: int = 16):
+        super().__init__(runlog=runlog, registry=registry, source=source,
+                         warmup=warmup, cooldown_steps=cooldown_steps)
+        self.alpha = alpha
+        self.underflow_min, self.underflow_k = underflow_min, underflow_k
+        self.snr_k, self.snr_floor_db = snr_k, snr_floor_db
+        self.ef_k = ef_k
+        self.router_load_max, self.router_streak = (router_load_max,
+                                                    router_streak)
+        self.entropy_k = entropy_k
+        self._ewma: Dict[tuple, Ewma] = {}
+        self._router_hot = 0
+
+    def _e(self, *key) -> Ewma:
+        e = self._ewma.get(key)
+        if e is None:
+            e = self._ewma[key] = Ewma(self.alpha)
+        return e
+
+    def observe(self, step: int, scopes: Dict[str, Dict[str, Any]],
+                *, t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """Feed one recorded numerics step; returns anomalies fired."""
+        t = time.time() if t is None else t
+        fired: List[Dict[str, Any]] = []
+        for scope, stats in sorted((scopes or {}).items()):
+            uf = stats.get("underflow_frac")
+            if uf is not None and math.isfinite(uf):
+                e = self._e("uf", scope)
+                if uf >= self.underflow_min and self._spike(
+                        e, uf, self.underflow_k, ratio=3.0):
+                    self._fire("underflow_creep", step, uf, e.mean, t,
+                               fired)
+                e.update(uf)
+            snr = stats.get("snr_db")
+            if snr is not None and math.isfinite(snr):
+                e = self._e("snr", scope)
+                if self._sag(e, snr, self.snr_k,
+                             floor=self.snr_floor_db):
+                    self._fire("quant_snr_collapse", step, snr, e.mean,
+                               t, fired)
+                e.update(snr)
+            if scope == "ef":
+                rms = stats.get("rms")
+                if rms is not None and math.isfinite(rms):
+                    e = self._e("ef", scope)
+                    if self._spike(e, rms, self.ef_k, ratio=4.0):
+                        self._fire("ef_residual_blowup", step, rms,
+                                   e.mean, t, fired)
+                    e.update(rms)
+            if scope == "moe":
+                lm = stats.get("load_max")
+                if lm is not None and math.isfinite(lm):
+                    # level rule with a streak: a router pinned on one
+                    # expert is collapsed NOW, whatever the baseline
+                    # was.  `load` is token-denominated (a balanced
+                    # top-k router sits at k/E), so the threshold rises
+                    # to 2x balanced for high-k/E configs — a fixed
+                    # 0.7 would alarm permanently on e.g. E=4, k=3
+                    # (balanced load_max 0.75); past 1.0 the level
+                    # rule is unreachable and the entropy sag carries
+                    # the detection alone.
+                    load = stats.get("load")
+                    thresh = self.router_load_max
+                    if load is not None and len(load):
+                        # load may be a list (RunLog) or ndarray (the
+                        # raw device_get pytree) — take plain floats
+                        ksum = float(sum(float(v) for v in load))
+                        thresh = max(thresh, 2.0 * ksum / len(load))
+                    if thresh <= 1.0 + 1e-9 and lm >= thresh - 1e-6:
+                        self._router_hot += 1
+                        if self._router_hot >= self.router_streak:
+                            self._fire("router_collapse", step, lm,
+                                       thresh, t, fired)
+                    else:
+                        self._router_hot = 0
+                ent = stats.get("entropy")
+                if ent is not None and math.isfinite(ent):
+                    e = self._e("entropy", scope)
+                    if self._sag(e, ent, self.entropy_k):
+                        self._fire("router_collapse", step, ent, e.mean,
+                                   t, fired)
+                    e.update(ent)
+        return fired
+
+
+def maybe_numerics_health_monitor(runlog=None, source=None, **kw
+                                  ) -> Optional[NumericsHealthMonitor]:
+    """A NumericsHealthMonitor when HETU_TPU_HEALTH is set, else None —
+    the numerics observatory's single-None-check gate (same flag as the
+    scalar training monitor: one switch turns the whole health surface
+    on; the stats themselves additionally need HETU_TPU_NUMERICS)."""
+    from hetu_tpu.utils import flags
+    if not flags.bool_flag("HETU_TPU_HEALTH"):
+        return None
+    return NumericsHealthMonitor(runlog=runlog, source=source, **kw)
 
 
 def maybe_serving_health_monitor(runlog=None, source=None, **kw

@@ -20,6 +20,7 @@ connection threads and the trainer loop write concurrently.
 from __future__ import annotations
 
 import json
+import math
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -52,7 +53,8 @@ class Histogram:
     slots round-robin so long runs keep a recent-ish sample while the
     aggregate stats stay exact."""
 
-    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_sample", "_next")
+    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_sample", "_next",
+                 "nonfinite")
 
     def __init__(self, cap: int = 2048):
         self.cap = cap
@@ -62,9 +64,17 @@ class Histogram:
         self.vmax: Optional[float] = None
         self._sample: List[float] = []
         self._next = 0
+        self.nonfinite = 0
 
     def observe(self, value: float):
         v = float(value)
+        if not math.isfinite(v):
+            # a single NaN/inf observation must not poison the running
+            # sum/min/max or the reservoir percentiles (one poisoned
+            # export would blind every downstream consumer) — count it
+            # separately and keep the finite statistics exact
+            self.nonfinite += 1
+            return
         self.count += 1
         self.total += v
         self.vmin = v if self.vmin is None else min(self.vmin, v)
@@ -85,6 +95,10 @@ class Histogram:
                "mean": (self.total / self.count) if self.count else None}
         for p in (50, 95, 99):
             out[f"p{p}"] = self.percentile(p)
+        if self.nonfinite:
+            # only surfaced when present so existing summary consumers
+            # see an unchanged shape on healthy histograms
+            out["nonfinite"] = self.nonfinite
         return out
 
 

@@ -1,0 +1,525 @@
+"""The numerics observatory: in-graph tensor statistics, quantization
+SNR accounting, and the host-side recording pipeline.
+
+Hetu's scale story runs on aggressive precision reduction — bf16
+compute, int8/int4 collectives, quantized ZeRO refresh, int8 KV pages —
+but until this module nothing watched the numbers themselves: the health
+monitor saw only scalar loss/grad-norm, so underflow creep, SNR
+collapse on a compressed path, EF-residual blowup or a collapsing MoE
+router were invisible until the loss diverged.
+
+Design
+------
+Stats are computed *inside* the jitted step (tiny reductions traced at
+the tap site) and returned as an auxiliary pytree of scalars — no host
+round-trip per tensor, donation-safe, and host-fetched only when
+``HETU_TPU_NUMERICS`` is on.  The mechanics:
+
+* ``collecting()`` installs a thread-local :class:`Collector` for the
+  duration of one traced step (the trainer/serving engine wraps its
+  step function).  Unset flag = the wrapper never runs = the traced
+  program is byte-identical to the seed (registered identity contract,
+  enforced by the flag-identity sweep on all canonical programs).
+* ``tap_tree`` / ``tap_stats`` / ``tap_quant_error`` record values into
+  the collector's top *frame*.  Each frame remembers the JAX trace it
+  was opened under; a tap arriving from a *different* trace (inside a
+  ``lax.scan`` body, a ``vmap``, a ``custom_vjp`` — anywhere its value
+  could not legally escape to the frame's return) is silently skipped
+  and counted, never leaked.  Sites under such transforms instead
+  return their stats explicitly, through one of the bridges below.
+* ``frame()`` opens a nested frame whose stats are handed back to the
+  enclosing code as a pytree — the bridge out of ``value_and_grad``
+  (the trainer's micro-batch loss), out of ``shard_map`` bodies (the
+  quantized grad sync, the ZeRO refresh), and out of anything else
+  that must thread values through a transform boundary.
+  ``reduce_stacked`` folds a scan-stacked stats tree, ``reduce_axis``
+  folds a mesh axis inside a ``shard_map`` body, and ``merge`` folds a
+  returned stats tree back into the ambient collector — each stat
+  carries its own reduction rule (max for absmax, sum for counts and
+  signal/error powers, mean otherwise).
+* ``Collector.finalize()`` resolves accumulated signal/error powers
+  into per-scope ``snr_db`` and returns the ``{scope: {stat: value}}``
+  pytree the step emits.
+
+Host side, ``record()`` is the one sink: a schema-versioned
+``numerics`` RunLog record, labeled gauges/histograms in the metrics
+registry (``numerics.*`` per scope, ``moe.expert_load`` /
+``moe.capacity_dropped`` / ``moe.router_entropy`` — the live
+expert-load gauges ROADMAP item 1 names; gauges ride the existing
+cluster telemetry push), and ``summarize_numerics`` is THE reader both
+``tools_numerics.py`` and ``tools_obs_report.py`` render from.
+
+Stats per tensor scope: ``absmax``, ``rms``, ``l2``, ``nonfinite``
+(count), ``underflow_frac`` / ``overflow_frac`` (fraction of nonzero
+values whose magnitude falls below the smallest normal / above the max
+of the tensor's 16-bit reference dtype — bf16 unless the tensor is
+already f16/bf16).  Quantized paths add ``snr_db`` (exact: measured
+from the same comm/compress primitives the wire uses); the MoE scope
+adds ``load`` (per-expert routing fraction), ``load_max``, ``entropy``
+(router entropy, nats), ``dropped`` and ``drop_frac`` (capacity
+drops).  See docs/observability.md for the full table and the detector
+thresholds that consume these (obs.health.NumericsHealthMonitor).
+"""
+from __future__ import annotations
+
+import contextlib
+import math
+import threading
+from typing import Any, Dict, Iterable, List, Optional
+
+#: schema version stamped on every ``numerics`` RunLog record
+NUMERICS_SCHEMA = 1
+
+# ---------------------------------------------------------------------------
+# reduction rules: how one stat combines across repeated taps, scan
+# stacking, and mesh axes.  Unknown names default to mean.
+# ---------------------------------------------------------------------------
+_SUM_STATS = frozenset({"nonfinite", "dropped", "sig_pow", "err_pow",
+                        "count", "tokens"})
+_MAX_STATS = frozenset({"absmax", "load_max"})
+
+
+def rule_for(name: str) -> str:
+    if name in _SUM_STATS:
+        return "sum"
+    if name in _MAX_STATS:
+        return "max"
+    return "mean"
+
+
+# ---------------------------------------------------------------------------
+# flag gates
+# ---------------------------------------------------------------------------
+
+def numerics_enabled() -> bool:
+    """The HETU_TPU_NUMERICS gate (read at build time by the trainer and
+    the serving engine — the registered identity contract is that unset
+    leaves every canonical program traced-HLO byte-identical)."""
+    from hetu_tpu.utils import flags
+    return flags.bool_flag("HETU_TPU_NUMERICS")
+
+
+def record_every() -> int:
+    """HETU_TPU_NUMERICS_EVERY: host-fetch/record sampling interval in
+    steps (the in-graph stats are computed every step either way — the
+    traced program cannot depend on a host-side sampling phase)."""
+    from hetu_tpu.utils import flags
+    return max(1, flags.int_flag("HETU_TPU_NUMERICS_EVERY"))
+
+
+# ---------------------------------------------------------------------------
+# the collector
+# ---------------------------------------------------------------------------
+
+def _cur_trace():
+    from jax.core import trace_ctx
+    return trace_ctx.trace
+
+
+class _Frame:
+    __slots__ = ("trace", "acc")
+
+    def __init__(self):
+        self.trace = _cur_trace()
+        # scope -> stat -> [rule, value, count]
+        self.acc: Dict[str, Dict[str, list]] = {}
+
+    def add(self, scope: str, name: str, value):
+        sc = self.acc.setdefault(scope, {})
+        rule = rule_for(name)
+        slot = sc.get(name)
+        if slot is None:
+            sc[name] = [rule, value, 1]
+            return
+        if rule == "sum":
+            slot[1] = slot[1] + value
+        elif rule == "max":
+            import jax.numpy as jnp
+            slot[1] = jnp.maximum(slot[1], value)
+        else:
+            slot[1] = slot[1] + value
+            slot[2] += 1
+
+    def resolve(self) -> Dict[str, Dict[str, Any]]:
+        out: Dict[str, Dict[str, Any]] = {}
+        for scope, stats in self.acc.items():
+            dst = out.setdefault(scope, {})
+            for name, (rule, value, count) in stats.items():
+                dst[name] = value / count if (rule == "mean"
+                                              and count > 1) else value
+        return out
+
+
+class Collector:
+    """Per-step tap accumulator (install via :func:`collecting`)."""
+
+    def __init__(self):
+        self.frames: List[_Frame] = [_Frame()]
+        self.skipped = 0      # taps rejected by the trace guard
+
+    def push_frame(self):
+        self.frames.append(_Frame())
+
+    def pop_frame(self) -> Dict[str, Dict[str, Any]]:
+        return self.frames.pop().resolve()
+
+    def finalize(self) -> Dict[str, Dict[str, Any]]:
+        """Resolve the root frame into the step's stats pytree,
+        converting accumulated signal/error powers into ``snr_db``."""
+        assert len(self.frames) == 1, "unbalanced numerics frames"
+        return _with_snr(self.frames[0].resolve())
+
+
+def _with_snr(scopes: Dict[str, Dict[str, Any]]) -> Dict[str, Dict[str, Any]]:
+    import jax.numpy as jnp
+    for stats in scopes.values():
+        if "sig_pow" in stats and "err_pow" in stats:
+            sig, err = stats["sig_pow"], stats["err_pow"]
+            stats["snr_db"] = 10.0 * jnp.log10(
+                (sig + 1e-30) / (err + 1e-30))
+    return scopes
+
+
+_tls = threading.local()
+
+
+def _current() -> Optional[Collector]:
+    return getattr(_tls, "collector", None)
+
+
+def active() -> bool:
+    """A collector is installed on this thread (static during one trace
+    — gate any stats-only computation on this so the unset-flag program
+    stays byte-identical)."""
+    return _current() is not None
+
+
+@contextlib.contextmanager
+def collecting():
+    """Install a Collector for the duration of one (traced) step body."""
+    prev = _current()
+    col = Collector()
+    _tls.collector = col
+    try:
+        yield col
+    finally:
+        _tls.collector = prev
+
+
+class _FrameHandle:
+    __slots__ = ("stats",)
+
+    def __init__(self):
+        self.stats: Dict[str, Dict[str, Any]] = {}
+
+
+@contextlib.contextmanager
+def frame():
+    """Open a nested frame; on exit its resolved stats land on the
+    handle (``{}`` when no collector is installed).  THE bridge for taps
+    under a transform: push inside the transformed function, return the
+    handle's stats through the function's own outputs."""
+    h = _FrameHandle()
+    col = _current()
+    if col is None:
+        yield h
+        return
+    col.push_frame()
+    try:
+        yield h
+    finally:
+        h.stats = col.pop_frame()
+
+
+# ---------------------------------------------------------------------------
+# taps
+# ---------------------------------------------------------------------------
+
+def _tap(scope: str, name: str, value):
+    col = _current()
+    if col is None:
+        return
+    fr = col.frames[-1]
+    if fr.trace is not _cur_trace():
+        # inside a scan/vmap/custom_vjp body relative to the open frame:
+        # the value could not legally escape — skip, never leak
+        col.skipped += 1
+        return
+    fr.add(scope, name, value)
+
+
+def tap_stats(scope: str, **stats):
+    """Record raw stat scalars (or small vectors) under ``scope``."""
+    for name, value in stats.items():
+        _tap(scope, name, value)
+
+
+def tree_stats(tree) -> Dict[str, Any]:
+    """Pure in-graph tensor statistics over a pytree of float arrays:
+    absmax / rms / l2 / nonfinite count / bf16(f16) underflow+overflow
+    fractions.  Usable anywhere (no collector needed)."""
+    import jax
+    import jax.numpy as jnp
+    leaves = [x for x in jax.tree.leaves(tree)
+              if hasattr(x, "dtype") and jnp.issubdtype(x.dtype, jnp.floating)]
+    if not leaves:
+        return {}
+    n = 0
+    sum_sq = jnp.zeros((), jnp.float32)
+    absmax = jnp.zeros((), jnp.float32)
+    nonfinite = jnp.zeros((), jnp.int32)
+    under = jnp.zeros((), jnp.int32)
+    over = jnp.zeros((), jnp.int32)
+    n_finite = jnp.zeros((), jnp.int32)
+    n_nonzero = jnp.zeros((), jnp.int32)   # finite AND nonzero
+    for x in leaves:
+        # reference dtype for the range fractions: the tensor's own
+        # 16-bit dtype when it has one, else bf16 (the compute dtype the
+        # precision-reduction story cares about)
+        ref = x.dtype if x.dtype in (jnp.bfloat16, jnp.float16) \
+            else jnp.bfloat16
+        fi = jnp.finfo(ref)
+        # the underflow zone sits a margin ABOVE the smallest normal:
+        # XLA/TPU flush subnormals to zero (FTZ), so counting exact
+        # subnormals would read 0.0 at precisely the moment everything
+        # dies — instead we count the band where a few more halvings
+        # flush.  2^8 of headroom for the 8-bit-exponent dtypes
+        # (bf16/f32 — the band 2^-126..2^-118 is never visited by a
+        # healthy run), 2^2 for f16's narrow 5-bit exponent (its tiny
+        # is 6.1e-5; a wide band would flag healthy activations).
+        margin = 4.0 if ref == jnp.float16 else 256.0
+        tiny, fmax = float(fi.tiny) * margin, float(fi.max)
+        a = jnp.abs(x.astype(jnp.float32))
+        finite = jnp.isfinite(a)
+        af = jnp.where(finite, a, 0.0)
+        n += int(x.size)
+        sum_sq = sum_sq + jnp.sum(af * af)
+        absmax = jnp.maximum(absmax, jnp.max(af))
+        nonfinite = nonfinite + jnp.sum(
+            (~finite).astype(jnp.int32))
+        under = under + jnp.sum(((a > 0) & (a < tiny)).astype(jnp.int32))
+        over = over + jnp.sum((finite & (a > fmax)).astype(jnp.int32))
+        n_finite = n_finite + jnp.sum(finite.astype(jnp.int32))
+        n_nonzero = n_nonzero + jnp.sum(
+            (finite & (a > 0)).astype(jnp.int32))
+    # range fractions denominate over the values that CAN be in range:
+    # underflow over finite NONZERO values (a mostly-zero tensor whose
+    # every live value is dying must read ~1.0, not ~0.1), overflow
+    # over finite values — matching the documented definitions
+    return {
+        "absmax": absmax,
+        "rms": jnp.sqrt(sum_sq / max(n, 1)),
+        "l2": jnp.sqrt(sum_sq),
+        "nonfinite": nonfinite,
+        "underflow_frac": (under.astype(jnp.float32)
+                           / jnp.maximum(n_nonzero, 1)),
+        "overflow_frac": (over.astype(jnp.float32)
+                          / jnp.maximum(n_finite, 1)),
+    }
+
+
+def tap_tree(scope: str, tree):
+    """Tap the full tensor-stat set of a pytree under ``scope`` (no-op
+    when no collector is installed — and the stats are only COMPUTED
+    when one is, so the unset-flag trace is untouched)."""
+    if not active():
+        return
+    for name, value in tree_stats(tree).items():
+        _tap(scope, name, value)
+
+
+def tap_quant_error(scope: str, signal, error):
+    """Accumulate one quantize site's exact signal/error powers under
+    ``scope`` (``finalize`` turns them into ``snr_db``).  ``error`` is
+    the site's own residual (x - dequantize(quantize(x))) so the
+    measurement reuses the wire's arithmetic, not a model of it."""
+    if not active():
+        return
+    import jax.numpy as jnp
+    s = signal.astype(jnp.float32)
+    e = error.astype(jnp.float32)
+    _tap(scope, "sig_pow", jnp.sum(s * s))
+    _tap(scope, "err_pow", jnp.sum(e * e))
+
+
+def tap_quant_roundtrip(scope: str, x, mode: str,
+                        block_size: Optional[int] = None):
+    """SNR probe for call sites that cannot expose their internal
+    (q, scales) pair (e.g. the custom_vjp-wrapped SP collectives, whose
+    bodies trace under their own trace): re-run the exact
+    quantize->dequantize roundtrip on ``x`` with the same comm/compress
+    primitives and accumulate the powers.  Costs one extra quantize —
+    only ever traced when the collector is active."""
+    if not active():
+        return
+    import jax.numpy as jnp
+    from hetu_tpu.comm.compress import (dequantize_blockwise,
+                                        quantize_blockwise)
+    from hetu_tpu.comm.wire import DEFAULT_BLOCK, mode_bits
+    bs = block_size or DEFAULT_BLOCK
+    flat = x.reshape(-1).astype(jnp.float32)
+    pad = (-flat.shape[0]) % bs
+    if pad:
+        flat = jnp.concatenate([flat, jnp.zeros((pad,), jnp.float32)])
+    q, s = quantize_blockwise(flat, bs, bits=mode_bits(mode))
+    tap_quant_error(scope, flat, flat - dequantize_blockwise(q, s))
+
+
+# ---------------------------------------------------------------------------
+# cross-transform reductions
+# ---------------------------------------------------------------------------
+
+def _reduce(name: str, v, fold_max, fold_sum, fold_mean):
+    r = rule_for(name)
+    if r == "max":
+        return fold_max(v)
+    if r == "sum":
+        return fold_sum(v)
+    return fold_mean(v)
+
+
+def reduce_stacked(scopes: Dict[str, Dict[str, Any]]
+                   ) -> Dict[str, Dict[str, Any]]:
+    """Fold a stats tree whose values are stacked along a leading axis
+    (a ``lax.scan`` ys output, a vmapped per-group stats dict) down to
+    per-stat scalars/vectors with each stat's own rule."""
+    import jax.numpy as jnp
+    return {scope: {name: _reduce(name, v,
+                                  lambda x: jnp.max(x, axis=0),
+                                  lambda x: jnp.sum(x, axis=0),
+                                  lambda x: jnp.mean(x, axis=0))
+                    for name, v in stats.items()}
+            for scope, stats in scopes.items()}
+
+
+def reduce_axis(scopes: Dict[str, Dict[str, Any]], axis_name: str
+                ) -> Dict[str, Dict[str, Any]]:
+    """Fold a stats tree across a mesh axis INSIDE a shard_map body
+    (pmax/psum/pmean per rule) so the body can return replicated stats
+    (out_spec ``P()``)."""
+    from jax import lax
+    return {scope: {name: _reduce(name, v,
+                                  lambda x: lax.pmax(x, axis_name),
+                                  lambda x: lax.psum(x, axis_name),
+                                  lambda x: lax.pmean(x, axis_name))
+                    for name, v in stats.items()}
+            for scope, stats in scopes.items()}
+
+
+def merge(scopes: Dict[str, Dict[str, Any]]):
+    """Fold a returned stats tree back into the ambient collector's top
+    frame (no-op when none is installed or the tree is empty)."""
+    if not scopes or not active():
+        return
+    for scope, stats in scopes.items():
+        for name, v in stats.items():
+            _tap(scope, name, v)
+
+
+# ---------------------------------------------------------------------------
+# host side: the one sink and the one reader
+# ---------------------------------------------------------------------------
+
+def _jsonable_scopes(scopes) -> Dict[str, Dict[str, Any]]:
+    import numpy as np
+    out: Dict[str, Dict[str, Any]] = {}
+    for scope, stats in scopes.items():
+        dst = out.setdefault(str(scope), {})
+        for name, v in stats.items():
+            a = np.asarray(v)
+            dst[str(name)] = (a.tolist() if a.ndim else float(a))
+    return out
+
+
+def record(scopes, *, step: int, registry=None,
+           runlog=None) -> Optional[Dict[str, Any]]:
+    """THE host-side sink for one step's (already device_get) stats:
+    schema-versioned ``numerics`` RunLog record + labeled registry
+    gauges/histograms.  Cluster visibility rides the gauges through the
+    existing telemetry push — deliberately NOT the event push
+    (``numerics`` is excluded from aggregate.EVENT_KINDS: per-step
+    records verbatim would multiply wire cost for data the coordinator
+    already has as series).  Returns the written record (or None when
+    there was nothing)."""
+    if not scopes:
+        return None
+    if registry is None:
+        from hetu_tpu.obs.metrics import get_registry
+        registry = get_registry()
+    scopes = _jsonable_scopes(scopes)
+    for scope, stats in scopes.items():
+        for name, v in stats.items():
+            if isinstance(v, list):
+                for i, vi in enumerate(v):
+                    registry.set_gauge(f"numerics.{name}", vi,
+                                       scope=scope, index=str(i))
+                continue
+            registry.set_gauge(f"numerics.{name}", v, scope=scope)
+            if name == "snr_db":
+                registry.observe("numerics.snr_db_hist", v, scope=scope)
+    moe = scopes.get("moe")
+    if moe:
+        # the live expert-load surface ROADMAP item 1 names.  NB: with
+        # HETU_TPU_NUMERICS_EVERY > 1 this counter accumulates only the
+        # SAMPLED steps' drops (the unsampled stats are never fetched);
+        # at the default interval of 1 it is exact
+        if moe.get("dropped"):
+            registry.inc("moe.capacity_dropped", float(moe["dropped"]))
+        for i, vi in enumerate(moe.get("load") or []):
+            registry.set_gauge("moe.expert_load", vi, expert=str(i))
+        if moe.get("entropy") is not None:
+            registry.set_gauge("moe.router_entropy", moe["entropy"])
+    registry.inc("numerics.records")
+    rec = {"kind": "numerics", "numerics_schema": NUMERICS_SCHEMA,
+           "step": step, "scopes": scopes}
+    if runlog is not None:
+        written = runlog.log("numerics", numerics_schema=NUMERICS_SCHEMA,
+                             step=step, scopes=scopes)
+        rec = written or rec
+    return rec
+
+
+def summarize_numerics(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
+    """THE reader over ``numerics`` RunLog records — shared by
+    tools_numerics.py and tools_obs_report.py (no second parser).
+
+    Returns ``{"records", "steps": [first, last], "scopes": {scope:
+    {"last": {...}, "min_snr_db", "max_underflow_frac", "nonfinite",
+    "taps"}}, "worst": [scope, ...]}`` with ``worst`` ranked most
+    alarming first (lowest SNR, then highest underflow fraction)."""
+    recs = [r for r in records if r.get("kind") == "numerics"]
+    scopes: Dict[str, Dict[str, Any]] = {}
+    steps: List[int] = []
+    for r in recs:
+        if r.get("step") is not None:
+            steps.append(int(r["step"]))
+        for scope, stats in (r.get("scopes") or {}).items():
+            agg = scopes.setdefault(scope, {
+                "last": {}, "min_snr_db": None,
+                "max_underflow_frac": None, "nonfinite": 0, "taps": 0})
+            agg["last"] = stats
+            agg["taps"] += 1
+            snr = stats.get("snr_db")
+            if snr is not None and (agg["min_snr_db"] is None
+                                    or snr < agg["min_snr_db"]):
+                agg["min_snr_db"] = snr
+            uf = stats.get("underflow_frac")
+            if uf is not None and (agg["max_underflow_frac"] is None
+                                   or uf > agg["max_underflow_frac"]):
+                agg["max_underflow_frac"] = uf
+            nf = stats.get("nonfinite")
+            if nf:
+                agg["nonfinite"] += int(nf)
+
+    def badness(item):
+        name, agg = item
+        snr = agg["min_snr_db"]
+        uf = agg["max_underflow_frac"] or 0.0
+        return (-agg["nonfinite"],
+                snr if snr is not None else math.inf,
+                -uf, name)
+
+    worst = [name for name, _ in sorted(scopes.items(), key=badness)]
+    return {"records": len(recs),
+            "steps": [min(steps), max(steps)] if steps else None,
+            "scopes": scopes, "worst": worst}

@@ -61,6 +61,21 @@ Kind fields:
                   per-compile graph-contract lint record
                   (hetu_tpu/analysis, HETU_TPU_LINT=1,
                   docs/static_analysis.md)
+    numerics      numerics_schema (version), step, scopes — the numerics
+                  observatory's per-step stats pytree (obs/numerics.py,
+                  HETU_TPU_NUMERICS): {scope: {stat: value}} with
+                  absmax/rms/l2/nonfinite/underflow_frac/overflow_frac
+                  per tensor scope (params, grads, update, adam_m,
+                  embed, hidden, logits, ef), snr_db (+ sig_pow/err_pow)
+                  per compressed path (grad_sync/a2a, grad_sync/ag,
+                  grad_sync/two_level, zero_refresh, sp/<op>, kv_pages)
+                  and the moe scope's load/load_max/entropy/dropped/
+                  drop_frac; one record per HETU_TPU_NUMERICS_EVERY
+                  steps
+    scaler        event (growth | backoff), scale, prev, step — one
+                  record per dynamic-loss-scale transition (AMP runs;
+                  optim/grad_scaler.classify_transition); the per-step
+                  value lives in the scaler.loss_scale gauge
     rotated       segment, records — the size-cap rotation marker (the
                   last record of a rotated segment)
     summary       metrics (a MetricsRegistry snapshot), profiler summary

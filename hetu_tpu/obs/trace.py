@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import contextlib
 import json
+import math
 import time
 from typing import Any, Dict, Iterable, List, Optional
 
@@ -295,6 +296,26 @@ def _emit_run_events(tr: ChromeTrace, recs: List[Dict[str, Any]],
                                   ("slot", "slo_class", "reason",
                                    "tokens", "chunk", "segment")
                                   if r.get(k) is not None})
+        elif kind == "numerics":
+            # one counter lane per scope (ph "C"): Perfetto draws each
+            # scope's scalar stats as stacked area over the run — the
+            # numerics observatory's timeline view (underflow ramps and
+            # SNR collapses are visible as shape, not just as the
+            # detector firings on the health lane)
+            for scope, stats in sorted((r.get("scopes") or {}).items()):
+                vals = {name: float(v) for name, v in stats.items()
+                        if isinstance(v, (int, float))
+                        and math.isfinite(float(v))
+                        and name in ("rms", "absmax", "underflow_frac",
+                                     "overflow_frac", "snr_db",
+                                     "entropy", "load_max", "dropped")}
+                if vals:
+                    tr.add_counter(f"numerics/{scope}", ts, vals, pid=pid)
+        elif kind == "scaler":
+            tr.add_instant(f"scaler {r.get('event')}", ts, pid=pid,
+                           tid="health", cat="scaler",
+                           args={k: r[k] for k in ("scale", "prev", "step")
+                                 if r.get(k) is not None})
         elif kind == "serve":
             ev = r.get("event")
             if ev in ("admit", "done", "reshard"):
@@ -322,6 +343,25 @@ def trace_from_runlog(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
     t0 = min(float(r["t"]) for r in recs)
     pid = "run"
     _name_run_lanes(tr, pid, "training run", serving=_has_serving(recs))
+    _emit_run_events(tr, recs, pid, t0)
+    return tr
+
+
+def numerics_trace(records: Iterable[Dict[str, Any]]) -> ChromeTrace:
+    """Standalone numerics timeline: ONLY the per-scope counter lanes
+    (plus scaler transitions and anomaly instants for context) from a
+    RunLog — what ``tools_numerics.py --chrome-trace`` writes.  The full
+    run view (steps/compiles/serving interleaved) is
+    :func:`trace_from_runlog`'s job; this one stays readable when a long
+    run's step lane would drown the counters."""
+    recs = [r for r in records if isinstance(r, dict) and "t" in r
+            and r.get("kind") in ("numerics", "scaler", "anomaly")]
+    tr = ChromeTrace()
+    if not recs:
+        return tr
+    t0 = min(float(r["t"]) for r in recs)
+    pid = "numerics"
+    tr.name_process(pid, "numerics observatory")
     _emit_run_events(tr, recs, pid, t0)
     return tr
 

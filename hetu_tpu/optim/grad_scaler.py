@@ -56,3 +56,16 @@ class GradScaler:
         )
         tracker = jnp.where(grow, 0, tracker)
         return {"scale": scale, "growth_tracker": tracker}
+
+
+def classify_transition(prev: float | None, new: float) -> str | None:
+    """Host-side loss-scale transition classifier: the trainer compares
+    each step's fetched scale against the previous one and emits ONE
+    ``scaler`` RunLog event per transition (docs/observability.md) —
+    'growth' (a finished growth-interval streak), 'backoff' (a
+    non-finite step halved the scale), or None (unchanged / first
+    observation).  One definition so the trainer and its regression
+    test cannot disagree on what counts as a transition."""
+    if prev is None or new == prev:
+        return None
+    return "growth" if new > prev else "backoff"

@@ -126,6 +126,16 @@ class AdamW(Optimizer):
         new_params = jax.tree.map(lambda t: t[0], triples, is_leaf=is_t)
         new_m = jax.tree.map(lambda t: t[1], triples, is_leaf=is_t)
         new_v = jax.tree.map(lambda t: t[2], triples, is_leaf=is_t)
+        from hetu_tpu.obs import numerics as _numerics
+        if _numerics.active():
+            # numerics observatory (HETU_TPU_NUMERICS): watch the update
+            # magnitude (lr-scale — where int8 delta-gather error lives)
+            # and the first moment.  Only traced when a collector is on.
+            deltas = jax.tree.map(
+                lambda n, p: n.astype(jnp.float32) - p.astype(jnp.float32),
+                new_params, params)
+            _numerics.tap_tree("update", deltas)
+            _numerics.tap_tree("adam_m", new_m)
         return new_params, {"step": step, "m": new_m, "v": new_v}
 
 

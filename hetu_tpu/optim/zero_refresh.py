@@ -82,6 +82,7 @@ def quantized_zero_update(optimizer, grads, opt_state, params, *, mesh,
             f"{sorted(opt_state)} — extend the body's slot threading "
             "before enabling HETU_TPU_ZERO_COMPRESS with this optimizer")
     dp = int(mesh.shape[axis])
+    from hetu_tpu.obs import numerics as _numerics
 
     def body(params, grads, m, v, step):
         i = lax.axis_index(axis)
@@ -92,31 +93,48 @@ def quantized_zero_update(optimizer, grads, opt_state, params, *, mesh,
             size = x.shape[d] // dp
             return lax.dynamic_slice_in_dim(x, i * size, size, axis=d)
 
-        p_sh = jax.tree.map(shard, params, dims)
-        g_sh = grads if grads_sharded else jax.tree.map(shard, grads, dims)
-        new_p_sh, new_state = optimizer.update(
-            g_sh, {"step": step, "m": m, "v": v}, p_sh)
+        with _numerics.frame() as nf:
+            p_sh = jax.tree.map(shard, params, dims)
+            g_sh = (grads if grads_sharded
+                    else jax.tree.map(shard, grads, dims))
+            new_p_sh, new_state = optimizer.update(
+                g_sh, {"step": step, "m": m, "v": v}, p_sh)
 
-        def refresh(p_full, p_s, np_s, d):
-            if d == UNSHARDED:
-                return np_s  # updated exactly, replicated
-            delta = (np_s.astype(jnp.float32) - p_s.astype(jnp.float32))
-            dfull = all_gather_q(delta, axis, axis=d, tiled=True,
-                                 mode=mode, block_size=block_size)
-            return (p_full.astype(jnp.float32) + dfull).astype(p_full.dtype)
+            def refresh(p_full, p_s, np_s, d):
+                if d == UNSHARDED:
+                    return np_s  # updated exactly, replicated
+                delta = (np_s.astype(jnp.float32)
+                         - p_s.astype(jnp.float32))
+                dfull = all_gather_q(delta, axis, axis=d, tiled=True,
+                                     mode=mode, block_size=block_size)
+                if _numerics.active():
+                    # exact delta-gather quantization error: my shard's
+                    # reconstruction is my slice of the gathered full
+                    size = delta.shape[d]
+                    mine = lax.dynamic_slice_in_dim(
+                        dfull, i * size, size, axis=d)
+                    _numerics.tap_quant_error("zero_refresh", delta,
+                                              delta - mine)
+                return (p_full.astype(jnp.float32)
+                        + dfull).astype(p_full.dtype)
 
-        new_params = jax.tree.map(refresh, params, p_sh, new_p_sh, dims)
+            new_params = jax.tree.map(refresh, params, p_sh, new_p_sh,
+                                      dims)
+        nstats = _numerics.reduce_axis(nf.stats, axis)
         return (new_params, new_state["m"], new_state["v"],
-                new_state["step"])
+                new_state["step"], nstats)
 
     gspec: Any = specs if grads_sharded else P()
     fn = shard_map(
         body, mesh=mesh,
         in_specs=(P(), gspec, specs, specs, P()),
-        out_specs=(P(), specs, specs, P()),
+        out_specs=(P(), specs, specs, P(), P()),
         # the gathered params ARE replicated over dp but the checker
         # cannot infer that through the quantized gather
         check_rep=False)
-    new_params, new_m, new_v, new_step = fn(
+    new_params, new_m, new_v, new_step, nstats = fn(
         params, grads, opt_state["m"], opt_state["v"], opt_state["step"])
+    # stats folded across dp inside the body are step-level values here:
+    # hand them back to the ambient collector (no-op when inactive)
+    _numerics.merge(nstats)
     return new_params, {"step": new_step, "m": new_m, "v": new_v}

@@ -162,6 +162,24 @@ class ServingEngine:
         #: cluster telemetry push so tools_cluster.py sees this worker
         self.telemetry = telemetry
         self.steps_done = 0
+        # numerics observatory (obs/numerics.py, HETU_TPU_NUMERICS):
+        # read once at build — unset means the decode/write programs
+        # below are byte-identical to the flag not existing (registered
+        # identity contract).  When on, the int8 KV-page quantize sites
+        # tap their exact roundtrip SNR into a stats pytree the wrapped
+        # programs return alongside their outputs.
+        from hetu_tpu.obs.numerics import numerics_enabled, record_every
+        self._numerics = numerics_enabled()
+        self._numerics_every = record_every()
+        self._numerics_stats = None
+        # the numerics detectors (quant_snr_collapse on kv_pages, etc.)
+        # ride the same HETU_TPU_HEALTH gate as the serving monitor
+        # above — without this the serving side would RECORD SNR but
+        # never watch it
+        from hetu_tpu.obs.health import maybe_numerics_health_monitor
+        self._num_health = (maybe_numerics_health_monitor(
+            runlog=self.run_log, registry=self._registry,
+            source=self.telemetry) if self._numerics else None)
 
         # per-request prefill scratch: a dense [L, 1, max_len] cache the
         # chunk program advances; template zeros reused (functionally)
@@ -225,6 +243,28 @@ class ServingEngine:
         def write_fn(pool_tree, pages_row, ks, vs):
             return pool.write_pages(pool_tree, pages_row, ks, vs)
 
+        if self._numerics:
+            # wrap the programs that contain quantize sites in a
+            # numerics collector; their stats pytree rides out as one
+            # extra output (empty when KV pages are exact).  The
+            # unwrapped functions above ARE the unset-flag programs —
+            # byte-identity by construction.
+            from hetu_tpu.obs import numerics as _numerics
+            base_decode, base_write = decode_fn, write_fn
+
+            def decode_fn(params, pool_tree, table, tokens, positions):
+                with _numerics.collecting() as col:
+                    out = base_decode(params, pool_tree, table, tokens,
+                                      positions)
+                    stats = col.finalize()
+                return out + (stats,)
+
+            def write_fn(pool_tree, pages_row, ks, vs):
+                with _numerics.collecting() as col:
+                    tree = base_write(pool_tree, pages_row, ks, vs)
+                    stats = col.finalize()
+                return tree, stats
+
         # the pool tree is donated: the KV pool is the engine's dominant
         # allocation and it flows through every step — without donation
         # XLA would copy the whole pool to update one token per slot
@@ -233,6 +273,48 @@ class ServingEngine:
         self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
         self._chunk_jit = jax.jit(chunk_fn)
         self._write_jit = jax.jit(write_fn, donate_argnums=(0,))
+
+    # ---------------------------------------------------- numerics taps
+    def _run_decode(self, *args):
+        """Dispatch the decode program, peeling the numerics stats
+        output when the observatory wrapped it."""
+        out = self._decode_jit(*args)
+        if self._numerics:
+            nxt, tree, stats = out
+            self._note_numerics(stats)
+            return nxt, tree
+        return out
+
+    def _run_write(self, *args):
+        out = self._write_jit(*args)
+        if self._numerics:
+            tree, stats = out
+            self._note_numerics(stats)
+            return tree
+        return out
+
+    def _note_numerics(self, stats):
+        if stats:
+            self._numerics_stats = stats   # latest wins until recorded
+
+    def _maybe_record_numerics(self):
+        """Every HETU_TPU_NUMERICS_EVERY engine steps, host-fetch the
+        latest stats pytree and fan it out through the one numerics
+        sink (RunLog record + registry gauges + telemetry)."""
+        if (not self._numerics or self._numerics_stats is None
+                or self.steps_done % self._numerics_every):
+            return
+        from hetu_tpu.obs import numerics as _numerics
+        try:
+            host = jax.device_get(self._numerics_stats)
+        except Exception:   # telemetry never kills an engine step
+            self._numerics_stats = None
+            return
+        self._numerics_stats = None
+        _numerics.record(host, step=self.steps_done,
+                         registry=self._registry, runlog=self.run_log)
+        if self._num_health is not None:
+            self._num_health.observe(self.steps_done, host)
 
     def warmup(self):
         """Compile all three programs so the first request's TTFT is not
@@ -245,14 +327,14 @@ class ServingEngine:
         table = jnp.zeros((S, self.scheduler.max_pages), jnp.int32)
         toks = jnp.zeros(S, jnp.int32)
         pos = jnp.zeros(S, jnp.int32)
-        nxt, tree = self._decode_jit(self.params, self.pool.arrays.tree(),
+        nxt, tree = self._run_decode(self.params, self.pool.arrays.tree(),
                                      table, toks, pos)
         self.pool.arrays = PoolArrays.from_tree(tree)
         lg, cache = self._chunk_jit(self.params,
                                     jnp.zeros((1, C), jnp.int32),
                                     self._scratch, jnp.int32(0))
         row = jnp.zeros(self.scheduler.max_pages, jnp.int32)
-        tree = self._write_jit(self.pool.arrays.tree(), row,
+        tree = self._run_write(self.pool.arrays.tree(), row,
                                cache[0][:, 0], cache[1][:, 0])
         self.pool.arrays = PoolArrays.from_tree(tree)
         jax.block_until_ready(nxt)
@@ -335,7 +417,7 @@ class ServingEngine:
                 st = self.scheduler.slots[i]
                 tokens[i] = st.generated[-1]
                 positions[i] = st.pos
-            nxt, pool_tree = self._decode_jit(
+            nxt, pool_tree = self._run_decode(
                 self.params, self.pool.arrays.tree(),
                 jnp.asarray(self.scheduler.page_table),
                 jnp.asarray(tokens), jnp.asarray(positions))
@@ -373,6 +455,7 @@ class ServingEngine:
                     self.tracer.on_split(survivors, tnow, "evict")
 
         self.steps_done += 1
+        self._maybe_record_numerics()
         self._registry.set_gauge("serve.queue_depth",
                                  self.scheduler.queue_depth)
         self._registry.set_gauge("serve.slot_occupancy",
@@ -434,7 +517,7 @@ class ServingEngine:
         pages_row = np.full(self.scheduler.max_pages, PagePool.NULL_PAGE,
                             np.int32)
         pages_row[: len(st.pages)] = st.pages
-        tree = self._write_jit(self.pool.arrays.tree(),
+        tree = self._run_write(self.pool.arrays.tree(),
                                jnp.asarray(pages_row),
                                st.prefill_cache[0][:, 0],
                                st.prefill_cache[1][:, 0])

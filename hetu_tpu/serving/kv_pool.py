@@ -76,6 +76,17 @@ def dequantize_heads(q, s):
                                 s.reshape(-1)).reshape(q.shape)
 
 
+def _tap_kv_snr(x32, q, s):
+    """Numerics SNR tap at the int8 KV-page quantize site
+    (obs/numerics.py, HETU_TPU_NUMERICS): the exact roundtrip error of
+    the tokens just written.  Only traced when the serving engine
+    installed a collector around the program build."""
+    from hetu_tpu.obs import numerics as _numerics
+    if _numerics.active():
+        _numerics.tap_quant_error("kv_pages", x32,
+                                  x32 - dequantize_heads(q, s))
+
+
 @dataclasses.dataclass
 class PoolArrays:
     """The device-side pool state threaded through the engine's jitted
@@ -205,7 +216,9 @@ class PagePool:
         def put(pool, scale, toks):
             if scale is None:
                 return pool.at[:, page, off].set(toks.astype(pool.dtype)), None
-            q, s = quantize_heads(toks.astype(jnp.float32))
+            x32 = toks.astype(jnp.float32)
+            q, s = quantize_heads(x32)
+            _tap_kv_snr(x32, q, s)
             return (pool.at[:, page, off].set(q),
                     scale.at[:, page, off].set(s))
 
@@ -228,7 +241,9 @@ class PagePool:
             x = x.reshape(paged_shape)
             if scale is None:
                 return pool.at[:, pages_row].set(x.astype(pool.dtype)), None
-            q, s = quantize_heads(x.astype(jnp.float32))
+            x32 = x.astype(jnp.float32)
+            q, s = quantize_heads(x32)
+            _tap_kv_snr(x32, q, s)
             return (pool.at[:, pages_row].set(q),
                     scale.at[:, pages_row].set(s))
 

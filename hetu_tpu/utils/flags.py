@@ -124,6 +124,27 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "Pure post-compile HLO-text analysis: the traced program is "
          "byte-identical with the flag on or off; see "
          "docs/static_analysis.md", identity="1"),
+    Flag("HETU_TPU_NUMERICS", "bool", False,
+         "the numerics observatory (obs/numerics.py, "
+         "docs/observability.md): compute per-tensor absmax/rms/norm, "
+         "nonfinite counts and bf16 underflow/overflow fractions at "
+         "named scopes INSIDE the jitted step, exact quantization-error "
+         "SNR at every compressed path (DP grad sync, SP collectives, "
+         "ZeRO delta-gather, int8 KV pages), EF-residual norms, "
+         "loss-scale dynamics and MoE router stats (per-expert load, "
+         "entropy, capacity drops) -> an auxiliary stats pytree per "
+         "step, recorded as schema-versioned 'numerics' RunLog records "
+         "+ numerics.* registry gauges, feeding the numerics health "
+         "detectors (HETU_TPU_HEALTH).  Unset (default) = the step "
+         "wrapper never runs: the traced program is byte-identical to "
+         "the flag not existing (registered identity contract)",
+         identity="0"),
+    Flag("HETU_TPU_NUMERICS_EVERY", "int", 1,
+         "numerics host-fetch sampling interval in steps: record the "
+         "stats pytree every N-th step (the in-graph stats are traced "
+         "either way — only the device fetch + RunLog/registry write is "
+         "sampled).  Raise on hot loops where a per-step scalar fetch "
+         "is noticeable"),
     Flag("HETU_TPU_MAX_PLANS", "int", 8,
          "max compiled train-step plans per strategy (one per batch-shape "
          "bucket); a new shape past the cap is a loud error instead of a "

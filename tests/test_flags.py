@@ -78,6 +78,10 @@ def test_every_env_read_is_registered():
     # the graph-contract linter's per-compile hook
     # (hetu_tpu/analysis, docs/static_analysis.md)
     assert "HETU_TPU_LINT" in flags.REGISTRY
+    # the numerics observatory (obs/numerics.py, docs/observability.md):
+    # the main gate + its sampling-interval sub-flag
+    for name in ("HETU_TPU_NUMERICS", "HETU_TPU_NUMERICS_EVERY"):
+        assert name in flags.REGISTRY
 
 
 def test_identity_contract_table():
@@ -102,7 +106,10 @@ def test_identity_contract_table():
     # the serving flight recorder is host-side only: ON must be a no-op
     # for the compiled programs
     assert table["HETU_TPU_SERVE_TRACE"] == "1"
-    assert len(table) >= 14
+    # the numerics observatory changes the traced program when ON (the
+    # stats ride the step outputs), so its contract is the OFF value
+    assert table["HETU_TPU_NUMERICS"] == "0"
+    assert len(table) >= 15
     # flags with NO contract must stay contract-free: these genuinely
     # change program shapes, so an identity entry would be a lie the
     # sweep turns into a tier-1 failure

@@ -43,20 +43,23 @@ def test_capacity_drops_tokens():
     moe = MoEConfig(num_experts=2, top_k=1)
     logits = jnp.asarray(np.zeros((32, 2), np.float32))  # all tie -> expert 0
     logits = logits.at[:, 0].set(1.0)
-    disp, comb, aux = topk_routing(logits, jnp.arange(32), moe, capacity=8)
-    # only 8 of 32 tokens make it into expert 0
+    disp, comb, aux, dropped = topk_routing(logits, jnp.arange(32), moe,
+                                            capacity=8)
+    # only 8 of 32 tokens make it into expert 0; the rest are reported
     assert int(disp[:, 0, :].sum()) == 8
     assert int(disp[:, 1, :].sum()) == 0
+    assert int(dropped) == 24
 
 
 def test_hash_gate():
     moe = MoEConfig(num_experts=4, gate="hash")
     logits = jnp.zeros((16, 4))
     ids = jnp.arange(16, dtype=jnp.int32)
-    disp, comb, aux = topk_routing(logits, ids, moe, capacity=8)
+    disp, comb, aux, dropped = topk_routing(logits, ids, moe, capacity=8)
     # token t -> expert t % 4
     placed = np.asarray(disp).nonzero()
     np.testing.assert_array_equal(placed[1], np.arange(16) % 4)
+    assert int(dropped) == 0
 
 
 @pytest.mark.slow

@@ -134,6 +134,24 @@ def summarize(records) -> dict:
                     srv[k] = rep[k]
         out["serving"] = srv
 
+    # numerics observatory (obs/numerics.py, HETU_TPU_NUMERICS=1): the
+    # per-scope tensor/SNR summary + scaler dynamics, read through THE
+    # one numerics reader shared with tools_numerics.py (no second
+    # parser)
+    if any(r.get("kind") == "numerics" for r in records):
+        from hetu_tpu.obs.numerics import summarize_numerics
+        from tools_numerics import numerics_anomalies
+        num = summarize_numerics(records)
+        num_out: dict = {"records": num["records"], "worst": num["worst"],
+                         "scopes": num["scopes"]}
+        anom = numerics_anomalies(records)
+        if anom:
+            num_out["anomalies"] = anom
+        out["numerics"] = num_out
+    if any(r.get("kind") == "scaler" for r in records):
+        from tools_numerics import scaler_section
+        out["scaler"] = scaler_section(records)
+
     # analytic step profiles (obs.hlo_profile, HETU_TPU_PROFILE=1): the
     # newest profile record matches the plan the run actually stepped
     # with — top-k layers by predicted time + peak HBM vs the chip

@@ -215,6 +215,43 @@ def _hardware_free_kernels(batch: int = 8, seq: int = 2048):
     return rec
 
 
+def _hardware_free_moe(batch: int = 8, seq: int = 2048, ep: int = 8,
+                       experts: int = 64, top_k: int = 2,
+                       capacity_factor: float = 1.25):
+    """Analytic MoE dispatch record for an expert-parallel variant of
+    the bench config (comm/wire.py moe_dispatch_report): per-mode
+    bytes-on-wire of the token->expert transport — fp32 explicit a2a +
+    combine gather vs int8/int4, plus the two-level intra/inter split
+    when the profile declares a topology — and the expert FLOPs/token
+    (6 * k * 3 * h * i, the fwd+bwd convention flops_per_token uses).
+    Buffer elements = capacity_factor * top_k * tokens * hidden per
+    layer, priced at the bench config's bf16 activation width (so
+    ratio_int8 is ~1.97x vs bf16, directly comparable to the SP row).
+    Hardware-free like the comm record; tools_comm_report.py --compare
+    measures the same dispatch from real lowered HLO."""
+    from hetu_tpu.comm.wire import moe_dispatch_report
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    cfg = _bench_config()
+    hw = load_hardware_profile()
+    topo = hw.get("topology") or {}
+    n_elems = capacity_factor * top_k * batch * seq * cfg.hidden_size
+    rep = moe_dispatch_report(n_elems, ep,
+                              int(topo.get("slice_devices", 0)),
+                              elem_bytes=2.0)
+    rep.update({
+        "baseline_dtype": "bf16",
+        "experts": experts, "top_k": top_k,
+        "capacity_factor": capacity_factor,
+        "expert_flops_per_token": 6.0 * top_k * 3.0 * cfg.hidden_size
+        * cfg.intermediate_size,
+        "layers": cfg.num_hidden_layers,
+    })
+    if topo:
+        rep["intra_gbps"] = topo.get("intra_gbps")
+        rep["inter_gbps"] = topo.get("inter_gbps")
+    return rep
+
+
 def _hardware_free_serving(slots: int = 8, ctx: int = 2048):
     """Analytic serving record for the bench config: continuous-batching
     decode tokens/s (roofline over the profiled chip: params read once
@@ -336,6 +373,11 @@ def main():
                 detail["serving"] = _hardware_free_serving()
             except Exception as e:
                 print(f"# hardware-free serving estimate failed: {e!r}",
+                      file=sys.stderr)
+            try:
+                detail["moe"] = _hardware_free_moe()
+            except Exception as e:
+                print(f"# hardware-free moe estimate failed: {e!r}",
                       file=sys.stderr)
             try:
                 detail["kernels"] = _hardware_free_kernels()
@@ -486,6 +528,13 @@ def main():
         detail["serving"] = _hardware_free_serving()
     except Exception as e:
         print(f"# serving attach failed: {e!r}", file=sys.stderr)
+    try:
+        # analytic MoE dispatch companion (comm/wire.py): per-mode
+        # bytes of the expert-parallel token transport, one meaning
+        # across tunnel states (docs/moe.md)
+        detail["moe"] = _hardware_free_moe(batch, seq)
+    except Exception as e:
+        print(f"# moe attach failed: {e!r}", file=sys.stderr)
     try:
         # analytic fused-kernel companion (ops/pallas/traffic.py):
         # per-kernel fused-vs-unfused HBM bytes, one meaning across

@@ -1,7 +1,7 @@
 """HLO lints: distributed-correctness invariants checked statically over
 one compiled program's post-optimization text.
 
-Four lints, one walk surface (every parsing primitive comes from
+One walk surface (every parsing primitive comes from
 `hetu_tpu.obs.hlo_text` — the tokenizer shared with obs/comm.py and
 obs/hlo_profile.py, so a parse fix lands once):
 
@@ -36,6 +36,13 @@ obs/hlo_profile.py, so a parse fix lands once):
   unattributed FLOPs; this lint keeps the blind spot from growing
   silently.
 
+* **moe-dispatch** (warning) — an all-to-all over one FLAT replica
+  group that spans topology slices (size > slice_devices, divisible
+  into slices): every hop is paced by the slow inter-slice links while
+  the two-level schedule (HETU_TPU_COMM_TOPOLOGY=two_level — the MoE
+  dispatch's HAllToAll and the DP grad sync both route through it) was
+  available.  Vacuous without a profile topology.
+
 `lint_hlo` runs them all; each lint is also callable alone (the fixture
 tests pin one positive and one negative program per lint).
 """
@@ -50,8 +57,9 @@ from hetu_tpu.obs.hlo_text import (BRANCH_PAT, GROUPS_ATTR_PAT, LINE_PAT,
                                    alias_attribute_body, as_hlo_text,
                                    call_multipliers, donated_parameters,
                                    dot_flops, entry_computation,
-                                   entry_parameters, maybe_collective,
-                                   payload_bytes, split_computations)
+                                   entry_parameters, first_group,
+                                   maybe_collective, payload_bytes,
+                                   split_computations)
 
 #: "donating a scalar is noise" — buffers below this size are outside
 #: the donation/replication accounting by default (64 KiB)
@@ -322,6 +330,58 @@ def lint_scope_coverage(compiled_or_text, *, floor: float = 0.90,
     return findings
 
 
+def lint_moe_dispatch(compiled_or_text, *, topology=None,
+                      program: str = "hlo") -> List[Finding]:
+    """Flat slice-spanning dispatch all-to-alls: a program that lowers
+    an all-to-all whose replica group crosses slice boundaries in ONE
+    flat group (size > slice_devices, divisible into slices) is paying
+    inter-slice rates for every hop when the two-level schedule
+    (comm/topology groups; HETU_TPU_COMM_TOPOLOGY=two_level routes the
+    MoE dispatch and the DP grad sync through it) was available.
+    Vacuous when the profile declares no topology or nothing lowers an
+    all-to-all."""
+    if topology is None:
+        from hetu_tpu.comm.topology import load_topology
+        topology = load_topology()
+    if topology is None or topology.slice_devices <= 1:
+        return []
+    k = topology.slice_devices
+    txt = as_hlo_text(compiled_or_text)
+    comps = split_computations(txt)
+    findings: List[Finding] = []
+    for cname, lines in comps.items():
+        for ln in lines:
+            found = maybe_collective(ln)
+            if found is None or found[0] != "all-to-all":
+                continue
+            n, ranks = first_group(ln, 1)
+            if not ranks or n <= k or n % k:
+                continue
+            if topology.classify_group(ranks) != "inter":
+                continue
+            # a group with at most ONE rank per slice is the two-level
+            # schedule's own strided inter transversal — exactly the
+            # shape this lint recommends, never a finding.  FLAT
+            # slice-spanning groups put whole slices (>1 rank each) in
+            # one group.
+            per_slice: Dict[int, int] = {}
+            for r in ranks:
+                s = int(r) // k
+                per_slice[s] = per_slice.get(s, 0) + 1
+            if max(per_slice.values()) <= 1:
+                continue
+            findings.append(Finding(
+                "moe-dispatch", WARNING, f"{program}:{cname}",
+                f"all-to-all over a flat {n}-rank group spanning "
+                f"{n // k} slices of {k} — every hop pays the "
+                f"inter-slice rate; the two-level schedule "
+                f"(HETU_TPU_COMM_TOPOLOGY=two_level) was available but "
+                f"not taken",
+                {"group_size": n, "slice_devices": k,
+                 "line": ln.strip()[:200]}))
+    return findings
+
+
 def lint_hlo(compiled_or_text, *, expected_dtype: Optional[str] = None,
              min_bytes: int = MIN_BYTES, coverage_floor: float = 0.90,
              program: str = "hlo") -> List[Finding]:
@@ -333,4 +393,5 @@ def lint_hlo(compiled_or_text, *, expected_dtype: Optional[str] = None,
     out += lint_replication(txt, min_bytes=min_bytes, program=program)
     out += lint_dtype_drift(txt, expected_dtype, program=program)
     out += lint_scope_coverage(txt, floor=coverage_floor, program=program)
+    out += lint_moe_dispatch(txt, program=program)
     return out

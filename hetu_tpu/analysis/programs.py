@@ -1,5 +1,6 @@
 """Canonical programs the linter judges: ONE train step, ONE serving
-decode, and ONE MoE forward+backward, built the same way every time.
+decode, ONE MoE forward+backward, and ONE expert-parallel (ep=2) MoE
+step, built the same way every time.
 
 The flag-identity sweep (flag_identity.py) lowers these under each
 contracted flag value and diffs fingerprints against an unset
@@ -11,8 +12,10 @@ Shapes are tiny on purpose (the sweep lowers the train step a dozen
 times): a 2-layer scanned llama on the dp=4 virtual CPU mesh — the same
 configuration the per-flag byte-identity tests used before the sweep
 replaced them — the 8-slot serving decode program at page 8 /
-max_len 32, and a one-block unrolled MoE train step (single device) so
-the sweep's identity claims also cover the routing/dispatch code path.
+max_len 32, and a one-block unrolled MoE train step — once on a single
+device and once on an ep=2 mesh — so the sweep's identity claims also
+cover the routing/dispatch code paths (incl. the HETU_TPU_MOE_DISPATCH
+branch point, which only an ep>1 trace reaches).
 
 Every flag under contract acts at Trainer/ServingEngine BUILD time or
 at trace time, so the builders construct FRESH objects per call: the
@@ -133,6 +136,41 @@ def moe_step_text(*, optimized: bool = False) -> str:
         tr.close()
 
 
+def canonical_moe_ep_trainer():
+    """The canonical EXPERT-PARALLEL MoE train-step owner: the same
+    one-block MoE llama as `canonical_moe_trainer`, on an ep=2 mesh —
+    the program whose trace actually reaches the ep>1 branch point in
+    `nn/moe.py` (HETU_TPU_MOE_DISPATCH reads there), so the dispatch
+    flag's gspmd identity contract covers the code path it gates and a
+    regression that perturbs the ep lowering under any contracted flag
+    fails the sweep."""
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.engine import Trainer, TrainingConfig
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.parallel import ParallelStrategy
+    cfg = LlamaConfig.tiny(
+        remat=False, use_scan=False, num_experts=4, moe_top_k=2,
+        num_hidden_layers=1, hidden_size=32, intermediate_size=64,
+        vocab_size=128, num_attention_heads=2, num_key_value_heads=2,
+        max_position_embeddings=64, moe_capacity_factor=1.0)
+    st = ParallelStrategy(mesh=MeshConfig(ep=2))
+    tc = TrainingConfig(global_batch_size=4, micro_batch_size=4,
+                        seq_len=16, lr=1e-3, warmup_steps=2,
+                        total_steps=10, log_every=1000)
+    return Trainer(LlamaLMHeadModel(cfg, st), tc, st).build()
+
+
+def moe_ep_step_text(*, optimized: bool = False) -> str:
+    """Lowered text of the canonical ep=2 MoE step under the CURRENT
+    environment — the sweep's fourth program (the expert-parallel
+    dispatch surface)."""
+    tr = canonical_moe_ep_trainer()
+    try:
+        return tr.lowered_step(canonical_moe_batch(), optimized=optimized)
+    finally:
+        tr.close()
+
+
 def serving_decode_text(*, optimized: bool = False) -> str:
     """Lowered text of the canonical serving decode program under the
     CURRENT environment (flags read through ServeConfig.from_flags and
@@ -172,4 +210,5 @@ PROGRAMS = {
     "train": train_step_text,
     "decode": serving_decode_text,
     "moe": moe_step_text,
+    "moe_ep": moe_ep_step_text,
 }

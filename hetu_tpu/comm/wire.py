@@ -126,6 +126,103 @@ def two_level_sync_bytes(n_elements: float, dp: int, slice_devices: int,
     return {"intra_bytes": intra, "inter_bytes": inter}
 
 
+def moe_dispatch_wire_bytes(n_elements: float, ep: int, mode: str = "none",
+                            block_size: int = DEFAULT_BLOCK,
+                            elem_bytes: float = 4.0) -> float:
+    """Per-participant bytes on wire for one explicit MoE dispatch round
+    trip (nn/moe_dispatch.py) of a local expert buffer of `n_elements`
+    values over a flat `ep`-rank group: the dispatch all-to-all (each
+    rank ships its partial [E, C, h] buffer, keeps 1/ep) PLUS the
+    combine all-gather (each rank receives the other ranks' expert
+    outputs) — both (ep-1)/ep * N * w.  `mode` "none" is the fp32 a2a
+    path; int8/int4 ride the quantized collectives (~3.94x / ~7.76x
+    fewer bytes at the default block, same as the grad-sync ratios)."""
+    if ep <= 1:
+        return 0.0
+    w = wire_bytes_per_element(mode, block_size, elem_bytes)
+    return 2.0 * (ep - 1) / ep * n_elements * w
+
+
+def moe_two_level_dispatch_bytes(n_elements: float, ep: int,
+                                 slice_devices: int, mode: str = "none",
+                                 block_size: int = DEFAULT_BLOCK,
+                                 elem_bytes: float = 4.0
+                                 ) -> Dict[str, float]:
+    """Per-participant intra/inter byte split of the HIERARCHICAL MoE
+    dispatch (HetuMoE's HAllToAll over comm/topology groups): intra-slice
+    a2a of the full partial buffer + intra all-gather of the finished
+    outputs run at intra rates; only the 1/k slice-aggregated bundles
+    cross slices on the strided transversals:
+
+        intra: 2 (k-1)/k * N * w
+        inter: 2 (s-1)/s * (N/k) * w
+
+    vs a flat slice-spanning schedule whose inter-slice share is
+    2 (ep-k)/ep * N * w — the inter links move ~k-fold fewer bytes.
+    Falls back to flat accounting (all bytes intra) when the topology
+    does not apply."""
+    w = wire_bytes_per_element(mode, block_size, elem_bytes)
+    k = int(slice_devices)
+    if ep <= 1:
+        return {"intra_bytes": 0.0, "inter_bytes": 0.0}
+    if k <= 1 or ep % k or ep <= k:
+        return {"intra_bytes": moe_dispatch_wire_bytes(
+                    n_elements, ep, mode, block_size, elem_bytes),
+                "inter_bytes": 0.0}
+    s = ep // k
+    intra = 2.0 * (k - 1) / k * n_elements * w
+    inter = 2.0 * (s - 1) / s * (n_elements / k) * w
+    return {"intra_bytes": intra, "inter_bytes": inter}
+
+
+def moe_flat_inter_bytes(n_elements: float, ep: int, slice_devices: int,
+                         mode: str = "none",
+                         block_size: int = DEFAULT_BLOCK,
+                         elem_bytes: float = 4.0) -> float:
+    """Inter-slice share of a FLAT slice-spanning dispatch round trip:
+    of each rank's (ep-1)/ep a2a sends, (ep-k)/(ep-1) target peers in
+    other slices (ditto the combine gather) — the bytes the two-level
+    schedule keeps off the slow links."""
+    k = int(slice_devices)
+    if ep <= k or k < 1 or ep % k:
+        return 0.0
+    w = wire_bytes_per_element(mode, block_size, elem_bytes)
+    return 2.0 * (ep - k) / ep * n_elements * w
+
+
+def moe_dispatch_report(n_elements: float, ep: int,
+                        slice_devices: int = 0,
+                        block_size: int = DEFAULT_BLOCK,
+                        elem_bytes: float = 4.0) -> Dict[str, Any]:
+    """The fp32-vs-int8-vs-two-level MoE dispatch comparison for a local
+    expert buffer of `n_elements` values — the hardware-free record
+    consumed by bench.py `detail.moe`, the cost model's EP terms and
+    tools_comm_report's analytic fallback (the analyzer obs.comm does
+    the same accounting from real lowered HLO)."""
+    fp32 = moe_dispatch_wire_bytes(n_elements, ep, "none", block_size,
+                                   elem_bytes)
+    int8 = moe_dispatch_wire_bytes(n_elements, ep, "int8", block_size,
+                                   elem_bytes)
+    out: Dict[str, Any] = {
+        "ep": ep, "buffer_elements": float(n_elements),
+        "fp32_wire_bytes": fp32, "int8_wire_bytes": int8,
+        "int4_wire_bytes": moe_dispatch_wire_bytes(
+            n_elements, ep, "int4", block_size, elem_bytes),
+        "ratio_int8": (fp32 / int8) if int8 else None,
+        "block_size": block_size, "analytic": True,
+    }
+    k = int(slice_devices)
+    if k > 1 and ep > k and ep % k == 0:
+        out["two_level_int8"] = moe_two_level_dispatch_bytes(
+            n_elements, ep, k, "int8", block_size, elem_bytes)
+        out["flat_inter_int8"] = moe_flat_inter_bytes(
+            n_elements, ep, k, "int8", block_size, elem_bytes)
+        out["inter_ratio_two_level"] = (
+            out["flat_inter_int8"] / out["two_level_int8"]["inter_bytes"]
+            if out["two_level_int8"]["inter_bytes"] else None)
+    return out
+
+
 def analytic_dp_sync(n_params: float, dp: int, *,
                      block_size: int = DEFAULT_BLOCK,
                      ici_gbps: Optional[float] = None) -> Dict[str, Any]:

@@ -281,29 +281,37 @@ def topk_routing(logits, ids, moe: MoEConfig, capacity: int):
     bool, combine [T, E, C] f32, aux_loss, dropped) where ``dropped`` is
     the scalar int32 count of capacity-dropped (token, slot) pairs —
     the same accounting ``sort_routing`` carries in its plan.  Memory
-    O(T·E·C) — use dispatch="sort" beyond toy sizes."""
+    O(T·E·C) — use dispatch="sort" beyond toy sizes.
+
+    Single cumsum-based construction (no per-slot Python loop): the
+    (token, slot) pairs flatten SLOT-MAJOR — all slot-0 picks in token
+    order, then slot-1 — exactly ``sort_routing``'s drop priority, so
+    position-in-expert is one exclusive cumsum of the one-hot pair
+    matrix and the [T, E, C] masks assemble from one einsum over the
+    pair dim (the routing-parity regression test pins the plans
+    identical to the sort path's)."""
     T, E = logits.shape
     expert_idx, gate_vals = select_experts(logits, ids, moe)
     k = expert_idx.shape[1]
+    TK = T * k
 
-    dispatch = jnp.zeros((T, E, capacity), jnp.bool_)
-    combine = jnp.zeros((T, E, capacity), jnp.float32)
-    fill = jnp.zeros((E,), jnp.int32)
-    for slot in range(k):
-        e = expert_idx[:, slot]
-        onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)
-        pos_in_e = (jnp.cumsum(onehot, axis=0) - onehot)
-        pos = jnp.take_along_axis(pos_in_e, e[:, None], axis=1)[:, 0] + fill[e]
-        keep = pos < capacity
-        pos_c = jnp.clip(pos, 0, capacity - 1)
-        upd = (jax.nn.one_hot(e, E, dtype=jnp.float32)[:, :, None] *
-               jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32)[:, None, :])
-        upd = upd * keep[:, None, None]
-        dispatch = dispatch | (upd > 0)
-        combine = combine + upd * gate_vals[:, slot][:, None, None]
-        fill = fill + jnp.sum(onehot * keep[:, None], axis=0)
+    e_flat = expert_idx.T.reshape(TK)           # slot-major pair order
+    g_flat = gate_vals.T.reshape(TK)
+    onehot_e = jax.nn.one_hot(e_flat, E, dtype=jnp.int32)       # [TK, E]
+    pos_in_e = jnp.cumsum(onehot_e, axis=0) - onehot_e          # exclusive
+    pos = jnp.take_along_axis(pos_in_e, e_flat[:, None], axis=1)[:, 0]
+    keep = pos < capacity
+    pos_c = jnp.clip(pos, 0, capacity - 1)
+    w = jnp.where(keep, 1.0, 0.0)
+    pair = (jax.nn.one_hot(e_flat, E, dtype=jnp.float32) * w[:, None],
+            jax.nn.one_hot(pos_c, capacity, dtype=jnp.float32))
+    # [TK, E] x [TK, C] -> [TK, E, C], folded back to tokens slot-major
+    combine_f = jnp.einsum("se,sc->sec", pair[0] * g_flat[:, None], pair[1])
+    combine = combine_f.reshape(k, T, E, capacity).sum(axis=0)
+    disp_f = jnp.einsum("se,sc->sec", pair[0], pair[1])
+    dispatch = disp_f.reshape(k, T, E, capacity).sum(axis=0) > 0
 
-    dropped = T * k - jnp.sum(fill)
+    dropped = TK - jnp.sum(keep.astype(jnp.int32))
     return dispatch, combine, aux_losses(logits, expert_idx, moe), dropped
 
 
@@ -381,6 +389,20 @@ class MoELayer(Module):
         group_axes = tuple(a for a, n in (("dp", db), ("cp", cs)) if n > 1)
         if group_axes:
             xg = DS.make(3, {0: group_axes}).constrain(xg)
+
+        # explicit expert-parallel dispatch (HETU_TPU_MOE_DISPATCH,
+        # nn/moe_dispatch.py): same routing plan, transport through a
+        # shard_map over ep (quantized a2a + all-gather, hierarchical
+        # under a two-level topology).  "gspmd" — the unset default —
+        # takes the constraint-based path below, byte-identical to the
+        # flag not existing (registered identity contract).
+        from hetu_tpu.nn import moe_dispatch as _md
+        if _md.resolved_mode(st) != "gspmd":
+            yg, aux = _md.explicit_forward(self, params, xg, ig,
+                                           capacity, group_axes, Tg)
+            y = yg.reshape(db, cs, b // db, s // cs, h)
+            y = y.transpose(0, 2, 1, 3, 4).reshape(b, s, h)
+            return y, jnp.mean(aux)
 
         def route_one(xt, ids):
             logits = xt.astype(jnp.float32) @ params["router"]

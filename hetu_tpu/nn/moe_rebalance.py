@@ -1,0 +1,121 @@
+"""Capacity-factor rebalancing from the live expert-load gauges.
+
+`nn/moe.py` publishes router telemetry through the numerics observatory
+(PR 12): `moe.expert_load` gauges — per-expert fraction of TOKENS
+carrying that expert, summing to ~top_k — and the `moe.capacity_dropped`
+counter.  This module closes the loop HetuMoE closes with its dynamic
+capacity: a host-side watcher that reads those gauges and proposes a new
+`capacity_factor` when the observed load says the current one is wrong
+in either direction.
+
+Why host-side: capacity is a STATIC shape (the [E, C, h] dispatch
+buffers), so changing it means re-tracing — exactly a plan change, the
+same tier as a hot switch.  The watcher therefore never touches a live
+step; it emits a decision the caller applies by rebuilding the layer /
+train step with `apply(moe_cfg, factor)` (dataclasses.replace — the
+PlanPool keys recompiles per strategy already).
+
+The math: a perfectly balanced router puts `top_k / E` of the tokens on
+each expert, and capacity C = cf * T * k / E holds `cf` times that.
+The hottest expert needs `cf >= load_max * E / k` to drop nothing, so:
+
+* GROW  when `needed > cf` (tokens are being capacity-dropped) for
+  `strikes` consecutive observations -> `needed * headroom`, capped.
+* SHRINK when `needed * shrink_margin < cf` (buffers mostly padding)
+  for `strikes` observations -> `needed * headroom`, floored.
+
+Hysteresis (strike counting + the shrink margin) keeps a noisy router
+from thrashing recompiles — the same strike pattern the serving
+LoadAdaptiveMesh uses.
+"""
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+from hetu_tpu.obs.metrics import MetricsRegistry, get_registry
+
+
+@dataclasses.dataclass
+class RebalanceDecision:
+    """One proposed capacity change: the new factor + the evidence."""
+    capacity_factor: float
+    reason: str                # "grow" | "shrink"
+    load_max: float            # observed hottest-expert token fraction
+    needed_factor: float       # cf that would just fit the hottest
+
+
+class CapacityRebalancer:
+    """Watch the `moe.expert_load` gauges, propose capacity changes.
+
+    Call `observe()` once per reporting interval (whenever the numerics
+    observatory has refreshed the gauges — every HETU_TPU_NUMERICS_EVERY
+    steps under the flag); it returns a RebalanceDecision when `strikes`
+    consecutive observations agree a change is warranted, else None."""
+
+    def __init__(self, num_experts: int, top_k: int,
+                 capacity_factor: float,
+                 registry: Optional[MetricsRegistry] = None, *,
+                 headroom: float = 1.1, shrink_margin: float = 1.5,
+                 strikes: int = 2, min_factor: float = 1.0,
+                 max_factor: float = 8.0):
+        if strikes < 1:
+            raise ValueError(f"strikes must be >= 1, got {strikes}")
+        self.num_experts = num_experts
+        self.top_k = max(top_k, 1)
+        self.capacity_factor = float(capacity_factor)
+        self.registry = registry if registry is not None else get_registry()
+        self.headroom = headroom
+        self.shrink_margin = shrink_margin
+        self.strikes = strikes
+        self.min_factor = min_factor
+        self.max_factor = max_factor
+        self._grow_streak = 0
+        self._shrink_streak = 0
+
+    # ------------------------------------------------------------------
+    def _load_max(self) -> Optional[float]:
+        loads = [self.registry.gauge_value("moe.expert_load",
+                                           expert=str(i))
+                 for i in range(self.num_experts)]
+        loads = [v for v in loads if v is not None]
+        return max(loads) if loads else None
+
+    def observe(self) -> Optional[RebalanceDecision]:
+        load_max = self._load_max()
+        if load_max is None:
+            return None                      # gauges not published yet
+        needed = load_max * self.num_experts / self.top_k
+        cf = self.capacity_factor
+        if needed > cf:
+            self._grow_streak += 1
+            self._shrink_streak = 0
+            if self._grow_streak >= self.strikes:
+                return self._decide("grow", load_max, needed)
+        elif needed * self.shrink_margin < cf:
+            self._shrink_streak += 1
+            self._grow_streak = 0
+            if self._shrink_streak >= self.strikes:
+                return self._decide("shrink", load_max, needed)
+        else:
+            self._grow_streak = self._shrink_streak = 0
+        return None
+
+    def _decide(self, reason: str, load_max: float,
+                needed: float) -> Optional[RebalanceDecision]:
+        new = min(self.max_factor,
+                  max(self.min_factor, needed * self.headroom))
+        self._grow_streak = self._shrink_streak = 0
+        if abs(new - self.capacity_factor) < 1e-9:
+            return None                      # clamped into no-op
+        self.capacity_factor = new
+        self.registry.set_gauge("moe.capacity_factor", new)
+        self.registry.inc("moe.rebalances", reason=reason)
+        return RebalanceDecision(capacity_factor=new, reason=reason,
+                                 load_max=load_max, needed_factor=needed)
+
+
+def apply(moe_cfg, factor: float):
+    """A MoEConfig with the rebalanced capacity factor — feed it to a
+    fresh MoELayer / model build (a plan change, like a hot switch)."""
+    return dataclasses.replace(moe_cfg, capacity_factor=float(factor))

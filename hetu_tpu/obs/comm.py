@@ -133,6 +133,7 @@ def collective_report(compiled_or_text, *, hw: Optional[Dict] = None,
     inter_bw = topo.inter_gbps * 1e9 if topo else ar_bw
     per_op: Dict[str, Dict[str, float]] = {}
     t_intra = t_inter = t_p2p = 0.0
+    b_inter = 0.0
     total = 0.0
     dynamic = False
     for r in rows:
@@ -148,6 +149,7 @@ def collective_report(compiled_or_text, *, hw: Optional[Dict] = None,
             t_p2p += wb / p2p_bw
         elif cls == "inter":
             t_inter += wb / inter_bw
+            b_inter += wb
         else:
             t_intra += wb / intra_bw
     report: Dict[str, Any] = {
@@ -158,6 +160,12 @@ def collective_report(compiled_or_text, *, hw: Optional[Dict] = None,
         "predicted_comm_s": t_intra + t_inter + t_p2p,
         "predicted_comm_s_intra": t_intra,
         "predicted_comm_s_inter": t_inter,
+        # the intra/inter BYTE split (p2p rows count as intra here):
+        # a flat slice-spanning collective lands its whole payload in
+        # wire_bytes_inter, a two-level schedule only its 1/slice
+        # exchange — the measurable half of the HetCCL/HAllToAll claim
+        "wire_bytes_intra": total - b_inter,
+        "wire_bytes_inter": b_inter,
         "chip": hw.get("chip", "unknown"),
     }
     if dynamic:

@@ -193,7 +193,8 @@ class ParallelStrategy:
                  global_batch: Optional[int] = None,
                  seq_len: Optional[int] = None,
                  stage_layers: Optional[Tuple[int, ...]] = None,
-                 deterministic: bool = False) -> "ParallelStrategy":
+                 deterministic: bool = False,
+                 moe_dispatch: Optional[str] = None) -> "ParallelStrategy":
         """The ONE chokepoint encoding the real engine envelope.
 
         Every planner (Trainer, searcher, Malleus/Ampelos,
@@ -205,6 +206,11 @@ class ParallelStrategy:
           via getattr) or None for mesh-only checks.
         deterministic: True = an inference/eval plan (dropout never runs,
           so dropout-composition rules are skipped).
+        moe_dispatch: the MoE dispatch mode this PLAN runs under; None
+          (trainer path) reads the live HETU_TPU_MOE_DISPATCH flag —
+          callers judging hypothetical plans (the searcher's
+          per-candidate modes) pass the candidate's own mode so a flag
+          exported in the planning process cannot veto them.
         """
         def fail(msg):
             raise StrategyValidationError(f"[{self.describe()}] {msg}")
@@ -283,6 +289,18 @@ class ParallelStrategy:
                         stripe_granularity(seq_len, self.cp) is None:
                     fail(f"seq_len={seq_len} needs a cp*m divisor (m >= 2) "
                          f"for the 'stripe' CP split (cp={self.cp})")
+
+        # explicit MoE dispatch envelope (HETU_TPU_MOE_DISPATCH,
+        # nn/moe_dispatch.py): the dispatch shard_map composes with
+        # tp=1, pp=1 — reject the plan here instead of at trace time
+        if self.ep > 1 and (self.tp > 1 or self.pp > 1):
+            if moe_dispatch is None:
+                from hetu_tpu.utils import flags as _flags
+                moe_dispatch = _flags.str_flag("HETU_TPU_MOE_DISPATCH")
+            if moe_dispatch != "gspmd":
+                fail("HETU_TPU_MOE_DISPATCH explicit modes require "
+                     f"tp=1, pp=1 (got tp={self.tp}, pp={self.pp}); "
+                     "unset the flag for this mesh")
 
         if model_cfg is None:
             return self

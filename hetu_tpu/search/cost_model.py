@@ -21,6 +21,10 @@ class StrategyCandidate:
     tp: int = 1
     pp: int = 1
     cp: int = 1
+    # expert parallelism (MoE models only): shards the stacked [E, ...]
+    # expert parameters over the ep mesh axis and adds the dispatch
+    # transport term priced per `moe_dispatch` below
+    ep: int = 1
     sequence_parallel: bool = True
     zero: bool = True
     remat: bool = True
@@ -73,14 +77,23 @@ class StrategyCandidate:
     # CostModel.kernel_fusion_factors) — the searcher sees the byte cut
     # the flag buys, the same way grad_compress exposes its wire factor.
     pallas: bool = False
+    # explicit MoE dispatch (HETU_TPU_MOE_DISPATCH, nn/moe_dispatch.py):
+    # "gspmd" prices the compiler's full-width combine transport;
+    # "fp32" the explicit a2a+all-gather round trip; "int8"/"int4"
+    # scale it by the wire factor (comm/wire.moe_dispatch_wire_bytes).
+    # With comm_topology="two_level" + a profile topology that applies
+    # to ep, the dispatch is priced hierarchically (intra bytes at
+    # intra_gbps, the 1/slice inter exchange at inter_gbps) — so the
+    # searcher prefers two-level on multi-slice ep on merit.
+    moe_dispatch: str = "gspmd"
 
     @property
     def num_devices(self):
-        return self.dp * self.tp * self.pp * self.cp
+        return self.dp * self.tp * self.pp * self.cp * self.ep
 
     def describe(self):
         bits = []
-        for k in ("dp", "tp", "pp", "cp"):
+        for k in ("dp", "tp", "pp", "cp", "ep"):
             v = getattr(self, k)
             if v > 1:
                 bits.append(f"{k}{v}")
@@ -102,6 +115,8 @@ class StrategyCandidate:
             bits.append("2lvl")
         if self.pallas:
             bits.append("pk")
+        if self.moe_dispatch != "gspmd":
+            bits.append("moe-" + self.moe_dispatch)
         return "x".join(bits) or "single"
 
     @property
@@ -130,6 +145,13 @@ class CostModel:
     # replaces them with XLA's compiled-memory analysis of the real block
     act_boundary_units: float = 1.0
     act_full_units: float = 12.0
+    # MoE (0 = dense): the stacked [E, ...] expert FFN parameters are
+    # 3*E*hidden*intermediate per layer; an ep candidate holds 1/ep of
+    # them (the fits_hbm correction) and pays the dispatch transport
+    # (moe_dispatch_s below)
+    num_experts: int = 0
+    moe_top_k: int = 2
+    moe_capacity_factor: float = 1.25
     # measured per-layer compute rate (FLOPs per token per layer,
     # no-remat normalized) from a compiled step's per-layer HLO profile
     # (obs.hlo_profile via calibrate.apply_profile_calibration) — when
@@ -148,6 +170,16 @@ class CostModel:
             self.act_boundary_units = float(m["act_boundary_units"])
         if "act_full_units" in m:
             self.act_full_units = float(m["act_full_units"])
+
+    @property
+    def expert_params(self) -> float:
+        """Parameters living in the stacked [E, ...] expert tensors
+        (SwiGLU FFN: E * 3 * h * i per MoE layer) — the share an ep
+        candidate divides by ep instead of replicating."""
+        if self.num_experts <= 0:
+            return 0.0
+        return (3.0 * self.num_experts * self.hidden * self.intermediate
+                * self.num_layers)
 
     def _allreduce_gbps(self, axis: str, size: int) -> float:
         """Measured per-axis allreduce bus bandwidth when the profiler
@@ -329,6 +361,14 @@ class CostModel:
             t_comm += (self.num_layers / max(c.pp, 1)) * (c.cp - 1) \
                 * kv_bytes / (self.hw.ici_p2p_gbps * 1e9)
 
+        # MoE expert-parallel dispatch (nn/moe_dispatch.py): the
+        # token->expert transport over the ep axis, priced per the
+        # candidate's moe_dispatch mode (comm/wire.py byte formulas) at
+        # intra/inter rates when a topology applies — two-level wins on
+        # merit exactly where the HLO analyzer measures it winning
+        if self.num_experts > 0 and c.ep > 1:
+            t_comm += self._moe_dispatch_s(c)
+
         # comm/compute overlap (reference: overlap_coefficient.json:2): with
         # a measured coefficient k in [1, 2], per-layer collectives overlap
         # the compute stream but slow it —
@@ -359,14 +399,57 @@ class CostModel:
                 busy *= (m + c.pp - 1) / m
         return busy
 
+    def _moe_dispatch_s(self, c: StrategyCandidate) -> float:
+        """Per-step seconds of the MoE dispatch transport: buffer
+        elements = capacity_factor * top_k * local tokens * hidden per
+        layer, moved fwd AND bwd (the custom-vjp transposes ride the
+        same collectives)."""
+        from hetu_tpu.comm.wire import (moe_dispatch_wire_bytes,
+                                        moe_two_level_dispatch_bytes)
+        tokens_local = self.global_batch * self.seq_len \
+            / max(c.dp * c.cp, 1)
+        n_elems = (self.moe_capacity_factor * max(self.moe_top_k, 1)
+                   * tokens_local * self.hidden)
+        layers = self.num_layers / max(c.pp, 1)
+        topo = None
+        tsec = getattr(self.hw, "topology", None)
+        if tsec:
+            from hetu_tpu.comm.topology import Topology
+            topo = Topology.from_profile({"topology": tsec})
+        mode = c.moe_dispatch
+        qmode = "none" if mode in ("gspmd", "fp32") else mode
+        if mode == "gspmd":
+            # the compiler's full-width combine transport (one gather
+            # direction; no explicit dispatch a2a)
+            per = 2.0 * (c.ep - 1) / c.ep * n_elems * 4.0
+        else:
+            per = moe_dispatch_wire_bytes(n_elems, c.ep, qmode)
+        per *= 2.0                          # fwd + bwd transports
+        if topo is not None and topo.applies(c.ep):
+            if mode != "gspmd" and c.comm_topology == "two_level":
+                sg = moe_two_level_dispatch_bytes(
+                    n_elems, c.ep, topo.slice_devices, qmode)
+                return 2.0 * layers * (
+                    sg["intra_bytes"] / (topo.intra_gbps * 1e9)
+                    + sg["inter_bytes"] / (topo.inter_gbps * 1e9))
+            # flat schedule spanning slices: paced by the slow links
+            return layers * per / (topo.inter_gbps * 1e9)
+        return layers * per / (self._allreduce_gbps("ep", c.ep) * 1e9)
+
     # ---------------- memory ----------------
     def per_device_memory(self, c: StrategyCandidate) -> float:
         shard = max(c.tp * c.pp, 1)
-        params = 4.0 * self.num_params / shard           # fp32 master
-        opt = 8.0 * self.num_params / shard              # adam m+v fp32
+        # the stacked [E, ...] expert tensors shard over ep ON TOP of
+        # tp/pp — without this split an ep candidate's expert memory
+        # reads as replicated and fits_hbm mis-gates it
+        exp = min(self.expert_params, self.num_params)
+        dense = self.num_params - exp
+        eff = dense / shard + exp / (shard * max(c.ep, 1))
+        params = 4.0 * eff                               # fp32 master
+        opt = 8.0 * eff                                  # adam m+v fp32
         if c.zero and c.dp > 1:
             opt /= c.dp
-        grads = 4.0 * self.num_params / shard
+        grads = 4.0 * eff
         b_local = self.global_batch / max(c.dp * c.cp, 1)
         seq_local = self.seq_len / max(c.cp, 1)
         layers_local = self.num_layers / max(c.pp, 1)

@@ -26,8 +26,9 @@ from hetu_tpu.search.profiler import HardwareProfile
 from hetu_tpu.utils.parallel_config import generate_ds_parallel_config
 
 
-def _factorizations(n: int):
-    """All (dp, tp, pp, cp) with dp*tp*pp*cp == n, power-of-two factors."""
+def _factorizations(n: int, with_ep: bool = False):
+    """All (dp, tp, pp, cp, ep) with product n, power-of-two factors;
+    ep stays 1 unless `with_ep` (MoE models)."""
     def divs(x):
         d = 1
         while d <= x:
@@ -37,8 +38,9 @@ def _factorizations(n: int):
     for tp in divs(n):
         for pp in divs(n // tp):
             for cp in divs(n // tp // pp):
-                dp = n // tp // pp // cp
-                yield dp, tp, pp, cp
+                rest = n // tp // pp // cp
+                for ep in (divs(rest) if with_ep else (1,)):
+                    yield rest // ep, tp, pp, cp, ep
 
 
 def candidate_strategy(c: StrategyCandidate) -> "ParallelStrategy":
@@ -48,7 +50,7 @@ def candidate_strategy(c: StrategyCandidate) -> "ParallelStrategy":
     from hetu_tpu.core.mesh import MeshConfig
     from hetu_tpu.parallel.strategy import ParallelStrategy
     return ParallelStrategy(
-        mesh=MeshConfig(dp=c.dp, tp=c.tp, pp=c.pp, cp=c.cp),
+        mesh=MeshConfig(dp=c.dp, tp=c.tp, pp=c.pp, cp=c.cp, ep=c.ep),
         sequence_parallel=c.sequence_parallel, zero=c.zero,
         cp_tp_eff=c.cp_tp_eff, pp_tp_eff=c.pp_tp_eff)
 
@@ -59,6 +61,7 @@ def search_strategy(cost: CostModel, num_devices: int,
                     pp_schedule: str = "auto",
                     deterministic: bool = True,
                     n_micro: Optional[int] = None,
+                    moe_dispatch: str = "gspmd",
                     ) -> List[Tuple[StrategyCandidate, float, float]]:
     """Rank feasible candidates by predicted step time.
     Returns [(candidate, time_s, mem_bytes)] best-first.
@@ -71,16 +74,27 @@ def search_strategy(cost: CostModel, num_devices: int,
     pp_schedule: "auto" scores BOTH schedules per pipeline candidate and
     lets the cost model pick on merit (gpipe's O(n_micro) memory vs
     1f1b's O(pp) memory and mixed-mesh round penalty); or pin "gpipe" /
-    "1f1b".  n_micro: pin the micro count (None = the 2*pp heuristic)."""
+    "1f1b".  n_micro: pin the micro count (None = the 2*pp heuristic).
+    moe_dispatch: the dispatch mode ep candidates are priced under
+    (HETU_TPU_MOE_DISPATCH value the run would set); MoE models
+    (cost.num_experts > 0) additionally enumerate the ep axis —
+    ParallelStrategy.validate enforces num_experts % ep."""
     from hetu_tpu.parallel.strategy import StrategyValidationError
     results = []
     skipped = 0
-    for dp, tp, pp, cp in _factorizations(num_devices):
+    moe = cost.num_experts > 0
+    for dp, tp, pp, cp, ep in _factorizations(num_devices, with_ep=moe):
         if tp > max_tp or pp > max_pp or cp > max_cp:
             continue
         if cost.num_layers % pp:
             continue
         if cost.global_batch % max(dp * cp, 1):
+            continue
+        if ep > 1 and cost.num_experts % ep:
+            continue
+        if ep > 1 and moe_dispatch != "gspmd" and (tp > 1 or pp > 1):
+            # the explicit dispatch shard_map's envelope
+            # (nn/moe_dispatch.validate_envelope): tp=1, pp=1
             continue
         schedules = (("gpipe", "1f1b") if pp > 1 and pp_schedule == "auto"
                      else (pp_schedule if pp > 1 else "gpipe",))
@@ -90,15 +104,22 @@ def search_strategy(cost: CostModel, num_devices: int,
                     nm = n_micro if n_micro is not None else \
                         (max(2 * pp, 1) if pp > 1 else 1)
                     c = StrategyCandidate(dp=dp, tp=tp, pp=pp, cp=cp,
+                                          ep=ep,
                                           sequence_parallel=sp, zero=dp > 1,
                                           remat=remat, n_micro=nm,
-                                          pp_schedule=sched)
+                                          pp_schedule=sched,
+                                          moe_dispatch=(moe_dispatch
+                                                        if ep > 1
+                                                        else "gspmd"))
                     try:
                         candidate_strategy(c).validate(
                             model_cfg, pp_schedule=sched, n_micro=nm,
                             global_batch=cost.global_batch,
                             seq_len=cost.seq_len,
-                            deterministic=deterministic)
+                            deterministic=deterministic,
+                            # judge the candidate under ITS mode, not
+                            # whatever flag the planning process exports
+                            moe_dispatch=c.moe_dispatch)
                     except StrategyValidationError:
                         skipped += 1
                         continue

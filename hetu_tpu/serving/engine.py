@@ -80,6 +80,12 @@ class ServeConfig:
     prefill_chunk: int = 32
     num_pages: int = 0
     kv_quant: str = "none"           # "none" (exact, default) | "int8"
+    # MoE serving (HETU_TPU_MOE_DISPATCH, serving/experts.py): int8/int4
+    # store the stacked [E, ...] expert weights resident-quantized
+    # (KV-pool-style blockwise payloads + f32 scales, dequantized inside
+    # the decode/prefill programs); gspmd (default) and fp32 leave the
+    # params untouched.  Ignored for dense models.
+    moe_dispatch: str = "gspmd"
 
     def __post_init__(self):
         if self.max_len % self.page_size:
@@ -95,6 +101,10 @@ class ServeConfig:
         if self.kv_quant not in ("none", "int8"):
             raise ValueError(f"kv_quant {self.kv_quant!r} invalid; "
                              "choices: ('none', 'int8')")
+        if self.moe_dispatch not in ("gspmd", "fp32", "int8", "int4"):
+            raise ValueError(
+                f"moe_dispatch {self.moe_dispatch!r} invalid; choices: "
+                "('gspmd', 'fp32', 'int8', 'int4')")
         if self.num_pages == 0:
             self.num_pages = self.num_slots * (self.max_len
                                                // self.page_size)
@@ -112,6 +122,7 @@ class ServeConfig:
             prefill_chunk=flags.int_flag("HETU_TPU_SERVE_PREFILL_CHUNK"),
             num_pages=flags.int_flag("HETU_TPU_SERVE_PAGES"),
             kv_quant=flags.str_flag("HETU_TPU_KV_QUANT"),
+            moe_dispatch=flags.str_flag("HETU_TPU_MOE_DISPATCH"),
         )
         vals.update(overrides)
         return ServeConfig(**vals)
@@ -181,6 +192,32 @@ class ServingEngine:
             runlog=self.run_log, registry=self._registry,
             source=self.telemetry) if self._numerics else None)
 
+        # MoE: resident quantized expert weights (serving/experts.py).
+        # Quantized ONCE here, dequantized inside the compiled programs
+        # — the params tree the engine holds stays int8/int4 on the
+        # expert share.  The reshard hook moves fp params; composing it
+        # with the quantized tree would reshard int payloads it cannot
+        # re-slice — refuse loudly.
+        n_exp = getattr(c, "num_experts", 0) or 0
+        self._moe_spec = None
+        if n_exp > 0 and self.config.moe_dispatch in ("int8", "int4"):
+            if self.reshard is not None:
+                raise ValueError(
+                    "resident-quantized MoE experts (moe_dispatch="
+                    f"{self.config.moe_dispatch!r}) do not compose with "
+                    "the reshard hook — use gspmd/fp32 dispatch or drop "
+                    "the hook")
+            from hetu_tpu.serving.experts import (expert_bytes,
+                                                  quantize_expert_tree)
+            bits = 8 if self.config.moe_dispatch == "int8" else 4
+            self.params, self._moe_spec = quantize_expert_tree(
+                params, n_exp, bits=bits)
+            eb = expert_bytes(self._moe_spec)
+            self._registry.set_gauge("serve.moe_expert_bytes",
+                                     eb["quantized_bytes"])
+            self._registry.set_gauge("serve.moe_expert_bytes_fp",
+                                     eb["fp_bytes"])
+
         # per-request prefill scratch: a dense [L, 1, max_len] cache the
         # chunk program advances; template zeros reused (functionally)
         # for every admission
@@ -242,6 +279,22 @@ class ServingEngine:
 
         def write_fn(pool_tree, pages_row, ks, vs):
             return pool.write_pages(pool_tree, pages_row, ks, vs)
+
+        if self._moe_spec is not None:
+            # resident int experts: the programs dequantize on entry, so
+            # only the transient working copy is fp (the decode step's
+            # expert HBM read is the quantized payload)
+            from hetu_tpu.serving.experts import dequantize_expert_tree
+            spec = self._moe_spec
+            base_decode_fp, base_chunk_fp = decode_fn, chunk_fn
+
+            def decode_fn(params, pool_tree, table, tokens, positions):
+                return base_decode_fp(dequantize_expert_tree(params, spec),
+                                      pool_tree, table, tokens, positions)
+
+            def chunk_fn(params, chunk, cache, start):
+                return base_chunk_fp(dequantize_expert_tree(params, spec),
+                                     chunk, cache, start)
 
         if self._numerics:
             # wrap the programs that contain quantize sites in a

@@ -175,6 +175,20 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "bytes).  Same homogeneous-DP envelope as GRAD_COMPRESS; none "
          "(default) is HLO-byte-identical to unset",
          choices=("none", "int8", "int4"), identity="none"),
+    Flag("HETU_TPU_MOE_DISPATCH", "str", "gspmd",
+         "MoE expert-parallel token dispatch (nn/moe_dispatch.py, "
+         "docs/moe.md): gspmd (default) keeps the compiler-chosen "
+         "collectives — byte-identical to unset; fp32/int8/int4 route the "
+         "sort dispatch through an explicit shard_map over the ep axis "
+         "(HetuMoE HAllToAll): each ep rank scatters its token share, an "
+         "all-to-all (comm/collectives.all_to_all_q — quantized custom-vjp "
+         "both directions for int8/int4) delivers expert buffers, and the "
+         "combine all-gathers expert outputs.  With "
+         "HETU_TPU_COMM_TOPOLOGY=two_level and an applicable topology the "
+         "dispatch runs hierarchically (intra-slice a2a at intra rates, "
+         "strided inter-slice transversal at inter rates).  No-op at "
+         "ep=1; explicit modes require tp=1, pp=1 (loud error otherwise)",
+         choices=("gspmd", "fp32", "int8", "int4"), identity="gspmd"),
     Flag("HETU_TPU_COMM_TOPOLOGY", "str", "flat",
          "collective routing over the hardware profile's `topology` "
          "section (comm/topology.py): two_level runs the DP grad sync "

@@ -82,6 +82,9 @@ def test_every_env_read_is_registered():
     # the main gate + its sampling-interval sub-flag
     for name in ("HETU_TPU_NUMERICS", "HETU_TPU_NUMERICS_EVERY"):
         assert name in flags.REGISTRY
+    # the explicit expert-parallel MoE dispatch (nn/moe_dispatch.py,
+    # docs/moe.md)
+    assert "HETU_TPU_MOE_DISPATCH" in flags.REGISTRY
 
 
 def test_identity_contract_table():
@@ -109,7 +112,10 @@ def test_identity_contract_table():
     # the numerics observatory changes the traced program when ON (the
     # stats ride the step outputs), so its contract is the OFF value
     assert table["HETU_TPU_NUMERICS"] == "0"
-    assert len(table) >= 15
+    # the explicit MoE dispatch reshapes the traced program when routed,
+    # so its contract is the GSPMD default
+    assert table["HETU_TPU_MOE_DISPATCH"] == "gspmd"
+    assert len(table) >= 16
     # flags with NO contract must stay contract-free: these genuinely
     # change program shapes, so an identity entry would be a lie the
     # sweep turns into a tier-1 failure

@@ -422,9 +422,10 @@ def test_identity_sweep_detects_a_broken_contract(monkeypatch):
 
 
 def test_identity_sweep_covers_every_contract_and_holds():
-    """Acceptance: 100% of registered byte-identity flags, ALL THREE
-    canonical programs (train, serving decode, and the MoE
-    forward+backward added with the numerics observatory), zero
+    """Acceptance: 100% of registered byte-identity flags, ALL FOUR
+    canonical programs (train, serving decode, the MoE
+    forward+backward added with the numerics observatory, and the ep=2
+    expert-parallel MoE step added with the explicit dispatch), zero
     violations — the systematic replacement for the per-flag
     hand-written byte-identity tests."""
     from hetu_tpu.analysis.flag_identity import identity_sweep
@@ -437,11 +438,11 @@ def test_identity_sweep_covers_every_contract_and_holds():
         "HETU_TPU_PALLAS", "HETU_TPU_PALLAS_KERNELS",
         "HETU_TPU_KV_QUANT", "HETU_TPU_PROFILE",
         "HETU_TPU_COMM_ANALYZE", "HETU_TPU_LINT",
-        "HETU_TPU_NUMERICS"}
+        "HETU_TPU_NUMERICS", "HETU_TPU_MOE_DISPATCH"}
     sweep = identity_sweep()
     covered = {(r["flag"], r["program"]) for r in sweep["rows"]}
     assert covered == {(f, p) for f in table
-                       for p in ("train", "decode", "moe")}
+                       for p in ("train", "decode", "moe", "moe_ep")}
     violations = [r for r in sweep["rows"] if not r["ok"]]
     assert violations == [], violations
     assert not any(f.severity == "error" for f in sweep["findings"])

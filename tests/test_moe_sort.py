@@ -133,6 +133,38 @@ def test_grouped_hash_gate_uses_global_token_index():
                                rtol=1e-4, atol=1e-5)
 
 
+def test_dense_routing_plan_pins_to_sort_plan():
+    """The vectorized dense dispatcher (single cumsum construction, no
+    per-slot Python loop) must produce the IDENTICAL routing plan to
+    sort_routing: same kept (token, expert, position) triples, same
+    gates, same drop count — under capacity pressure, where slot-major
+    priority is visible."""
+    rng = np.random.default_rng(9)
+    T, E, k, C = 32, 4, 2, 8
+    logits = jnp.asarray(rng.normal(size=(T, E)), jnp.float32)
+    moe = MoEConfig(num_experts=E, top_k=k, capacity_factor=0.5)
+    ids = jnp.arange(T, dtype=jnp.int32)
+    disp, comb, _aux, dropped = topk_routing(logits, ids, moe, C)
+
+    eidx, gv = select_experts(logits, ids, moe)
+    plan = sort_routing(eidx, gv, E, C)
+    dense_d = np.zeros((T, E, C), bool)
+    dense_c = np.zeros((T, E, C), np.float32)
+    dest = np.asarray(plan["dest"])
+    tok = np.asarray(plan["tok"])
+    keep = np.asarray(plan["keep"])
+    gate = np.asarray(plan["gate"])
+    for j in range(T * k):
+        if keep[j]:
+            e, c = divmod(int(dest[j]), C)
+            dense_d[tok[j], e, c] = True
+            dense_c[tok[j], e, c] += gate[j]
+    assert keep.sum() < T * k, "capacity pressure did not bite"
+    np.testing.assert_array_equal(np.asarray(disp), dense_d)
+    np.testing.assert_allclose(np.asarray(comb), dense_c, rtol=1e-6)
+    assert int(dropped) == int(plan["dropped"])
+
+
 def test_balance_gate_spreads_load():
     # adversarial logits that all prefer expert 0: balance must spread
     rng = np.random.default_rng(5)

@@ -14,9 +14,10 @@ tools_obs_report.py.
 
 `--compare` lowers every compressible wire path — the DP grad sync, the
 SP activation gathers/scatters (dstates.convert), the ZeRO-1 param
-refresh — flag-off vs flag-on, plus the analytic hetero-DP/PP bridge,
-and prints fp32 vs compressed bytes with predicted times at the
-topology's intra/inter-slice rates.
+refresh, the MoE expert dispatch (an ep=8 MoE layer's explicit a2a +
+combine gather, nn/moe_dispatch.py) — flag-off vs flag-on, plus the
+analytic hetero-DP/PP bridge, and prints fp32 vs compressed bytes with
+predicted times at the topology's intra/inter-slice rates.
 
 The model lowers with use_scan=False so every collective is top-level in
 the HLO (the analyzer also resolves `while` trip counts for scanned
@@ -136,6 +137,41 @@ def lowered_sp_report(mode: str, *, tp: int = 4, batch: int = 4,
         return collective_report(compiled)
 
 
+def lowered_moe_report(mode: str, *, ep: int = 8, experts: int = 8,
+                       batch: int = 2, seq: int = 16, hidden: int = 32,
+                       topology: str = "flat"):
+    """collective_report of a lowered MoE layer forward on an ep-mesh
+    under HETU_TPU_MOE_DISPATCH=`mode` (nn/moe_dispatch.py): the
+    dispatch all-to-all + combine all-gather are the only collectives
+    in the program, so the report IS the dispatch cost.  topology=
+    "two_level" opts into the hierarchical schedule (needs the
+    profile's slice topology to apply to ep)."""
+    env = {"HETU_TPU_MOE_DISPATCH": mode,
+           "HETU_TPU_COMM_TOPOLOGY": topology}
+    with _scoped_env(**env):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        import hetu_tpu as ht
+        from hetu_tpu.core.mesh import MeshConfig
+        from hetu_tpu.nn.moe import MoEConfig, MoELayer
+        from hetu_tpu.obs.comm import collective_report
+        from hetu_tpu.parallel import ParallelStrategy
+
+        moe = MoEConfig(num_experts=experts, top_k=2, capacity_factor=2.0)
+        st = ParallelStrategy(mesh=MeshConfig(ep=ep))
+        mesh = st.build_mesh()
+        layer = MoELayer(hidden, 2 * hidden, moe, st)
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.normal(size=(batch, seq, hidden)), jnp.float32)
+        with ht.use_mesh(mesh):
+            p = layer.init(jax.random.key(0), mesh=mesh)
+            compiled = jax.jit(lambda p_, x_: layer(p_, x_)[0]) \
+                .lower(p, x).compile()
+        return collective_report(compiled)
+
+
 def _print_table(mode: str, report, table, verbose: bool):
     print(f"== HETU_TPU_GRAD_COMPRESS={mode} ==")
     print(f"{'collective':<20}{'count':>6}{'wire bytes':>14}")
@@ -193,6 +229,15 @@ def path_compare(dp: int = 4, batch: int = 8, seq: int = 64,
     ag32 = z32["collectives"].get("all-gather", {}).get("wire_bytes", 0.0)
     agq = zq["collectives"].get("all-gather", {}).get("wire_bytes", 0.0)
     paths["zero_refresh"] = _path_row(ag32, agq, ag32 / intra, agq / intra)
+
+    # MoE expert dispatch: the explicit a2a + combine gather of an
+    # ep=8 MoE layer, fp32 vs quantized (nn/moe_dispatch.py; the only
+    # collectives the lowered program contains)
+    m32 = lowered_moe_report("fp32")
+    mq = lowered_moe_report(sp_mode)
+    paths["moe_dispatch"] = _path_row(
+        m32["total_wire_bytes"], mq["total_wire_bytes"],
+        m32["predicted_comm_s"], mq["predicted_comm_s"])
 
     # hetero-DP/PP bridge: one non-resident group shipping the tiny
     # model's sum-grads across meshes (device_put rides the slow

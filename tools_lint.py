@@ -9,8 +9,8 @@ Modes (combinable; with none given, --self runs — the cheap CI gate):
   --hlo           compile the canonical train step (and serving decode)
                   and run the HLO lints over the post-optimization text:
                   donation, replica-groups, replication, dtype-drift,
-                  scope-coverage.  Needs jax; pays one XLA compile per
-                  program.
+                  scope-coverage, moe-dispatch.  Needs jax; pays one XLA
+                  compile per program.
   --flags         the flag-identity sweep: every `identity=` contract in
                   utils/flags.py, canonical train step + serving decode,
                   traced-text fingerprints vs an unset environment.
@@ -43,7 +43,7 @@ DEFAULT_ALLOWLIST = os.path.join(REPO_ROOT, "lint_allowlist.json")
 AST_LINTS = ("env-bypass", "vjp-signature", "shardmap-constraints",
              "unseeded-rng", "parse")
 HLO_LINTS = ("donation", "replica-groups", "replication", "dtype-drift",
-             "scope-coverage")
+             "scope-coverage", "moe-dispatch")
 
 
 def _findings_self(args):

@@ -71,7 +71,13 @@ def identity_sweep(only_flags: Optional[Sequence[str]] = None,
     rows: List[Dict[str, Any]] = []
     findings: List[Finding] = []
     for name, value in sorted(table.items()):
-        for prog in prog_names:
+        # serving-only flags contract against the decode program alone
+        # (Flag.identity_programs — reads are structurally confined to
+        # hetu_tpu/serving, so a training lower is pure sweep cost)
+        flag_progs = _flags.identity_contract_programs(name)
+        progs = (prog_names if flag_progs is None
+                 else [p for p in prog_names if p in flag_progs])
+        for prog in progs:
             with scoped_env(**{**all_unset, name: value}):
                 fp = fingerprint(PROGRAMS[prog]())
             ok = fp == baseline[prog]

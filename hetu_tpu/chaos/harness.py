@@ -335,7 +335,7 @@ def run_chaos_demo(workdir: str, plan: FaultPlan, num_steps: int = 36,
 def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
                            requests: int = 18, rate: float = 60.0,
                            burst: int = 6, num_slots: int = 2,
-                           num_pages: int = 10,
+                           num_pages: int = 10, preempt: bool = False,
                            seed: int = 0) -> Dict[str, Any]:
     """The serving chaos scenario (the PR 7 follow-up): a seeded
     burst-arrival trace through the REAL continuous-batching engine
@@ -349,7 +349,14 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     stall-attribution sections from `serving/slo_report.py` — the same
     report path `tools_serving_report.py` renders — plus the injected
     summary and fired-detector counts, so "what did the slowdown cost,
-    and who paid" is answerable per class."""
+    and who paid" is answerable per class.
+
+    ``preempt=True`` (the ``serve-preempt`` schedule) additionally runs
+    SLO-class-aware preemptive admission with the gold class at
+    priority 2: when the decode slowdown piles bulk decodes onto every
+    slot, arriving gold requests evict-and-requeue the bulk occupants —
+    the report's `preemptions` section shows who was bumped, and gold's
+    attainment holds while bulk pays."""
     import jax
     import jax.numpy as jnp
     from hetu_tpu import serving
@@ -364,7 +371,8 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     model = LlamaLMHeadModel(cfg)
     params = model.init(jax.random.key(seed))
 
-    classes = [serving.SLOClass("gold", ttft_s=0.5, token_gap_s=0.25),
+    classes = [serving.SLOClass("gold", ttft_s=0.5, token_gap_s=0.25,
+                                priority=2 if preempt else 0),
                serving.SLOClass("bulk")]
     arrivals = serving.bursty_arrivals(requests, rate, burst=burst,
                                        seed=seed)
@@ -381,7 +389,8 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     eng = serving.ServingEngine(
         model, params,
         serving.ServeConfig(num_slots=num_slots, page_size=8, max_len=32,
-                            prefill_chunk=8, num_pages=num_pages),
+                            prefill_chunk=8, num_pages=num_pages,
+                            preempt=preempt),
         registry=registry, run_log=run_log, tracer=tracer, health=health)
     eng.warmup()
 
@@ -404,6 +413,7 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
         "engine_steps": eng.steps_done,
         "injected": plan.summary(),
         "detectors": detectors,
+        "preemptions": eng.scheduler.preempted,
         "slo": report,
         "runlog": log_path,
     }
@@ -454,6 +464,18 @@ def named_plan(name: str, **kw) -> FaultPlan:
                       count=kw.get("count", 12),
                       delay_s=kw.get("delay_s", 0.25)),
         ])
+    if name == "serve-preempt":
+        # serve-burst with SLO-class preemption armed
+        # (run_serving_chaos_demo(preempt=True)): the slow-decode window
+        # pins bulk decodes on every slot, so arriving gold (priority 2)
+        # requests must evict-and-requeue them — the report's
+        # preemptions section names the victims
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="slow_worker", rank=0,
+                      at_step=kw.get("at_step", 4),
+                      count=kw.get("count", 16),
+                      delay_s=kw.get("delay_s", 0.25)),
+        ])
     if name == "stall":
         # a heartbeat stall longer than the server timeout: the classic
         # long-XLA-compile false positive — the stalled worker is declared
@@ -464,4 +486,4 @@ def named_plan(name: str, **kw) -> FaultPlan:
         ])
     raise ValueError(f"unknown schedule {name!r}; known: "
                      "kill-partition-corrupt, partition, corrupt, stall, "
-                     "slow, serve-burst")
+                     "slow, serve-burst, serve-preempt")

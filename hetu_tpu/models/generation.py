@@ -304,12 +304,32 @@ def _paged_write(pool, table, positions, t):
     return pool.at[page, positions % ps].set(t.astype(pool.dtype))
 
 
+def _paged_write_q(pool, scale, table, positions, t):
+    """The int8-page form of `_paged_write`: quantize the token's
+    head-vectors through the SAME blockwise primitives the gather path
+    uses (comm/compress -> the fused Pallas quant kernel when routed),
+    so pool contents are bit-identical across the two decode programs;
+    write payload + per-head-vector f32 scale."""
+    from hetu_tpu.comm.compress import quantize_blockwise
+    ps = pool.shape[1]
+    S = positions.shape[0]
+    hd = t.shape[-1]
+    x32 = t.astype(jnp.float32)
+    q, s = quantize_blockwise(x32, block_size=hd)
+    q = q.reshape(t.shape)
+    s = s.reshape(t.shape[:-1])
+    page = table[jnp.arange(S), positions // ps]
+    off = positions % ps
+    return pool.at[page, off].set(q), scale.at[page, off].set(s)
+
+
 def _decode_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
-                           positions):
+                           positions, k_scale, v_scale):
     from hetu_tpu.ops.pallas.paged_attention import paged_attention
     c = model.config
     mp_ = params["model"]
     b = tokens.shape[0]
+    quant = k_scale is not None
     x = _gpt_embed(model, mp_, tokens[:, None], positions[:, None])
     block = model.model.block
     att = block.attn
@@ -317,30 +337,41 @@ def _decode_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
     scale = hd ** -0.5
 
     def body(h, xs):
-        lp, kp, vp = xs
+        if quant:
+            lp, kp, vp, ksc, vsc = xs
+        else:
+            lp, kp, vp = xs
+            ksc = vsc = None
         hn = block.ln1(lp["ln1"], h)
         qkv = jnp.einsum("bsh,hngd->bsngd", hn,
                          lp["attn"]["wqkv"].astype(h.dtype)) \
             + lp["attn"]["bqkv"].astype(h.dtype)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
-        kp = _paged_write(kp, table, positions, k[:, 0])
-        vp = _paged_write(vp, table, positions, v[:, 0])
+        if quant:
+            kp, ksc = _paged_write_q(kp, ksc, table, positions, k[:, 0])
+            vp, vsc = _paged_write_q(vp, vsc, table, positions, v[:, 0])
+        else:
+            kp = _paged_write(kp, table, positions, k[:, 0])
+            vp = _paged_write(vp, table, positions, v[:, 0])
         with jax.named_scope("pallas_paged_attention"):
             attn = paged_attention(q[:, 0], kp, vp, table, positions,
-                                   softmax_scale=scale)
+                                   softmax_scale=scale,
+                                   k_scale=ksc, v_scale=vsc)
         h = h + att.o_proj(lp["attn"]["o_proj"],
                            attn.reshape(b, 1, nh * hd))
         h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
-        return h, (kp, vp)
+        return h, ((kp, vp, ksc, vsc) if quant else (kp, vp))
 
-    x, (new_k, new_v) = lax.scan(body, x, (mp_["blocks"], k_pool, v_pool))
+    xs = ((mp_["blocks"], k_pool, v_pool, k_scale, v_scale) if quant
+          else (mp_["blocks"], k_pool, v_pool))
+    x, pools = lax.scan(body, x, xs)
     hidden = model.model.final_ln(mp_["final_ln"], x)
     logits = model.logits(params, hidden)[:, 0, :]
-    return logits, new_k, new_v
+    return (logits,) + tuple(pools)
 
 
 def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
-                      positions):
+                      positions, *, k_scale=None, v_scale=None):
     """One decode step attending DIRECTLY over a paged KV pool — the
     gather-free form of `decode_step_slots` (ops/pallas/paged_attention;
     serving engine's HETU_TPU_PALLAS decode program).
@@ -351,18 +382,27 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
     it.  This step's K/V are scattered into each slot's page BEFORE the
     kernel runs (so the token sees itself, exactly like the dense path's
     write-then-attend), and the updated pools are returned:
-    (logits [S, vocab], new_k_pool, new_v_pool).  Exact fp pages only —
-    the engine keeps the gather path for quantized pools."""
-    from hetu_tpu.ops.pallas.paged_attention import paged_attention
+    (logits [S, vocab], new_k_pool, new_v_pool).
+
+    int8 pools (``HETU_TPU_KV_QUANT=int8``) pass their per-head-vector
+    f32 scales [L, P, page_size, n_kv] as k_scale/v_scale: the token
+    write quantizes through the shared blockwise primitives and the
+    kernel dequantizes pages in-VMEM; the return gains
+    (..., new_k_scale, new_v_scale)."""
     c = model.config
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
                          "params)")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    quant = k_scale is not None
     positions = positions.astype(jnp.int32)
     table = table.astype(jnp.int32)
     if _is_gpt(model):
         return _decode_step_paged_gpt(model, params, tokens, k_pool,
-                                      v_pool, table, positions)
+                                      v_pool, table, positions,
+                                      k_scale, v_scale)
+    from hetu_tpu.ops.pallas.paged_attention import paged_attention
     mp_ = params["model"]
     b = tokens.shape[0]
     x = model.model.embed(mp_["embed"], tokens[:, None]).astype(
@@ -374,7 +414,11 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
     scale = c.head_dim ** -0.5
 
     def body(h, xs):
-        layer_params, kp, vp = xs
+        if quant:
+            layer_params, kp, vp, ksc, vsc = xs
+        else:
+            layer_params, kp, vp = xs
+            ksc = vsc = None
         hn = block.input_norm(layer_params["input_norm"], h)
         qkv = jnp.einsum("bsh,hkgd->bskgd", hn,
                          layer_params["attn"]["wqkv"].astype(h.dtype))
@@ -382,11 +426,16 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
         k = qkv[..., att.group, :]
         v = qkv[..., att.group + 1, :]
         q, k = ops.apply_rotary_qk(q, k, cos, sin, positions[:, None])
-        kp = _paged_write(kp, table, positions, k[:, 0])
-        vp = _paged_write(vp, table, positions, v[:, 0])
+        if quant:
+            kp, ksc = _paged_write_q(kp, ksc, table, positions, k[:, 0])
+            vp, vsc = _paged_write_q(vp, vsc, table, positions, v[:, 0])
+        else:
+            kp = _paged_write(kp, table, positions, k[:, 0])
+            vp = _paged_write(vp, table, positions, v[:, 0])
         with jax.named_scope("pallas_paged_attention"):
             attn = paged_attention(q[:, 0], kp, vp, table, positions,
-                                   softmax_scale=scale)
+                                   softmax_scale=scale,
+                                   k_scale=ksc, v_scale=vsc)
         h = h + att.o_proj(layer_params["attn"]["o_proj"],
                            attn.reshape(b, 1, att.n_q * c.head_dim))
         mlp_out = block.mlp(layer_params["mlp"],
@@ -394,16 +443,18 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
         if isinstance(mlp_out, tuple):  # MoE
             mlp_out = mlp_out[0]
         h = h + mlp_out
-        return h, (kp, vp)
+        return h, ((kp, vp, ksc, vsc) if quant else (kp, vp))
 
-    x, (new_k, new_v) = lax.scan(
-        body, x, (mp_["layers"]["layers"], k_pool, v_pool))
+    xs = ((mp_["layers"]["layers"], k_pool, v_pool, k_scale, v_scale)
+          if quant else (mp_["layers"]["layers"], k_pool, v_pool))
+    x, pools = lax.scan(body, x, xs)
     hidden = model.model.final_norm(mp_["final_norm"], x)
     logits = model.logits(params, hidden)[:, 0, :]
-    return logits, new_k, new_v
+    return (logits,) + tuple(pools)
 
 
-def _extend_cache_gpt(model, params, tokens, cache, start):
+def _extend_cache_gpt(model, params, tokens, cache, start,
+                      collect: bool = False):
     c = model.config
     mp = params["model"]
     b, C = tokens.shape
@@ -430,15 +481,19 @@ def _extend_cache_gpt(model, params, tokens, cache, start):
         h = h + att.o_proj(lp["attn"]["o_proj"],
                            attn.reshape(b, C, nh * hd))
         h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
-        return h, (ck, cv)
+        return h, ((ck, cv, k, v) if collect else (ck, cv))
 
-    x, (new_k, new_v) = lax.scan(body, x, (mp["blocks"], cache_k, cache_v))
+    x, ys = lax.scan(body, x, (mp["blocks"], cache_k, cache_v))
     hidden = model.model.final_ln(mp["final_ln"], x)
     logits = model.logits(params, hidden)
-    return logits, (new_k, new_v)
+    if collect:
+        new_k, new_v, k_chunk, v_chunk = ys
+        return logits, (new_k, new_v), (k_chunk, v_chunk)
+    return logits, ys
 
 
-def extend_cache(model, params, tokens, cache, start):
+def extend_cache(model, params, tokens, cache, start, *,
+                 collect_token_kv: bool = False):
     """Advance a KV cache by a whole token block (chunked prefill).
 
     tokens: [b, C] int32 at absolute positions start..start+C-1 (start
@@ -447,13 +502,19 @@ def extend_cache(model, params, tokens, cache, start):
     (logits [b, C, vocab], new_cache).  Running consecutive chunks
     through this is numerically the incremental form of `prefill` — the
     serving engine uses it so one long prompt never stalls the decode
-    batch (docs/serving.md)."""
+    batch (docs/serving.md).
+
+    ``collect_token_kv=True`` (the `verify_step_slots` path) also
+    returns the chunk's per-layer K/V [L, b, C, n_kv, hd] so a paged
+    cache can scatter them into its pool; the default False traces
+    exactly the pre-speculative chunk program."""
     c = model.config
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
                          "params)")
     if _is_gpt(model):
-        return _extend_cache_gpt(model, params, tokens, cache, start)
+        return _extend_cache_gpt(model, params, tokens, cache, start,
+                                 collect=collect_token_kv)
     mp = params["model"]
     b, C = tokens.shape
     rows = jnp.arange(b)
@@ -488,13 +549,42 @@ def extend_cache(model, params, tokens, cache, start):
         if isinstance(mlp_out, tuple):  # MoE
             mlp_out = mlp_out[0]
         h = h + mlp_out
-        return h, (ck, cv)
+        return h, ((ck, cv, k, v) if collect_token_kv else (ck, cv))
 
-    x, (new_k, new_v) = lax.scan(
+    x, ys = lax.scan(
         body, x, (mp["layers"]["layers"], cache_k, cache_v))
     hidden = model.model.final_norm(mp["final_norm"], x)
     logits = model.logits(params, hidden)
-    return logits, (new_k, new_v)
+    if collect_token_kv:
+        new_k, new_v, k_chunk, v_chunk = ys
+        return logits, (new_k, new_v), (k_chunk, v_chunk)
+    return logits, ys
+
+
+def verify_step_slots(model, params, tokens, cache, positions):
+    """The speculative-decoding VERIFY step: advance every slot by a
+    whole [k+1]-token block in ONE forward (serving/spec_decode.py).
+
+    tokens: [S, k+1] int32 — per slot, the last emitted token followed
+    by the k draft tokens; positions: [S] int32 — the slot's current
+    write position (token i of the block sits at positions[s] + i).
+    This is exactly `extend_cache` with PER-SLOT start positions (each
+    batch row an independent sequence at its own depth, the
+    `decode_step_slots` convention) plus the block's per-layer K/V
+    handed out for the paged-pool scatter.
+
+    Returns (logits [S, k+1, vocab], new_cache, (k_chunk, v_chunk))
+    with k_chunk/v_chunk [L, S, k+1, n_kv, hd].  logits[:, i] is the
+    next-token distribution AFTER input token i — the verification
+    targets: greedy acceptance compares draft i+1 against
+    argmax(logits[:, i]), bit-identical to what the sequential
+    single-token path would have computed at that depth (same
+    chunk-causal grouped-GQA attention as chunked prefill — one
+    implementation, so spec-decode and sequential decode cannot drift
+    numerically)."""
+    return extend_cache(model, params, tokens, cache,
+                        positions.astype(jnp.int32),
+                        collect_token_kv=True)
 
 
 def generate(model, params, input_ids, *, max_new_tokens: int,

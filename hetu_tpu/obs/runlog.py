@@ -23,15 +23,24 @@ Kind fields:
                   baseline — online health-detector firings
     straggler     stragglers (flagged ranks), workers (per-rank
                   ratio/z) — the cluster straggler report transitions
-    serve         event (admit | done | reshard | report) + the serving
-                  SLO fields (hetu_tpu/serving, docs/serving.md); every
-                  event also stamps `now` (driver-clock seconds — the
-                  engine's virtual clock, matching span t0/t1):
+    serve         event (admit | done | preempt | reshard | report) +
+                  the serving SLO fields (hetu_tpu/serving,
+                  docs/serving.md); every event also stamps `now`
+                  (driver-clock seconds — the engine's virtual clock,
+                  matching span t0/t1):
                   admit: req, slot, prompt_len, chunks, ttft_s,
-                  queue_wait_s, slo_class, queue_depth, page_util;
+                  queue_wait_s, slo_class, shared_tokens (prompt tokens
+                  resident via the radix prefix cache — 0 on a miss),
+                  queue_depth, page_util;
                   done: req, reason, tokens, ttft_s, e2e_s, tokens_per_s,
-                  slo_class, slo_ttft_s, slo_token_gap_s, queue_depth,
-                  slot_occupancy, page_util;
+                  slo_class, slo_ttft_s, slo_token_gap_s, spec_proposed/
+                  spec_accepted (speculative-decoding draft counts),
+                  shared_prefix_tokens, prompt_len, preemptions,
+                  queue_depth, slot_occupancy, page_util;
+                  preempt: req, slot, by (the preemptor rid), by_class,
+                  slo_class (the victim's), tokens_discarded,
+                  queue_depth — one per HETU_TPU_SERVE_PREEMPT
+                  evict-and-requeue;
                   reshard: tier, strategy, pause_s; report: requests,
                   tokens, elapsed_s, tokens_per_s
     span          the serving flight recorder (HETU_TPU_SERVE_TRACE,

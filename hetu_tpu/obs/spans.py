@@ -48,7 +48,10 @@ SPAN_SCHEMA = 1
 SPAN_KINDS = ("queued", "prefill", "decode", "reshard_pause",
               "done", "evicted")
 TERMINAL_KINDS = ("done", "evicted")
-STALL_REASONS = ("none", "no_slot", "no_pages")
+#: ``preempted`` marks a RE-queued span: the request was evicted by a
+#: higher-priority admission (HETU_TPU_SERVE_PREEMPT) and waits again —
+#: same trace, so the tiling/reconciliation contract still holds
+STALL_REASONS = ("none", "no_slot", "no_pages", "preempted")
 
 #: span-record fields that are structure, not attrs
 _CORE_FIELDS = ("schema", "kind", "t", "span_schema", "span", "trace",

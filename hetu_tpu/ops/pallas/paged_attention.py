@@ -19,8 +19,20 @@ forward; GQA folds grouped q heads against the pool's kv heads via an
 in-VMEM reshape (no materialized repeat).  Decode is forward-only — no
 vjp (the training path keeps flash attention).
 
+int8 pages (``HETU_TPU_KV_QUANT=int8``, the PR 9 "exact-fp pages only"
+gap closed): the kernel takes the pool's per-head-vector f32 absmax
+scales as two extra page-indexed operands and dequantizes each page
+IN-VMEM (``k * scale``) right after the DMA — the HBM read is the int8
+payload (+ the small scale plane), ~3.9x fewer cache bytes per decode
+step than fp32 pages (ops/pallas/traffic.paged_attn_traffic prices it;
+`detail.kernels` records the row).  The token K/V scattered pre-kernel
+quantize through the SAME blockwise primitives the gather path uses
+(comm/compress -> ops/pallas/quant when routed), so pool contents are
+bit-identical across the two decode programs.
+
 Shape contract (drift-tested against `compatible`): hd % 128, q heads
-divide by kv heads, table/positions/q agree on the slot count."""
+divide by kv heads, table/positions/q agree on the slot count, scales
+present iff quant."""
 from __future__ import annotations
 
 import functools
@@ -36,7 +48,8 @@ from hetu_tpu.ops.pallas import _interpret
 NEG_INF = -1e30
 
 
-def _check_shapes(q_shape, pool_shape, table_shape, pos_shape
+def _check_shapes(q_shape, pool_shape, table_shape, pos_shape, *,
+                  quant: str = "none"
                   ) -> Tuple[int, int, int, int, int, int]:
     if len(q_shape) != 3 or len(pool_shape) != 4:
         raise ValueError(f"expected q [S, nq, hd] and pool [P, ps, n_kv, "
@@ -54,19 +67,30 @@ def _check_shapes(q_shape, pool_shape, table_shape, pos_shape
     if hd % 128:
         raise ValueError(f"head dim {hd} is not lane-aligned (% 128); "
                          f"the gather fallback handles it")
+    if quant not in ("none", "int8"):
+        raise ValueError(f"paged-attention page mode {quant!r} "
+                         "unsupported; known: ('none', 'int8')")
     return S, nq, hd, P, ps, n_kv
 
 
-def compatible(q_shape, pool_shape, table_shape, pos_shape) -> bool:
+def compatible(q_shape, pool_shape, table_shape, pos_shape, *,
+               quant: str = "none") -> bool:
     try:
-        _check_shapes(q_shape, pool_shape, table_shape, pos_shape)
+        _check_shapes(q_shape, pool_shape, table_shape, pos_shape,
+                      quant=quant)
         return True
     except ValueError:
         return False
 
 
-def _kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
-            m_scr, l_scr, acc_scr, *, scale, ps, n_kv, group, mp):
+def _kernel(*refs, scale, ps, n_kv, group, mp, quant):
+    if quant:
+        (table_ref, pos_ref, q_ref, k_ref, v_ref, ks_ref, vs_ref,
+         o_ref, m_scr, l_scr, acc_scr) = refs
+    else:
+        (table_ref, pos_ref, q_ref, k_ref, v_ref,
+         o_ref, m_scr, l_scr, acc_scr) = refs
+        ks_ref = vs_ref = None
     s_idx = pl.program_id(0)
     p = pl.program_id(1)
 
@@ -86,6 +110,11 @@ def _kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
         q = q_ref[0].astype(jnp.float32)               # [nq, hd]
         k = k_ref[0].astype(jnp.float32)               # [ps, n_kv, hd]
         v = v_ref[0].astype(jnp.float32)
+        if quant:
+            # dequantize the page in-VMEM: one f32 absmax scale per
+            # head-vector (the kv_pool blockwise layout)
+            k = k * ks_ref[0].astype(jnp.float32)[..., None]
+            v = v * vs_ref[0].astype(jnp.float32)[..., None]
         nq, hd = q.shape
         qg = q.reshape(n_kv, group, hd)
         s = jax.lax.dot_general(
@@ -114,29 +143,46 @@ def _kernel(table_ref, pos_ref, q_ref, k_ref, v_ref, o_ref,
 
 
 def paged_attention(q, k_pool, v_pool, table, positions, *,
-                    softmax_scale: Optional[float] = None):
+                    softmax_scale: Optional[float] = None,
+                    k_scale=None, v_scale=None):
     """Decode attention over paged KV.  q: [S, nq, hd] (one token per
     slot); k_pool/v_pool: [P, page_size, n_kv, hd] (page 0 = the null
     page); table: [S, max_pages] int32 page ids; positions: [S] int32 —
-    slot s attends over global positions <= positions[s].  Returns
-    [S, nq, hd].  Raises ValueError on shapes outside `compatible` (the
-    dense-gather fallback in models/generation handles those)."""
+    slot s attends over global positions <= positions[s].  int8 pools
+    pass their per-head-vector f32 scales [P, page_size, n_kv] as
+    k_scale/v_scale and dequantize in-kernel.  Returns [S, nq, hd].
+    Raises ValueError on shapes outside `compatible` (the dense-gather
+    fallback in models/generation handles those)."""
+    quant = k_scale is not None
+    if quant != (v_scale is not None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
     S, nq, hd, P, ps, n_kv = _check_shapes(
-        q.shape, k_pool.shape, table.shape, positions.shape)
+        q.shape, k_pool.shape, table.shape, positions.shape,
+        quant="int8" if quant else "none")
+    if quant and tuple(k_scale.shape) != (P, ps, n_kv):
+        raise ValueError(f"scales {k_scale.shape} must be "
+                         f"[P={P}, ps={ps}, n_kv={n_kv}]")
     mp = table.shape[1]
     group = nq // n_kv
     scale = softmax_scale if softmax_scale is not None else hd ** -0.5
 
+    page_spec = pl.BlockSpec((1, ps, n_kv, hd),
+                             lambda s, p, tab, pos: (tab[s, p], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, nq, hd), lambda s, p, tab, pos: (s, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [q, k_pool, v_pool]
+    if quant:
+        scale_spec = pl.BlockSpec(
+            (1, ps, n_kv), lambda s, p, tab, pos: (tab[s, p], 0, 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [k_scale, v_scale]
+
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,
         grid=(S, mp),
-        in_specs=[
-            pl.BlockSpec((1, nq, hd), lambda s, p, tab, pos: (s, 0, 0)),
-            pl.BlockSpec((1, ps, n_kv, hd),
-                         lambda s, p, tab, pos: (tab[s, p], 0, 0, 0)),
-            pl.BlockSpec((1, ps, n_kv, hd),
-                         lambda s, p, tab, pos: (tab[s, p], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, nq, hd),
                                lambda s, p, tab, pos: (s, 0, 0)),
         scratch_shapes=[
@@ -147,11 +193,10 @@ def paged_attention(q, k_pool, v_pool, table, positions, *,
     )
     return pl.pallas_call(
         functools.partial(_kernel, scale=scale, ps=ps, n_kv=n_kv,
-                          group=group, mp=mp),
+                          group=group, mp=mp, quant=quant),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((S, nq, hd), q.dtype),
         compiler_params=pltpu.CompilerParams(
             dimension_semantics=("parallel", "arbitrary")),
         interpret=_interpret(),
-    )(table.astype(jnp.int32), positions.astype(jnp.int32), q, k_pool,
-      v_pool)
+    )(table.astype(jnp.int32), positions.astype(jnp.int32), *operands)

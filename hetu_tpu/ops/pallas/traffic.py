@@ -167,14 +167,29 @@ def flash_traffic(batch: int, seq: int, heads: int, head_dim: int, *,
 
 def paged_attn_traffic(slots: int, max_pages: int, page_size: int,
                        kv_heads: int, head_dim: int, *,
-                       elem_bytes: float = 4.0) -> Dict[str, Any]:
+                       elem_bytes: float = 4.0,
+                       quant: str = "none") -> Dict[str, Any]:
     """Paged decode vs the gather path: the fallback gathers every
     slot's pages into a dense [S, max_len] view (read pool, write
     dense) and the attention reads the dense view back — three passes
-    over the cache bytes.  The kernel DMAs each scheduled page once."""
+    over the cache bytes.  The kernel DMAs each scheduled page once.
+
+    ``quant="int8"`` prices the int8-page mode (serving/kv_pool.py:
+    1 byte/elem + one f32 scale per head-vector): the kernel's read is
+    the quantized payload, while the gather fallback additionally
+    materializes the DEQUANTIZED dense view at the compute width — the
+    in-kernel dequantize earns its keep on top of the payload cut."""
+    elems = 2.0 * slots * max_pages * page_size * kv_heads * head_dim
     e = float(elem_bytes)
-    cache = 2.0 * slots * max_pages * page_size * kv_heads * head_dim * e
-    chain: Chain = [
+    if quant == "int8":
+        cache_q = elems * (1.0 + 4.0 / head_dim)    # payload + scales
+        chain: Chain = [
+            ("gather_pages", cache_q, elems * e),   # dequantized dense
+            ("attend_dense", elems * e, 0.0),
+        ]
+        return _report("paged_attn_int8", chain, cache_q, 0.0)
+    cache = elems * e
+    chain = [
         ("gather_pages", cache, cache),
         ("attend_dense", cache, 0.0),
     ]
@@ -229,14 +244,17 @@ def kernel_traffic_report(*, batch: int, seq: int, hidden: int,
     q.pop("chain", None)
     q["per_step_multiplier"] = 1
     out["quant"] = q
-    p = paged_attn_traffic(serve_slots, serve_pages, serve_page_size,
-                           kv_heads, head_dim, elem_bytes=elem_bytes)
-    for k in ("unfused_bytes", "unfused_read_bytes", "unfused_write_bytes",
-              "fused_bytes", "fused_read_bytes", "fused_write_bytes"):
-        p[k] = p[k] * num_layers
-    p["per_step_multiplier"] = num_layers
-    p.pop("chain", None)
-    out["paged_attn"] = p
+    for quant in ("none", "int8"):
+        p = paged_attn_traffic(serve_slots, serve_pages, serve_page_size,
+                               kv_heads, head_dim, elem_bytes=elem_bytes,
+                               quant=quant)
+        for k in ("unfused_bytes", "unfused_read_bytes",
+                  "unfused_write_bytes", "fused_bytes",
+                  "fused_read_bytes", "fused_write_bytes"):
+            p[k] = p[k] * num_layers
+        p["per_step_multiplier"] = num_layers
+        p.pop("chain", None)
+        out[p["kernel"]] = p
     return out
 
 

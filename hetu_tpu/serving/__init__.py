@@ -16,13 +16,19 @@ unset cannot perturb any training program.
 from hetu_tpu.serving.engine import ServeConfig, ServingEngine  # noqa: F401
 from hetu_tpu.serving.kv_pool import (PagePool,  # noqa: F401
                                       PoolArrays, kv_bytes_per_token)
-from hetu_tpu.serving.request import (DEFAULT_SLO, Request,  # noqa: F401
-                                      RequestResult, RequestStats,
+from hetu_tpu.serving.prefix_cache import (RadixPrefixCache,  # noqa: F401
+                                           maybe_prefix_cache)
+from hetu_tpu.serving.request import (DEFAULT_SLO, GREEDY,  # noqa: F401
+                                      Request, RequestResult,
+                                      RequestStats, SamplingParams,
                                       SLOClass)
 from hetu_tpu.serving.reshard import LoadAdaptiveMesh  # noqa: F401
 from hetu_tpu.serving.scheduler import Scheduler, SlotState  # noqa: F401
 from hetu_tpu.serving.slo_report import (serving_report,  # noqa: F401
                                          render_text)
+from hetu_tpu.serving.spec_decode import (CallableDrafter,  # noqa: F401
+                                          Drafter, NGramDrafter,
+                                          make_drafter)
 from hetu_tpu.serving.traces import (bursty_arrivals,  # noqa: F401
                                      poisson_arrivals, synthetic_requests)
 from hetu_tpu.serving.tracing import (RequestTracer,  # noqa: F401
@@ -31,9 +37,12 @@ from hetu_tpu.serving.tracing import (RequestTracer,  # noqa: F401
 __all__ = [
     "ServingEngine", "ServeConfig",
     "PagePool", "PoolArrays", "kv_bytes_per_token",
+    "RadixPrefixCache", "maybe_prefix_cache",
     "Request", "RequestResult", "RequestStats", "SLOClass", "DEFAULT_SLO",
+    "SamplingParams", "GREEDY",
     "Scheduler", "SlotState",
     "LoadAdaptiveMesh",
+    "Drafter", "NGramDrafter", "CallableDrafter", "make_drafter",
     "RequestTracer", "maybe_tracer",
     "serving_report", "render_text",
     "poisson_arrivals", "bursty_arrivals", "synthetic_requests",

@@ -31,8 +31,16 @@ granularity and the engine stamps SLO metrics into the `obs` registry
 same observability spine training runs use, so `tools_obs_report.py`
 reads a serving run like any other.
 
-Decoding is greedy (per-request EOS, length budgets).  Model families:
-llama + gpt, via the family dispatch in `models/generation`.
+Decoding is greedy by default (per-request EOS, length budgets); the
+production decoding subsystem layers on top, all default-off with
+registered decode-program byte-identity contracts: in-graph seeded
+sampling (HETU_TPU_SERVE_SAMPLE, serving/sampling.py), the radix
+prefix cache (HETU_TPU_SERVE_PREFIX_CACHE, serving/prefix_cache.py —
+shared prompts admit with their KV pages resident), speculative
+decoding (HETU_TPU_SPEC_DECODE, serving/spec_decode.py — the decode
+program becomes a batched k+1-token verify), and SLO-class preemptive
+admission (HETU_TPU_SERVE_PREEMPT).  Model families: llama + gpt, via
+the family dispatch in `models/generation`.
 
 The optional `reshard` hook (`serving/reshard.LoadAdaptiveMesh`) is the
 Hetis move: queue-depth tier changes re-shard the serving params through
@@ -86,6 +94,26 @@ class ServeConfig:
     # the decode/prefill programs); gspmd (default) and fp32 leave the
     # params untouched.  Ignored for dense models.
     moe_dispatch: str = "gspmd"
+    # -- the production decoding subsystem (all default-off: the unset
+    #    programs are byte-identical to the pre-subsystem engine,
+    #    enforced by the flag-identity sweep) -------------------------
+    #: in-graph temperature/top-k/top-p sampling (HETU_TPU_SERVE_SAMPLE,
+    #: serving/sampling.py): the decode program takes per-slot seeded
+    #: PRNG keys; greedy rows stay argmax bit-for-bit
+    sampling: bool = False
+    #: speculative decoding (HETU_TPU_SPEC_DECODE, spec_decode.py):
+    #: "none" | "ngram" — verify spec_k drafts + 1 in one batched step
+    spec_decode: str = "none"
+    spec_k: int = 4
+    #: radix prefix cache (HETU_TPU_SERVE_PREFIX_CACHE,
+    #: prefix_cache.py): shared page-aligned prompt prefixes admit with
+    #: their KV pages already resident (COW refcounts in kv_pool.py)
+    prefix_cache: bool = False
+    prefix_cache_pages: int = 0      # 0 = bounded by pool pressure only
+    #: SLO-class-aware preemptive admission (HETU_TPU_SERVE_PREEMPT):
+    #: under slot/page pressure a strictly-higher-priority queued
+    #: request evicts-and-requeues the lowest-priority live slot
+    preempt: bool = False
 
     def __post_init__(self):
         if self.max_len % self.page_size:
@@ -105,9 +133,22 @@ class ServeConfig:
             raise ValueError(
                 f"moe_dispatch {self.moe_dispatch!r} invalid; choices: "
                 "('gspmd', 'fp32', 'int8', 'int4')")
+        if self.spec_decode not in ("none", "ngram"):
+            raise ValueError(
+                f"spec_decode {self.spec_decode!r} invalid; choices: "
+                "('none', 'ngram')")
+        if self.spec_decode != "none" and self.spec_k < 1:
+            raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
         if self.num_pages == 0:
             self.num_pages = self.num_slots * (self.max_len
                                                // self.page_size)
+
+    @property
+    def lookahead(self) -> int:
+        """Extra cache positions a verify step may write past the
+        sequence head (0 without speculative decoding) — widens every
+        page reservation (scheduler.py)."""
+        return self.spec_k if self.spec_decode != "none" else 0
 
     @staticmethod
     def from_flags(**overrides) -> "ServeConfig":
@@ -123,6 +164,12 @@ class ServeConfig:
             num_pages=flags.int_flag("HETU_TPU_SERVE_PAGES"),
             kv_quant=flags.str_flag("HETU_TPU_KV_QUANT"),
             moe_dispatch=flags.str_flag("HETU_TPU_MOE_DISPATCH"),
+            sampling=flags.bool_flag("HETU_TPU_SERVE_SAMPLE"),
+            spec_decode=flags.str_flag("HETU_TPU_SPEC_DECODE"),
+            spec_k=flags.int_flag("HETU_TPU_SPEC_K"),
+            prefix_cache=flags.bool_flag("HETU_TPU_SERVE_PREFIX_CACHE"),
+            prefix_cache_pages=flags.int_flag("HETU_TPU_SERVE_PREFIX_PAGES"),
+            preempt=flags.bool_flag("HETU_TPU_SERVE_PREEMPT"),
         )
         vals.update(overrides)
         return ServeConfig(**vals)
@@ -135,7 +182,7 @@ class ServingEngine:
                  *, run_log: Optional[RunLog] = None,
                  registry: Optional[MetricsRegistry] = None,
                  reshard=None, tracer=None, health=None,
-                 telemetry=None):
+                 telemetry=None, drafter=None):
         self.model = model
         self.params = params
         self.config = config or ServeConfig.from_flags()
@@ -148,9 +195,40 @@ class ServingEngine:
             page_size=self.config.page_size,
             num_kv_heads=n_kv, head_dim=c.head_dim,
             dtype=c.compute_dtype, quant=self.config.kv_quant)
+        # radix prefix cache (serving/prefix_cache.py): shared prompt
+        # prefixes admit with their pages already resident
+        self.prefix_cache = None
+        if self.config.prefix_cache:
+            from hetu_tpu.serving.prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(
+                self.pool, max_pages=self.config.prefix_cache_pages)
         self.scheduler = Scheduler(num_slots=self.config.num_slots,
                                    pool=self.pool,
-                                   max_len=self.config.max_len)
+                                   max_len=self.config.max_len,
+                                   prefix_cache=self.prefix_cache,
+                                   lookahead=self.config.lookahead)
+        # speculative decoding (serving/spec_decode.py): host drafter +
+        # the batched verify program built below; `drafter=` overrides
+        # the config mode with any Drafter instance (a small draft
+        # model plugs in here)
+        from hetu_tpu.serving.spec_decode import make_drafter
+        if drafter is not None and self.config.spec_decode == "none":
+            # the reservation lookahead and the verify program are both
+            # sized by the config — a drafter without them would write
+            # past reservations
+            raise ValueError("a custom drafter needs spec_decode set "
+                             "(e.g. ServeConfig(spec_decode='ngram')) so "
+                             "the verify program and page lookahead exist")
+        self.drafter = (drafter if drafter is not None
+                        else make_drafter(self.config.spec_decode))
+        self.spec = self.drafter is not None
+        #: per-rid preemption counts + the work counters accrued before
+        #: each requeue (requests survive requeues; their SlotState —
+        #: and its RequestStats — does not): folded back into the final
+        #: done event so acceptance-rate/chunk accounting describes the
+        #: whole run, not just the last incarnation
+        self._preempt_counts = {}
+        self._carried_stats = {}
         self.reshard = reshard
         self._registry = registry if registry is not None else get_registry()
         if run_log is None:
@@ -232,10 +310,12 @@ class ServingEngine:
         """Route the decode program through the gather-free Pallas
         paged-attention kernel (ops/pallas/paged_attention) when the
         HETU_TPU_PALLAS surface and the kernel's shape gate allow.
-        Exact fp pages only — the int8 page mode keeps the gather path
-        (pages dequantize during the gather).  Evaluated once at build:
-        the decision is static, like every other program shape."""
-        if self.pool.quant != "none":
+        int8 pages dequantize IN-KERNEL (the scales ride in as extra
+        operands).  Speculative decoding keeps the gather path — the
+        verify step is multi-query, outside the decode kernel's
+        single-token shape.  Evaluated once at build: the decision is
+        static, like every other program shape."""
+        if self.spec:
             return False
         from hetu_tpu.ops.pallas import paged_attention as _pa
         from hetu_tpu.ops.pallas import resolve_route
@@ -245,33 +325,57 @@ class ServingEngine:
         pool_shape = (self.config.num_pages + 1, self.config.page_size,
                       self.pool.num_kv_heads, self.pool.head_dim)
         ok = _pa.compatible(q_shape, pool_shape,
-                            (S, self.scheduler.max_pages), (S,))
+                            (S, self.scheduler.max_pages), (S,),
+                            quant=self.pool.quant)
         return resolve_route("paged_attn", ok)
 
     def _build_programs(self):
         model, pool = self.model, self.pool
         self.decode_paged = self._use_paged_kernel()
+        sampling_on = self.config.sampling
+
+        def pick_token(logits, positions, sample_args):
+            """Next token per slot: plain argmax (the byte-identical
+            default), or the in-graph sampler when the engine was built
+            with HETU_TPU_SERVE_SAMPLE (serving/sampling.py; greedy
+            rows still argmax inside it)."""
+            if not sampling_on:
+                return jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            from hetu_tpu.serving.sampling import sample_tokens
+            seeds, temps, top_ks, top_ps = sample_args
+            # the emitted token's sequence position is positions + 1
+            # (its input rides at `positions`) — the (seed, position)
+            # key derivation every sampling site in the engine shares
+            return sample_tokens(logits, seeds, positions + 1,
+                                 temps, top_ks, top_ps)
 
         if self.decode_paged:
             from hetu_tpu.models.generation import decode_step_paged
 
-            def decode_fn(params, pool_tree, table, tokens, positions):
+            def decode_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
                 # gather-free: the kernel walks the page table directly;
                 # this token's K/V are scattered inside the step (the
-                # write_token scatter is folded into the program)
-                logits, nk, nv = decode_step_paged(
+                # write_token scatter is folded into the program).  int8
+                # pools carry (k, v, k_scale, v_scale) — the kernel
+                # dequantizes pages in-VMEM
+                quant = len(pool_tree) == 4
+                ks = pool_tree[2] if quant else None
+                vs = pool_tree[3] if quant else None
+                logits, *new_pools = decode_step_paged(
                     model, params, tokens, pool_tree[0], pool_tree[1],
-                    table, positions)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                return nxt, (nk, nv)
+                    table, positions, k_scale=ks, v_scale=vs)
+                nxt = pick_token(logits, positions, sample_args)
+                return nxt, tuple(new_pools)
         else:
-            def decode_fn(params, pool_tree, table, tokens, positions):
+            def decode_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
                 ck, cv = pool.gather(pool_tree, table)
                 logits, _, (kt, vt) = decode_step_slots(
                     model, params, tokens, (ck, cv), positions)
                 new_tree = pool.write_token(pool_tree, table, positions,
                                             kt, vt)
-                nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                nxt = pick_token(logits, positions, sample_args)
                 return nxt, new_tree
 
         def chunk_fn(params, chunk, cache, start):
@@ -280,6 +384,40 @@ class ServingEngine:
         def write_fn(pool_tree, pages_row, ks, vs):
             return pool.write_pages(pool_tree, pages_row, ks, vs)
 
+        # speculative-decoding verify (serving/spec_decode.py): score
+        # the last token + k drafts in one multi-query forward
+        # (models/generation.verify_step_slots), scatter the block's
+        # K/V, and compute the sample-then-match acceptance in-graph —
+        # the host only reads [S, k+1] target tokens and [S] emit
+        # counts, never the logits
+        K1 = self.config.spec_k + 1
+
+        def verify_fn(params, pool_tree, table, tokens, positions,
+                      *sample_args):
+            from hetu_tpu.models.generation import verify_step_slots
+            ck, cv = pool.gather(pool_tree, table)
+            logits, _, (kc, vc) = verify_step_slots(
+                model, params, tokens, (ck, cv), positions)
+            pos_grid = positions[:, None] + jnp.arange(K1, dtype=jnp.int32)
+            new_tree = pool.write_tokens(pool_tree, table, pos_grid,
+                                         kc, vc)
+            if sampling_on:
+                from hetu_tpu.serving.sampling import sample_token_grid
+                seeds, temps, top_ks, top_ps = sample_args
+                targets = sample_token_grid(logits, seeds, pos_grid + 1,
+                                            temps, top_ks, top_ps)
+            else:
+                targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            match = (targets[:, :-1] == tokens[:, 1:]).astype(jnp.int32)
+            n_emit = jnp.cumprod(match, axis=1).sum(axis=1) + 1   # [S]
+            return targets, n_emit.astype(jnp.int32), new_tree
+
+        # prefix-cache prime (serving/prefix_cache.py): gather a slot's
+        # resident shared-prefix pages into the dense prefill scratch so
+        # suffix chunks attend over them (read-only — not donated)
+        def prime_fn(pool_tree, pages_row):
+            return pool.gather(pool_tree, pages_row[None])
+
         if self._moe_spec is not None:
             # resident int experts: the programs dequantize on entry, so
             # only the transient working copy is fp (the decode step's
@@ -287,14 +425,23 @@ class ServingEngine:
             from hetu_tpu.serving.experts import dequantize_expert_tree
             spec = self._moe_spec
             base_decode_fp, base_chunk_fp = decode_fn, chunk_fn
+            base_verify_fp = verify_fn
 
-            def decode_fn(params, pool_tree, table, tokens, positions):
+            def decode_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
                 return base_decode_fp(dequantize_expert_tree(params, spec),
-                                      pool_tree, table, tokens, positions)
+                                      pool_tree, table, tokens, positions,
+                                      *sample_args)
 
             def chunk_fn(params, chunk, cache, start):
                 return base_chunk_fp(dequantize_expert_tree(params, spec),
                                      chunk, cache, start)
+
+            def verify_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
+                return base_verify_fp(dequantize_expert_tree(params, spec),
+                                      pool_tree, table, tokens, positions,
+                                      *sample_args)
 
         if self._numerics:
             # wrap the programs that contain quantize sites in a
@@ -304,11 +451,21 @@ class ServingEngine:
             # byte-identity by construction.
             from hetu_tpu.obs import numerics as _numerics
             base_decode, base_write = decode_fn, write_fn
+            base_verify = verify_fn
 
-            def decode_fn(params, pool_tree, table, tokens, positions):
+            def decode_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
                 with _numerics.collecting() as col:
                     out = base_decode(params, pool_tree, table, tokens,
-                                      positions)
+                                      positions, *sample_args)
+                    stats = col.finalize()
+                return out + (stats,)
+
+            def verify_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
+                with _numerics.collecting() as col:
+                    out = base_verify(params, pool_tree, table, tokens,
+                                      positions, *sample_args)
                     stats = col.finalize()
                 return out + (stats,)
 
@@ -322,10 +479,19 @@ class ServingEngine:
         # allocation and it flows through every step — without donation
         # XLA would copy the whole pool to update one token per slot
         # (the engine always reassigns self.pool.arrays from the
-        # returned tree, so the donated input is never reused)
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+        # returned tree, so the donated input is never reused).  With
+        # speculative decoding on, the verify program IS the decode-step
+        # program (there is no single-token decode to build).
+        if self.spec:
+            self._decode_jit = None
+            self._verify_jit = jax.jit(verify_fn, donate_argnums=(1,))
+        else:
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+            self._verify_jit = None
         self._chunk_jit = jax.jit(chunk_fn)
         self._write_jit = jax.jit(write_fn, donate_argnums=(0,))
+        self._prime_jit = (jax.jit(prime_fn)
+                           if self.prefix_cache is not None else None)
 
     # ---------------------------------------------------- numerics taps
     def _run_decode(self, *args):
@@ -336,6 +502,16 @@ class ServingEngine:
             nxt, tree, stats = out
             self._note_numerics(stats)
             return nxt, tree
+        return out
+
+    def _run_verify(self, *args):
+        """Dispatch the spec-decode verify program (same numerics
+        peel)."""
+        out = self._verify_jit(*args)
+        if self._numerics:
+            targets, n_emit, tree, stats = out
+            self._note_numerics(stats)
+            return targets, n_emit, tree
         return out
 
     def _run_write(self, *args):
@@ -380,8 +556,16 @@ class ServingEngine:
         table = jnp.zeros((S, self.scheduler.max_pages), jnp.int32)
         toks = jnp.zeros(S, jnp.int32)
         pos = jnp.zeros(S, jnp.int32)
-        nxt, tree = self._run_decode(self.params, self.pool.arrays.tree(),
-                                     table, toks, pos)
+        sample_args = self._sample_args([]) if self.config.sampling else ()
+        if self.spec:
+            toks2 = jnp.zeros((S, self.config.spec_k + 1), jnp.int32)
+            nxt, _, tree = self._run_verify(
+                self.params, self.pool.arrays.tree(), table, toks2, pos,
+                *sample_args)
+        else:
+            nxt, tree = self._run_decode(
+                self.params, self.pool.arrays.tree(), table, toks, pos,
+                *sample_args)
         self.pool.arrays = PoolArrays.from_tree(tree)
         lg, cache = self._chunk_jit(self.params,
                                     jnp.zeros((1, C), jnp.int32),
@@ -390,11 +574,20 @@ class ServingEngine:
         tree = self._run_write(self.pool.arrays.tree(), row,
                                cache[0][:, 0], cache[1][:, 0])
         self.pool.arrays = PoolArrays.from_tree(tree)
+        if self._prime_jit is not None:
+            jax.block_until_ready(
+                self._prime_jit(self.pool.arrays.tree(), row))
         jax.block_until_ready(nxt)
         return self
 
     # ----------------------------------------------------------- intake
     def submit(self, req: Request, now: Optional[float] = None):
+        if req.sampling.temperature > 0 and not self.config.sampling:
+            raise ValueError(
+                f"request {req.rid} asks for sampling (temperature "
+                f"{req.sampling.temperature}) but the engine was built "
+                "greedy-only — set HETU_TPU_SERVE_SAMPLE=1 / "
+                "ServeConfig(sampling=True)")
         if now is not None:
             req.arrival_t = now
         self.scheduler.submit(req)
@@ -434,12 +627,19 @@ class ServingEngine:
             t_adm = clock()
             adm = self.scheduler.admit_next(t_adm)
             if adm is None:
+                # SLO-class preemption (HETU_TPU_SERVE_PREEMPT): a
+                # stalled strictly-higher-priority head may evict the
+                # lowest-priority live slot and retry the admission
+                if (self.config.preempt and self.scheduler.queue
+                        and self._try_preempt(clock())):
+                    continue
                 break
             slot_idx, st = adm
             st.prefilling = True
-            st.prefill_cache = self._scratch
+            self._start_prefill(slot_idx, st, t_adm)
             if self.tracer is not None:
-                self.tracer.on_admit(st.request, slot_idx, t_adm)
+                self.tracer.on_admit(st.request, slot_idx, t_adm,
+                                     shared_tokens=st.shared_tokens)
         if self.scheduler.queue:
             # admission declined with work queued: count the stall and
             # stamp the scheduler's reserve-on-admit attribution on
@@ -464,40 +664,52 @@ class ServingEngine:
             # every step (single source of truth): last emitted token +
             # next write position per decoding slot; empty/prefilling
             # rows ride along at (0, 0) writing into their masked region
-            tokens = np.zeros(self.config.num_slots, np.int32)
-            positions = np.zeros(self.config.num_slots, np.int32)
+            S = self.config.num_slots
+            positions = np.zeros(S, np.int32)
             for i in active:
-                st = self.scheduler.slots[i]
-                tokens[i] = st.generated[-1]
-                positions[i] = st.pos
-            nxt, pool_tree = self._run_decode(
-                self.params, self.pool.arrays.tree(),
-                jnp.asarray(self.scheduler.page_table),
-                jnp.asarray(tokens), jnp.asarray(positions))
-            nxt = np.asarray(nxt)
-            self.pool.arrays = PoolArrays.from_tree(pool_tree)
+                positions[i] = self.scheduler.slots[i].pos
+            sample_args = (self._sample_args(active)
+                           if self.config.sampling else ())
+            if self.spec:
+                emitted = self._spec_decode_step(active, positions,
+                                                 sample_args)
+            else:
+                tokens = np.zeros(S, np.int32)
+                for i in active:
+                    tokens[i] = self.scheduler.slots[i].generated[-1]
+                nxt, pool_tree = self._run_decode(
+                    self.params, self.pool.arrays.tree(),
+                    jnp.asarray(self.scheduler.page_table),
+                    jnp.asarray(tokens), jnp.asarray(positions),
+                    *sample_args)
+                nxt = np.asarray(nxt)
+                self.pool.arrays = PoolArrays.from_tree(pool_tree)
+                emitted = {i: [int(nxt[i])] for i in active}
             decode_wall = time.perf_counter() - td
             self._registry.inc("serve.decode_steps")
             # token_latency_s is the USER-visible inter-token gap: every
-            # active slot advances one token per decode step, so the gap
-            # IS the step wall.  The amortized per-token engine cost
-            # (wall / active slots — the throughput number) is its own
+            # active slot advances >= one token per decode step, so the
+            # gap IS the step wall.  The amortized per-token engine cost
+            # (wall / tokens emitted — the throughput number) is its own
             # series; conflating them would understate latency by up to
             # num_slots x.
+            n_emitted = sum(len(v) for v in emitted.values())
             self._registry.observe("serve.token_latency_s", decode_wall)
             self._registry.observe("serve.token_cost_s",
-                                   decode_wall / len(active))
+                                   decode_wall / max(n_emitted, 1))
             tnow = clock()
             n_done0 = len(finished)
             for i in active:
                 st = self.scheduler.slots[i]
-                tok = int(nxt[i])
-                st.generated.append(tok)
-                st.pos += 1
-                self._registry.inc("serve.tokens_out")
-                if self.tracer is not None:
-                    self.tracer.on_token(st.request, tnow)
-                self._maybe_finish(i, st, tok, tnow, finished)
+                for tok in emitted[i]:
+                    st.generated.append(tok)
+                    st.pos += 1
+                    self._registry.inc("serve.tokens_out")
+                    if self.tracer is not None:
+                        self.tracer.on_token(st.request, tnow)
+                    self._maybe_finish(i, st, tok, tnow, finished)
+                    if self.scheduler.slots[i] is None:
+                        break            # finished: drop surplus drafts
             if self.tracer is not None and len(finished) > n_done0:
                 # an eviction changed the batch composition: split the
                 # survivors' decode segments so the boundary is visible
@@ -540,16 +752,155 @@ class ServingEngine:
                                 queue_depth=self.scheduler.queue_depth)
         return finished
 
+    # --------------------------------------------------------- sampling
+    def _sample_args(self, active):
+        """Per-slot sampling-parameter vectors for the jitted programs
+        (inactive rows ride along greedy at seed 0)."""
+        S = self.config.num_slots
+        seeds = np.zeros(S, np.uint32)
+        temps = np.zeros(S, np.float32)
+        top_ks = np.zeros(S, np.int32)
+        top_ps = np.zeros(S, np.float32)
+        for i in active:
+            sp = self.scheduler.slots[i].request.sampling
+            seeds[i] = sp.seed & 0xFFFFFFFF
+            temps[i] = sp.temperature
+            top_ks[i] = sp.top_k
+            top_ps[i] = sp.top_p
+        return (jnp.asarray(seeds), jnp.asarray(temps),
+                jnp.asarray(top_ks), jnp.asarray(top_ps))
+
+    # ------------------------------------------------------ spec decode
+    def _spec_decode_step(self, active, positions, sample_args):
+        """One speculative decode step over the active slots: draft k
+        tokens per slot on the host, verify all k+1 in ONE batched
+        forward, accept by sample-then-match (serving/spec_decode.py).
+        Returns {slot: emitted tokens} (>= 1 per active slot)."""
+        S, k = self.config.num_slots, self.config.spec_k
+        w = getattr(self.drafter, "window", None)
+        tokens = np.zeros((S, k + 1), np.int32)
+        for i in active:
+            st = self.scheduler.slots[i]
+            # hand the drafter only the trailing window it reads —
+            # O(window) per step, not O(prompt + generated)
+            if w:
+                from_prompt = max(0, w - len(st.generated))
+                ctx = (st.request.prompt[st.request.prompt_len
+                                         - from_prompt:].tolist()
+                       + st.generated[-w:])
+            else:
+                ctx = st.request.prompt.tolist() + st.generated
+            tokens[i, 0] = st.generated[-1]
+            tokens[i, 1:] = self.drafter.propose(ctx, k)
+        targets, n_emit, pool_tree = self._run_verify(
+            self.params, self.pool.arrays.tree(),
+            jnp.asarray(self.scheduler.page_table),
+            jnp.asarray(tokens), jnp.asarray(positions), *sample_args)
+        targets = np.asarray(targets)
+        n_emit = np.asarray(n_emit)
+        self.pool.arrays = PoolArrays.from_tree(pool_tree)
+        emitted = {}
+        for i in active:
+            n = int(n_emit[i])
+            emitted[i] = [int(t) for t in targets[i, :n]]
+            st = self.scheduler.slots[i]
+            st.stats.spec_proposed += k
+            st.stats.spec_accepted += n - 1
+            self._registry.inc("serve.spec_proposed", value=k)
+            self._registry.inc("serve.spec_accepted", value=n - 1)
+            self._registry.observe("serve.spec_emitted", float(n))
+        return emitted
+
+    # ------------------------------------------------------- preemption
+    def _try_preempt(self, now: float) -> bool:
+        """Evict-and-requeue the lowest-priority live slot when the
+        stalled queue head outranks it (HETU_TPU_SERVE_PREEMPT).
+        Returns True when a slot was freed (the caller retries
+        admission)."""
+        head = self.scheduler.queue[0]
+        victim = self.scheduler.preempt_victim(head.slo.priority)
+        if victim is None:
+            return False
+        st = self.scheduler.slots[victim]
+        req = st.request
+        self._preempt_counts[req.rid] = \
+            self._preempt_counts.get(req.rid, 0) + 1
+        carried = self._carried_stats.setdefault(
+            req.rid, {"spec_proposed": 0, "spec_accepted": 0,
+                      "prefill_chunks": 0})
+        carried["spec_proposed"] += st.stats.spec_proposed
+        carried["spec_accepted"] += st.stats.spec_accepted
+        carried["prefill_chunks"] += st.stats.prefill_chunks
+        self.scheduler.preempt(victim)
+        self._registry.inc("serve.preemptions")
+        self._registry.inc("serve.preemptions_class",
+                           slo_class=req.slo.name)
+        if self.tracer is not None:
+            self.tracer.on_preempt(req, victim, now, by=head.rid)
+        self._log_serve(event="preempt", req=req.rid, slot=victim,
+                        by=head.rid, by_class=head.slo.name,
+                        slo_class=req.slo.name, now=now,
+                        tokens_discarded=len(st.generated),
+                        queue_depth=self.scheduler.queue_depth)
+        return True
+
+    def _first_token(self, req, logits_row, position: int) -> int:
+        """The TTFT token from the final prefill chunk's logits: argmax
+        (the default), or the seeded sampler for sampling requests —
+        same (seed, position) key derivation as the decode program, so
+        the whole stream is one deterministic sequence."""
+        if not (self.config.sampling and req.sampling.temperature > 0):
+            return int(np.argmax(np.asarray(logits_row)))
+        from hetu_tpu.serving.sampling import sample_tokens
+        sp = req.sampling
+        tok = sample_tokens(
+            jnp.asarray(logits_row)[None],
+            jnp.asarray([sp.seed & 0xFFFFFFFF], jnp.uint32),
+            jnp.asarray([position], jnp.int32),
+            jnp.asarray([sp.temperature], jnp.float32),
+            jnp.asarray([sp.top_k], jnp.int32),
+            jnp.asarray([sp.top_p], jnp.float32))
+        return int(np.asarray(tok)[0])
+
     # ---------------------------------------------------------- prefill
+    def _start_prefill(self, slot_idx: int, st, now: float):
+        """Attach the prefill scratch to a freshly admitted slot.  With
+        a radix-cache hit the scratch is PRIMED: the shared pages
+        gather into positions [0, shared_tokens) (exact in the fp page
+        mode — the bytes written at caching time), so suffix chunks
+        attend over the resident prefix and prefill FLOPs drop to the
+        unshared suffix."""
+        if st.shared_tokens:
+            row = np.full(self.scheduler.max_pages, PagePool.NULL_PAGE,
+                          np.int32)
+            shared_pages = st.shared_tokens // self.pool.page_size
+            row[:shared_pages] = st.pages[:shared_pages]
+            st.prefill_cache = self._prime_jit(self.pool.arrays.tree(),
+                                               jnp.asarray(row))
+            self._registry.inc("serve.prefix_hits")
+            self._registry.inc("serve.prefix_shared_tokens",
+                               value=st.shared_tokens)
+        else:
+            st.prefill_cache = self._scratch
+            if self.prefix_cache is not None:
+                self._registry.inc("serve.prefix_misses")
+        if self.prefix_cache is not None:
+            self._registry.set_gauge("serve.prefix_cache_pages",
+                                     self.prefix_cache.num_pages)
+
     def _advance_prefill(self, slot_idx: int, st, clock, finished):
         """Run ONE prefill chunk for a prefilling slot; on the last
         chunk, scatter the scratch K/V into the slot's pages, emit the
-        first token, and join the decode batch."""
+        first token, and join the decode batch.  A radix-cache hit
+        starts chunking at the shared boundary (`st.shared_tokens` —
+        the primed prefix is already in the scratch) and never
+        re-writes the shared pages."""
         req = st.request
         plen = req.prompt_len
         C = self.config.prefill_chunk
-        padded = math.ceil(plen / C) * C
-        s = st.chunks_done * C
+        base = st.shared_tokens
+        padded = base + math.ceil((plen - base) / C) * C
+        s = base + st.chunks_done * C
         ids = np.zeros(C, np.int32)
         seg = req.prompt[s: min(s + C, plen)]
         ids[: len(seg)] = seg
@@ -563,18 +914,30 @@ class ServingEngine:
             if self.tracer is not None:
                 self.tracer.on_chunk(req, clock(), st.chunks_done)
             return                        # more chunks: next engine step
-        # first generated token: argmax at the last VALID prompt position
-        # of the final chunk (padding tail positions carry garbage)
-        t1 = int(np.argmax(np.asarray(logits[0, plen - 1 - s])))
+        # first generated token: at the last VALID prompt position of
+        # the final chunk (padding tail positions carry garbage) —
+        # argmax, or the seeded sampler for sampling requests (same
+        # key derivation as the decode program: position plen)
+        t1 = self._first_token(req, logits[0, plen - 1 - s], plen)
 
+        # scatter only the FRESHLY prefilled pages; shared-prefix pages
+        # already hold these tokens' K/V (they are what the scratch was
+        # primed from) and are read-only to this slot (COW) — their row
+        # entries point at the null page so the write lands harmlessly
         pages_row = np.full(self.scheduler.max_pages, PagePool.NULL_PAGE,
                             np.int32)
         pages_row[: len(st.pages)] = st.pages
+        pages_row[: base // self.pool.page_size] = PagePool.NULL_PAGE
         tree = self._run_write(self.pool.arrays.tree(),
                                jnp.asarray(pages_row),
                                st.prefill_cache[0][:, 0],
                                st.prefill_cache[1][:, 0])
         self.pool.arrays = PoolArrays.from_tree(tree)
+        if self.prefix_cache is not None:
+            # index the finished prompt: full page-blocks not yet
+            # cached adopt this request's pages (incref — the slot
+            # keeps its own reference and releases it on finish)
+            self.prefix_cache.insert(req.prompt, st.pages, clock())
 
         st.prefilling = False
         st.prefill_cache = None
@@ -600,6 +963,7 @@ class ServingEngine:
                         chunks=st.stats.prefill_chunks, ttft_s=ttft,
                         queue_wait_s=st.stats.queue_wait_s, now=tnow,
                         slo_class=req.slo.name,
+                        shared_tokens=st.shared_tokens,
                         queue_depth=self.scheduler.queue_depth,
                         page_util=self.pool.utilization)
         self._maybe_finish(slot_idx, st, t1, tnow, finished)
@@ -630,6 +994,13 @@ class ServingEngine:
             self.tracer.on_finish(req, slot_idx, reason, tnow,
                                   tokens=len(res.tokens),
                                   e2e_s=st.stats.e2e_s)
+        st.stats.preemptions = self._preempt_counts.pop(req.rid, 0)
+        carried = self._carried_stats.pop(req.rid, None)
+        if carried is not None:
+            # work spent before each preemption belongs to this run
+            st.stats.spec_proposed += carried["spec_proposed"]
+            st.stats.spec_accepted += carried["spec_accepted"]
+            st.stats.prefill_chunks += carried["prefill_chunks"]
         self._log_serve(
             event="done", req=req.rid, slot=slot_idx,
             reason=reason, tokens=len(res.tokens),
@@ -637,6 +1008,11 @@ class ServingEngine:
             tokens_per_s=res.tokens_per_s, now=tnow,
             slo_class=req.slo.name,
             slo_ttft_s=req.slo.ttft_s, slo_token_gap_s=req.slo.token_gap_s,
+            spec_proposed=st.stats.spec_proposed,
+            spec_accepted=st.stats.spec_accepted,
+            shared_prefix_tokens=st.stats.shared_prefix_tokens,
+            prompt_len=req.prompt_len,
+            preemptions=st.stats.preemptions,
             queue_depth=self.scheduler.queue_depth,
             slot_occupancy=self.scheduler.occupancy,
             page_util=self.pool.utilization)

@@ -143,6 +143,13 @@ class PagePool:
         # garbage is overwritten by the next prefill/decode write before
         # any masked read can see it)
         self._free: List[int] = list(range(num_pages, 0, -1))
+        # copy-on-write reference counts (serving/prefix_cache.py): a
+        # freshly allocated page has one owner; the radix prefix cache
+        # and every slot sharing the page each hold one more.  A page
+        # returns to the free list when its LAST owner releases it —
+        # `free()` is decref, not destroy.  Without sharing every count
+        # stays 0/1 and the pre-COW semantics are unchanged.
+        self.refcount = np.zeros(num_pages + 1, np.int64)
         self.allocs = 0
         self.frees = 0
 
@@ -163,22 +170,38 @@ class PagePool:
         return self.used_count / self.num_pages
 
     def alloc(self, n: int) -> Optional[List[int]]:
-        """Pop n pages off the free list, or None (caller queues) when
-        the pool cannot satisfy the reservation."""
+        """Pop n pages off the free list (refcount 1 each), or None
+        (caller queues) when the pool cannot satisfy the reservation."""
         if n > len(self._free):
             return None
         pages = [self._free.pop() for _ in range(n)]
+        self.refcount[pages] = 1
         self.allocs += n
         return pages
 
+    def incref(self, pages: List[int]):
+        """Add one owner to each live page (prefix-cache sharing)."""
+        for p in pages:
+            if not (0 < p <= self.num_pages):
+                raise ValueError(f"incref of invalid page id {p}")
+            if self.refcount[p] < 1:
+                raise ValueError(f"incref of free page {p}")
+        for p in pages:     # per-element (fancy indexing drops dups)
+            self.refcount[p] += 1
+
     def free(self, pages: List[int]):
+        """Release one ownership of each page (decref); a page whose
+        last owner released it returns to the free list."""
         for p in pages:
             if not (0 < p <= self.num_pages):
                 raise ValueError(f"freeing invalid page id {p}")
-            if p in self._free:
+            if self.refcount[p] < 1 or p in self._free:
                 raise ValueError(f"double free of page {p}")
-        self._free.extend(pages)
-        self.frees += len(pages)
+        for p in pages:
+            self.refcount[p] -= 1
+            if self.refcount[p] == 0:
+                self._free.append(p)
+                self.frees += 1
 
     # ------------------------------------------------------ device ops
     # Pure functions over PoolArrays trees (the engine jits them inside
@@ -211,6 +234,37 @@ class PagePool:
         a = PoolArrays.from_tree(arrays_tree)
         S = positions.shape[0]
         page = table[jnp.arange(S), positions // self.page_size]
+        off = positions % self.page_size
+
+        def put(pool, scale, toks):
+            if scale is None:
+                return pool.at[:, page, off].set(toks.astype(pool.dtype)), None
+            x32 = toks.astype(jnp.float32)
+            q, s = quantize_heads(x32)
+            _tap_kv_snr(x32, q, s)
+            return (pool.at[:, page, off].set(q),
+                    scale.at[:, page, off].set(s))
+
+        nk, nks = put(a.k, a.k_scale, k_toks)
+        nv, nvs = put(a.v, a.v_scale, v_toks)
+        return PoolArrays(nk, nv, nks, nvs).tree()
+
+    def write_tokens(self, arrays_tree, table, positions, k_toks, v_toks):
+        """Scatter a BLOCK of tokens' K/V into the pool — the
+        spec-decode verify step's write (k+1 tokens per slot per step).
+        positions: [S, C] absolute write positions; k_toks/v_toks:
+        [L, S, C, n_kv, hd].  Positions beyond a slot's table row
+        (possible only for inactive rows riding along) redirect to the
+        null page instead of clamp-corrupting the row's last page."""
+        a = PoolArrays.from_tree(arrays_tree)
+        S, C = positions.shape
+        mp = table.shape[1]
+        pidx = positions // self.page_size                     # [S, C]
+        valid = pidx < mp
+        page = jnp.where(
+            valid,
+            table[jnp.arange(S)[:, None], jnp.clip(pidx, 0, mp - 1)],
+            PagePool.NULL_PAGE)
         off = positions % self.page_size
 
         def put(pool, scale, toks):

@@ -24,10 +24,17 @@ class SLOClass:
     """A named latency contract.  Targets are optional: None means the
     dimension is uncontracted (always attained); the default class has
     no targets at all — classless traffic reports attainment 1.0 and
-    its tokens all count toward goodput."""
+    its tokens all count toward goodput.
+
+    ``priority`` orders classes for preemptive admission
+    (HETU_TPU_SERVE_PREEMPT): under slot/page pressure a queued request
+    of a STRICTLY higher priority may evict-and-requeue the
+    lowest-priority live slot.  0 (default) = every class equal —
+    preemption can never fire between default-priority classes."""
     name: str = "default"
     ttft_s: Optional[float] = None       # arrival -> first token target
     token_gap_s: Optional[float] = None  # mean inter-token gap target
+    priority: int = 0
 
     def __post_init__(self):
         if not self.name:
@@ -40,45 +47,89 @@ class SLOClass:
 
     def to_dict(self) -> dict:
         return {"name": self.name, "ttft_s": self.ttft_s,
-                "token_gap_s": self.token_gap_s}
+                "token_gap_s": self.token_gap_s,
+                "priority": self.priority}
 
     @staticmethod
     def parse(spec: str) -> "SLOClass":
-        """``name[:ttft_s[:token_gap_s]]`` (empty/'-' = no target) —
-        the CLI surface: ``--slo-class gold:0.2:0.05``.  Extra fields
-        and non-numeric targets are loud errors: a silently dropped
-        field would run a different contract than the user typed."""
+        """``name[:ttft_s[:token_gap_s[:priority]]]`` (empty/'-' = no
+        target) — the CLI surface: ``--slo-class gold:0.2:0.05:2``.
+        Extra fields and non-numeric targets are loud errors: a
+        silently dropped field would run a different contract than the
+        user typed."""
         parts = spec.split(":")
-        if not parts[0] or len(parts) > 3:
+        if not parts[0] or len(parts) > 4:
             raise ValueError(f"bad SLO class spec {spec!r}; want "
-                             "name[:ttft_s[:token_gap_s]]")
+                             "name[:ttft_s[:token_gap_s[:priority]]]")
 
-        def num(i, what):
+        def num(i, what, cast=float):
             if len(parts) <= i or parts[i] in ("", "-"):
                 return None
             try:
-                return float(parts[i])
+                return cast(parts[i])
             except ValueError:
                 raise ValueError(
                     f"bad SLO class spec {spec!r}: {what} "
                     f"{parts[i]!r} is not a number (use '-' for no "
                     "target)") from None
+        prio = num(3, "priority", int)
         return SLOClass(parts[0], num(1, "ttft_s"),
-                        num(2, "token_gap_s"))
+                        num(2, "token_gap_s"),
+                        prio if prio is not None else 0)
 
 
 DEFAULT_SLO = SLOClass()
 
 
+@dataclasses.dataclass(frozen=True)
+class SamplingParams:
+    """Per-request decoding parameters (serving/sampling.py).
+
+    The defaults are GREEDY: temperature 0 makes the sampler an argmax
+    regardless of the filters, so a default-constructed request decodes
+    exactly like the pre-sampling engine.  ``seed`` keys a per-request
+    PRNG stream: the key for the token at sequence position p is
+    ``fold_in(key(seed), p)`` — a pure function of (seed, position), so
+    the same request replays to the same tokens across engine restarts,
+    slot assignments and batch compositions (the determinism golden in
+    tests/test_serving_decode.py)."""
+    temperature: float = 0.0
+    top_k: int = 0                     # 0 = filter disabled
+    top_p: float = 0.0                 # 0.0 (or >= 1.0) = disabled
+    seed: int = 0
+
+    def __post_init__(self):
+        if self.temperature < 0:
+            raise ValueError(f"temperature must be >= 0, "
+                             f"got {self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0, got {self.top_k}")
+        if not 0.0 <= self.top_p <= 1.0:
+            raise ValueError(f"top_p must be in [0, 1], got {self.top_p}")
+
+    @property
+    def greedy(self) -> bool:
+        return self.temperature == 0.0
+
+    def to_dict(self) -> dict:
+        return {"temperature": self.temperature, "top_k": self.top_k,
+                "top_p": self.top_p, "seed": self.seed}
+
+
+GREEDY = SamplingParams()
+
+
 @dataclasses.dataclass
 class Request:
-    """One generation request (greedy decode; per-request EOS)."""
+    """One generation request (greedy decode unless ``sampling`` says
+    otherwise; per-request EOS)."""
     rid: int
     prompt: np.ndarray                 # [plen] int32 token ids
     max_new_tokens: int
     eos_token_id: Optional[int] = None
     arrival_t: float = 0.0
     slo: SLOClass = DEFAULT_SLO
+    sampling: SamplingParams = GREEDY
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)
@@ -101,12 +152,25 @@ class Request:
 
 @dataclasses.dataclass
 class RequestStats:
-    """Per-request SLO timeline (driver-clock seconds)."""
+    """Per-request SLO timeline (driver-clock seconds) + the decoding
+    subsystem's per-request accounting (spec-decode acceptance, prefix
+    cache hits, preemptions — serving/slo_report.py aggregates these
+    from the ``done`` events)."""
     arrival_t: float = 0.0
     admit_t: Optional[float] = None
     first_token_t: Optional[float] = None
     done_t: Optional[float] = None
     prefill_chunks: int = 0
+    #: speculative decoding (serving/spec_decode.py): draft tokens
+    #: proposed / accepted over the request's verify steps
+    spec_proposed: int = 0
+    spec_accepted: int = 0
+    #: prompt tokens admitted with their KV pages already resident
+    #: (serving/prefix_cache.py) — prefill skipped them entirely
+    shared_prefix_tokens: int = 0
+    #: times this request was evicted-and-requeued by a higher-priority
+    #: admission (HETU_TPU_SERVE_PREEMPT)
+    preemptions: int = 0
 
     @property
     def queue_wait_s(self) -> Optional[float]:

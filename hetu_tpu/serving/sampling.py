@@ -1,0 +1,114 @@
+"""In-graph token sampling for the serving decode program.
+
+The engine's decode (and spec-decode verify) programs call
+:func:`sample_tokens` INSIDE the jitted step: per-slot temperature /
+top-k / top-p vectors ride in as program inputs, and the PRNG key for
+each sampled token is derived in-graph as
+
+    key = fold_in(jax.random.key(seed[slot]), token_position)
+
+— a pure function of the request's seed and the token's absolute
+sequence position.  That derivation is the determinism contract: the
+same request replays to the same tokens across engine restarts, slot
+assignments, batch compositions AND speculative re-verification (the
+spec-decode path samples the token at position p with exactly the key
+the sequential path would have used, which is what makes the
+sample-then-match acceptance rule distribution-exact).
+
+Greedy stays greedy bit-for-bit: rows with temperature == 0 take the
+plain ``argmax`` of the unfiltered logits (the filters never touch
+them), so a mixed batch of greedy and sampling requests decodes the
+greedy rows exactly like the sampling-free program.  The engine only
+builds the sampling program at all under ``HETU_TPU_SERVE_SAMPLE`` —
+unset, the decode program is byte-identical to the pre-sampling engine
+(registered identity contract, enforced by the flag-identity sweep).
+
+Filter semantics match ``models/generation.generate``'s sampler (HF
+conventions): top-k first, nucleus over the renormalized top-k
+distribution, the max-probability token always survives.  One
+descending full-vocab sort serves both filters per row.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+#: the filter mask value (matches generate()'s sampler)
+_NEG = -1e30
+
+
+def slot_keys(seeds, positions):
+    """[S] per-slot typed PRNG keys: ``fold_in(key(seed), position)``.
+    ``positions`` are the ABSOLUTE sequence positions of the tokens
+    being sampled (prompt + generated index), not engine step counts —
+    the restart-determinism contract."""
+    def one(seed, pos):
+        return jax.random.fold_in(jax.random.key(seed), pos)
+    return jax.vmap(one)(seeds.astype(jnp.uint32),
+                         positions.astype(jnp.uint32))
+
+
+def filtered_logits(logits, temps, top_ks, top_ps):
+    """Apply per-row temperature + top-k + top-p filtering.
+
+    logits: [S, V] f32; temps: [S] f32 (0 = greedy row — returned
+    unfiltered, the caller argmaxes it); top_ks: [S] int32 (0 =
+    disabled); top_ps: [S] f32 (0 or >= 1 = disabled).  Returns the
+    filtered, temperature-scaled logits [S, V]."""
+    V = logits.shape[-1]
+    temps = temps.astype(jnp.float32)
+    safe_t = jnp.where(temps > 0, temps, 1.0)
+    scaled = logits.astype(jnp.float32) / safe_t[:, None]
+
+    # ONE descending sort per row serves both filters
+    desc = jnp.sort(scaled, axis=-1)[:, ::-1]
+
+    # top-k: mask everything below the per-row kth value (k=0 -> V)
+    k_eff = jnp.where(top_ks > 0, top_ks, V).astype(jnp.int32)
+    kth = jnp.take_along_axis(
+        desc, jnp.clip(k_eff[:, None] - 1, 0, V - 1), axis=-1)
+    out = jnp.where(scaled < kth, _NEG, scaled)
+
+    # nucleus over the renormalized top-k distribution (HF semantics):
+    # the filtered descending view is the top-k prefix of `desc`
+    p_on = (top_ps > 0.0) & (top_ps < 1.0)
+    desc_f = jnp.where(jnp.arange(V)[None, :] < k_eff[:, None], desc, _NEG)
+    probs = jax.nn.softmax(desc_f, axis=-1)
+    cum = jnp.cumsum(probs, axis=-1)
+    keep = cum - probs < top_ps[:, None]      # mass BEFORE this token
+    cutoff = jnp.min(jnp.where(keep, desc_f, jnp.inf), axis=-1,
+                     keepdims=True)
+    out = jnp.where(p_on[:, None] & (out < cutoff), _NEG, out)
+    return out
+
+
+def sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
+    """Sample (or argmax) one token per slot, in-graph.
+
+    logits: [S, V]; seeds/positions/top_ks: [S] int; temps/top_ps: [S]
+    f32.  ``positions`` are the sampled tokens' absolute sequence
+    positions (the key-derivation input).  Rows with temperature 0 take
+    ``argmax`` of the UNFILTERED logits — exactly the greedy program's
+    token.  Returns [S] int32."""
+    greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+    filt = filtered_logits(logits, temps, top_ks, top_ps)
+    keys = slot_keys(seeds, positions)
+    sampled = jax.vmap(
+        lambda k, row: jax.random.categorical(k, row))(keys, filt)
+    return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy_tok)
+
+
+def sample_token_grid(logits, seeds, positions, temps, top_ks, top_ps):
+    """The spec-decode form: sample a [S, C] grid of tokens, one per
+    verify position.  logits: [S, C, V]; positions: [S, C] absolute
+    sequence positions of the tokens being sampled; per-slot params
+    broadcast over C.  Each (slot, position) uses the same key the
+    sequential path would — acceptance by sample-then-match is then the
+    exact rejection rule for a deterministic drafter
+    (serving/spec_decode.py)."""
+    S, C, V = logits.shape
+    flat = logits.reshape(S * C, V)
+    rep = lambda x: jnp.repeat(x, C)  # noqa: E731 — [S] -> [S*C]
+    toks = sample_tokens(flat, rep(seeds), positions.reshape(-1),
+                         rep(temps), rep(top_ks), rep(top_ps))
+    return toks.reshape(S, C)

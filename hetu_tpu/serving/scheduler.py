@@ -34,7 +34,10 @@ class SlotState:
     """One live decode slot.  A freshly admitted slot spends its first
     engine steps PREFILLING (one chunk per step, interleaved with the
     decode batch — the engine drives these fields); it joins the decode
-    batch when the last chunk lands."""
+    batch when the last chunk lands.  ``shared_tokens`` > 0 means the
+    leading pages of ``pages`` are radix-cache pages resident from an
+    earlier request (serving/prefix_cache.py) — prefill starts at that
+    boundary and those pages are never written by this slot."""
     request: Request
     pages: List[int]
     pos: int                     # next cache write position (= tokens cached)
@@ -43,45 +46,71 @@ class SlotState:
     prefilling: bool = False
     prefill_cache: object = None      # scratch KV carry while prefilling
     chunks_done: int = 0
+    shared_tokens: int = 0
+    admit_seq: int = 0                # admission order (preemption ties)
 
 
 class Scheduler:
-    """Slot + page bookkeeping for the continuous-batching engine."""
+    """Slot + page bookkeeping for the continuous-batching engine.
 
-    def __init__(self, *, num_slots: int, pool: PagePool, max_len: int):
+    ``lookahead`` (speculative decoding, serving/spec_decode.py) widens
+    every page reservation by k cache positions: a verify step writes
+    draft K/V up to ``pos + k``, so reserve-on-admit must cover
+    ``total_len + k`` for the no-mid-flight-out-of-pages guarantee to
+    keep holding.  ``prefix_cache`` (serving/prefix_cache.py) lets an
+    admission start with its page-aligned shared prefix already
+    resident: the reservation shrinks to the unshared suffix and the
+    shared pages are ref'd, not copied."""
+
+    def __init__(self, *, num_slots: int, pool: PagePool, max_len: int,
+                 prefix_cache=None, lookahead: int = 0):
         if max_len % pool.page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {pool.page_size}")
+        if lookahead < 0:
+            raise ValueError(f"lookahead must be >= 0, got {lookahead}")
         self.num_slots = num_slots
         self.pool = pool
         self.max_len = max_len
         self.max_pages = max_len // pool.page_size
+        self.prefix_cache = prefix_cache
+        self.lookahead = lookahead
         self.slots: List[Optional[SlotState]] = [None] * num_slots
         self.queue: Deque[Request] = collections.deque()
         # the device-facing view: row s = slot s's pages, null-padded
         self.page_table = np.zeros((num_slots, self.max_pages), np.int32)
         self.admitted = 0
         self.released = 0
+        self.preempted = 0
+        self._admit_seq = 0
         #: why the LAST failed admission attempt stalled (the
         #: reserve-on-admit attribution the flight recorder reads):
         #: "no_slot" = every decode slot live, "no_pages" = the queue
         #: head's full reservation was short; None = no stall observed
         self.last_stall: Optional[str] = None
 
+    def _reserve_tokens(self, req: Request) -> int:
+        """Cache positions an admission must cover: the worst-case
+        sequence plus the spec-decode write lookahead."""
+        return req.total_len + self.lookahead
+
     # ----------------------------------------------------------- queue
     def submit(self, req: Request):
         """Queue a request.  Rejects loudly what could NEVER run (a
         permanently stalled queue must be a bug report, not a hang)."""
-        if req.total_len > self.max_len:
+        if self._reserve_tokens(req) > self.max_len:
+            extra = (f" + spec lookahead {self.lookahead}"
+                     if self.lookahead else "")
             raise ValueError(
                 f"request {req.rid}: prompt {req.prompt_len} + "
-                f"max_new {req.max_new_tokens} exceeds max_len "
+                f"max_new {req.max_new_tokens}{extra} exceeds max_len "
                 f"{self.max_len}")
-        if self.pool.pages_for(req.total_len) > self.pool.num_pages:
+        if self.pool.pages_for(self._reserve_tokens(req)) \
+                > self.pool.num_pages:
             raise ValueError(
                 f"request {req.rid}: needs "
-                f"{self.pool.pages_for(req.total_len)} pages but the pool "
-                f"only has {self.pool.num_pages}")
+                f"{self.pool.pages_for(self._reserve_tokens(req))} pages "
+                f"but the pool only has {self.pool.num_pages}")
         self.queue.append(req)
 
     @property
@@ -103,7 +132,14 @@ class Scheduler:
     def admit_next(self, now: float) -> Optional[Tuple[int, SlotState]]:
         """Admit the queue head if a slot and its full page reservation
         are available; FIFO — a large head request blocks the queue
-        rather than starving (head-of-line policy, documented limit)."""
+        rather than starving (head-of-line policy, documented limit).
+
+        With a prefix cache attached, the head's page-aligned cached
+        prefix admits ALREADY RESIDENT: its pages are ref-shared (COW —
+        never written by this slot) and only the unshared suffix is
+        freshly allocated.  A short allocation first asks the cache to
+        evict LRU entries — cached pages are best-effort slack, never a
+        reason to queue."""
         if not self.queue:
             self.last_stall = None
             return None
@@ -112,16 +148,42 @@ class Scheduler:
             self.last_stall = "no_slot"
             return None
         req = self.queue[0]
-        pages = self.pool.alloc(self.pool.pages_for(req.total_len))
-        if pages is None:
+        shared_tokens, shared_pages = 0, []
+        if self.prefix_cache is not None:
+            shared_tokens, shared_pages = self.prefix_cache.match(
+                req.prompt, now)
+            if shared_pages:
+                # take the slot's reference BEFORE any eviction can
+                # run: an unpinned matched chain is itself an
+                # evictable LRU leaf, and evict-then-realloc would
+                # hand a matched page back as this admission's "fresh"
+                # suffix page — prefix and suffix silently aliased
+                # onto one physical page (caught by the regression
+                # test; released below if the admission still fails)
+                self.pool.incref(shared_pages)
+        need = self.pool.pages_for(self._reserve_tokens(req)) \
+            - len(shared_pages)
+        fresh = self.pool.alloc(need)
+        if fresh is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.pool.free_count,
+                                    require_free=True)
+            fresh = self.pool.alloc(need)
+        if fresh is None:
+            if shared_pages:
+                self.pool.free(shared_pages)    # unpin the match
             self.last_stall = "no_pages"
             return None
+        pages = list(shared_pages) + fresh
         self.last_stall = None
         self.queue.popleft()
         slot_idx = free[0]
+        self._admit_seq += 1
         st = SlotState(request=req, pages=pages, pos=0,
                        stats=RequestStats(arrival_t=req.arrival_t,
-                                          admit_t=now))
+                                          admit_t=now),
+                       shared_tokens=shared_tokens,
+                       admit_seq=self._admit_seq)
+        st.stats.shared_prefix_tokens = shared_tokens
         self.slots[slot_idx] = st
         row = self.page_table[slot_idx]
         row[:] = PagePool.NULL_PAGE
@@ -130,9 +192,11 @@ class Scheduler:
         return slot_idx, st
 
     def release(self, slot_idx: int):
-        """Evict a finished sequence: pages back on the free list, table
-        row re-pointed at the null page (the slot keeps decoding as an
-        inactive row; its writes dump into page 0)."""
+        """Evict a finished sequence: pages released (shared prefix
+        pages decref — they stay resident while the radix cache or
+        another slot holds them), table row re-pointed at the null page
+        (the slot keeps decoding as an inactive row; its writes dump
+        into page 0)."""
         st = self.slots[slot_idx]
         if st is None:
             raise ValueError(f"slot {slot_idx} is not live")
@@ -141,28 +205,63 @@ class Scheduler:
         self.page_table[slot_idx, :] = PagePool.NULL_PAGE
         self.released += 1
 
+    # ------------------------------------------------------- preemption
+    def preempt_victim(self, priority: int) -> Optional[int]:
+        """The slot a `priority`-class admission may evict under
+        pressure (HETU_TPU_SERVE_PREEMPT): the lowest-priority live
+        slot, STRICTLY below `priority` (equal classes never preempt
+        each other — no thrash), youngest admission first among ties
+        (least sunk prefill cost).  None = nothing preemptible."""
+        live = [(st.request.slo.priority, -st.admit_seq, i)
+                for i, st in enumerate(self.slots) if st is not None]
+        if not live:
+            return None
+        prio, _, idx = min(live)
+        return idx if prio < priority else None
+
+    def preempt(self, slot_idx: int) -> Request:
+        """Evict-and-requeue a live slot: pages released, the ORIGINAL
+        request re-queued at the back (it re-prefills from scratch on
+        re-admission — deterministic decode regenerates the same
+        tokens).  Returns the requeued request."""
+        st = self.slots[slot_idx]
+        if st is None:
+            raise ValueError(f"slot {slot_idx} is not live")
+        self.release(slot_idx)
+        self.released -= 1          # a preemption is not a completion
+        self.preempted += 1
+        self.queue.append(st.request)
+        return st.request
+
     # ------------------------------------------------------ invariants
     def check_invariants(self):
         """The memory-pool correctness contract (fuzz-tested):
-        * no page is owned by two live slots (aliasing),
-        * live pages + free pages partition the pool exactly,
+        * refcounts are EXACT: every live page's count equals its owner
+          count (slots holding it + one per radix-cache entry) — no
+          page is shared without a reference, none leaks one,
+        * a page shared by two slots is legal ONLY under COW (both
+          slots hold it inside their shared page-aligned prefix, below
+          every write position) — without a prefix cache this reduces
+          to the original no-aliasing rule,
+        * live (refcount > 0) + free pages partition the pool exactly,
         * each table row mirrors its slot's page list, null-padded,
         * the null page is never owned and never free-listed,
         * every live position fits its reservation."""
-        seen: Dict[int, int] = {}
+        owners: Dict[int, int] = {}
+        writers: Dict[int, List[int]] = {}   # slots holding p UNSHARED
         for i, st in enumerate(self.slots):
             if st is None:
                 if (self.page_table[i] != PagePool.NULL_PAGE).any():
                     raise AssertionError(f"empty slot {i} has a non-null "
                                          "table row")
                 continue
-            for p in st.pages:
+            shared_pages = st.shared_tokens // self.pool.page_size
+            for j, p in enumerate(st.pages):
                 if p == PagePool.NULL_PAGE:
                     raise AssertionError(f"slot {i} owns the null page")
-                if p in seen:
-                    raise AssertionError(
-                        f"page {p} aliased by slots {seen[p]} and {i}")
-                seen[p] = i
+                owners[p] = owners.get(p, 0) + 1
+                if j >= shared_pages:
+                    writers.setdefault(p, []).append(i)
             row = self.page_table[i]
             want = st.pages + [PagePool.NULL_PAGE] * (self.max_pages
                                                       - len(st.pages))
@@ -176,15 +275,37 @@ class Scheduler:
             if st.pos > self.max_len:
                 raise AssertionError(f"slot {i} position {st.pos} beyond "
                                      f"max_len {self.max_len}")
+        for p, slots_w in writers.items():
+            # at most one slot may hold a page outside its shared
+            # prefix (the original allocator — the only legal writer);
+            # two writers would be genuine cache-corrupting aliasing
+            if len(slots_w) > 1:
+                raise AssertionError(
+                    f"page {p} aliased OUTSIDE a shared prefix by "
+                    f"slots {slots_w}")
+        if self.prefix_cache is not None:
+            for p in self.prefix_cache.owned_pages():
+                if p == PagePool.NULL_PAGE:
+                    raise AssertionError("prefix cache owns the null page")
+                owners[p] = owners.get(p, 0) + 1
         free = self.pool._free
         if len(set(free)) != len(free):
             raise AssertionError("duplicate pages on the free list")
         if PagePool.NULL_PAGE in free:
             raise AssertionError("null page on the free list")
-        overlap = set(free) & set(seen)
+        overlap = set(free) & set(owners)
         if overlap:
             raise AssertionError(f"pages both live and free: {overlap}")
-        if len(seen) + len(free) != self.pool.num_pages:
+        if len(owners) + len(free) != self.pool.num_pages:
             raise AssertionError(
-                f"pool leak: {len(seen)} live + {len(free)} free != "
+                f"pool leak: {len(owners)} live + {len(free)} free != "
                 f"{self.pool.num_pages} pages")
+        for p, n in owners.items():
+            if self.pool.refcount[p] != n:
+                raise AssertionError(
+                    f"page {p} refcount {self.pool.refcount[p]} != "
+                    f"{n} owners")
+        stray = [int(p) for p in range(1, self.pool.num_pages + 1)
+                 if self.pool.refcount[p] > 0 and p not in owners]
+        if stray:
+            raise AssertionError(f"refcounted pages with no owner: {stray}")

@@ -59,6 +59,7 @@ def collect(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "dones": [r for r in serves if r.get("event") == "done"],
         "reshards": [r for r in serves if r.get("event") == "reshard"],
         "reports": [r for r in serves if r.get("event") == "report"],
+        "preempts": [r for r in serves if r.get("event") == "preempt"],
         "traces": collect_traces(records),
         "anomalies": [r for r in records if r.get("kind") == "anomaly"],
     }
@@ -189,6 +190,68 @@ def class_report(rows: List[Dict[str, Any]],
     return out
 
 
+def spec_decode_report(collected: Dict[str, Any]
+                       ) -> Optional[Dict[str, Any]]:
+    """Speculative-decoding acceptance over a run (from the ``done``
+    events' spec counters): drafts proposed/accepted, the measured
+    per-draft acceptance rate, and the mean tokens emitted per verify
+    step it implies — the number the analytic roofline in
+    bench.py detail.serving prices.  None when the run never drafted."""
+    dones = [d for d in collected["dones"] if d.get("spec_proposed")]
+    if not dones:
+        return None
+    proposed = sum(int(d.get("spec_proposed") or 0) for d in dones)
+    accepted = sum(int(d.get("spec_accepted") or 0) for d in dones)
+    return {
+        "requests": len(dones),
+        "drafts_proposed": proposed,
+        "drafts_accepted": accepted,
+        "acceptance_rate": accepted / proposed if proposed else 0.0,
+    }
+
+
+def prefix_cache_report(collected: Dict[str, Any]
+                        ) -> Optional[Dict[str, Any]]:
+    """Radix-cache effectiveness (from the ``admit`` events):
+    admissions that hit, prompt tokens admitted already-resident, and
+    the prefill-token fraction the cache eliminated.  None when no
+    admit event carries the field (pre-cache logs degrade
+    gracefully)."""
+    admits = [a for a in collected["admits"]
+              if a.get("shared_tokens") is not None]
+    if not admits:
+        return None
+    shared = sum(int(a.get("shared_tokens") or 0) for a in admits)
+    prompt = sum(int(a.get("prompt_len") or 0) for a in admits)
+    hits = sum(1 for a in admits if (a.get("shared_tokens") or 0) > 0)
+    return {
+        "admits": len(admits),
+        "hits": hits,
+        "hit_rate": hits / len(admits),
+        "shared_tokens": shared,
+        "prompt_tokens": prompt,
+        "prefill_tokens_saved_frac": shared / prompt if prompt else 0.0,
+    }
+
+
+def preemption_report(collected: Dict[str, Any]
+                      ) -> Optional[Dict[str, Any]]:
+    """Who preempted whom (from the ``preempt`` events): counts per
+    victim class and per preemptor class."""
+    pre = collected["preempts"]
+    if not pre:
+        return None
+    victims: Dict[str, int] = {}
+    by: Dict[str, int] = {}
+    for p in pre:
+        victims[str(p.get("slo_class", "default"))] = \
+            victims.get(str(p.get("slo_class", "default")), 0) + 1
+        by[str(p.get("by_class", "default"))] = \
+            by.get(str(p.get("by_class", "default")), 0) + 1
+    return {"preemptions": len(pre), "victim_classes": victims,
+            "preemptor_classes": by}
+
+
 def stall_breakdown(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     """How queued time attributes across the scheduler's stall reasons
     (span-traced runs only): request counts and total queued seconds per
@@ -254,6 +317,15 @@ def serving_report(records: Iterable[Dict[str, Any]], *,
     rec = reconciliation(rows)
     if rec is not None:
         out["reconciliation"] = rec
+    spec = spec_decode_report(collected)
+    if spec is not None:
+        out["spec_decode"] = spec
+    cache = prefix_cache_report(collected)
+    if cache is not None:
+        out["prefix_cache"] = cache
+    pre = preemption_report(collected)
+    if pre is not None:
+        out["preemptions"] = pre
     if collected["anomalies"]:
         by_kind: Dict[str, int] = {}
         for a in collected["anomalies"]:
@@ -310,6 +382,27 @@ def render_text(report: Dict[str, Any]) -> str:
         lines.append(
             f"span reconciliation: {rec['requests']} traced requests, "
             f"max |spans - e2e| = {rec['max_residual_s']:.3g}s")
+    spec = report.get("spec_decode")
+    if spec:
+        lines.append(
+            f"spec decode: {spec['drafts_accepted']}/"
+            f"{spec['drafts_proposed']} drafts accepted "
+            f"(acceptance {spec['acceptance_rate']:.0%} over "
+            f"{spec['requests']} requests)")
+    cache = report.get("prefix_cache")
+    if cache:
+        lines.append(
+            f"prefix cache: {cache['hits']}/{cache['admits']} admissions "
+            f"hit ({cache['hit_rate']:.0%}); {cache['shared_tokens']}/"
+            f"{cache['prompt_tokens']} prompt tokens resident "
+            f"({cache['prefill_tokens_saved_frac']:.0%} of prefill "
+            "eliminated)")
+    pre = report.get("preemptions")
+    if pre:
+        victims = ", ".join(f"{k}={v}" for k, v in
+                            sorted(pre["victim_classes"].items()))
+        lines.append(f"preemptions: {pre['preemptions']} "
+                     f"(victims by class: {victims})")
     if report.get("anomalies"):
         lines.append("anomalies: " + ", ".join(
             f"{k}={n}" for k, n in sorted(report["anomalies"].items())))

@@ -1,0 +1,180 @@
+"""Speculative decoding: draft k tokens on the host, verify k+1 in one
+batched forward.
+
+The decode-step cost of a serving engine is HBM-bound: every step reads
+the full parameter set once no matter how many tokens it emits.
+Speculative decoding amortizes that read — a cheap DRAFTER proposes k
+tokens per slot, and ONE `verify_step_slots` forward
+(models/generation.py — the `extend_cache` machinery with per-slot
+depths) scores all k+1 positions.  Accepted drafts emit in bulk; the
+roofline win is provable hardware-free (`roofline_report`, the
+comm/wire.py discipline; bench.py detail.serving records it).
+
+**Acceptance = sample-then-match.**  Per verify position the engine
+computes the token the SEQUENTIAL path would have emitted there —
+argmax for greedy rows, `sampling.sample_tokens` with the position's
+own fold_in key for sampling rows — and accepts draft tokens while they
+match.  For a DETERMINISTIC drafter (a point-mass proposal q) this is
+exactly the standard speculative rejection rule: the draft is accepted
+with probability p(d), and conditioned on rejection the emitted token
+is distributed as the residual norm(max(p - q, 0)) = p restricted to
+tokens != d — so the output DISTRIBUTION matches the non-speculative
+path, and because the per-position PRNG keys are identical, sampled
+output is token-IDENTICAL run-for-run too.  Greedy is the
+temperature->0 case: accept iff draft == argmax (token-identical to
+sequential `generate()`, the acceptance golden).
+
+**Drafters** are pluggable host-side proposers (`Drafter.propose`).
+`NGramDrafter` is the built-in model-free one (prompt-lookup decoding):
+match the longest recent n-gram earlier in the sequence and replay the
+tokens that followed it — free to compute, and highly effective on the
+repetitive spans (code, quotations, structured output) where serving
+traffic actually burns tokens.  A small draft MODEL plugs in as a
+`Drafter` returning its own argmax rollout; the engine only sees
+`propose`.
+
+Gated by ``HETU_TPU_SPEC_DECODE`` (none | ngram; registered identity
+contract — unset builds the pre-speculative decode program
+byte-for-byte) with ``HETU_TPU_SPEC_K`` draft tokens per step.  See
+docs/serving.md.
+"""
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+
+class Drafter:
+    """Host-side draft proposer interface."""
+
+    #: how many trailing context tokens `propose` actually reads; the
+    #: engine slices the sequence to this before calling (None = the
+    #: full history) so drafting stays O(window) per step instead of
+    #: rebuilding the whole prompt+generated list on the decode hot
+    #: loop (quadratic per request at long contexts)
+    window: Optional[int] = None
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        """Propose k draft continuations of `tokens` (the trailing
+        `window` of prompt + generated so far).  Must return exactly k
+        token ids."""
+        raise NotImplementedError
+
+
+class NGramDrafter(Drafter):
+    """Prompt-lookup drafting: find the most recent earlier occurrence
+    of the longest trailing n-gram (n down to 1) and propose the tokens
+    that followed it; pad by repeating the last token when the lookup
+    comes up short (a deliberately cheap tail — mismatches cost one
+    rejected draft, not correctness)."""
+
+    def __init__(self, max_ngram: int = 3, window: int = 1024):
+        if max_ngram < 1:
+            raise ValueError("max_ngram must be >= 1")
+        self.max_ngram = max_ngram
+        self.window = window
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        toks = list(tokens[-self.window:])
+        n = len(toks)
+        out: List[int] = []
+        for m in range(min(self.max_ngram, n - 1), 0, -1):
+            tail = toks[n - m:]
+            # most recent earlier occurrence of the trailing m-gram
+            for s in range(n - m - 1, -1, -1):
+                if toks[s:s + m] == tail:
+                    out = toks[s + m: s + m + k]
+                    break
+            if out:
+                break
+        last = toks[-1] if toks else 0
+        while len(out) < k:
+            out.append(out[-1] if out else last)
+        return out[:k]
+
+
+class CallableDrafter(Drafter):
+    """Adapter: any ``fn(tokens, k) -> [k] ids`` (e.g. a small draft
+    model's rollout) as a Drafter."""
+
+    def __init__(self, fn):
+        self.fn = fn
+
+    def propose(self, tokens: Sequence[int], k: int) -> List[int]:
+        out = list(self.fn(tokens, k))
+        if len(out) != k:
+            raise ValueError(f"drafter returned {len(out)} tokens, "
+                             f"wanted {k}")
+        return out
+
+
+def make_drafter(mode: str, **kw) -> Optional[Drafter]:
+    """The HETU_TPU_SPEC_DECODE vocabulary -> a Drafter (None for
+    'none')."""
+    if mode == "none":
+        return None
+    if mode == "ngram":
+        return NGramDrafter(**kw)
+    raise ValueError(f"unknown spec-decode mode {mode!r}; "
+                     "choices: ('none', 'ngram')")
+
+
+def accept_counts(targets: np.ndarray, drafts: np.ndarray) -> np.ndarray:
+    """Host-side twin of the in-graph acceptance rule (the engine's
+    program computes this with cumprod; tests pin the two together).
+    targets: [S, k+1] the per-position sequential-path tokens; drafts:
+    [S, k].  Returns [S] n_emit in [1, k+1]: the longest matched prefix
+    plus the one always-emitted correction/bonus token."""
+    match = targets[:, :-1] == drafts            # [S, k]
+    acc = np.cumprod(match.astype(np.int64), axis=1).sum(axis=1)
+    return acc + 1
+
+
+# ---------------------------------------------------------------------------
+# analytic roofline (bench.py detail.serving, the hardware-free pattern)
+# ---------------------------------------------------------------------------
+
+def expected_tokens_per_step(acceptance: float, k: int) -> float:
+    """E[tokens emitted per verify step] under per-position acceptance
+    probability `acceptance`: 1 + a + a^2 + ... + a^k (the matched
+    prefix is geometric, truncated at k, plus the always-emitted
+    bonus/correction token)."""
+    if not 0.0 <= acceptance <= 1.0:
+        raise ValueError(f"acceptance must be in [0, 1], got {acceptance}")
+    if acceptance == 1.0:
+        return float(k + 1)
+    return (1.0 - acceptance ** (k + 1)) / (1.0 - acceptance)
+
+
+def roofline_report(*, n_params: float, flops_per_token: float,
+                    step_bytes: float, slots: int, k: int,
+                    acceptance: float, peak_flops: float,
+                    hbm_bytes_per_s: float) -> Dict[str, float]:
+    """Analytic spec-decode speedup at the roofline (hardware-free).
+
+    A plain decode step moves `step_bytes` (params + every slot's KV)
+    and computes `slots * flops_per_token`; a verify step moves the
+    SAME bytes (params read once, KV read once — the k+1 queries share
+    them) but computes (k+1)x the FLOPs and emits
+    `expected_tokens_per_step(acceptance, k)` tokens per slot.  While
+    decode is HBM-bound (it always is at serving batch sizes), the
+    verify step's extra FLOPs ride under the same memory roof and the
+    speedup approaches E[emit] directly."""
+    e_emit = expected_tokens_per_step(acceptance, k)
+    t_decode = max(slots * flops_per_token / peak_flops,
+                   step_bytes / hbm_bytes_per_s)
+    t_verify = max(slots * (k + 1) * flops_per_token / peak_flops,
+                   step_bytes / hbm_bytes_per_s)
+    base = slots / t_decode
+    spec = slots * e_emit / t_verify
+    return {
+        "k": float(k),
+        "acceptance": acceptance,
+        "expected_tokens_per_step": round(e_emit, 4),
+        "decode_step_s": t_decode,
+        "verify_step_s": t_verify,
+        "decode_tokens_per_s": round(base, 1),
+        "spec_tokens_per_s": round(spec, 1),
+        "speedup": round(spec / base, 3),
+    }

@@ -11,7 +11,8 @@ from typing import List, Optional, Sequence
 
 import numpy as np
 
-from hetu_tpu.serving.request import DEFAULT_SLO, Request, SLOClass
+from hetu_tpu.serving.request import (DEFAULT_SLO, GREEDY, Request,
+                                      SamplingParams, SLOClass)
 
 
 def poisson_arrivals(n: int, rate_per_s: float, *, seed: int = 0
@@ -52,25 +53,44 @@ def synthetic_requests(n: int, *, vocab_size: int, prompt_lens=(4, 24),
                        max_new=(4, 12), eos_token_id: Optional[int] = None,
                        arrivals: Optional[np.ndarray] = None,
                        slo_classes: Optional[Sequence[SLOClass]] = None,
+                       shared_prefix_len: int = 0,
+                       sampling: Optional[SamplingParams] = None,
                        seed: int = 0) -> List[Request]:
     """n seeded requests with uniform prompt lengths / decode budgets and
     the given arrival times (default: all at t=0).  ``slo_classes``
     assigns latency classes round-robin (deterministic — request i gets
-    class i % len); None keeps every request in the default class."""
+    class i % len); None keeps every request in the default class.
+
+    ``shared_prefix_len`` prepends one seeded "system prompt" of that
+    many tokens to EVERY request (the radix-prefix-cache workload;
+    prompt_lens then sizes the per-request suffix).  ``sampling`` stamps
+    the given SamplingParams on every request with a per-request seed
+    (base seed + rid — deterministic, distinct streams)."""
     rng = np.random.default_rng(seed)
     if arrivals is None:
         arrivals = np.zeros(n)
     if len(arrivals) != n:
         raise ValueError(f"{len(arrivals)} arrival times for {n} requests")
+    prefix = (rng.integers(0, vocab_size,
+                           size=shared_prefix_len).astype(np.int32)
+              if shared_prefix_len else None)
     reqs = []
     for i in range(n):
         plen = int(rng.integers(prompt_lens[0], prompt_lens[1] + 1))
         mnew = int(rng.integers(max_new[0], max_new[1] + 1))
         slo = (slo_classes[i % len(slo_classes)] if slo_classes
                else DEFAULT_SLO)
+        prompt = rng.integers(0, vocab_size, size=plen).astype(np.int32)
+        if prefix is not None:
+            prompt = np.concatenate([prefix, prompt])
+        sp = GREEDY
+        if sampling is not None:
+            sp = SamplingParams(temperature=sampling.temperature,
+                                top_k=sampling.top_k,
+                                top_p=sampling.top_p,
+                                seed=sampling.seed + i)
         reqs.append(Request(
-            rid=i,
-            prompt=rng.integers(0, vocab_size, size=plen).astype(np.int32),
+            rid=i, prompt=prompt,
             max_new_tokens=mnew, eos_token_id=eos_token_id,
-            arrival_t=float(arrivals[i]), slo=slo))
+            arrival_t=float(arrivals[i]), slo=slo, sampling=sp))
     return reqs

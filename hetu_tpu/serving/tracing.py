@@ -108,18 +108,24 @@ class RequestTracer:
         """The scheduler declined admission this step; stamp the
         reserve-on-admit reason on every still-queued request (the
         LAST observed reason wins — it names what the request was
-        actually waiting on when it finally mattered)."""
+        actually waiting on when it finally mattered).  A `preempted`
+        stamp is sticky: the request is back in the queue BECAUSE it
+        was evicted, and that attribution must survive later stalls."""
         for rid in rids:
             st = self._open.get(rid)
-            if st is not None and st.phase == "queued":
+            if (st is not None and st.phase == "queued"
+                    and st.stall_reason != "preempted"):
                 st.stall_reason = reason
 
-    def on_admit(self, req, slot: int, now: float):
+    def on_admit(self, req, slot: int, now: float,
+                 shared_tokens: int = 0):
         st = self._open.get(req.rid)
         if st is None:
             return
         st.slot = slot
-        self._emit(st, "queued", st.last_t, now, reason=st.stall_reason)
+        self._emit(st, "queued", st.last_t, now, reason=st.stall_reason,
+                   **({"shared_tokens": shared_tokens}
+                      if shared_tokens else {}))
         st.phase = "prefill"
         st.last_t = now
 
@@ -170,6 +176,31 @@ class RequestTracer:
             st = self._open.get(rid)
             if st is not None:
                 self._close_segment(st, now, end=why)
+
+    def on_preempt(self, req, slot: int, now: float, *,
+                   by: Optional[int] = None):
+        """A higher-priority admission evicted this request
+        (HETU_TPU_SERVE_PREEMPT): close the open decode segment (or the
+        partial prefill), and re-enter the QUEUED phase inside the SAME
+        trace with the sticky ``preempted`` stall reason — the
+        re-admission emits a second queued span, so the tiling (and the
+        span-vs-e2e reconciliation) stays exact across the requeue."""
+        st = self._open.get(req.rid)
+        if st is None:
+            return
+        st.slot = slot
+        if st.phase == "decode":
+            self._close_segment(st, now, end="preempt")
+        elif st.phase == "prefill" and now > st.last_t:
+            self._emit(st, "prefill", st.last_t, now, chunk=st.chunks,
+                       discarded=True)
+            st.last_t = now
+        st.phase = "queued"
+        st.stall_reason = "preempted"
+        st.slot = None
+        st.chunks = 0
+        st.seg_tokens = 0
+        st.seg_index = 0
 
     def on_pause(self, rids: Iterable[int], t0: float, t1: float,
                  **attrs: Any):

@@ -39,6 +39,14 @@ class Flag:
     #: (hetu_tpu/analysis/flag_identity.py, tools_lint.py --flags), which
     #: replaced the per-flag hand-written byte-identity tests.
     identity: Optional[str] = None
+    #: which canonical programs (analysis/programs.py PROGRAMS keys) the
+    #: identity contract sweeps against; None = all of them.  Flags read
+    #: ONLY inside hetu_tpu/serving (structurally enforced: serving is
+    #: never imported from the package root and the env-bypass AST lint
+    #: pins every read to this module) cannot perturb a training trace,
+    #: so their contracts sweep the decode program alone — the training
+    #: lowers would be pure sweep cost with no information.
+    identity_programs: Optional[Tuple[str, ...]] = None
 
 
 REGISTRY: Dict[str, Flag] = {f.name: f for f in [
@@ -222,6 +230,64 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
     Flag("HETU_TPU_SERVE_PAGES", "int", 0,
          "usable KV pages in the pool; 0 (default) = full reservation "
          "(slots * max_len / page), i.e. admission never waits on pages"),
+    Flag("HETU_TPU_SERVE_SAMPLE", "bool", False,
+         "in-graph serving sampler (serving/sampling.py): the decode "
+         "program takes per-slot temperature/top-k/top-p vectors and "
+         "seeded PRNG keys derived as fold_in(key(seed), position) — "
+         "same seed => same tokens across engine restarts and batch "
+         "compositions; greedy rows (temperature 0) stay argmax.  "
+         "Unset (default) builds the greedy-only decode program "
+         "byte-identical to the flag not existing (registered identity "
+         "contract); SamplingParams on a Request then raise loudly",
+         identity="0", identity_programs=("decode",)),
+    Flag("HETU_TPU_SPEC_DECODE", "str", "none",
+         "speculative decoding (serving/spec_decode.py): ngram drafts "
+         "HETU_TPU_SPEC_K tokens per slot per step (prompt-lookup, "
+         "host-side, model-free) and ONE batched verify forward "
+         "(models/generation.verify_step_slots) scores all k+1 "
+         "positions; acceptance is sample-then-match — the exact "
+         "rejection rule for a deterministic drafter, so greedy output "
+         "is token-identical to sequential generate() and sampled "
+         "output matches the non-speculative distribution (and seed).  "
+         "none (default) builds the single-token decode program "
+         "byte-identical to unset",
+         choices=("none", "ngram"), identity="none",
+         identity_programs=("decode",)),
+    Flag("HETU_TPU_SPEC_K", "int", 4,
+         "draft tokens per speculative decode step (the verify "
+         "program's static width is k+1); also widens every page "
+         "reservation by k positions (reserve-on-admit must cover the "
+         "draft writes).  Read only when HETU_TPU_SPEC_DECODE is set — "
+         "the registered identity contract pins that setting it alone "
+         "leaves the decode program byte-identical",
+         identity="4", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_PREFIX_CACHE", "bool", False,
+         "radix prefix cache (serving/prefix_cache.py): finished "
+         "prompts' page-aligned KV pages stay resident in a radix tree "
+         "keyed by token blocks, with copy-on-write refcounts in the "
+         "page pool — a request sharing the prefix admits with those "
+         "pages already in its page table and prefill runs only the "
+         "unshared suffix (>= 90% of prefill FLOPs eliminated for a "
+         "fully-shared system prompt, bench.py detail.serving).  "
+         "Host-side bookkeeping only: the decode program is "
+         "byte-identical either way (registered identity contract)",
+         identity="0", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_PREFIX_PAGES", "int", 0,
+         "radix-cache page budget (0 = bounded only by pool pressure: "
+         "the scheduler evicts LRU cache entries on demand when an "
+         "admission's reservation comes up short, so cached pages are "
+         "best-effort slack and can never deadlock admission)",
+         identity="0", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_PREEMPT", "bool", False,
+         "SLO-class-aware preemptive admission: when the queue head's "
+         "class priority strictly outranks the lowest-priority live "
+         "slot and admission stalls (no_slot/no_pages), that slot is "
+         "evicted-and-requeued (pages released, 'preempted' stall "
+         "reason span, serve 'preempt' event) and the head admits.  "
+         "Equal priorities never preempt (no thrash).  Host-side "
+         "policy only — decode program byte-identical (registered "
+         "identity contract)",
+         identity="0", identity_programs=("decode",)),
     Flag("HETU_TPU_SERVE_TRACE", "bool", False,
          "serving flight recorder (serving/tracing.py): record every "
          "request's lifecycle as schema-versioned 'span' RunLog records "
@@ -324,6 +390,12 @@ def identity_flags() -> Dict[str, str]:
     under systematic enforcement; there are no per-flag tests to write."""
     return {f.name: f.identity for f in REGISTRY.values()
             if f.identity is not None}
+
+
+def identity_contract_programs(name: str) -> Optional[Tuple[str, ...]]:
+    """The canonical programs `name`'s identity contract sweeps against
+    (None = every program) — the sweep's per-flag program axis."""
+    return _lookup(name).identity_programs
 
 
 def describe() -> str:

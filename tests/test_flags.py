@@ -60,11 +60,16 @@ def test_every_env_read_is_registered():
     for name in ("HETU_TPU_TELEMETRY_PUSH", "HETU_TPU_HEALTH",
                  "HETU_TPU_RUNLOG_MAX_MB"):
         assert name in flags.REGISTRY
-    # the serving surface (hetu_tpu/serving, docs/serving.md)
+    # the serving surface (hetu_tpu/serving, docs/serving.md), incl.
+    # the PR 15 production-decoding flags (sampling, speculative
+    # decoding, radix prefix cache, preemptive admission)
     for name in ("HETU_TPU_KV_QUANT", "HETU_TPU_SERVE_SLOTS",
                  "HETU_TPU_SERVE_PAGE", "HETU_TPU_SERVE_MAX_LEN",
                  "HETU_TPU_SERVE_PREFILL_CHUNK", "HETU_TPU_SERVE_PAGES",
-                 "HETU_TPU_SERVE_TRACE"):
+                 "HETU_TPU_SERVE_TRACE", "HETU_TPU_SERVE_SAMPLE",
+                 "HETU_TPU_SPEC_DECODE", "HETU_TPU_SPEC_K",
+                 "HETU_TPU_SERVE_PREFIX_CACHE",
+                 "HETU_TPU_SERVE_PREFIX_PAGES", "HETU_TPU_SERVE_PREEMPT"):
         assert name in flags.REGISTRY
     # the analytic step profiler + perf-budget surface
     # (obs.hlo_profile / obs.budget, docs/observability.md)
@@ -115,7 +120,22 @@ def test_identity_contract_table():
     # the explicit MoE dispatch reshapes the traced program when routed,
     # so its contract is the GSPMD default
     assert table["HETU_TPU_MOE_DISPATCH"] == "gspmd"
-    assert len(table) >= 16
+    # the decoding subsystem: every new serve/spec flag is contracted
+    # at its off/neutral value, and — being serving-confined reads —
+    # each sweeps the decode program (identity_programs)
+    assert table["HETU_TPU_SERVE_SAMPLE"] == "0"
+    assert table["HETU_TPU_SPEC_DECODE"] == "none"
+    assert table["HETU_TPU_SPEC_K"] == "4"
+    assert table["HETU_TPU_SERVE_PREFIX_CACHE"] == "0"
+    assert table["HETU_TPU_SERVE_PREEMPT"] == "0"
+    for name in ("HETU_TPU_SERVE_SAMPLE", "HETU_TPU_SPEC_DECODE",
+                 "HETU_TPU_SPEC_K", "HETU_TPU_SERVE_PREFIX_CACHE",
+                 "HETU_TPU_SERVE_PREFIX_PAGES",
+                 "HETU_TPU_SERVE_PREEMPT"):
+        assert flags.identity_contract_programs(name) == ("decode",)
+    # unrestricted contracts sweep everything
+    assert flags.identity_contract_programs("HETU_TPU_PALLAS") is None
+    assert len(table) >= 22
     # flags with NO contract must stay contract-free: these genuinely
     # change program shapes, so an identity entry would be a lie the
     # sweep turns into a tier-1 failure

@@ -422,27 +422,47 @@ def test_identity_sweep_detects_a_broken_contract(monkeypatch):
 
 
 def test_identity_sweep_covers_every_contract_and_holds():
-    """Acceptance: 100% of registered byte-identity flags, ALL FOUR
-    canonical programs (train, serving decode, the MoE
-    forward+backward added with the numerics observatory, and the ep=2
-    expert-parallel MoE step added with the explicit dispatch), zero
-    violations — the systematic replacement for the per-flag
-    hand-written byte-identity tests."""
+    """Acceptance: 100% of registered byte-identity flags, each against
+    its contracted program set — ALL FOUR canonical programs (train,
+    serving decode, the MoE forward+backward added with the numerics
+    observatory, and the ep=2 expert-parallel MoE step added with the
+    explicit dispatch) by default, the decode program alone for
+    serving-confined flags (Flag.identity_programs: their reads are
+    structurally pinned to hetu_tpu/serving by the env-bypass lint +
+    the serving package never importing from the root, so a training
+    lower carries no information) — zero violations: the systematic
+    replacement for the per-flag hand-written byte-identity tests."""
     from hetu_tpu.analysis.flag_identity import identity_sweep
     from hetu_tpu.utils import flags
     table = flags.identity_flags()
-    # the surface this PR put under contract — shrinkage is a failure
+    # the surface under contract — shrinkage is a failure
     assert set(table) >= {
         "HETU_TPU_GRAD_COMPRESS", "HETU_TPU_SP_COMPRESS",
         "HETU_TPU_ZERO_COMPRESS", "HETU_TPU_COMM_TOPOLOGY",
         "HETU_TPU_PALLAS", "HETU_TPU_PALLAS_KERNELS",
         "HETU_TPU_KV_QUANT", "HETU_TPU_PROFILE",
         "HETU_TPU_COMM_ANALYZE", "HETU_TPU_LINT",
-        "HETU_TPU_NUMERICS", "HETU_TPU_MOE_DISPATCH"}
+        "HETU_TPU_NUMERICS", "HETU_TPU_MOE_DISPATCH",
+        # the PR 15 decoding subsystem (decode-program contracts)
+        "HETU_TPU_SERVE_SAMPLE", "HETU_TPU_SPEC_DECODE",
+        "HETU_TPU_SPEC_K", "HETU_TPU_SERVE_PREFIX_CACHE",
+        "HETU_TPU_SERVE_PREFIX_PAGES", "HETU_TPU_SERVE_PREEMPT"}
+    all_programs = ("train", "decode", "moe", "moe_ep")
+    want = set()
+    for f in table:
+        progs = flags.identity_contract_programs(f)
+        for p in (all_programs if progs is None else progs):
+            want.add((f, p))
+    # a restricted contract may only restrict to real programs, and
+    # every serving-confined flag still sweeps the decode program
+    for f in table:
+        progs = flags.identity_contract_programs(f)
+        if progs is not None:
+            assert set(progs) <= set(all_programs), (f, progs)
+            assert "decode" in progs, f
     sweep = identity_sweep()
     covered = {(r["flag"], r["program"]) for r in sweep["rows"]}
-    assert covered == {(f, p) for f in table
-                       for p in ("train", "decode", "moe", "moe_ep")}
+    assert covered == want
     violations = [r for r in sweep["rows"] if not r["ok"]]
     assert violations == [], violations
     assert not any(f.severity == "error" for f in sweep["findings"])

@@ -229,6 +229,44 @@ def test_paged_attention_parity():
                                atol=FWD_TOL)
 
 
+def test_paged_attention_int8_parity():
+    """int8 pages (PR 15): the kernel's in-VMEM dequantize matches the
+    gather path's dequantize-then-attend over the SAME quantized pool —
+    the parity half of closing the exact-fp-pages-only gap."""
+    from hetu_tpu.serving.kv_pool import quantize_heads, dequantize_heads
+    rng = np.random.default_rng(5)
+    S, P, ps, n_kv, nq, hd = 3, 9, 8, 2, 4, 128
+    kp32 = jnp.asarray(rng.standard_normal((P, ps, n_kv, hd),
+                                           dtype=np.float32))
+    vp32 = jnp.asarray(rng.standard_normal((P, ps, n_kv, hd),
+                                           dtype=np.float32))
+    q = jnp.asarray(rng.standard_normal((S, nq, hd), dtype=np.float32))
+    kq, ks = quantize_heads(kp32)
+    vq, vs = quantize_heads(vp32)
+    table = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [6, 7, 8, 0]],
+                        jnp.int32)
+    positions = jnp.asarray([20, 9, 17], jnp.int32)
+    out = paged_attention.paged_attention(q, kq, vq, table, positions,
+                                          k_scale=ks, v_scale=vs)
+    ref = _dense_paged_reference(q, dequantize_heads(kq, ks),
+                                 dequantize_heads(vq, vs), table,
+                                 positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=FWD_TOL)
+    # scales must come as a pair, with the pinned layout
+    with pytest.raises(ValueError, match="both k_scale and v_scale"):
+        paged_attention.paged_attention(q, kq, vq, table, positions,
+                                        k_scale=ks)
+    with pytest.raises(ValueError, match="scales"):
+        paged_attention.paged_attention(q, kq, vq, table, positions,
+                                        k_scale=ks.T, v_scale=vs.T)
+    # the gate accepts exactly the supported page modes
+    assert paged_attention.compatible(q.shape, kq.shape, table.shape,
+                                      positions.shape, quant="int8")
+    assert not paged_attention.compatible(q.shape, kq.shape, table.shape,
+                                          positions.shape, quant="int4")
+
+
 # ---------------------------------------------------------------------------
 # gate/kernel drift: the gate's verdict must MATCH what the kernel
 # actually accepts (satellite 2 — extended to every kernel's gate)
@@ -474,11 +512,22 @@ def test_serving_paged_decode_token_identical(monkeypatch):
     r1 = eng1.run([copy.deepcopy(r) for r in reqs])
     eng1.close()
     assert [r.tokens for r in r0] == [r.tokens for r in r1]
-    # int8 page mode keeps the gather path even when forced
+    # int8 page mode routes too (PR 15: in-kernel dequantize closed the
+    # exact-fp-pages-only gap) and matches the int8 GATHER path
+    # token-for-token — both programs quantize through the same
+    # blockwise primitives, so pool contents are bit-identical
     eng2 = ServingEngine(model, params,
                          ServeConfig(kv_quant="int8", **sc))
-    assert eng2.decode_paged is False
+    assert eng2.decode_paged is True
+    r2 = eng2.run([copy.deepcopy(r) for r in reqs])
     eng2.close()
+    monkeypatch.setenv("HETU_TPU_PALLAS", "0")
+    eng3 = ServingEngine(model, params,
+                         ServeConfig(kv_quant="int8", **sc))
+    assert eng3.decode_paged is False
+    r3 = eng3.run([copy.deepcopy(r) for r in reqs])
+    eng3.close()
+    assert [r.tokens for r in r2] == [r.tokens for r in r3]
 
 
 # ---------------------------------------------------------------------------
@@ -573,10 +622,15 @@ def test_kernel_traffic_acceptance():
                                 intermediate=4096, num_layers=12,
                                 q_heads=12, kv_heads=12, head_dim=128)
     assert set(rep) == {"norm", "swiglu", "rotary", "flash", "quant",
-                        "paged_attn"}
+                        "paged_attn", "paged_attn_int8"}
     for r in rep.values():
         assert r["fused_bytes"] > 0
         assert r["unfused_bytes"] > r["fused_bytes"]
+    # the int8-page kernel reads ~1/elem_bytes the cache payload of the
+    # fp kernel AND skips the dequantized dense round trip
+    assert rep["paged_attn_int8"]["fused_bytes"] < \
+        rep["paged_attn"]["fused_bytes"]
+    assert rep["paged_attn_int8"]["reduction"] >= 3.0
     roof = kernel_roofline(rep)
     assert roof["norm"]["speedup"] >= 3.0
     assert all(v["fused_s"] > 0 for v in roof.values())
@@ -588,9 +642,10 @@ def test_bench_detail_kernels_record():
     import bench
     rec = bench._hardware_free_kernels(batch=2, seq=512)
     assert set(rec) == {"norm", "swiglu", "rotary", "flash", "quant",
-                        "paged_attn"}
+                        "paged_attn", "paged_attn_int8"}
     assert rec["norm"]["reduction"] >= 3.0
     assert rec["paged_attn"]["reduction"] >= 3.0
+    assert rec["paged_attn_int8"]["reduction"] >= 3.0
     from tools_bench_kernels import kernel_section
     assert kernel_section(2, 512) == rec
 

@@ -397,6 +397,27 @@ def test_chaos_serving_scenario(tmp_path):
     assert slo["reconciliation"]["max_residual_s"] <= 1e-6
 
 
+def test_chaos_serve_preempt_scenario(tmp_path):
+    """The serve-preempt schedule (the PR 7 follow-up closed in PR 15):
+    the slow-decode window pins bulk decodes on both slots, so gold
+    (priority 2) arrivals evict-and-requeue them — preemptions land in
+    the engine counter AND the report's preemptions section, victims
+    all bulk, and every request (including the bumped ones) still
+    completes."""
+    from hetu_tpu.chaos.harness import named_plan, run_serving_chaos_demo
+    plan = named_plan("serve-preempt", at_step=4, count=12, delay_s=0.15)
+    report = run_serving_chaos_demo(str(tmp_path), plan, requests=12,
+                                    rate=80.0, burst=6, preempt=True)
+    assert report["completed"]
+    assert report["preemptions"] >= 1
+    pre = report["slo"]["preemptions"]
+    assert pre["preemptions"] == report["preemptions"]
+    assert set(pre["victim_classes"]) == {"bulk"}
+    assert set(pre["preemptor_classes"]) == {"gold"}
+    # span tiling survives the requeues exactly
+    assert report["slo"]["reconciliation"]["max_residual_s"] <= 1e-6
+
+
 def test_cli_serving_trace_and_report(tmp_path, capsys):
     """CLI smoke (mirrors test_cli_self_is_clean): one tools_serving.py
     --trace run with classes + chrome trace, then

@@ -49,7 +49,8 @@ def main(argv=None) -> int:
                     help="emit the record as JSON instead of the table")
     ap.add_argument("--chain", metavar="KERNEL", default=None,
                     help="print one kernel's unfused op chain (norm, "
-                         "swiglu, rotary, quant, flash, paged_attn)")
+                         "swiglu, rotary, quant, flash, paged_attn, "
+                         "paged_attn_int8)")
     args = ap.parse_args(argv)
 
     if args.chain:
@@ -72,6 +73,9 @@ def main(argv=None) -> int:
                 cfg.head_dim),
             "paged_attn": lambda: t.paged_attn_traffic(
                 8, 16, 16, cfg.num_key_value_heads, cfg.head_dim),
+            "paged_attn_int8": lambda: t.paged_attn_traffic(
+                8, 16, 16, cfg.num_key_value_heads, cfg.head_dim,
+                quant="int8"),
         }
         if args.chain not in builders:
             print(f"unknown kernel {args.chain!r}; "

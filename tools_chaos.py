@@ -7,7 +7,8 @@ recovery report.
     python tools_chaos.py --steps 48 --workers 2 --json report.json
 
 Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
-partition, corrupt, stall, slow, serve-burst.  A path argument loads a
+partition, corrupt, stall, slow, serve-burst, serve-preempt.  A path
+argument loads a
 FaultPlan JSON (docs/fault_tolerance.md has the schema — the same format
 the HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs
 with HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster
@@ -20,7 +21,11 @@ llama, CPU) with a slow-decode window injected mid-run — the flight
 recorder traces every request and the report's `slo` key carries the
 per-class SLO attainment / goodput / stall attribution from
 `serving/slo_report.py` (the `tools_serving_report.py` path), plus the
-fired serving health detectors.
+fired serving health detectors.  `--schedule serve-preempt` is the same
+scenario with SLO-class preemptive admission armed (gold at priority 2):
+the slowdown pins bulk decodes on every slot and arriving gold requests
+evict-and-requeue them — the report's `slo.preemptions` section names
+the victims.
 
 The demo run is CPU-only and model-free (StubTrainer checkpoints real
 bytes through orbax; the control plane — reconnecting rpc client,
@@ -73,12 +78,13 @@ def main(argv=None) -> int:
         plan = named_plan(args.schedule)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="hetu_chaos_")
-    if args.schedule == "serve-burst":
+    if args.schedule in ("serve-burst", "serve-preempt"):
         # the serving scenario has its own knobs; the training demo's
         # --steps/--workers do not apply to it
-        report = run_serving_chaos_demo(workdir, plan,
-                                        requests=args.requests,
-                                        rate=args.rate, burst=args.burst)
+        report = run_serving_chaos_demo(
+            workdir, plan, requests=args.requests,
+            rate=args.rate, burst=args.burst,
+            preempt=args.schedule == "serve-preempt")
     else:
         report = run_chaos_demo(workdir, plan, num_steps=args.steps,
                                 workers=args.workers)

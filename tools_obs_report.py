@@ -129,7 +129,8 @@ def summarize(records) -> dict:
             srv["classes"] = rep["classes"]
             srv["slo_attainment"] = rep["slo_attainment"]
             for k in ("goodput_tokens_per_s", "stall_breakdown",
-                      "reconciliation"):
+                      "reconciliation", "spec_decode", "prefix_cache",
+                      "preemptions"):
                 if rep.get(k) is not None:
                     srv[k] = rep[k]
         out["serving"] = srv

@@ -7,6 +7,10 @@ synthetic arrival trace and print an SLO report.
     python tools_serving.py --trace poisson --requests 16 \
         --slo-class gold:0.2:0.05 --slo-class bulk \
         --runlog /tmp/serve.jsonl --chrome-trace /tmp/serve_trace.json
+    python tools_serving.py --sample --temperature 0.8 --top-k 40
+    python tools_serving.py --spec ngram --spec-k 4 --runlog /tmp/s.jsonl
+    python tools_serving.py --shared-prefix 64 --max-len 128 \
+        --runlog /tmp/s.jsonl
 
 Seeded and CPU-safe (tiny LLaMA by default): the same trace replays to
 the same tokens every run.  The report is one JSON object — request
@@ -14,11 +18,22 @@ count, TTFT / e2e latency percentiles, tokens/s, slot occupancy and
 cache-page utilization — plus RunLog ``serve`` events when --runlog is
 given (summarize those with `python tools_obs_report.py <runlog>`).
 
-`--slo-class name[:ttft_s[:token_gap_s]]` (repeatable) assigns latency
-classes round-robin; per-class attainment/goodput come from
-`python tools_serving_report.py <runlog>`.  `--chrome-trace OUT.json`
-turns on the flight recorder (the HETU_TPU_SERVE_TRACE path) and
-renders the per-slot span timeline for Perfetto.  See docs/serving.md.
+`--slo-class name[:ttft_s[:token_gap_s[:priority]]]` (repeatable)
+assigns latency classes round-robin; per-class attainment/goodput come
+from `python tools_serving_report.py <runlog>`.  `--chrome-trace
+OUT.json` turns on the flight recorder (the HETU_TPU_SERVE_TRACE path)
+and renders the per-slot span timeline for Perfetto.
+
+Decoding-subsystem trace modes (docs/serving.md):
+`--sample` builds the in-graph sampling decode program
+(HETU_TPU_SERVE_SAMPLE) and stamps seeded per-request SamplingParams;
+`--spec ngram` runs speculative decoding (the report gains draft
+acceptance counts; tools_serving_report prints the acceptance-rate
+section); `--shared-prefix N` prepends one N-token system prompt to
+every request and turns on the radix prefix cache — the report's
+prefix_cache keys (and tools_serving_report's cache-hit section) show
+the prefill tokens eliminated; `--preempt` arms SLO-class preemptive
+admission (pair with prioritized --slo-class specs, e.g. gold:0.2:-:2).
 """
 from __future__ import annotations
 
@@ -127,6 +142,27 @@ def main(argv=None) -> int:
                          "timeline here (open in Perfetto)")
     ap.add_argument("--per-request", action="store_true",
                     help="include the per-request table in the report")
+    ap.add_argument("--sample", action="store_true",
+                    help="build the sampling decode program "
+                         "(HETU_TPU_SERVE_SAMPLE) and stamp seeded "
+                         "SamplingParams on every request")
+    ap.add_argument("--temperature", type=float, default=0.8,
+                    help="--sample: sampling temperature")
+    ap.add_argument("--top-k", type=int, default=0,
+                    help="--sample: top-k filter (0 = off)")
+    ap.add_argument("--top-p", type=float, default=0.0,
+                    help="--sample: nucleus filter (0 = off)")
+    ap.add_argument("--spec", default=None, metavar="MODE",
+                    help="speculative decoding mode (ngram); the report "
+                         "gains draft acceptance counts")
+    ap.add_argument("--spec-k", type=int, default=4,
+                    help="--spec: draft tokens per verify step")
+    ap.add_argument("--shared-prefix", type=int, default=0, metavar="N",
+                    help="prepend one N-token system prompt to every "
+                         "request and enable the radix prefix cache")
+    ap.add_argument("--preempt", action="store_true",
+                    help="SLO-class preemptive admission (pair with "
+                         "prioritized --slo-class specs)")
     args = ap.parse_args(argv)
 
     from hetu_tpu import serving
@@ -149,14 +185,26 @@ def main(argv=None) -> int:
     mlo, mhi = (int(x) for x in args.max_new.split(","))
     slo_classes = ([serving.SLOClass.parse(s) for s in args.slo_class]
                    if args.slo_class else None)
+    sampling = (serving.SamplingParams(
+        temperature=args.temperature, top_k=args.top_k,
+        top_p=args.top_p, seed=args.seed) if args.sample else None)
     reqs = serving.synthetic_requests(
         n, vocab_size=model.config.vocab_size, prompt_lens=(lo, hi),
         max_new=(mlo, mhi), eos_token_id=args.eos, arrivals=arrivals,
-        slo_classes=slo_classes, seed=args.seed)
+        slo_classes=slo_classes, shared_prefix_len=args.shared_prefix,
+        sampling=sampling, seed=args.seed)
+    if args.shared_prefix and args.max_len < args.shared_prefix + hi + mhi:
+        raise SystemExit(
+            f"--max-len {args.max_len} cannot hold the {args.shared_prefix}"
+            f"-token shared prefix + suffix {hi} + decode budget {mhi}")
 
     cfg_kw = dict(num_slots=args.slots, page_size=args.page,
                   max_len=args.max_len, prefill_chunk=args.chunk,
-                  num_pages=args.pages)
+                  num_pages=args.pages, sampling=args.sample,
+                  preempt=args.preempt,
+                  prefix_cache=bool(args.shared_prefix))
+    if args.spec is not None:
+        cfg_kw.update(spec_decode=args.spec, spec_k=args.spec_k)
     if args.quant is not None:
         cfg_kw["kv_quant"] = args.quant
     cfg = serving.ServeConfig.from_flags(**cfg_kw)
@@ -183,6 +231,19 @@ def main(argv=None) -> int:
     rep["kv_quant"] = cfg.kv_quant
     if slo_classes:
         rep["slo_classes"] = [c.to_dict() for c in slo_classes]
+    if cfg.spec_decode != "none":
+        proposed = sum(r.stats.spec_proposed for r in results)
+        accepted = sum(r.stats.spec_accepted for r in results)
+        rep["spec_decode"] = {
+            "mode": cfg.spec_decode, "k": cfg.spec_k,
+            "drafts_proposed": proposed, "drafts_accepted": accepted,
+            "acceptance_rate": round(accepted / proposed, 4)
+            if proposed else 0.0,
+        }
+    if eng.prefix_cache is not None:
+        rep["prefix_cache"] = eng.prefix_cache.stats()
+    if cfg.preempt:
+        rep["preemptions"] = eng.scheduler.preempted
     if args.per_request:
         rep["per_request"] = [
             {"rid": r.rid, "tokens": len(r.tokens),

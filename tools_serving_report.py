@@ -7,14 +7,20 @@ attainment, goodput and stall attribution from a serving RunLog.
     python tools_serving_report.py /tmp/serve.jsonl --json
     python tools_serving_report.py /tmp/serve.jsonl --per-request --json
 
-Reads the ``serve`` events (admit/done/reshard/report) and — when the
-run traced with ``HETU_TPU_SERVE_TRACE`` — the ``span`` records, all
-through the ONE reader in `hetu_tpu/serving/slo_report.py` (the same
-module `tools_obs_report.py`'s serving section uses; there is no second
-RunLog parser).  With spans present the report adds stall attribution
-(`no_slot` vs `no_pages` queue time) and the span-vs-e2e reconciliation
-check; without them it degrades to the done-event percentile and
-attainment tables.
+Reads the ``serve`` events (admit/done/preempt/reshard/report) and —
+when the run traced with ``HETU_TPU_SERVE_TRACE`` — the ``span``
+records, all through the ONE reader in `hetu_tpu/serving/slo_report.py`
+(the same module `tools_obs_report.py`'s serving section uses; there is
+no second RunLog parser).  With spans present the report adds stall
+attribution (`no_slot` vs `no_pages` vs `preempted` queue time) and the
+span-vs-e2e reconciliation check; without them it degrades to the
+done-event percentile and attainment tables.  Runs that used the
+decoding subsystem gain their sections automatically: speculative
+decoding prints the **acceptance-rate** line (drafts accepted /
+proposed, from the done events), the radix prefix cache prints the
+**cache-hit** line (admissions hit + prefill tokens eliminated, from
+the admit events), and preemptive admission prints victim/preemptor
+class counts.
 
 Pure host-side file munging: no device contact, safe when the TPU
 tunnel is down.  See docs/serving.md (SLO classes) and

@@ -419,6 +419,61 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     }
 
 
+def run_fleet_chaos_demo(workdir: str, plan: FaultPlan, *,
+                         requests: int = 5000, rate: float = 2000.0,
+                         burst: int = 16, num_slots: int = 16,
+                         seed: int = 0) -> Dict[str, Any]:
+    """The ``fleet-storm`` scenario: a bursty MULTI-TENANT arrival storm
+    through the fleet simulator (serving/fleet.py — the real scheduler/
+    page-pool/quota machinery under an analytic clock, no model, no
+    device) while the plan's ``slow_worker`` windows inflate the modeled
+    step time, exactly like the live engine's on_step sleep inflates its
+    wall clock.  Three tenants ride the storm — ``acme`` (gold-classed,
+    preemption-armed), ``bigco`` (bulk) and ``free`` (bulk, quota-capped
+    at a few slots/pages) — so the recovery report answers what the
+    slowdown cost PER TENANT: attainment/goodput from the simulator's
+    exact ledger plus the sampled-RunLog view through
+    `serving/slo_report.py` (they must agree; the fleet tests pin it).
+
+    Hardware-free and fast: tens of thousands of requests cost seconds,
+    so this is the chaos schedule that can afford fleet-scale load."""
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.serving import slo_report
+    from hetu_tpu.serving.fleet import (FleetConfig, FleetSimulator,
+                                        analytic_models, fleet_workload)
+    from hetu_tpu.serving.request import SLOClass, parse_quotas
+
+    classes = [SLOClass("gold", ttft_s=0.05, token_gap_s=0.02,
+                        priority=2),
+               SLOClass("bulk"), SLOClass("bulk")]
+    reqs = fleet_workload(requests, rate_per_s=rate, burst=burst,
+                          tenants=("acme", "bigco", "free"),
+                          slo_classes=classes, prompt_lens=(8, 48),
+                          max_new=(4, 16), seed=seed)
+    svc, cost = analytic_models(num_params=1e9, num_layers=16,
+                                hidden_size=2048, num_kv_heads=8,
+                                head_dim=128, page_size=16)
+    cfg = FleetConfig(num_slots=num_slots, page_size=16, max_len=128,
+                      prefill_chunk=32, preempt=True,
+                      quotas=parse_quotas("free:2:16"))
+    log_path = os.path.join(workdir, "fleet_chaos.jsonl")
+    run_log = RunLog(log_path)
+    sim = FleetSimulator(svc, config=cfg, cost_model=cost,
+                         run_log=run_log, fault_plan=plan)
+    fleet = sim.run(reqs)
+    run_log.close()
+    slo = slo_report.serving_report(RunLog.read(log_path))
+    return {
+        "completed": fleet["completed"] == len(reqs),
+        "requests": fleet["completed"],
+        "sim_steps": fleet["steps"],
+        "injected": plan.summary(),
+        "fleet": fleet,
+        "slo": slo,
+        "runlog": log_path,
+    }
+
+
 # ------------------------------------------------------------ schedules
 def named_plan(name: str, **kw) -> FaultPlan:
     """Built-in schedules for the replay CLI and the acceptance test."""
@@ -476,6 +531,18 @@ def named_plan(name: str, **kw) -> FaultPlan:
                       count=kw.get("count", 16),
                       delay_s=kw.get("delay_s", 0.25)),
         ])
+    if name == "fleet-storm":
+        # the fleet scenario (run_fleet_chaos_demo): a multi-tenant
+        # burst storm through the discrete-event fleet simulator with a
+        # slow-service window — step_delay() inflates the MODELED step
+        # time (no wall sleep), so the per-tenant attainment/goodput/cost
+        # report shows who paid for the slowdown at fleet scale
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="slow_worker", rank=0,
+                      at_step=kw.get("at_step", 50),
+                      count=kw.get("count", 200),
+                      delay_s=kw.get("delay_s", 0.02)),
+        ])
     if name == "stall":
         # a heartbeat stall longer than the server timeout: the classic
         # long-XLA-compile false positive — the stalled worker is declared
@@ -486,4 +553,4 @@ def named_plan(name: str, **kw) -> FaultPlan:
         ])
     raise ValueError(f"unknown schedule {name!r}; known: "
                      "kill-partition-corrupt, partition, corrupt, stall, "
-                     "slow, serve-burst, serve-preempt")
+                     "slow, serve-burst, serve-preempt, fleet-storm")

@@ -21,6 +21,7 @@ from __future__ import annotations
 
 import json
 import math
+import random
 import threading
 import time
 from typing import Any, Dict, Iterable, List, Optional, Tuple
@@ -49,21 +50,25 @@ class Histogram:
     """Bounded-reservoir timing histogram.
 
     Keeps the first `cap` observations verbatim plus running count/sum/
-    min/max for everything; past the cap, new values overwrite reservoir
-    slots round-robin so long runs keep a recent-ish sample while the
-    aggregate stats stay exact."""
+    min/max for everything; past the cap, the reservoir is maintained by
+    uniform sampling (Vitter's Algorithm R): observation number k > cap
+    replaces a random slot with probability cap/k, so the sample stays a
+    uniform draw over the WHOLE run, not a sliding window of the tail —
+    whole-run percentiles over 10^6 observations still see early-run
+    outliers.  The RNG is seeded per-histogram (deterministic; the
+    unseeded-rng lint and the golden tests both rely on that)."""
 
-    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_sample", "_next",
+    __slots__ = ("cap", "count", "total", "vmin", "vmax", "_sample", "_rng",
                  "nonfinite")
 
-    def __init__(self, cap: int = 2048):
+    def __init__(self, cap: int = 2048, seed: int = 0):
         self.cap = cap
         self.count = 0
         self.total = 0.0
         self.vmin: Optional[float] = None
         self.vmax: Optional[float] = None
         self._sample: List[float] = []
-        self._next = 0
+        self._rng = random.Random(seed)
         self.nonfinite = 0
 
     def observe(self, value: float):
@@ -82,8 +87,11 @@ class Histogram:
         if len(self._sample) < self.cap:
             self._sample.append(v)
         else:
-            self._sample[self._next] = v
-            self._next = (self._next + 1) % self.cap
+            # Algorithm R: keep this value with probability cap/count,
+            # evicting a uniformly random resident
+            j = self._rng.randrange(self.count)
+            if j < self.cap:
+                self._sample[j] = v
 
     def percentile(self, p: float) -> Optional[float]:
         """p in [0, 100] over the reservoir (exact until `cap` samples)."""

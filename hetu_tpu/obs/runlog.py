@@ -27,18 +27,26 @@ Kind fields:
                   the serving SLO fields (hetu_tpu/serving,
                   docs/serving.md); every event also stamps `now`
                   (driver-clock seconds — the engine's virtual clock,
-                  matching span t0/t1):
+                  matching span t0/t1); per-request events (admit/done/
+                  preempt) carry `tenant` and, on a sampled RunLog
+                  (HETU_TPU_RUNLOG_SERVE_SAMPLE > 1), `sample_weight`
+                  (how many requests the sampled record stands for —
+                  slo_report re-weights by it):
                   admit: req, slot, prompt_len, chunks, ttft_s,
-                  queue_wait_s, slo_class, shared_tokens (prompt tokens
-                  resident via the radix prefix cache — 0 on a miss),
-                  queue_depth, page_util;
+                  queue_wait_s, slo_class, tenant, shared_tokens (prompt
+                  tokens resident via the radix prefix cache — 0 on a
+                  miss), queue_depth, page_util;
                   done: req, reason, tokens, ttft_s, e2e_s, tokens_per_s,
-                  slo_class, slo_ttft_s, slo_token_gap_s, spec_proposed/
-                  spec_accepted (speculative-decoding draft counts),
-                  shared_prefix_tokens, prompt_len, preemptions,
-                  queue_depth, slot_occupancy, page_util;
+                  slo_class, tenant, slo_ttft_s, slo_token_gap_s,
+                  spec_proposed/spec_accepted (speculative-decoding
+                  draft counts), shared_prefix_tokens, prompt_len,
+                  preemptions, queue_depth, slot_occupancy, page_util,
+                  + the cost-ledger fields when the run priced requests
+                  (serving/costs.py COST_FIELDS: cost_prefill_flops,
+                  cost_decode_flops, cost_page_s, cost_kv_byte_s,
+                  cost_wire_bytes);
                   preempt: req, slot, by (the preemptor rid), by_class,
-                  slo_class (the victim's), tokens_discarded,
+                  slo_class (the victim's), tenant, tokens_discarded,
                   queue_depth — one per HETU_TPU_SERVE_PREEMPT
                   evict-and-requeue;
                   reshard: tier, strategy, pause_s; report: requests,
@@ -51,8 +59,9 @@ Kind fields:
                   (driver-clock seconds; spans of one request tile
                   [arrival, done] — durations sum to its e2e_s), plus
                   per-kind attrs: queued carries reason
-                  (none|no_slot|no_pages — the scheduler's
-                  reserve-on-admit stall attribution), prefill carries
+                  (none|no_slot|no_pages|preempted|quota_exceeded — the
+                  scheduler's reserve-on-admit stall attribution,
+                  obs/spans.py STALL_REASONS), prefill carries
                   chunk (+ last on the TTFT chunk), decode carries
                   tokens/segment/end, reshard_pause carries tier, the
                   zero-duration terminals carry reason/tokens/e2e_s

@@ -8,7 +8,9 @@ RunLog gives every training step a record:
                    reason the scheduler's reserve-on-admit decision
                    produced (``none`` = admitted without waiting,
                    ``no_slot`` = every decode slot was live,
-                   ``no_pages`` = the full page reservation was short)
+                   ``no_pages`` = the full page reservation was short,
+                   ``quota_exceeded`` = the tenant was over its
+                   admission quota)
     prefill        one span per prefill chunk (the disaggregated chunk
                    program); the last chunk's span ends at TTFT
     decode         a decode segment — split at evictions and reshard
@@ -50,8 +52,12 @@ SPAN_KINDS = ("queued", "prefill", "decode", "reshard_pause",
 TERMINAL_KINDS = ("done", "evicted")
 #: ``preempted`` marks a RE-queued span: the request was evicted by a
 #: higher-priority admission (HETU_TPU_SERVE_PREEMPT) and waits again —
-#: same trace, so the tiling/reconciliation contract still holds
-STALL_REASONS = ("none", "no_slot", "no_pages", "preempted")
+#: same trace, so the tiling/reconciliation contract still holds.
+#: ``quota_exceeded`` means the head request's TENANT was over its
+#: admission quota (slots or pages; HETU_TPU_SERVE_QUOTAS) even though
+#: the pool itself could have served it.
+STALL_REASONS = ("none", "no_slot", "no_pages", "preempted",
+                 "quota_exceeded")
 
 #: span-record fields that are structure, not attrs
 _CORE_FIELDS = ("schema", "kind", "t", "span_schema", "span", "trace",

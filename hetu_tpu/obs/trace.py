@@ -326,7 +326,7 @@ def _emit_run_events(tr: ChromeTrace, recs: List[Dict[str, Any]],
                                else f"serve {ev}", ts, pid=pid,
                                tid="serving", cat=f"serve:{ev}",
                                args={k: r[k] for k in
-                                     ("slot", "reason", "tier",
+                                     ("slot", "reason", "tier", "tenant",
                                       "queue_depth", "page_util")
                                      if r.get(k) is not None})
 
@@ -462,7 +462,8 @@ def serving_trace(records: Iterable[Dict[str, Any]], *,
                            if r.get("req") is not None else ev,
                            ts, pid=pid, tid="events", cat=f"serve:{ev}",
                            args={k: r[k] for k in
-                                 ("slot", "reason", "tier", "slo_class")
+                                 ("slot", "reason", "tier", "slo_class",
+                                  "tenant")
                                  if r.get(k) is not None})
         counters = {k: r[k] for k in ("queue_depth", "page_util")
                     if r.get(k) is not None}

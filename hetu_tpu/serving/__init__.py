@@ -13,7 +13,13 @@ serving flags (HETU_TPU_KV_QUANT, HETU_TPU_SERVE_TRACE + the
 serve-shape flags) are read only inside this package, so leaving them
 unset cannot perturb any training program.
 """
+from hetu_tpu.serving.costs import (COST_FIELDS,  # noqa: F401
+                                    CostLedger, CostModel,
+                                    aggregate_costs)
 from hetu_tpu.serving.engine import ServeConfig, ServingEngine  # noqa: F401
+from hetu_tpu.serving.fleet import (FleetConfig,  # noqa: F401
+                                    FleetSimulator, ServiceModel,
+                                    analytic_models, fleet_workload)
 from hetu_tpu.serving.kv_pool import (PagePool,  # noqa: F401
                                       PoolArrays, kv_bytes_per_token)
 from hetu_tpu.serving.prefix_cache import (RadixPrefixCache,  # noqa: F401
@@ -21,7 +27,8 @@ from hetu_tpu.serving.prefix_cache import (RadixPrefixCache,  # noqa: F401
 from hetu_tpu.serving.request import (DEFAULT_SLO, GREEDY,  # noqa: F401
                                       Request, RequestResult,
                                       RequestStats, SamplingParams,
-                                      SLOClass)
+                                      SLOClass, TenantQuota,
+                                      parse_quotas, rid_sampled)
 from hetu_tpu.serving.reshard import LoadAdaptiveMesh  # noqa: F401
 from hetu_tpu.serving.scheduler import Scheduler, SlotState  # noqa: F401
 from hetu_tpu.serving.slo_report import (serving_report,  # noqa: F401
@@ -36,10 +43,14 @@ from hetu_tpu.serving.tracing import (RequestTracer,  # noqa: F401
 
 __all__ = [
     "ServingEngine", "ServeConfig",
+    "FleetSimulator", "FleetConfig", "ServiceModel", "analytic_models",
+    "fleet_workload",
+    "CostModel", "CostLedger", "COST_FIELDS", "aggregate_costs",
     "PagePool", "PoolArrays", "kv_bytes_per_token",
     "RadixPrefixCache", "maybe_prefix_cache",
     "Request", "RequestResult", "RequestStats", "SLOClass", "DEFAULT_SLO",
     "SamplingParams", "GREEDY",
+    "TenantQuota", "parse_quotas", "rid_sampled",
     "Scheduler", "SlotState",
     "LoadAdaptiveMesh",
     "Drafter", "NGramDrafter", "CallableDrafter", "make_drafter",

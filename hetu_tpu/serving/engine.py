@@ -65,7 +65,8 @@ from hetu_tpu.obs.health import maybe_serving_health_monitor
 from hetu_tpu.obs.metrics import MetricsRegistry, get_registry
 from hetu_tpu.obs.runlog import RunLog, default_runlog_path
 from hetu_tpu.serving.kv_pool import PagePool, PoolArrays
-from hetu_tpu.serving.request import Request, RequestResult
+from hetu_tpu.serving.request import (Request, RequestResult,
+                                      rid_sampled)
 from hetu_tpu.serving.scheduler import Scheduler
 from hetu_tpu.serving.tracing import maybe_tracer
 from hetu_tpu.utils.logging import get_logger
@@ -114,6 +115,18 @@ class ServeConfig:
     #: under slot/page pressure a strictly-higher-priority queued
     #: request evicts-and-requeues the lowest-priority live slot
     preempt: bool = False
+    #: per-tenant admission quotas (HETU_TPU_SERVE_QUOTAS,
+    #: serving/request.py TenantQuota): caps each tenant's LIVE
+    #: slots/pages at admission; {} (default) = quota-free — the
+    #: admission path is byte-identical to the pre-tenant engine
+    quotas: dict = dataclasses.field(default_factory=dict)
+    #: serve-event RunLog sampling (HETU_TPU_RUNLOG_SERVE_SAMPLE): only
+    #: a deterministic hashed 1-in-N of rids (request.py rid_sampled)
+    #: emit admit/done/preempt events,
+    #: stamped sample_weight=N (slo_report re-weights).  Registry
+    #: counters stay exact.  1 (default) = every event, byte-identical
+    #: RunLog to the pre-sampling engine
+    serve_sample: int = 1
 
     def __post_init__(self):
         if self.max_len % self.page_size:
@@ -139,6 +152,9 @@ class ServeConfig:
                 "('none', 'ngram')")
         if self.spec_decode != "none" and self.spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
+        if self.serve_sample < 1:
+            raise ValueError(f"serve_sample must be >= 1, "
+                             f"got {self.serve_sample}")
         if self.num_pages == 0:
             self.num_pages = self.num_slots * (self.max_len
                                                // self.page_size)
@@ -155,6 +171,7 @@ class ServeConfig:
         """Defaults from the serving flag surface (utils/flags.py:
         HETU_TPU_KV_QUANT + the serve-shape flags); explicit kwargs
         win."""
+        from hetu_tpu.serving.request import parse_quotas
         from hetu_tpu.utils import flags
         vals = dict(
             num_slots=flags.int_flag("HETU_TPU_SERVE_SLOTS"),
@@ -170,6 +187,8 @@ class ServeConfig:
             prefix_cache=flags.bool_flag("HETU_TPU_SERVE_PREFIX_CACHE"),
             prefix_cache_pages=flags.int_flag("HETU_TPU_SERVE_PREFIX_PAGES"),
             preempt=flags.bool_flag("HETU_TPU_SERVE_PREEMPT"),
+            quotas=parse_quotas(flags.str_flag("HETU_TPU_SERVE_QUOTAS")),
+            serve_sample=flags.int_flag("HETU_TPU_RUNLOG_SERVE_SAMPLE"),
         )
         vals.update(overrides)
         return ServeConfig(**vals)
@@ -182,7 +201,7 @@ class ServingEngine:
                  *, run_log: Optional[RunLog] = None,
                  registry: Optional[MetricsRegistry] = None,
                  reshard=None, tracer=None, health=None,
-                 telemetry=None, drafter=None):
+                 telemetry=None, drafter=None, cost_model=None):
         self.model = model
         self.params = params
         self.config = config or ServeConfig.from_flags()
@@ -206,7 +225,16 @@ class ServingEngine:
                                    pool=self.pool,
                                    max_len=self.config.max_len,
                                    prefix_cache=self.prefix_cache,
-                                   lookahead=self.config.lookahead)
+                                   lookahead=self.config.lookahead,
+                                   quotas=self.config.quotas)
+        # per-request cost ledger (serving/costs.py): when a CostModel
+        # rides along, every done event carries analytic cost_* fields
+        # (prefill/decode FLOPs, page-seconds, KV byte-seconds, wire
+        # bytes) for slo_report's per-tenant cost attribution
+        self.ledger = None
+        if cost_model is not None:
+            from hetu_tpu.serving.costs import CostLedger
+            self.ledger = CostLedger(cost_model)
         # speculative decoding (serving/spec_decode.py): host drafter +
         # the batched verify program built below; `drafter=` overrides
         # the config mode with any Drafter instance (a small draft
@@ -597,6 +625,21 @@ class ServingEngine:
         if self.tracer is not None:
             self.tracer.on_submit(req)
 
+    def _sampled(self, rid: int) -> bool:
+        """Does `rid` emit per-request serve events?  Deterministic
+        hashed 1-in-N (HETU_TPU_RUNLOG_SERVE_SAMPLE, request.py
+        `rid_sampled`) — the same requests are sampled on every replay,
+        and N=1 (the default) keeps the RunLog byte-identical to the
+        pre-sampling engine.  Registry counters are never sampled."""
+        return rid_sampled(rid, self.config.serve_sample)
+
+    def _weight_fields(self) -> dict:
+        """The sample_weight stamp for sampled per-request events (only
+        when sampling is actually on — the N=1 record shape is
+        unchanged)."""
+        n = self.config.serve_sample
+        return {"sample_weight": n} if n > 1 else {}
+
     def _log_serve(self, **fields):
         """One serve event to every attached sink: the RunLog and (when
         a TelemetrySource rides along) the cluster telemetry push."""
@@ -636,6 +679,8 @@ class ServingEngine:
                 break
             slot_idx, st = adm
             st.prefilling = True
+            if self.ledger is not None:
+                self.ledger.on_admit(st.request.rid, len(st.pages), t_adm)
             self._start_prefill(slot_idx, st, t_adm)
             if self.tracer is not None:
                 self.tracer.on_admit(st.request, slot_idx, t_adm,
@@ -726,6 +771,15 @@ class ServingEngine:
         self._registry.set_gauge("serve.slot_occupancy",
                                  self.scheduler.occupancy)
         self._registry.set_gauge("serve.page_util", self.pool.utilization)
+        for t in self.config.quotas:
+            # quota gauges: each quota'd tenant's live usage, so a
+            # registry snapshot shows who is pinned at their cap
+            self._registry.set_gauge("serve.tenant_slots",
+                                     self.scheduler.tenant_slots.get(t, 0),
+                                     tenant=t)
+            self._registry.set_gauge("serve.tenant_pages",
+                                     self.scheduler.tenant_pages.get(t, 0),
+                                     tenant=t)
         if self.health is not None:
             self.health.observe_step(
                 self.steps_done, queue_depth=self.scheduler.queue_depth,
@@ -831,17 +885,26 @@ class ServingEngine:
         carried["spec_proposed"] += st.stats.spec_proposed
         carried["spec_accepted"] += st.stats.spec_accepted
         carried["prefill_chunks"] += st.stats.prefill_chunks
+        if self.ledger is not None:
+            # the victim's computed-but-discarded work is part of what
+            # the request truly cost (it re-runs on re-admission)
+            self.ledger.on_preempt(req.rid, now,
+                                   ctx_start=st.shared_tokens,
+                                   tokens_cached=st.pos)
         self.scheduler.preempt(victim)
         self._registry.inc("serve.preemptions")
         self._registry.inc("serve.preemptions_class",
                            slo_class=req.slo.name)
         if self.tracer is not None:
             self.tracer.on_preempt(req, victim, now, by=head.rid)
-        self._log_serve(event="preempt", req=req.rid, slot=victim,
-                        by=head.rid, by_class=head.slo.name,
-                        slo_class=req.slo.name, now=now,
-                        tokens_discarded=len(st.generated),
-                        queue_depth=self.scheduler.queue_depth)
+        if self._sampled(req.rid):
+            self._log_serve(event="preempt", req=req.rid, slot=victim,
+                            by=head.rid, by_class=head.slo.name,
+                            slo_class=req.slo.name, tenant=req.tenant,
+                            now=now,
+                            tokens_discarded=len(st.generated),
+                            queue_depth=self.scheduler.queue_depth,
+                            **self._weight_fields())
         return True
 
     def _first_token(self, req, logits_row, position: int) -> int:
@@ -958,14 +1021,16 @@ class ServingEngine:
                                        chunk=st.chunks_done)
         if self.health is not None:
             self.health.observe_ttft(ttft, step=self.steps_done, t=tnow)
-        self._log_serve(event="admit", req=req.rid,
-                        slot=slot_idx, prompt_len=plen,
-                        chunks=st.stats.prefill_chunks, ttft_s=ttft,
-                        queue_wait_s=st.stats.queue_wait_s, now=tnow,
-                        slo_class=req.slo.name,
-                        shared_tokens=st.shared_tokens,
-                        queue_depth=self.scheduler.queue_depth,
-                        page_util=self.pool.utilization)
+        if self._sampled(req.rid):
+            self._log_serve(event="admit", req=req.rid,
+                            slot=slot_idx, prompt_len=plen,
+                            chunks=st.stats.prefill_chunks, ttft_s=ttft,
+                            queue_wait_s=st.stats.queue_wait_s, now=tnow,
+                            slo_class=req.slo.name, tenant=req.tenant,
+                            shared_tokens=st.shared_tokens,
+                            queue_depth=self.scheduler.queue_depth,
+                            page_util=self.pool.utilization,
+                            **self._weight_fields())
         self._maybe_finish(slot_idx, st, t1, tnow, finished)
 
     # ----------------------------------------------------------- finish
@@ -1001,21 +1066,30 @@ class ServingEngine:
             st.stats.spec_proposed += carried["spec_proposed"]
             st.stats.spec_accepted += carried["spec_accepted"]
             st.stats.prefill_chunks += carried["prefill_chunks"]
-        self._log_serve(
-            event="done", req=req.rid, slot=slot_idx,
-            reason=reason, tokens=len(res.tokens),
-            ttft_s=st.stats.ttft_s, e2e_s=st.stats.e2e_s,
-            tokens_per_s=res.tokens_per_s, now=tnow,
-            slo_class=req.slo.name,
-            slo_ttft_s=req.slo.ttft_s, slo_token_gap_s=req.slo.token_gap_s,
-            spec_proposed=st.stats.spec_proposed,
-            spec_accepted=st.stats.spec_accepted,
-            shared_prefix_tokens=st.stats.shared_prefix_tokens,
-            prompt_len=req.prompt_len,
-            preemptions=st.stats.preemptions,
-            queue_depth=self.scheduler.queue_depth,
-            slot_occupancy=self.scheduler.occupancy,
-            page_util=self.pool.utilization)
+        cost = {}
+        if self.ledger is not None:
+            cost = self.ledger.finish(
+                req.rid, tnow, prompt_len=req.prompt_len,
+                shared_tokens=st.stats.shared_prefix_tokens,
+                tokens_out=len(res.tokens))
+        if self._sampled(req.rid):
+            self._log_serve(
+                event="done", req=req.rid, slot=slot_idx,
+                reason=reason, tokens=len(res.tokens),
+                ttft_s=st.stats.ttft_s, e2e_s=st.stats.e2e_s,
+                tokens_per_s=res.tokens_per_s, now=tnow,
+                slo_class=req.slo.name, tenant=req.tenant,
+                slo_ttft_s=req.slo.ttft_s,
+                slo_token_gap_s=req.slo.token_gap_s,
+                spec_proposed=st.stats.spec_proposed,
+                spec_accepted=st.stats.spec_accepted,
+                shared_prefix_tokens=st.stats.shared_prefix_tokens,
+                prompt_len=req.prompt_len,
+                preemptions=st.stats.preemptions,
+                queue_depth=self.scheduler.queue_depth,
+                slot_occupancy=self.scheduler.occupancy,
+                page_util=self.pool.utilization,
+                **cost, **self._weight_fields())
         finished.append(res)
 
     # -------------------------------------------------------------- run

@@ -1,0 +1,722 @@
+"""Fleet observatory: a seeded discrete-event simulator driving the REAL
+serving state machines hardware-free.
+
+ROADMAP item 1's proving ground: every fleet-scale policy question
+(multi-tenant quotas, preemption fairness, prefix-cache sizing) is
+answered by replaying 10^6+ requests through the SAME host-side state
+machines the live engine runs — `Scheduler` (admission, reserve-on-
+admit, preemption), `PagePool`/`RadixPrefixCache` (COW refcounts, LRU
+eviction), `RequestTracer` spans and the SLO/priority policies — under a
+virtual clock whose per-step service times come from a pluggable
+analytic `ServiceModel` (the bench.py ``detail.serving`` roofline:
+params read once per step, every slot reads its context KV), NOT from
+running any jax program.  No jax math anywhere in the hot loop: a
+million requests complete in seconds, and `check_invariants()` + span
+reconciliation fuzz at a scale the jitted tests cannot reach.
+
+What is simulated vs real:
+
+* REAL: admission order, page allocation/eviction/refcounts, tenant
+  quotas, preemption victims, span tiling, stall attribution — every
+  policy decision is made by the production code path.
+* MODELED: step durations (`ServiceModel` roofline) and token values
+  (requests always finish by length; no logits exist).  A chaos
+  `FaultPlan`'s ``slow_worker`` windows inflate the modeled step time
+  exactly like the engine's on_step hook inflates the wall clock.
+
+Accounting is EXACT regardless of RunLog sampling: per-(tenant, class)
+aggregates (attainment, goodput, latency reservoirs, stall and cost
+attribution) are accumulated in memory for every request, while serve
+events / spans are emitted for a deterministic 1-in-N sample of
+requests (``HETU_TPU_RUNLOG_SERVE_SAMPLE``) with ``sample_weight`` so
+`slo_report.py` stays unbiased.  The report is derived ONLY from the
+virtual clock — same seed + trace, byte-identical `tools_fleet.py
+--json` output (docs/serving.md).
+"""
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Dict, List, Optional, Sequence
+
+from hetu_tpu.obs.metrics import Histogram
+from hetu_tpu.serving.costs import COST_FIELDS, CostLedger, CostModel
+from hetu_tpu.serving.kv_pool import PagePool, kv_bytes_per_token
+from hetu_tpu.serving.request import Request, TenantQuota, rid_sampled
+from hetu_tpu.serving.scheduler import Scheduler
+from hetu_tpu.serving.tracing import RequestTracer
+
+#: bump when the `tools_fleet.py --json` report shape changes
+FLEET_SCHEMA = 1
+
+
+@dataclasses.dataclass(frozen=True)
+class ServiceModel:
+    """Analytic per-step service times: the roofline bench.py's
+    ``detail.serving`` record prices decode with (params read once per
+    step, each slot reads its own context KV; FLOPs = 2N per token +
+    4*L*hidden per cached position), turned into a pluggable clock for
+    the simulator.  Frozen + pure arithmetic — deterministic and safe
+    in the 10^6-request hot loop."""
+    #: matmul FLOPs per computed token (2 * N_params)
+    flops_per_token: float
+    #: attention FLOPs per computed token per cached context position
+    attn_flops_per_ctx: float
+    #: parameter bytes streamed once per step (bf16 = 2 * N_params)
+    param_bytes: float
+    #: cache bytes per resident token position (kv_pool byte model)
+    kv_bytes_per_token: float
+    #: chip peak (obs/mfu hardware profile)
+    peak_flops: float
+    hbm_bytes_per_s: float
+    #: fixed per-step host/dispatch overhead
+    step_overhead_s: float = 50e-6
+
+    @staticmethod
+    def from_hardware_profile(*, num_params: float, num_layers: int,
+                              hidden_size: int, num_kv_heads: int,
+                              head_dim: int, kv_mode: str = "fp16",
+                              hw: Optional[dict] = None,
+                              step_overhead_s: float = 50e-6
+                              ) -> "ServiceModel":
+        """Calibrate from the profiled chip (obs/mfu
+        `load_hardware_profile`) + model dimensions — the exact inputs
+        bench.py's serving roofline uses, so simulated tokens/s and the
+        BENCH record can never disagree on the formula."""
+        if hw is None:
+            from hetu_tpu.obs.mfu import load_hardware_profile
+            hw = load_hardware_profile()
+        return ServiceModel(
+            flops_per_token=2.0 * float(num_params),
+            attn_flops_per_ctx=4.0 * num_layers * hidden_size,
+            param_bytes=2.0 * float(num_params),
+            kv_bytes_per_token=kv_bytes_per_token(
+                num_layers, num_kv_heads, head_dim, kv_mode),
+            peak_flops=float(hw["bf16_tflops"]) * 1e12,
+            hbm_bytes_per_s=float(hw["hbm_gbps"]) * 1e9,
+            step_overhead_s=step_overhead_s)
+
+    def to_dict(self) -> dict:
+        return dataclasses.asdict(self)
+
+    def prefill_chunk_s(self, chunk: int, ctx: int) -> float:
+        """One padded prefill chunk of `chunk` tokens starting at cache
+        position `ctx` (static shapes: the PADDED chunk runs)."""
+        flops = (self.flops_per_token * chunk
+                 + self.attn_flops_per_ctx
+                 * (ctx * chunk + chunk * (chunk - 1) / 2.0))
+        bytes_ = (self.param_bytes
+                  + (ctx + chunk) * self.kv_bytes_per_token)
+        return max(flops / self.peak_flops,
+                   bytes_ / self.hbm_bytes_per_s) + self.step_overhead_s
+
+    def decode_step_s(self, slots: int, kv_tokens: int) -> float:
+        """One batched decode step: `slots` active rows, `kv_tokens`
+        total resident context positions read."""
+        if slots <= 0:
+            return 0.0
+        flops = (self.flops_per_token * slots
+                 + self.attn_flops_per_ctx * kv_tokens)
+        bytes_ = self.param_bytes + kv_tokens * self.kv_bytes_per_token
+        return max(flops / self.peak_flops,
+                   bytes_ / self.hbm_bytes_per_s) + self.step_overhead_s
+
+
+def analytic_models(*, num_params: float, num_layers: int,
+                    hidden_size: int, num_kv_heads: int, head_dim: int,
+                    page_size: int, kv_mode: str = "fp16",
+                    hw: Optional[dict] = None
+                    ) -> "tuple[ServiceModel, CostModel]":
+    """The matched (ServiceModel, CostModel) pair for one model+chip:
+    time and cost priced from the same dimensions, so a fleet report's
+    latency and FLOPs columns describe the same machine."""
+    svc = ServiceModel.from_hardware_profile(
+        num_params=num_params, num_layers=num_layers,
+        hidden_size=hidden_size, num_kv_heads=num_kv_heads,
+        head_dim=head_dim, kv_mode=kv_mode, hw=hw)
+    cost = CostModel.from_model_dims(
+        num_params=num_params, num_layers=num_layers,
+        hidden_size=hidden_size, num_kv_heads=num_kv_heads,
+        head_dim=head_dim, page_size=page_size, kv_mode=kv_mode)
+    return svc, cost
+
+
+@dataclasses.dataclass
+class FleetConfig:
+    """Simulator shape — mirrors ServeConfig's host-side knobs (the sim
+    has no device-side ones)."""
+    num_slots: int = 64
+    page_size: int = 16
+    max_len: int = 512
+    prefill_chunk: int = 64
+    num_pages: int = 0            # 0 = full reservation for every slot
+    prefix_cache: bool = False
+    prefix_cache_pages: int = 0   # 0 = unbounded (insert-budget off)
+    preempt: bool = False
+    quotas: Dict[str, TenantQuota] = dataclasses.field(default_factory=dict)
+    #: run check_invariants() every N sim steps (plus once at the end);
+    #: 0 disables the periodic sweep (the final check still runs)
+    invariant_every: int = 997
+    #: serve-event/span sampling: 1-in-N requests reach the RunLog/
+    #: tracer; 0 = read HETU_TPU_RUNLOG_SERVE_SAMPLE (default 1 = all)
+    sample: int = 0
+
+
+class _Bucket:
+    """Exact per-(tenant, class) accumulator — every request lands here
+    regardless of RunLog sampling."""
+
+    __slots__ = ("requests", "tokens", "slo_ok", "goodput_tokens",
+                 "preemptions", "stalls", "ttft", "e2e", "queue_wait",
+                 "costs")
+
+    def __init__(self):
+        self.requests = 0
+        self.tokens = 0
+        self.slo_ok = 0
+        self.goodput_tokens = 0
+        self.preemptions = 0
+        self.stalls: Dict[str, int] = {}
+        # seeded reservoirs: deterministic percentiles at any count
+        self.ttft = Histogram()
+        self.e2e = Histogram()
+        self.queue_wait = Histogram()
+        self.costs = {k: 0.0 for k in COST_FIELDS}
+
+
+def _merge_hist(dst: Histogram, src: Histogram):
+    """Fold `src`'s reservoir + exact running stats into `dst` (used to
+    roll per-(tenant, class) buckets up to per-tenant / per-class rows).
+    The merged reservoir is approximate but deterministic; count/total/
+    min/max stay exact."""
+    for v in src._sample:
+        dst.observe(v)
+    # the observes above counted only the reservoir; correct the running
+    # stats to src's exact values
+    dst.count += src.count - len(src._sample)
+    dst.total += src.total - sum(src._sample)
+    if src.vmin is not None:
+        dst.vmin = (src.vmin if dst.vmin is None
+                    else min(dst.vmin, src.vmin))
+    if src.vmax is not None:
+        dst.vmax = (src.vmax if dst.vmax is None
+                    else max(dst.vmax, src.vmax))
+
+
+def _hist_summary(h: Histogram) -> Optional[Dict[str, Any]]:
+    if not h.count:
+        return None
+    return {"mean": h.total / h.count, "p50": h.percentile(50),
+            "p95": h.percentile(95), "p99": h.percentile(99),
+            "max": h.vmax}
+
+
+class FleetSimulator:
+    """Discrete-event replay of a request trace through the production
+    scheduler/page-pool/prefix-cache/preemption machinery.
+
+    One instance = one run: construct, `run(requests)`, read the
+    returned report (or `report()` again later).  Wire a RunLog to get
+    the sampled serve/span event stream every serving tool understands;
+    wire a chaos `FaultPlan` to inflate service times through its
+    ``slow_worker`` windows (`step_delay`)."""
+
+    def __init__(self, service: ServiceModel, *,
+                 config: Optional[FleetConfig] = None,
+                 cost_model: Optional[CostModel] = None,
+                 run_log=None, registry=None, fault_plan=None):
+        cfg = config or FleetConfig()
+        self.cfg = cfg
+        self.service = service
+        self.run_log = run_log
+        self.registry = registry
+        self.fault_plan = fault_plan
+        pages = cfg.num_pages or cfg.num_slots * (cfg.max_len
+                                                  // cfg.page_size)
+        # the REAL pool/scheduler/cache — host-side only (no device
+        # arrays): policy decisions come from the production code path
+        self.pool = PagePool(num_layers=1, num_pages=pages,
+                             page_size=cfg.page_size, num_kv_heads=1,
+                             head_dim=1, device_arrays=False)
+        self.prefix_cache = None
+        if cfg.prefix_cache:
+            from hetu_tpu.serving.prefix_cache import RadixPrefixCache
+            self.prefix_cache = RadixPrefixCache(
+                self.pool, max_pages=cfg.prefix_cache_pages)
+        self.sched = Scheduler(num_slots=cfg.num_slots, pool=self.pool,
+                               max_len=cfg.max_len,
+                               prefix_cache=self.prefix_cache,
+                               quotas=cfg.quotas)
+        self.ledger = (CostLedger(cost_model)
+                       if cost_model is not None else None)
+        if cfg.sample:
+            self.sample = cfg.sample
+        else:
+            from hetu_tpu.utils import flags
+            self.sample = max(
+                1, flags.int_flag("HETU_TPU_RUNLOG_SERVE_SAMPLE"))
+        # the real flight recorder over the SAMPLED requests (keep=True:
+        # the end-of-run reconciliation sweep reads the kept traces)
+        self.tracer = RequestTracer(run_log=run_log, keep=True,
+                                    max_kept=1 << 20)
+        # ---- exact accounting (per request, sampling-independent)
+        self._buckets: Dict[tuple, _Bucket] = {}
+        self._first_reason: Dict[int, str] = {}
+        self._enter_seq: Dict[int, int] = {}
+        self._preempt_counts: Dict[int, int] = {}
+        self._stall_seq = 0
+        self._stall_reason = "none"
+        self.stall_steps: Dict[str, int] = {}
+        self.quota_peaks: Dict[str, Dict[str, int]] = {}
+        self.submitted = 0
+        self.completed = 0
+        self.tokens_out = 0
+        self.prefill_chunks = 0
+        self.preemptions = 0
+        self.steps = 0
+        self.invariant_checks = 0
+        self._start = 0.0
+        self._end = 0.0
+
+    # ------------------------------------------------------------ utils
+    def _sampled(self, rid: int) -> bool:
+        return rid_sampled(rid, self.sample)
+
+    def _weight_fields(self) -> Dict[str, Any]:
+        return {"sample_weight": self.sample} if self.sample > 1 else {}
+
+    def _bucket(self, tenant: str, cls: str) -> _Bucket:
+        b = self._buckets.get((tenant, cls))
+        if b is None:
+            b = self._buckets[(tenant, cls)] = _Bucket()
+        return b
+
+    def _log(self, **fields):
+        if self.run_log is not None:
+            self.run_log.log("serve", **fields)
+
+    # -------------------------------------------------------- lifecycle
+    def _submit(self, req: Request):
+        self.sched.submit(req)
+        self.submitted += 1
+        self._enter_seq[req.rid] = self._stall_seq
+        if self._sampled(req.rid):
+            self.tracer.on_submit(req)
+
+    def _queued_reason(self, rid: int) -> str:
+        """The stall-attribution reason the tracer would have stamped on
+        this request — computed lazily at admission (O(1) per request)
+        instead of walking the whole queue every stalled step: a stall
+        event is global to the FIFO queue, so 'the last stall observed
+        while this request was queued' is exactly 'the last global stall
+        if any occurred after it entered'.  ``preempted`` is sticky,
+        matching RequestTracer.on_stall."""
+        if rid in self._preempt_counts:
+            return "preempted"
+        if self._stall_seq > self._enter_seq.get(rid, self._stall_seq):
+            return self._stall_reason
+        return "none"
+
+    def _on_admit(self, slot_idx: int, st, now: float):
+        req = st.request
+        rid = req.rid
+        reason = self._queued_reason(rid)
+        # stall attribution reported per request = the FIRST admission's
+        # wait (what collect_traces' RequestTrace.stall_reason reads)
+        self._first_reason.setdefault(rid, reason)
+        self._enter_seq.pop(rid, None)
+        st.prefilling = True
+        if self.ledger is not None:
+            self.ledger.on_admit(rid, len(st.pages), now)
+        t = req.tenant
+        peaks = self.quota_peaks.get(t)
+        if peaks is None:
+            peaks = self.quota_peaks[t] = {"slots": 0, "pages": 0}
+        peaks["slots"] = max(peaks["slots"],
+                             self.sched.tenant_slots.get(t, 0))
+        peaks["pages"] = max(peaks["pages"],
+                             self.sched.tenant_pages.get(t, 0))
+        if self._sampled(rid):
+            if reason != "none":
+                self.tracer.on_stall([rid], reason)
+            self.tracer.on_admit(req, slot_idx, now,
+                                 shared_tokens=st.shared_tokens)
+
+    def _try_preempt(self, now: float) -> bool:
+        head = self.sched.queue[0]
+        victim = self.sched.preempt_victim(head.slo.priority)
+        if victim is None:
+            return False
+        st = self.sched.slots[victim]
+        req = st.request
+        rid = req.rid
+        self._preempt_counts[rid] = self._preempt_counts.get(rid, 0) + 1
+        self.preemptions += 1
+        if self.ledger is not None:
+            self.ledger.on_preempt(rid, now, ctx_start=st.shared_tokens,
+                                   tokens_cached=st.pos)
+        tokens_discarded = len(st.generated)
+        self.sched.preempt(victim)
+        self._enter_seq[rid] = self._stall_seq
+        b = self._bucket(req.tenant, req.slo.name)
+        b.preemptions += 1
+        if self._sampled(rid):
+            self.tracer.on_preempt(req, victim, now, by=head.rid)
+            self._log(event="preempt", req=rid, slot=victim,
+                      by=head.rid, by_class=head.slo.name,
+                      slo_class=req.slo.name, tenant=req.tenant, now=now,
+                      tokens_discarded=tokens_discarded,
+                      queue_depth=self.sched.queue_depth,
+                      **self._weight_fields())
+        return True
+
+    def _advance_prefill(self, slot_idx: int, st, now: float) -> float:
+        """One (padded) prefill chunk; on the final chunk the first
+        token is emitted — same per-step contract as the engine."""
+        req = st.request
+        plen = req.prompt_len
+        C = self.cfg.prefill_chunk
+        base = st.shared_tokens
+        s = base + st.chunks_done * C
+        dt = self.service.prefill_chunk_s(C, s)
+        st.chunks_done += 1
+        st.stats.prefill_chunks += 1
+        self.prefill_chunks += 1
+        padded = base + math.ceil((plen - base) / C) * C
+        if s + C < padded:
+            if self._sampled(req.rid):
+                self.tracer.on_chunk(req, now, st.chunks_done)
+            return dt
+        # final chunk: prompt fully cached — index it, emit TTFT token
+        if self.prefix_cache is not None:
+            self.prefix_cache.insert(req.prompt, st.pages, now)
+        st.prefilling = False
+        st.pos = plen
+        st.generated.append(0)     # modeled token (no logits exist)
+        self.tokens_out += 1
+        st.stats.first_token_t = now
+        rid = req.rid
+        if self._sampled(rid):
+            self.tracer.on_first_token(req, slot_idx, now,
+                                       chunk=st.chunks_done)
+            self._log(event="admit", req=rid, slot=slot_idx,
+                      prompt_len=plen, chunks=st.stats.prefill_chunks,
+                      ttft_s=st.stats.ttft_s,
+                      queue_wait_s=st.stats.queue_wait_s, now=now,
+                      slo_class=req.slo.name, tenant=req.tenant,
+                      shared_tokens=st.shared_tokens,
+                      queue_depth=self.sched.queue_depth,
+                      page_util=self.pool.utilization,
+                      **self._weight_fields())
+        if len(st.generated) >= req.max_new_tokens:
+            self._finish(slot_idx, st, now)
+        return dt
+
+    def _finish(self, slot_idx: int, st, now: float):
+        req = st.request
+        rid = req.rid
+        st.stats.done_t = now
+        tokens = len(st.generated)
+        self.sched.release(slot_idx)
+        st.stats.preemptions = self._preempt_counts.pop(rid, 0)
+        reason_first = self._first_reason.pop(rid, "none")
+        cost = None
+        if self.ledger is not None:
+            cost = self.ledger.finish(
+                rid, now, prompt_len=req.prompt_len,
+                shared_tokens=st.stats.shared_prefix_tokens,
+                tokens_out=tokens)
+        ttft = st.stats.ttft_s
+        e2e = st.stats.e2e_s
+        gap = ((e2e - ttft) / (tokens - 1)
+               if (tokens > 1 and e2e is not None and ttft is not None)
+               else 0.0)
+        slo = req.slo
+        ttft_ok = slo.ttft_s is None or (ttft is not None
+                                         and ttft <= slo.ttft_s)
+        gap_ok = slo.token_gap_s is None or gap <= slo.token_gap_s
+        ok = ttft_ok and gap_ok
+        b = self._bucket(req.tenant, slo.name)
+        b.requests += 1
+        b.tokens += tokens
+        b.stalls[reason_first] = b.stalls.get(reason_first, 0) + 1
+        if ok:
+            b.slo_ok += 1
+            b.goodput_tokens += tokens
+        if ttft is not None:
+            b.ttft.observe(ttft)
+        if e2e is not None:
+            b.e2e.observe(e2e)
+        if st.stats.queue_wait_s is not None:
+            b.queue_wait.observe(st.stats.queue_wait_s)
+        if cost is not None:
+            for k in COST_FIELDS:
+                b.costs[k] += cost[k]
+        self.completed += 1
+        if self._sampled(rid):
+            self.tracer.on_finish(req, slot_idx, "length", now,
+                                  tokens=tokens, e2e_s=e2e)
+            self._log(event="done", req=rid, slot=slot_idx,
+                      reason="length", tokens=tokens, ttft_s=ttft,
+                      e2e_s=e2e,
+                      tokens_per_s=(tokens / e2e if e2e else None),
+                      now=now, slo_class=slo.name, tenant=req.tenant,
+                      slo_ttft_s=slo.ttft_s,
+                      slo_token_gap_s=slo.token_gap_s,
+                      shared_prefix_tokens=st.stats.shared_prefix_tokens,
+                      prompt_len=req.prompt_len,
+                      preemptions=st.stats.preemptions,
+                      queue_depth=self.sched.queue_depth,
+                      slot_occupancy=self.sched.occupancy,
+                      page_util=self.pool.utilization,
+                      **dict(cost or {}), **self._weight_fields())
+
+    # ------------------------------------------------------------- step
+    def _step(self, now: float, step_idx: int) -> float:
+        """One engine-step equivalent at virtual time `now`; returns the
+        modeled step duration."""
+        sched = self.sched
+        while True:
+            adm = sched.admit_next(now)
+            if adm is None:
+                if (self.cfg.preempt and sched.queue
+                        and self._try_preempt(now)):
+                    continue
+                break
+            slot_idx, st = adm
+            self._on_admit(slot_idx, st, now)
+        if sched.queue:
+            reason = sched.last_stall or "none"
+            self._stall_seq += 1
+            self._stall_reason = reason
+            self.stall_steps[reason] = self.stall_steps.get(reason, 0) + 1
+        dt = 0.0
+        finished0 = self.completed
+        for i in sched.active_slots():
+            st = sched.slots[i]
+            if st is not None and st.prefilling:
+                dt += self._advance_prefill(i, st, now)
+        decoding = [i for i in sched.active_slots()
+                    if not sched.slots[i].prefilling]
+        if decoding:
+            kv_tokens = sum(sched.slots[i].pos for i in decoding)
+            dt += self.service.decode_step_s(len(decoding), kv_tokens)
+            for i in decoding:
+                st = sched.slots[i]
+                st.generated.append(0)
+                st.pos += 1
+                self.tokens_out += 1
+                if self._sampled(st.request.rid):
+                    self.tracer.on_token(st.request, now)
+                if len(st.generated) >= st.request.max_new_tokens:
+                    self._finish(i, st, now)
+        if self.completed > finished0:
+            survivors = [sched.slots[i].request.rid
+                         for i in sched.active_slots()
+                         if not sched.slots[i].prefilling
+                         and self._sampled(sched.slots[i].request.rid)]
+            if survivors:
+                self.tracer.on_split(survivors, now, "evict")
+        if self.fault_plan is not None:
+            dt += self.fault_plan.step_delay(0, step_idx)
+        return dt
+
+    # -------------------------------------------------------------- run
+    def run(self, requests: Sequence[Request]) -> Dict[str, Any]:
+        """Replay the trace to completion; returns `report()`."""
+        reqs = sorted(requests, key=lambda r: (r.arrival_t, r.rid))
+        n = len(reqs)
+        i = 0
+        now = reqs[0].arrival_t if reqs else 0.0
+        self._start = now
+        sched = self.sched
+        every = self.cfg.invariant_every
+        while True:
+            while i < n and reqs[i].arrival_t <= now + 1e-12:
+                self._submit(reqs[i])
+                i += 1
+            if not any(s is not None for s in sched.slots) \
+                    and not sched.queue:
+                if i >= n:
+                    break
+                now = max(now, reqs[i].arrival_t)
+                continue
+            before = (sched.admitted, self.completed)
+            dt = self._step(now, self.steps)
+            self.steps += 1
+            if every and self.steps % every == 0:
+                sched.check_invariants()
+                self.invariant_checks += 1
+            if dt <= 0.0:
+                # a zero-duration step made no progress toward any
+                # event: admit/finish must have moved, else we are
+                # wedged (a quota no request can ever satisfy is
+                # rejected at submit, so this is a genuine bug)
+                if (sched.admitted, self.completed) == before \
+                        and i >= n:
+                    raise RuntimeError(
+                        f"fleet sim wedged at step {self.steps}: queue "
+                        f"depth {sched.queue_depth}, stall "
+                        f"{sched.last_stall!r}, no progress possible")
+                dt = self.service.step_overhead_s
+            now += dt
+        self._end = now
+        sched.check_invariants()
+        self.invariant_checks += 1
+        if self.run_log is not None:
+            elapsed = max(now - self._start, 1e-9)
+            self._log(event="report", requests=self.completed,
+                      tokens=self.tokens_out, elapsed_s=elapsed,
+                      now=now, tokens_per_s=self.tokens_out / elapsed)
+        if self.registry is not None:
+            self._flush_registry()
+        return self.report()
+
+    def _flush_registry(self):
+        """Exact counters/gauges into the metrics registry in one batch
+        (the hot loop never takes the registry lock)."""
+        reg = self.registry
+        reg.inc("serve.requests_submitted", value=self.submitted)
+        reg.inc("serve.requests_done", value=self.completed)
+        reg.inc("serve.tokens_out", value=self.tokens_out)
+        reg.inc("serve.prefill_chunks", value=self.prefill_chunks)
+        reg.inc("serve.preemptions", value=self.preemptions)
+        for reason, c in sorted(self.stall_steps.items()):
+            reg.inc("serve.admission_stalls", value=c, reason=reason)
+        for t, peaks in sorted(self.quota_peaks.items()):
+            reg.set_gauge("serve.tenant_slots_peak", peaks["slots"],
+                          tenant=t)
+            reg.set_gauge("serve.tenant_pages_peak", peaks["pages"],
+                          tenant=t)
+
+    # ----------------------------------------------------------- report
+    def _check_traces(self) -> Dict[str, Any]:
+        """Validate + reconcile every kept (sampled) trace: exact span
+        tiling means zero residual by construction — any nonzero
+        residual is a tracer/sim bug, surfaced here."""
+        max_residual = 0.0
+        checked = 0
+        for tr in self.tracer.traces.values():
+            tr.validate()
+            term = tr.terminal
+            e2e = term.attrs.get("e2e_s") if term is not None else None
+            r = tr.reconcile(e2e)
+            if r is not None:
+                checked += 1
+                max_residual = max(max_residual, r)
+        return {"traces_checked": checked,
+                "max_residual_s": max_residual}
+
+    @staticmethod
+    def _bucket_report(b: _Bucket, elapsed: float) -> Dict[str, Any]:
+        out: Dict[str, Any] = {
+            "requests": b.requests, "tokens_out": b.tokens,
+            "slo_attainment": (b.slo_ok / b.requests
+                               if b.requests else None),
+            "goodput_tokens": b.goodput_tokens,
+            "goodput_tokens_per_s": (b.goodput_tokens / elapsed
+                                     if elapsed > 0 else None),
+            "preemptions": b.preemptions,
+            "stall_breakdown": dict(sorted(b.stalls.items())),
+            "ttft_s": _hist_summary(b.ttft),
+            "e2e_s": _hist_summary(b.e2e),
+            "queue_wait_s": _hist_summary(b.queue_wait),
+        }
+        if any(b.costs.values()):
+            out["cost"] = dict(b.costs)
+        return out
+
+    def report(self) -> Dict[str, Any]:
+        """The fleet report (tools_fleet.py's --json payload): derived
+        ONLY from virtual-clock quantities and seeded reservoirs, so the
+        same seed + trace reproduces it byte-identically."""
+        elapsed = max(self._end - self._start, 0.0)
+        tenants: Dict[str, _Bucket] = {}
+        classes: Dict[str, _Bucket] = {}
+        stall_breakdown: Dict[str, int] = {}
+        for (tenant, cls), b in self._buckets.items():
+            for agg_key, agg in ((tenant, tenants), (cls, classes)):
+                m = agg.get(agg_key)
+                if m is None:
+                    m = agg[agg_key] = _Bucket()
+                m.requests += b.requests
+                m.tokens += b.tokens
+                m.slo_ok += b.slo_ok
+                m.goodput_tokens += b.goodput_tokens
+                m.preemptions += b.preemptions
+                for k, v in b.stalls.items():
+                    m.stalls[k] = m.stalls.get(k, 0) + v
+                for k, v in b.costs.items():
+                    m.costs[k] += v
+                for attr in ("ttft", "e2e", "queue_wait"):
+                    _merge_hist(getattr(m, attr), getattr(b, attr))
+            for k, v in b.stalls.items():
+                stall_breakdown[k] = stall_breakdown.get(k, 0) + v
+        quotas: Dict[str, Any] = {}
+        for t, q in sorted(self.cfg.quotas.items()):
+            peaks = self.quota_peaks.get(t, {"slots": 0, "pages": 0})
+            quotas[t] = dict(q.to_dict(), peak_slots=peaks["slots"],
+                             peak_pages=peaks["pages"])
+        costs = {
+            "by_tenant": {t: dict(m.costs)
+                          for t, m in sorted(tenants.items())
+                          if any(m.costs.values())},
+        } if self.ledger is not None else None
+        if costs is not None:
+            total = {k: 0.0 for k in COST_FIELDS}
+            for c in costs["by_tenant"].values():
+                for k in COST_FIELDS:
+                    total[k] += c[k]
+            costs["total"] = total
+        out: Dict[str, Any] = {
+            "fleet_schema": FLEET_SCHEMA,
+            "requests": self.submitted,
+            "completed": self.completed,
+            "tokens_out": self.tokens_out,
+            "elapsed_s": elapsed,
+            "tokens_per_s": (self.tokens_out / elapsed
+                             if elapsed > 0 else None),
+            "steps": self.steps,
+            "admitted": self.sched.admitted,
+            "preemptions": self.preemptions,
+            "prefill_chunks": self.prefill_chunks,
+            "stall_steps": dict(sorted(self.stall_steps.items())),
+            "stall_breakdown": dict(sorted(stall_breakdown.items())),
+            "tenants": {t: self._bucket_report(m, elapsed)
+                        for t, m in sorted(tenants.items())},
+            "classes": {c: self._bucket_report(m, elapsed)
+                        for c, m in sorted(classes.items())},
+            "quotas": quotas,
+            "invariants": {"checks": self.invariant_checks, "ok": True},
+            "trace_check": self._check_traces(),
+            "sample": self.sample,
+            "service_model": self.service.to_dict(),
+        }
+        if costs is not None:
+            out["costs"] = costs
+        if self.prefix_cache is not None:
+            out["prefix_cache"] = {
+                k: v for k, v in self.prefix_cache.stats().items()}
+        return out
+
+
+def fleet_workload(n: int, *, rate_per_s: float, burst: int = 0,
+                   tenants: Sequence[str] = ("default",),
+                   slo_classes=None, prompt_lens=(16, 64),
+                   max_new=(4, 16), shared_prefix_len: int = 0,
+                   vocab_size: int = 32000, seed: int = 0
+                   ) -> List[Request]:
+    """The canonical multi-tenant fleet trace: seeded arrivals (Poisson,
+    or bursty when ``burst`` > 0) with tenants and SLO classes assigned
+    round-robin — the shared workload builder tools_fleet.py, the chaos
+    ``fleet-storm`` schedule and the tests all use."""
+    from hetu_tpu.serving.traces import (bursty_arrivals,
+                                         poisson_arrivals,
+                                         synthetic_requests)
+    arrivals = (bursty_arrivals(n, rate_per_s, burst=burst, seed=seed)
+                if burst else poisson_arrivals(n, rate_per_s, seed=seed))
+    return synthetic_requests(
+        n, vocab_size=vocab_size, prompt_lens=prompt_lens,
+        max_new=max_new, arrivals=arrivals, slo_classes=slo_classes,
+        shared_prefix_len=shared_prefix_len,
+        tenants=list(tenants), seed=seed)

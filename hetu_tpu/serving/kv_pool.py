@@ -116,7 +116,8 @@ class PagePool:
 
     def __init__(self, *, num_layers: int, num_pages: int, page_size: int,
                  num_kv_heads: int, head_dim: int,
-                 dtype=jnp.float32, quant: str = "none"):
+                 dtype=jnp.float32, quant: str = "none",
+                 device_arrays: bool = True):
         if quant not in ("none", "int8"):
             raise ValueError(f"kv quant mode {quant!r} invalid; "
                              "choices: ('none', 'int8')")
@@ -131,7 +132,13 @@ class PagePool:
         self.quant = quant
         shape = (num_layers, num_pages + 1, page_size, num_kv_heads,
                  head_dim)
-        if quant == "int8":
+        if not device_arrays:
+            # host-only pool (serving/fleet.py's discrete-event sim): the
+            # allocator / refcount / page-table machinery is the real
+            # thing, but no device memory is ever touched — a 10^6-page
+            # pool costs one numpy array, not gigabytes of jnp.zeros
+            self.arrays = None
+        elif quant == "int8":
             self.arrays = PoolArrays(
                 k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
                 k_scale=jnp.zeros(shape[:-1], jnp.float32),

@@ -82,6 +82,90 @@ DEFAULT_SLO = SLOClass()
 
 
 @dataclasses.dataclass(frozen=True)
+class TenantQuota:
+    """Per-tenant admission quota (HETU_TPU_SERVE_QUOTAS).
+
+    Caps how many decode slots and KV pages requests of one tenant may
+    hold LIVE at once; the scheduler checks the cap at admission, before
+    touching the pool, and stalls the queue head with the
+    ``quota_exceeded`` reason when its tenant is over.  0 = unlimited in
+    that dimension; a tenant with no quota registered is unlimited in
+    both — quota-free deployments see exactly the old admission path."""
+    tenant: str
+    max_slots: int = 0
+    max_pages: int = 0
+
+    def __post_init__(self):
+        if not self.tenant:
+            raise ValueError("tenant quota needs a tenant name")
+        if self.max_slots < 0 or self.max_pages < 0:
+            raise ValueError(
+                f"tenant {self.tenant!r}: quota caps must be >= 0, got "
+                f"slots={self.max_slots} pages={self.max_pages}")
+
+    def to_dict(self) -> dict:
+        return {"tenant": self.tenant, "max_slots": self.max_slots,
+                "max_pages": self.max_pages}
+
+    @staticmethod
+    def parse(spec: str) -> "TenantQuota":
+        """``tenant[:max_slots[:max_pages]]`` (empty/'-'/0 = unlimited)
+        — the CLI/flag surface: ``HETU_TPU_SERVE_QUOTAS=acme:2:16,free:1:4``."""
+        parts = spec.split(":")
+        if not parts[0] or len(parts) > 3:
+            raise ValueError(f"bad tenant quota spec {spec!r}; want "
+                             "tenant[:max_slots[:max_pages]]")
+
+        def num(i, what):
+            if len(parts) <= i or parts[i] in ("", "-"):
+                return 0
+            try:
+                return int(parts[i])
+            except ValueError:
+                raise ValueError(
+                    f"bad tenant quota spec {spec!r}: {what} "
+                    f"{parts[i]!r} is not an integer (use '-' for "
+                    "unlimited)") from None
+        return TenantQuota(parts[0], num(1, "max_slots"),
+                           num(2, "max_pages"))
+
+
+def parse_quotas(spec: str) -> dict:
+    """Comma-separated TenantQuota specs -> {tenant: TenantQuota}.
+    Empty/blank spec = no quotas (the identity contract of
+    HETU_TPU_SERVE_QUOTAS)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        q = TenantQuota.parse(part)
+        if q.tenant in out:
+            raise ValueError(f"duplicate tenant quota for {q.tenant!r}")
+        out[q.tenant] = q
+    return out
+
+
+#: Fibonacci-hash multiplier (2^64 / phi) for rid_sampled's bit mixer
+_SAMPLE_MIX = 0x9E3779B97F4A7C15
+
+
+def rid_sampled(rid: int, n: int) -> bool:
+    """Deterministic 1-in-`n` request sampling for RunLog serve events
+    and spans (HETU_TPU_RUNLOG_SERVE_SAMPLE): hash the rid, keep the
+    1/n bucket.  The multiplicative mix matters — a plain ``rid % n``
+    aliases with anything else assigned round-robin by rid (tenants,
+    SLO classes in the workload builders share the same stride), so a
+    modulo sample of a 2-tenant trace could contain ONE tenant.  The
+    hash is a pure function of (rid, n): the same request is sampled on
+    every replay, so goldens stay byte-identical."""
+    if n <= 1:
+        return True
+    return ((rid * _SAMPLE_MIX) & 0xFFFFFFFFFFFFFFFF) >> 32 < \
+        (1 << 32) // n
+
+
+@dataclasses.dataclass(frozen=True)
 class SamplingParams:
     """Per-request decoding parameters (serving/sampling.py).
 
@@ -130,6 +214,10 @@ class Request:
     arrival_t: float = 0.0
     slo: SLOClass = DEFAULT_SLO
     sampling: SamplingParams = GREEDY
+    #: who this request bills to: per-tenant quotas gate admission
+    #: (scheduler), and slo_report/costs aggregate per tenant.  The
+    #: default tenant keeps tenant-free callers byte-identical.
+    tenant: str = "default"
 
     def __post_init__(self):
         self.prompt = np.asarray(self.prompt, np.int32).reshape(-1)

@@ -26,7 +26,7 @@ from typing import Deque, Dict, List, Optional, Tuple
 import numpy as np
 
 from hetu_tpu.serving.kv_pool import PagePool
-from hetu_tpu.serving.request import Request, RequestStats
+from hetu_tpu.serving.request import Request, RequestStats, TenantQuota
 
 
 @dataclass
@@ -63,7 +63,8 @@ class Scheduler:
     shared pages are ref'd, not copied."""
 
     def __init__(self, *, num_slots: int, pool: PagePool, max_len: int,
-                 prefix_cache=None, lookahead: int = 0):
+                 prefix_cache=None, lookahead: int = 0,
+                 quotas: Optional[Dict[str, TenantQuota]] = None):
         if max_len % pool.page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {pool.page_size}")
@@ -75,6 +76,9 @@ class Scheduler:
         self.max_pages = max_len // pool.page_size
         self.prefix_cache = prefix_cache
         self.lookahead = lookahead
+        #: per-tenant admission caps (HETU_TPU_SERVE_QUOTAS); tenants
+        #: absent from the dict are unlimited, {} / None = quota-free
+        self.quotas: Dict[str, TenantQuota] = dict(quotas or {})
         self.slots: List[Optional[SlotState]] = [None] * num_slots
         self.queue: Deque[Request] = collections.deque()
         # the device-facing view: row s = slot s's pages, null-padded
@@ -83,10 +87,16 @@ class Scheduler:
         self.released = 0
         self.preempted = 0
         self._admit_seq = 0
+        # live per-tenant usage, maintained at admit/release (the quota
+        # check reads these instead of rescanning the slots each time);
+        # check_invariants() recomputes them from scratch
+        self.tenant_slots: Dict[str, int] = {}
+        self.tenant_pages: Dict[str, int] = {}
         #: why the LAST failed admission attempt stalled (the
         #: reserve-on-admit attribution the flight recorder reads):
         #: "no_slot" = every decode slot live, "no_pages" = the queue
-        #: head's full reservation was short; None = no stall observed
+        #: head's full reservation was short, "quota_exceeded" = the
+        #: head's tenant was over its cap; None = no stall observed
         self.last_stall: Optional[str] = None
 
     def _reserve_tokens(self, req: Request) -> int:
@@ -111,6 +121,14 @@ class Scheduler:
                 f"request {req.rid}: needs "
                 f"{self.pool.pages_for(self._reserve_tokens(req))} pages "
                 f"but the pool only has {self.pool.num_pages}")
+        q = self.quotas.get(req.tenant)
+        if q is not None and q.max_pages and \
+                self.pool.pages_for(self._reserve_tokens(req)) > q.max_pages:
+            raise ValueError(
+                f"request {req.rid}: tenant {req.tenant!r} quota caps "
+                f"pages at {q.max_pages} but the reservation alone needs "
+                f"{self.pool.pages_for(self._reserve_tokens(req))} — it "
+                "could never be admitted")
         self.queue.append(req)
 
     @property
@@ -148,6 +166,9 @@ class Scheduler:
             self.last_stall = "no_slot"
             return None
         req = self.queue[0]
+        if not self._quota_admits(req):
+            self.last_stall = "quota_exceeded"
+            return None
         shared_tokens, shared_pages = 0, []
         if self.prefix_cache is not None:
             shared_tokens, shared_pages = self.prefix_cache.match(
@@ -189,7 +210,26 @@ class Scheduler:
         row[:] = PagePool.NULL_PAGE
         row[: len(pages)] = pages
         self.admitted += 1
+        t = req.tenant
+        self.tenant_slots[t] = self.tenant_slots.get(t, 0) + 1
+        self.tenant_pages[t] = self.tenant_pages.get(t, 0) + len(pages)
         return slot_idx, st
+
+    def _quota_admits(self, req: Request) -> bool:
+        """Would admitting `req` keep its tenant within quota?  Checked
+        BEFORE the pool is touched, so a quota stall never pins shared
+        prefix pages or triggers cache eviction."""
+        q = self.quotas.get(req.tenant)
+        if q is None:
+            return True
+        if q.max_slots and \
+                self.tenant_slots.get(req.tenant, 0) + 1 > q.max_slots:
+            return False
+        if q.max_pages:
+            need = self.pool.pages_for(self._reserve_tokens(req))
+            if self.tenant_pages.get(req.tenant, 0) + need > q.max_pages:
+                return False
+        return True
 
     def release(self, slot_idx: int):
         """Evict a finished sequence: pages released (shared prefix
@@ -204,6 +244,9 @@ class Scheduler:
         self.slots[slot_idx] = None
         self.page_table[slot_idx, :] = PagePool.NULL_PAGE
         self.released += 1
+        t = st.request.tenant
+        self.tenant_slots[t] -= 1
+        self.tenant_pages[t] -= len(st.pages)
 
     # ------------------------------------------------------- preemption
     def preempt_victim(self, priority: int) -> Optional[int]:
@@ -246,9 +289,33 @@ class Scheduler:
         * live (refcount > 0) + free pages partition the pool exactly,
         * each table row mirrors its slot's page list, null-padded,
         * the null page is never owned and never free-listed,
-        * every live position fits its reservation."""
+        * every live position fits its reservation,
+        * the incremental per-tenant usage counters match a fresh scan
+          of the live slots, and no quota'd tenant exceeds its caps."""
         owners: Dict[int, int] = {}
         writers: Dict[int, List[int]] = {}   # slots holding p UNSHARED
+        tslots: Dict[str, int] = {}
+        tpages: Dict[str, int] = {}
+        for i, st in enumerate(self.slots):
+            if st is not None:
+                t = st.request.tenant
+                tslots[t] = tslots.get(t, 0) + 1
+                tpages[t] = tpages.get(t, 0) + len(st.pages)
+        if {k: v for k, v in self.tenant_slots.items() if v} != tslots:
+            raise AssertionError(
+                f"tenant slot usage {self.tenant_slots} != scan {tslots}")
+        if {k: v for k, v in self.tenant_pages.items() if v} != tpages:
+            raise AssertionError(
+                f"tenant page usage {self.tenant_pages} != scan {tpages}")
+        for t, q in self.quotas.items():
+            if q.max_slots and tslots.get(t, 0) > q.max_slots:
+                raise AssertionError(
+                    f"tenant {t!r} holds {tslots[t]} slots over its "
+                    f"quota {q.max_slots}")
+            if q.max_pages and tpages.get(t, 0) > q.max_pages:
+                raise AssertionError(
+                    f"tenant {t!r} holds {tpages[t]} pages over its "
+                    f"quota {q.max_pages}")
         for i, st in enumerate(self.slots):
             if st is None:
                 if (self.page_table[i] != PagePool.NULL_PAGE).any():

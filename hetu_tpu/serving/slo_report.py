@@ -17,9 +17,13 @@ The report answers the questions aggregate histograms cannot:
 * **goodput** — tokens/s counted only from requests that finished
   within their class SLO (the Hetis-style metric: violating traffic
   produces load, not goodput),
-* **stall attribution** — how queue time divides between ``no_slot``
-  and ``no_pages`` (the scheduler's reserve-on-admit decision, read
-  from the queued spans),
+* **stall attribution** — how queue time divides across the
+  scheduler's reserve-on-admit reasons (``no_slot`` / ``no_pages`` /
+  ``preempted`` / ``quota_exceeded``, read from the queued spans),
+* **per-tenant accounting** — the class table re-grouped by
+  ``Request.tenant`` (attainment/goodput per tenant) plus the cost
+  ledger's per-tenant ``cost_*`` sums (serving/costs.py) when the run
+  priced requests,
 * **reconciliation** — per request, queued + prefill + decode + pause
   span durations vs the recorded ``e2e_s`` (the acceptance property:
   within one engine-step quantum; exact by the tracer's tiling
@@ -30,6 +34,12 @@ unset there are no span records, and the report still renders the
 per-class percentile/attainment tables from the ``done`` events alone
 (token-gap attainment then uses e2e-derived mean gaps).
 
+Sampled RunLogs (``HETU_TPU_RUNLOG_SERVE_SAMPLE`` > 1) stay unbiased:
+each sampled done event carries ``sample_weight=N`` and every count/
+token-sum/attainment fraction here re-weights by it — only the latency
+percentiles stay unweighted (rid sampling is uniform, so the sampled
+rows are already a uniform draw of the population).
+
 Pure host-side record munging — no jax, no device contact.
 """
 from __future__ import annotations
@@ -38,6 +48,7 @@ from typing import Any, Dict, Iterable, List, Optional
 
 from hetu_tpu.obs.metrics import percentile_of_sorted
 from hetu_tpu.obs.spans import RequestTrace, collect_traces
+from hetu_tpu.serving.costs import COST_FIELDS, aggregate_costs
 
 #: bump when the report dict shape changes incompatibly (pinned by the
 #: CLI smoke tests)
@@ -84,10 +95,20 @@ def request_rows(collected: Dict[str, Any]) -> List[Dict[str, Any]]:
         row: Dict[str, Any] = {
             "rid": rid,
             "slo_class": str(d.get("slo_class", "default")),
+            "tenant": str(d.get("tenant") or "default"),
             "ttft_s": ttft, "e2e_s": e2e, "tokens": tokens,
             "reason": d.get("reason"),
             "ttft_target_s": ttft_target, "token_gap_target_s": gap_target,
         }
+        if d.get("sample_weight") is not None:
+            # a sampled RunLog (HETU_TPU_RUNLOG_SERVE_SAMPLE): this row
+            # stands for N requests — every count/sum below re-weights
+            row["sample_weight"] = d["sample_weight"]
+        for k in COST_FIELDS:
+            # per-request cost ledger fields (serving/costs.py) ride the
+            # done event when the engine ran with a CostModel
+            if d.get(k) is not None:
+                row[k] = d[k]
         if tr is not None and tr.terminal is not None:
             row["queued_s"] = tr.duration_s("queued")
             row["prefill_s"] = tr.duration_s("prefill")
@@ -154,6 +175,52 @@ def _elapsed_s(collected: Dict[str, Any],
     return max(1e-9, max(ends) - min(starts))
 
 
+def _weight(r: Dict[str, Any]) -> float:
+    """How many requests this row stands for (sample_weight on sampled
+    RunLogs, 1 otherwise)."""
+    return float(r.get("sample_weight") or 1.0)
+
+
+def _int_if_whole(v: float):
+    """Weighted counts render as ints when they are whole (every
+    unsampled log), so pre-sampling report consumers see no shape
+    change."""
+    return int(v) if float(v).is_integer() else v
+
+
+def _group_section(rs: List[Dict[str, Any]], elapsed_s: Optional[float],
+                   *, targets: bool) -> Dict[str, Any]:
+    """One aggregate table section over a row group (a class or a
+    tenant): weighted counts/attainment/goodput, unweighted latency
+    percentiles (rid-sampling is uniform, so the sampled rows ARE a
+    uniform draw — re-weighting would not change the order
+    statistics)."""
+    n_w = sum(_weight(r) for r in rs)
+    tokens = sum(r["tokens"] * _weight(r) for r in rs)
+    good_tokens = sum(r["tokens"] * _weight(r) for r in rs if r["slo_ok"])
+    sec: Dict[str, Any] = {
+        "requests": _int_if_whole(n_w),
+        "tokens_out": _int_if_whole(tokens),
+        "ttft_s": _pcts([r["ttft_s"] for r in rs]),
+        "e2e_s": _pcts([r["e2e_s"] for r in rs]),
+        "queue_wait_s": _pcts([r.get("queued_s") for r in rs]),
+        "token_gap_s": _pcts([r.get("token_gap_s") for r in rs]),
+        "attainment": {
+            "ttft": sum(_weight(r) for r in rs if r["ttft_ok"]) / n_w,
+            "token_gap": sum(_weight(r) for r in rs if r["gap_ok"]) / n_w,
+            "slo": sum(_weight(r) for r in rs if r["slo_ok"]) / n_w,
+        },
+        "goodput_tokens": _int_if_whole(good_tokens),
+    }
+    if targets:
+        sec["targets"] = {"ttft_s": rs[0]["ttft_target_s"],
+                          "token_gap_s": rs[0]["token_gap_target_s"]}
+    if elapsed_s:
+        sec["goodput_tokens_per_s"] = good_tokens / elapsed_s
+        sec["tokens_per_s"] = tokens / elapsed_s
+    return sec
+
+
 def class_report(rows: List[Dict[str, Any]],
                  elapsed_s: Optional[float]) -> Dict[str, Dict[str, Any]]:
     """Per-class table: counts, latency percentiles, attainment
@@ -161,33 +228,23 @@ def class_report(rows: List[Dict[str, Any]],
     by_cls: Dict[str, List[Dict[str, Any]]] = {}
     for row in rows:
         by_cls.setdefault(row["slo_class"], []).append(row)
-    out: Dict[str, Dict[str, Any]] = {}
-    for cls in sorted(by_cls):
-        rs = by_cls[cls]
-        n = len(rs)
-        tokens = sum(r["tokens"] for r in rs)
-        good_tokens = sum(r["tokens"] for r in rs if r["slo_ok"])
-        sec: Dict[str, Any] = {
-            "requests": n,
-            "tokens_out": tokens,
-            "targets": {"ttft_s": rs[0]["ttft_target_s"],
-                        "token_gap_s": rs[0]["token_gap_target_s"]},
-            "ttft_s": _pcts([r["ttft_s"] for r in rs]),
-            "e2e_s": _pcts([r["e2e_s"] for r in rs]),
-            "queue_wait_s": _pcts([r.get("queued_s") for r in rs]),
-            "token_gap_s": _pcts([r.get("token_gap_s") for r in rs]),
-            "attainment": {
-                "ttft": sum(r["ttft_ok"] for r in rs) / n,
-                "token_gap": sum(r["gap_ok"] for r in rs) / n,
-                "slo": sum(r["slo_ok"] for r in rs) / n,
-            },
-            "goodput_tokens": good_tokens,
-        }
-        if elapsed_s:
-            sec["goodput_tokens_per_s"] = good_tokens / elapsed_s
-            sec["tokens_per_s"] = tokens / elapsed_s
-        out[cls] = sec
-    return out
+    return {cls: _group_section(by_cls[cls], elapsed_s, targets=True)
+            for cls in sorted(by_cls)}
+
+
+def tenant_report(rows: List[Dict[str, Any]],
+                  elapsed_s: Optional[float]
+                  ) -> Optional[Dict[str, Dict[str, Any]]]:
+    """Per-tenant table (same shape as the class table, minus targets —
+    a tenant may mix classes).  None when every request is the default
+    tenant: tenant-free logs keep their report shape."""
+    if all(r["tenant"] == "default" for r in rows):
+        return None
+    by_t: Dict[str, List[Dict[str, Any]]] = {}
+    for row in rows:
+        by_t.setdefault(row["tenant"], []).append(row)
+    return {t: _group_section(by_t[t], elapsed_s, targets=False)
+            for t in sorted(by_t)}
 
 
 def spec_decode_report(collected: Dict[str, Any]
@@ -259,13 +316,15 @@ def stall_breakdown(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     traced = [r for r in rows if r.get("stall_reason") is not None]
     if not traced:
         return None
-    counts: Dict[str, int] = {}
+    counts: Dict[str, float] = {}
     waited: Dict[str, float] = {}
     for r in traced:
         reason = r["stall_reason"]
-        counts[reason] = counts.get(reason, 0) + 1
-        waited[reason] = waited.get(reason, 0.0) + (r.get("queued_s") or 0.0)
-    return {"requests": counts,
+        w = _weight(r)
+        counts[reason] = counts.get(reason, 0) + w
+        waited[reason] = (waited.get(reason, 0.0)
+                          + (r.get("queued_s") or 0.0) * w)
+    return {"requests": {k: _int_if_whole(v) for k, v in counts.items()},
             "queued_s": {k: round(v, 6) for k, v in waited.items()}}
 
 
@@ -293,17 +352,18 @@ def serving_report(records: Iterable[Dict[str, Any]], *,
         collected = collect(records)
     rows = request_rows(collected)
     elapsed = _elapsed_s(collected, rows)
-    tokens = sum(r["tokens"] for r in rows)
-    good = sum(r["tokens"] for r in rows if r["slo_ok"])
+    n_w = sum(_weight(r) for r in rows)
+    tokens = sum(r["tokens"] * _weight(r) for r in rows)
+    good = sum(r["tokens"] * _weight(r) for r in rows if r["slo_ok"])
     out: Dict[str, Any] = {
         "report_schema": REPORT_SCHEMA,
-        "requests": len(rows),
-        "tokens_out": tokens,
+        "requests": _int_if_whole(n_w),
+        "tokens_out": _int_if_whole(tokens),
         "elapsed_s": elapsed,
         "classes": class_report(rows, elapsed),
-        "slo_attainment": (sum(r["slo_ok"] for r in rows) / len(rows)
-                           if rows else None),
-        "goodput_tokens": good,
+        "slo_attainment": (sum(_weight(r) for r in rows if r["slo_ok"])
+                           / n_w if rows else None),
+        "goodput_tokens": _int_if_whole(good),
         "spans_recorded": sum(len(t.spans)
                               for t in collected["traces"].values()),
         "reshards": len(collected["reshards"]),
@@ -311,6 +371,12 @@ def serving_report(records: Iterable[Dict[str, Any]], *,
     if elapsed:
         out["tokens_per_s"] = tokens / elapsed
         out["goodput_tokens_per_s"] = good / elapsed
+    tenants = tenant_report(rows, elapsed)
+    if tenants is not None:
+        out["tenants"] = tenants
+    costs = aggregate_costs(rows)
+    if costs is not None:
+        out["costs"] = costs
     stalls = stall_breakdown(rows)
     if stalls is not None:
         out["stall_breakdown"] = stalls
@@ -371,6 +437,39 @@ def render_text(report: Dict[str, Any]) -> str:
             f"{pct('e2e_s', 'p95'):>9} {pct('token_gap_s', 'p95'):>9} "
             f"{sec['attainment']['slo']:>7.0%} "
             f"{_fmt(sec.get('goodput_tokens_per_s'), digits=3):>8}")
+    tenants = report.get("tenants")
+    if tenants:
+        thdr = (f"{'tenant':>10} {'reqs':>7} {'tokens':>8} "
+                f"{'ttft p95':>9} {'e2e p95':>9} {'attain':>7} "
+                f"{'goodput':>8}")
+        lines.append(thdr)
+        lines.append("-" * len(thdr))
+        for t, sec in tenants.items():
+            def tpct(key, p):
+                d = sec.get(key)
+                return _fmt(d.get(p) if d else None)
+            lines.append(
+                f"{t:>10} {_fmt(sec['requests'], digits=6):>7} "
+                f"{_fmt(sec['tokens_out'], digits=6):>8} "
+                f"{tpct('ttft_s', 'p95'):>9} {tpct('e2e_s', 'p95'):>9} "
+                f"{sec['attainment']['slo']:>7.0%} "
+                f"{_fmt(sec.get('goodput_tokens_per_s'), digits=3):>8}")
+    costs = report.get("costs")
+    if costs:
+        for t, c in costs["by_tenant"].items():
+            lines.append(
+                f"cost[{t}]: prefill {_fmt(c['cost_prefill_flops'], digits=3)} "
+                f"+ decode {_fmt(c['cost_decode_flops'], digits=3)} FLOPs, "
+                f"{_fmt(c['cost_page_s'], digits=3)} page-s, "
+                f"{_fmt(c['cost_kv_byte_s'], digits=3)} KV byte-s, "
+                f"{_fmt(c['cost_wire_bytes'], digits=3)} wire B")
+        tot = costs["total"]
+        lines.append(
+            f"cost[total]: prefill {_fmt(tot['cost_prefill_flops'], digits=3)} "
+            f"+ decode {_fmt(tot['cost_decode_flops'], digits=3)} FLOPs, "
+            f"{_fmt(tot['cost_page_s'], digits=3)} page-s, "
+            f"{_fmt(tot['cost_kv_byte_s'], digits=3)} KV byte-s, "
+            f"{_fmt(tot['cost_wire_bytes'], digits=3)} wire B")
     stalls = report.get("stall_breakdown")
     if stalls:
         parts = [f"{k}={v}" for k, v in sorted(stalls["requests"].items())]

@@ -55,11 +55,15 @@ def synthetic_requests(n: int, *, vocab_size: int, prompt_lens=(4, 24),
                        slo_classes: Optional[Sequence[SLOClass]] = None,
                        shared_prefix_len: int = 0,
                        sampling: Optional[SamplingParams] = None,
+                       tenants: Optional[Sequence[str]] = None,
                        seed: int = 0) -> List[Request]:
     """n seeded requests with uniform prompt lengths / decode budgets and
     the given arrival times (default: all at t=0).  ``slo_classes``
     assigns latency classes round-robin (deterministic — request i gets
     class i % len); None keeps every request in the default class.
+    ``tenants`` assigns tenant names the same way (round-robin; None =
+    everyone in the default tenant — the fleet simulator's multi-tenant
+    workload knob).
 
     ``shared_prefix_len`` prepends one seeded "system prompt" of that
     many tokens to EVERY request (the radix-prefix-cache workload;
@@ -92,5 +96,6 @@ def synthetic_requests(n: int, *, vocab_size: int, prompt_lens=(4, 24),
         reqs.append(Request(
             rid=i, prompt=prompt,
             max_new_tokens=mnew, eos_token_id=eos_token_id,
-            arrival_t=float(arrivals[i]), slo=slo, sampling=sp))
+            arrival_t=float(arrivals[i]), slo=slo, sampling=sp,
+            tenant=(tenants[i % len(tenants)] if tenants else "default")))
     return reqs

@@ -288,6 +288,28 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "policy only — decode program byte-identical (registered "
          "identity contract)",
          identity="0", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_QUOTAS", "str", "",
+         "per-tenant admission quotas (serving/request.py parse_quotas): "
+         "comma list of tenant[:max_slots[:max_pages]] specs, e.g. "
+         "'acme:2:16,free:1:4' — the scheduler caps how many decode "
+         "slots / KV pages each tenant's LIVE requests may hold, "
+         "stalling the queue head with the 'quota_exceeded' reason when "
+         "its tenant is over (docs/serving.md).  Unset/empty (default) "
+         "= quota-free: the admission path is byte-identical to the "
+         "flag not existing (registered identity contract; host-side "
+         "policy only — the decode program never sees tenants)",
+         identity="", identity_programs=("decode",)),
+    Flag("HETU_TPU_RUNLOG_SERVE_SAMPLE", "int", 1,
+         "serve-event/span RunLog sampling: only a deterministic hashed "
+         "1-in-N of request ids (serving/request.py rid_sampled — "
+         "decorrelated from round-robin tenant/class assignment) emit "
+         "their 'serve'/'span' records, stamped with "
+         "sample_weight=N so serving/slo_report.py re-weights rates and "
+         "goodput unbiasedly (exact registry counters are never "
+         "sampled).  1 (default) logs every request — the RunLog is "
+         "byte-identical to the flag not existing (registered identity "
+         "contract); raise to ~1000 for 10^6-request fleet runs",
+         identity="1", identity_programs=("decode",)),
     Flag("HETU_TPU_SERVE_TRACE", "bool", False,
          "serving flight recorder (serving/tracing.py): record every "
          "request's lifecycle as schema-versioned 'span' RunLog records "

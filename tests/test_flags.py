@@ -69,7 +69,9 @@ def test_every_env_read_is_registered():
                  "HETU_TPU_SERVE_TRACE", "HETU_TPU_SERVE_SAMPLE",
                  "HETU_TPU_SPEC_DECODE", "HETU_TPU_SPEC_K",
                  "HETU_TPU_SERVE_PREFIX_CACHE",
-                 "HETU_TPU_SERVE_PREFIX_PAGES", "HETU_TPU_SERVE_PREEMPT"):
+                 "HETU_TPU_SERVE_PREFIX_PAGES", "HETU_TPU_SERVE_PREEMPT",
+                 "HETU_TPU_SERVE_QUOTAS",
+                 "HETU_TPU_RUNLOG_SERVE_SAMPLE"):
         assert name in flags.REGISTRY
     # the analytic step profiler + perf-budget surface
     # (obs.hlo_profile / obs.budget, docs/observability.md)
@@ -128,10 +130,15 @@ def test_identity_contract_table():
     assert table["HETU_TPU_SPEC_K"] == "4"
     assert table["HETU_TPU_SERVE_PREFIX_CACHE"] == "0"
     assert table["HETU_TPU_SERVE_PREEMPT"] == "0"
+    # the fleet-observatory surface: quota-free / log-everything are the
+    # identity values (host-side policy only; decode program unchanged)
+    assert table["HETU_TPU_SERVE_QUOTAS"] == ""
+    assert table["HETU_TPU_RUNLOG_SERVE_SAMPLE"] == "1"
     for name in ("HETU_TPU_SERVE_SAMPLE", "HETU_TPU_SPEC_DECODE",
                  "HETU_TPU_SPEC_K", "HETU_TPU_SERVE_PREFIX_CACHE",
                  "HETU_TPU_SERVE_PREFIX_PAGES",
-                 "HETU_TPU_SERVE_PREEMPT"):
+                 "HETU_TPU_SERVE_PREEMPT", "HETU_TPU_SERVE_QUOTAS",
+                 "HETU_TPU_RUNLOG_SERVE_SAMPLE"):
         assert flags.identity_contract_programs(name) == ("decode",)
     # unrestricted contracts sweep everything
     assert flags.identity_contract_programs("HETU_TPU_PALLAS") is None

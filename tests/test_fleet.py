@@ -1,0 +1,293 @@
+"""Fleet-observatory tests (tier-1, CPU, seeded, hardware-free): the
+discrete-event simulator fuzzing the real scheduler/pool/quota machinery
+at 10^4 requests with exact span tiling, the per-tenant cost ledger, the
+serve-sample flag's weighted reports, the byte-identical determinism
+golden for `tools_fleet.py --json`, and the 10^6-request acceptance run
+(slow-marked)."""
+import json
+import os
+
+import pytest
+
+from hetu_tpu.obs.metrics import MetricsRegistry
+from hetu_tpu.obs.runlog import RunLog
+from hetu_tpu.serving.costs import COST_FIELDS
+from hetu_tpu.serving.fleet import (FLEET_SCHEMA, FleetConfig,
+                                    FleetSimulator, ServiceModel,
+                                    analytic_models, fleet_workload)
+from hetu_tpu.serving.request import SLOClass, parse_quotas, rid_sampled
+
+#: one tiny chip profile so tests never depend on the repo-root JSON
+HW = {"bf16_tflops": 100.0, "hbm_gbps": 800.0}
+
+
+def _models(page_size=8):
+    return analytic_models(num_params=1e8, num_layers=4, hidden_size=256,
+                           num_kv_heads=2, head_dim=32,
+                           page_size=page_size, hw=HW)
+
+
+def _workload(n, seed=0, **kw):
+    kwargs = dict(rate_per_s=500.0, burst=8,
+                  tenants=("acme", "bigco", "free"),
+                  slo_classes=[SLOClass("gold", ttft_s=0.5,
+                                        token_gap_s=0.25, priority=2),
+                               SLOClass("bulk")],
+                  prompt_lens=(4, 24), max_new=(2, 8), seed=seed)
+    kwargs.update(kw)
+    return fleet_workload(n, **kwargs)
+
+
+def _config(**kw):
+    kwargs = dict(num_slots=8, page_size=8, max_len=64, prefill_chunk=8,
+                  preempt=True, quotas=parse_quotas("free:2:16"),
+                  invariant_every=101, sample=1)
+    kwargs.update(kw)
+    return FleetConfig(**kwargs)
+
+
+# ------------------------------------------------------------- tentpole
+def test_fleet_sim_10k_invariants_span_tiling_and_exact_accounting():
+    """The tier-1 fuzz: 10^4 multi-tenant requests with quotas +
+    preemption through the real machinery.  Invariants hold at every
+    sweep, every kept trace validates with ZERO span/e2e residual (the
+    sim stamps both from one virtual clock — any gap is a bug), and the
+    exact per-(tenant, class) accounting reconciles with the totals."""
+    n = 10_000
+    svc, cost = _models()
+    sim = FleetSimulator(svc, config=_config(), cost_model=cost)
+    rep = sim.run(_workload(n))
+
+    assert rep["fleet_schema"] == FLEET_SCHEMA
+    assert rep["requests"] == n and rep["completed"] == n
+    assert rep["invariants"]["ok"] and rep["invariants"]["checks"] >= 2
+    # exact span tiling: every request traced (sample=1), zero residual
+    assert rep["trace_check"]["traces_checked"] == n
+    assert rep["trace_check"]["max_residual_s"] < 1e-9
+    # exact accounting: tenant rows partition the fleet
+    assert sum(t["requests"] for t in rep["tenants"].values()) == n
+    assert sum(c["requests"] for c in rep["classes"].values()) == n
+    # global tokens_out counts EMITTED tokens (engine semantics), tenant
+    # rows count tokens of FINISHED requests — preemptions discard the
+    # difference, which is exactly the preemption waste
+    finished_tokens = sum(t["tokens_out"] for t in rep["tenants"].values())
+    assert finished_tokens <= rep["tokens_out"]
+    if rep["preemptions"] == 0:
+        assert finished_tokens == rep["tokens_out"]
+    # the quota'd tenant was actually capped (peaks at/below the caps,
+    # and the cap bound: never above)
+    q = rep["quotas"]["free"]
+    assert 0 < q["peak_slots"] <= q["max_slots"]
+    assert 0 < q["peak_pages"] <= q["max_pages"]
+    # quota pressure showed up in the stall attribution
+    assert rep["stall_breakdown"].get("quota_exceeded", 0) > 0
+    # preemption happened (gold priority 2 over bulk) and was counted
+    assert rep["preemptions"] > 0
+    assert sum(t["preemptions"]
+               for t in rep["tenants"].values()) == rep["preemptions"]
+    # cost ledger: balanced (no open entries), per-tenant sums to total
+    assert sim.ledger.open_count == 0
+    assert sim.ledger.finished == n
+    total = rep["costs"]["total"]
+    for k in COST_FIELDS:
+        assert total[k] > 0.0
+        assert total[k] == pytest.approx(
+            sum(c[k] for c in rep["costs"]["by_tenant"].values()))
+    # wire bytes are exact arithmetic: (prompt+out) * 4 summed
+    wire = sum((r.prompt_len + r.max_new_tokens) * 4.0
+               for r in _workload(n))
+    assert total["cost_wire_bytes"] == pytest.approx(wire)
+
+
+def test_fleet_report_deterministic_same_seed():
+    """The determinism golden: the report is derived only from the
+    virtual clock and seeded reservoirs, so the same seed + workload
+    gives BYTE-identical JSON — replayable policy experiments."""
+    svc, cost = _models()
+    out = []
+    for _ in range(2):
+        sim = FleetSimulator(svc, config=_config(), cost_model=cost)
+        rep = sim.run(_workload(2000, seed=7))
+        out.append(json.dumps(rep, indent=2, sort_keys=True))
+    assert out[0] == out[1]
+    # a different seed is a different run (the golden isn't vacuous)
+    sim = FleetSimulator(svc, config=_config(), cost_model=cost)
+    other = json.dumps(sim.run(_workload(2000, seed=8)),
+                       indent=2, sort_keys=True)
+    assert other != out[0]
+
+
+def test_fleet_sampled_runlog_weighted_report_and_exact_registry():
+    """HETU_TPU_RUNLOG_SERVE_SAMPLE semantics through the sim: the
+    sampled RunLog carries ~1/N of the per-request events stamped
+    sample_weight=N, `slo_report` re-weights them back to fleet totals
+    unbiasedly, and the registry counters stay exact regardless."""
+    from hetu_tpu.serving import slo_report
+    n = 4000
+    svc, cost = _models()
+
+    def run(sample, path):
+        reg = MetricsRegistry()
+        log = RunLog(str(path))
+        sim = FleetSimulator(svc, config=_config(sample=sample),
+                             cost_model=cost, run_log=log, registry=reg)
+        rep = sim.run(_workload(n))
+        log.close()
+        return rep, reg, RunLog.read(str(path))
+
+    import tempfile
+    d = tempfile.mkdtemp(prefix="fleet_sample_")
+    full_rep, full_reg, full_recs = run(1, os.path.join(d, "full.jsonl"))
+    samp_rep, samp_reg, samp_recs = run(10, os.path.join(d, "samp.jsonl"))
+
+    # exact in-memory accounting identical across sampling rates
+    assert samp_rep["completed"] == full_rep["completed"] == n
+    assert samp_rep["tokens_out"] == full_rep["tokens_out"]
+    # registry counters exact in both (never sampled)
+    for reg in (full_reg, samp_reg):
+        snap = {m["name"]: m for m in reg.snapshot()["counters"]}
+        assert snap["serve.requests_done"]["value"] == n
+        assert (snap["serve.tokens_out"]["value"]
+                == full_rep["tokens_out"])
+    # the sampled log is actually smaller, and weighted
+    full_dones = [r for r in full_recs if r.get("event") == "done"]
+    samp_dones = [r for r in samp_recs if r.get("event") == "done"]
+    assert len(full_dones) == n
+    assert 0 < len(samp_dones) < n // 5
+    assert all(r.get("sample_weight") == 10 for r in samp_dones)
+    assert all(r.get("sample_weight") is None for r in full_dones)
+    # the sample is the deterministic hashed subset
+    assert ({r["req"] for r in samp_dones}
+            == {r["req"] for r in full_dones if rid_sampled(r["req"], 10)})
+    # slo_report re-weights: totals within sampling error of the truth
+    full = slo_report.serving_report(full_recs)
+    samp = slo_report.serving_report(samp_recs)
+    assert full["requests"] == n
+    assert samp["requests"] == pytest.approx(n, rel=0.2)
+    assert samp["tokens_out"] == pytest.approx(full["tokens_out"],
+                                               rel=0.25)
+    # both tenants' sections survive sampling (the hashed sampler is
+    # decorrelated from round-robin tenant assignment)
+    assert set(samp["tenants"]) == set(full["tenants"])
+    # weighted per-tenant costs within sampling error of exact ledger
+    exact = full_rep["costs"]["total"]
+    est = samp["costs"]["total"]
+    assert est["cost_wire_bytes"] == pytest.approx(
+        exact["cost_wire_bytes"], rel=0.25)
+
+
+def test_rid_sampled_identity_and_uniformity():
+    """n=1 samples everything (the identity contract's behavioral
+    half); n>1 hits ~1/n of rids and is decorrelated from round-robin
+    strides (the modulo-sampling aliasing regression)."""
+    assert all(rid_sampled(r, 1) for r in range(1000))
+    for n in (2, 4, 7, 1000):
+        frac = sum(rid_sampled(r, n) for r in range(100_000)) / 100_000
+        assert frac == pytest.approx(1.0 / n, rel=0.15)
+    # stride-2 round-robin (2 tenants) must not alias with 1-in-4
+    even = sum(rid_sampled(r, 4) for r in range(0, 100_000, 2))
+    odd = sum(rid_sampled(r, 4) for r in range(1, 100_000, 2))
+    assert even == pytest.approx(odd, rel=0.1)
+
+
+def test_fleet_chaos_storm_inflates_virtual_time():
+    """fleet-storm: the chaos plan's slow_worker window inflates the
+    MODELED clock — same workload, same policy decisions, longer
+    simulated elapsed time; the run still completes and reconciles."""
+    from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
+    svc, cost = _models()
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="slow_worker", rank=0, at_step=10, count=50,
+                  delay_s=0.05)])
+    reps = []
+    for fp in (None, plan):
+        sim = FleetSimulator(svc, config=_config(), cost_model=cost,
+                             fault_plan=fp)
+        reps.append(sim.run(_workload(1500)))
+    calm, storm = reps
+    assert storm["completed"] == calm["completed"] == 1500
+    # every step in [10, 60) fired its 0.05s delay ...
+    assert plan.faults[0].injected == 50
+    # ... but net inflation is LESS than 2.5s: slow steps let queues
+    # build, so the storm run batches fuller and takes fewer steps.
+    # Assert strict inflation, not the naive injected total.
+    assert storm["elapsed_s"] > calm["elapsed_s"] + 0.25
+    assert storm["elapsed_s"] < calm["elapsed_s"] + 50 * 0.05
+    assert storm["trace_check"]["max_residual_s"] < 1e-9
+
+
+def test_tools_fleet_json_schema_and_exit(tmp_path, capsys):
+    """tools_fleet.py smoke: the pinned --json schema keys, exit 0 on a
+    complete+invariant-clean run, and the chrome-trace artifact."""
+    import tools_fleet
+    trace = tmp_path / "fleet_trace.json"
+    rc = tools_fleet.main([
+        "--requests", "400", "--rate", "500", "--tenants", "a,b",
+        "--quotas", "b:2:16", "--slo-class", "gold:0.2:0.05:2",
+        "--slo-class", "bulk", "--preempt", "--slots", "4",
+        "--page-size", "8", "--max-len", "64", "--prefill-chunk", "8",
+        "--prompt-lens", "4,16", "--max-new", "2,6",
+        "--chrome-trace", str(trace), "--json"])
+    assert rc == 0
+    rep = json.loads(capsys.readouterr().out)
+    for key in ("fleet_schema", "requests", "completed", "tokens_out",
+                "elapsed_s", "tokens_per_s", "steps", "admitted",
+                "preemptions", "prefill_chunks", "stall_steps",
+                "stall_breakdown", "tenants", "classes", "quotas",
+                "invariants", "trace_check", "sample", "service_model",
+                "costs"):
+        assert key in rep, key
+    assert rep["fleet_schema"] == FLEET_SCHEMA
+    assert rep["completed"] == 400
+    assert set(rep["tenants"]) == {"a", "b"}
+    assert set(rep["costs"]["by_tenant"]) == {"a", "b"}
+    # the chrome trace rendered the sampled requests
+    events = json.loads(trace.read_text())
+    assert any(e.get("ph") == "X" for e in events)
+    # text mode renders the same report without crashing
+    assert "fleet report" in tools_fleet.render_text(rep)
+
+
+def test_service_model_roofline_monotonic():
+    """Sanity on the analytic clock: more work is never faster, and
+    the hardware profile scales it."""
+    svc = ServiceModel.from_hardware_profile(
+        num_params=1e8, num_layers=4, hidden_size=256, num_kv_heads=2,
+        head_dim=32, hw=HW)
+    assert svc.decode_step_s(0, 0) == 0.0
+    assert (svc.decode_step_s(8, 4096) > svc.decode_step_s(8, 512)
+            > svc.decode_step_s(1, 64) > 0)
+    assert svc.prefill_chunk_s(64, 512) > svc.prefill_chunk_s(8, 0) > 0
+    fast = ServiceModel.from_hardware_profile(
+        num_params=1e8, num_layers=4, hidden_size=256, num_kv_heads=2,
+        head_dim=32, hw={"bf16_tflops": 1000.0, "hbm_gbps": 8000.0})
+    assert fast.decode_step_s(8, 4096) < svc.decode_step_s(8, 4096)
+
+
+@pytest.mark.slow
+def test_fleet_million_requests_acceptance():
+    """The acceptance bar: 10^6 requests through the real machinery,
+    hardware-free, with sampled invariant sweeps passing and zero
+    span-reconciliation residual on the sampled traces."""
+    n = 1_000_000
+    svc, cost = analytic_models(num_params=1e9, num_layers=8,
+                                hidden_size=1024, num_kv_heads=4,
+                                head_dim=64, page_size=8, hw=HW)
+    cfg = FleetConfig(num_slots=256, page_size=8, max_len=32,
+                      prefill_chunk=16, preempt=False,
+                      quotas=parse_quotas("free:64:1024"),
+                      invariant_every=5000, sample=1000)
+    reqs = fleet_workload(n, rate_per_s=20_000.0, burst=64,
+                          tenants=("acme", "bigco", "free"),
+                          prompt_lens=(4, 16), max_new=(2, 6), seed=0)
+    sim = FleetSimulator(svc, config=cfg, cost_model=cost)
+    rep = sim.run(reqs)
+    assert rep["completed"] == n
+    assert rep["invariants"]["ok"]
+    # 256 slots batch hard, so 10^6 requests resolve in ~2e4 steps —
+    # scale the sweep floor by actual steps, not request count
+    assert rep["invariants"]["checks"] >= rep["steps"] // 5000
+    assert rep["trace_check"]["traces_checked"] >= n // 2000
+    assert rep["trace_check"]["max_residual_s"] < 1e-6
+    assert sim.ledger.open_count == 0
+    assert sum(t["requests"] for t in rep["tenants"].values()) == n

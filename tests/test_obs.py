@@ -67,6 +67,45 @@ def test_histogram_reservoir_keeps_aggregates_exact_past_cap():
     assert len(h._sample) == 8
 
 
+def test_histogram_reservoir_is_uniform_not_recency_biased():
+    """Algorithm-R regression: at count >> cap the reservoir must be a
+    UNIFORM sample of the whole stream, so a burst of early-run
+    outliers survives into p99.  A recency-biased reservoir (the
+    classic broken variant: past cap, overwrite a random slot for EVERY
+    arrival) forgets the early spike almost completely — survival
+    probability (1 - 1/cap)^n -> 0 — and reports a flat tail.  Seeded —
+    the sample is deterministic for a fixed observation order."""
+    h = Histogram(cap=64)
+    # a 10% early outlier burst, then a long quiet tail (count >> cap)
+    for _ in range(1000):
+        h.observe(1000.0)
+    for _ in range(9000):
+        h.observe(1.0)
+    assert h.count == 10_000 and h.vmax == 1000.0
+    early = sum(1 for v in h._sample if v == 1000.0)
+    # uniform inclusion: E[outliers in reservoir] = 64 * 10% = 6.4; the
+    # broken recency variant keeps (1 - 1/64)^9000 ~ 6e-62 of them.
+    # Bound loosely (binomial, seeded): the spike must still be there.
+    assert 2 <= early <= 16, early
+    # ... and big enough that p99 (rank 63 of 64) sees it
+    assert h.percentile(99) == 1000.0
+    # order-reversal uniformity: a late burst survives at the same rate
+    h2 = Histogram(cap=64)
+    for _ in range(9000):
+        h2.observe(1.0)
+    for _ in range(1000):
+        h2.observe(1000.0)
+    late = sum(1 for v in h2._sample if v == 1000.0)
+    assert 2 <= late <= 16, late
+    # determinism: same seed + same stream -> identical reservoir
+    h3 = Histogram(cap=64)
+    for _ in range(1000):
+        h3.observe(1000.0)
+    for _ in range(9000):
+        h3.observe(1.0)
+    assert h3._sample == h._sample
+
+
 def test_registry_concurrent_increments_are_exact():
     reg = MetricsRegistry()
     N, THREADS = 1000, 8

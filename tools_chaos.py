@@ -7,8 +7,8 @@ recovery report.
     python tools_chaos.py --steps 48 --workers 2 --json report.json
 
 Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
-partition, corrupt, stall, slow, serve-burst, serve-preempt.  A path
-argument loads a
+partition, corrupt, stall, slow, serve-burst, serve-preempt,
+fleet-storm.  A path argument loads a
 FaultPlan JSON (docs/fault_tolerance.md has the schema — the same format
 the HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs
 with HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster
@@ -26,6 +26,16 @@ scenario with SLO-class preemptive admission armed (gold at priority 2):
 the slowdown pins bulk decodes on every slot and arriving gold requests
 evict-and-requeue them — the report's `slo.preemptions` section names
 the victims.
+
+`--schedule fleet-storm` scales the serving scenario to fleet size: a
+multi-tenant burst storm through the discrete-event fleet simulator
+(`serving/fleet.py` — the real scheduler/page-pool/quota machinery
+under an analytic service model, no model weights, no device), with the
+slow-service window inflating the MODELED step time instead of
+sleeping.  Thousands of requests replay in seconds; the report's
+`fleet` key carries per-tenant attainment/goodput, quota stalls and the
+per-request cost ledger, and `slo` re-derives the same story from the
+simulator's RunLog.
 
 The demo run is CPU-only and model-free (StubTrainer checkpoints real
 bytes through orbax; the control plane — reconnecting rpc client,
@@ -56,12 +66,15 @@ def main(argv=None) -> int:
                          "(training schedules only)")
     ap.add_argument("--workers", type=int, default=2,
                     help="demo cluster size (training schedules only)")
-    ap.add_argument("--requests", type=int, default=18,
-                    help="serve-burst: requests in the arrival trace")
-    ap.add_argument("--rate", type=float, default=60.0,
-                    help="serve-burst: mean arrival rate, requests/s")
-    ap.add_argument("--burst", type=int, default=6,
-                    help="serve-burst: requests per burst")
+    ap.add_argument("--requests", type=int, default=None,
+                    help="serving schedules: requests in the arrival "
+                         "trace (default 18; fleet-storm 5000)")
+    ap.add_argument("--rate", type=float, default=None,
+                    help="serving schedules: mean arrival rate, "
+                         "requests/s (default 60; fleet-storm 2000)")
+    ap.add_argument("--burst", type=int, default=None,
+                    help="serving schedules: requests per burst "
+                         "(default 6; fleet-storm 16)")
     ap.add_argument("--workdir", default=None,
                     help="where checkpoints land (default: a tmp dir)")
     ap.add_argument("--json", dest="json_out", default=None,
@@ -70,6 +83,7 @@ def main(argv=None) -> int:
 
     from hetu_tpu.chaos import FaultPlan
     from hetu_tpu.chaos.harness import (named_plan, run_chaos_demo,
+                                        run_fleet_chaos_demo,
                                         run_serving_chaos_demo)
 
     if os.path.exists(args.schedule):
@@ -78,12 +92,20 @@ def main(argv=None) -> int:
         plan = named_plan(args.schedule)
 
     workdir = args.workdir or tempfile.mkdtemp(prefix="hetu_chaos_")
-    if args.schedule in ("serve-burst", "serve-preempt"):
+    if args.schedule == "fleet-storm":
+        # fleet-scale serving storm through the discrete-event simulator;
+        # --requests/--rate/--burst apply, --steps/--workers do not
+        report = run_fleet_chaos_demo(
+            workdir, plan,
+            requests=args.requests or 5000,
+            rate=args.rate or 2000.0,
+            burst=args.burst or 16)
+    elif args.schedule in ("serve-burst", "serve-preempt"):
         # the serving scenario has its own knobs; the training demo's
         # --steps/--workers do not apply to it
         report = run_serving_chaos_demo(
-            workdir, plan, requests=args.requests,
-            rate=args.rate, burst=args.burst,
+            workdir, plan, requests=args.requests or 18,
+            rate=args.rate or 60.0, burst=args.burst or 6,
             preempt=args.schedule == "serve-preempt")
     else:
         report = run_chaos_demo(workdir, plan, num_steps=args.steps,

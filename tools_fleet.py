@@ -1,0 +1,245 @@
+"""Fleet observatory CLI: replay a seeded multi-tenant request trace
+through the discrete-event fleet simulator and print the report.
+
+    python tools_fleet.py                                  # 20k requests
+    python tools_fleet.py --requests 1000000 --slots 256   # fleet scale
+    python tools_fleet.py --tenants acme,bigco,free \
+        --quotas free:2:32 --slo-class gold:0.2:0.05:2 --slo-class bulk \
+        --preempt --json
+    python tools_fleet.py --chrome-trace /tmp/fleet.trace.json --sample 100
+
+The simulator (`hetu_tpu/serving/fleet.py`) drives the REAL serving
+state machines — Scheduler admission/reserve-on-admit/preemption,
+PagePool/RadixPrefixCache refcounts and eviction, tenant quotas,
+RequestTracer span tiling — under a virtual clock priced by an analytic
+roofline `ServiceModel`, so no device (and no jax math) is touched and
+10^6 requests replay in about a minute on one CPU.  Accounting is exact
+per request; the optional RunLog/chrome-trace stream is a deterministic
+1-in-N request sample (``--sample`` / HETU_TPU_RUNLOG_SERVE_SAMPLE)
+with ``sample_weight`` stamped so `slo_report.py` stays unbiased.
+
+The report carries per-(tenant, class) SLO attainment/goodput/latency
+reservoirs, stall attribution (including ``quota_exceeded``), quota
+peak occupancy, the per-request cost ledger rolled up per tenant
+(`serving/costs.py`), invariant-fuzz and span-reconciliation results,
+and the ServiceModel constants used.  ``--json`` output is
+byte-identical for a fixed seed + arguments (the determinism golden in
+tests/test_fleet.py pins this); ``--chrome-trace`` renders the sampled
+requests' per-slot timeline via `obs/trace.py serving_trace` (open at
+https://ui.perfetto.dev).  See docs/serving.md.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+
+def _pair(spec: str, name: str) -> tuple:
+    lo, _, hi = spec.partition(",")
+    lo, hi = int(lo), int(hi or lo)
+    if lo <= 0 or hi < lo:
+        raise SystemExit(f"--{name} must be LO[,HI] with 0 < LO <= HI, "
+                         f"got {spec!r}")
+    return lo, hi
+
+
+def _fmt_hist(h) -> str:
+    if not h:
+        return f"{'-':>8} {'-':>8} {'-':>8}"
+    return f"{h['p50']:>8.4f} {h['p95']:>8.4f} {h['p99']:>8.4f}"
+
+
+def render_text(rep: dict) -> str:
+    ln = []
+    ln.append(f"fleet report (schema {rep['fleet_schema']}): "
+              f"{rep['completed']}/{rep['requests']} requests, "
+              f"{rep['tokens_out']} tokens in {rep['elapsed_s']:.3f} "
+              f"simulated s ({rep['tokens_per_s']:.0f} tok/s)")
+    ln.append(f"  steps: {rep['steps']}  prefill chunks: "
+              f"{rep['prefill_chunks']}  preemptions: "
+              f"{rep['preemptions']}  sample: 1-in-{rep['sample']}")
+    inv, tc = rep["invariants"], rep["trace_check"]
+    ln.append(f"  invariants: {inv['checks']} checks "
+              f"{'ok' if inv['ok'] else 'FAILED'}  spans: "
+              f"{tc['traces_checked']} traces, max residual "
+              f"{tc['max_residual_s']:.3g}s")
+    if rep.get("stall_breakdown"):
+        parts = ", ".join(f"{k}={v}" for k, v in
+                          sorted(rep["stall_breakdown"].items()))
+        ln.append(f"  admission stalls: {parts}")
+    hdr = (f"  {'tenant/class':>16} {'reqs':>8} {'tokens':>9} "
+           f"{'attain':>7} {'goodput/s':>10} "
+           f"{'ttft p50':>8} {'p95':>8} {'p99':>8}")
+    for title, groups in (("tenant", rep.get("tenants") or {}),
+                          ("class", rep.get("classes") or {})):
+        if not groups:
+            continue
+        ln.append(f"per-{title}:")
+        ln.append(hdr)
+        ln.append("  " + "-" * (len(hdr) - 2))
+        for name in sorted(groups):
+            g = groups[name]
+            ln.append(f"  {name:>16} {g['requests']:>8} "
+                      f"{g['tokens_out']:>9} "
+                      f"{g['slo_attainment']:>7.3f} "
+                      f"{g['goodput_tokens_per_s']:>10.0f} "
+                      f"{_fmt_hist(g.get('ttft_s'))}")
+    for tenant, q in sorted((rep.get("quotas") or {}).items()):
+        ln.append(f"  quota[{tenant}]: slots {q['peak_slots']}"
+                  f"/{q['max_slots'] or '-'} peak, pages "
+                  f"{q['peak_pages']}/{q['max_pages'] or '-'} peak")
+    costs = rep.get("costs") or {}
+    for tenant in sorted(costs.get("by_tenant") or {}):
+        c = costs["by_tenant"][tenant]
+        ln.append(f"  cost[{tenant}]: "
+                  f"{c['cost_prefill_flops']:.3g} + "
+                  f"{c['cost_decode_flops']:.3g} FLOPs (prefill+decode), "
+                  f"{c['cost_page_s']:.3g} page-s, "
+                  f"{c['cost_kv_byte_s']:.3g} KV byte-s, "
+                  f"{c['cost_wire_bytes']:.0f} wire B")
+    if costs.get("total"):
+        c = costs["total"]
+        ln.append(f"  cost[TOTAL]: {c['cost_prefill_flops']:.3g} + "
+                  f"{c['cost_decode_flops']:.3g} FLOPs, "
+                  f"{c['cost_page_s']:.3g} page-s, "
+                  f"{c['cost_kv_byte_s']:.3g} KV byte-s, "
+                  f"{c['cost_wire_bytes']:.0f} wire B")
+    pc = rep.get("prefix_cache")
+    if pc:
+        ln.append(f"  prefix cache: {pc['hits']}/"
+                  f"{pc['hits'] + pc['misses']} hits, "
+                  f"{pc['shared_tokens']} shared tokens")
+    svc = rep["service_model"]
+    ln.append(f"  service model: {svc['flops_per_token']:.3g} FLOPs/tok, "
+              f"{svc['peak_flops']:.3g} peak FLOP/s, "
+              f"{svc['hbm_bytes_per_s']:.3g} HBM B/s, "
+              f"{svc['step_overhead_s']*1e6:.0f}us/step overhead")
+    return "\n".join(ln)
+
+
+def main(argv=None) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    ap = argparse.ArgumentParser(
+        description="million-request fleet simulation over the real "
+                    "serving state machines (no device, no jax math)")
+    # ---- workload
+    ap.add_argument("--requests", type=int, default=20_000)
+    ap.add_argument("--rate", type=float, default=2000.0,
+                    help="mean arrival rate, requests/s")
+    ap.add_argument("--burst", type=int, default=0,
+                    help="requests per burst (0 = Poisson arrivals)")
+    ap.add_argument("--tenants", default="default",
+                    help="comma-separated tenant names, assigned "
+                         "round-robin")
+    ap.add_argument("--slo-class", action="append", default=[],
+                    metavar="NAME[:TTFT_S[:GAP_S[:PRIO]]]",
+                    help="SLO class (repeatable), assigned round-robin")
+    ap.add_argument("--prompt-lens", default="16,64", metavar="LO[,HI]")
+    ap.add_argument("--max-new", default="4,16", metavar="LO[,HI]")
+    ap.add_argument("--shared-prefix", type=int, default=0,
+                    help="tokens of shared prompt prefix (exercises the "
+                         "radix cache)")
+    ap.add_argument("--seed", type=int, default=0)
+    # ---- fleet shape
+    ap.add_argument("--slots", type=int, default=64)
+    ap.add_argument("--pages", type=int, default=0,
+                    help="KV pages (0 = full reservation per slot)")
+    ap.add_argument("--page-size", type=int, default=16)
+    ap.add_argument("--max-len", type=int, default=512)
+    ap.add_argument("--prefill-chunk", type=int, default=64)
+    ap.add_argument("--prefix-cache", action="store_true")
+    ap.add_argument("--preempt", action="store_true",
+                    help="arm SLO-priority preemptive admission")
+    ap.add_argument("--quotas", default="",
+                    metavar="TENANT[:SLOTS[:PAGES]],...",
+                    help="per-tenant admission quotas "
+                         "(HETU_TPU_SERVE_QUOTAS syntax)")
+    ap.add_argument("--invariant-every", type=int, default=997,
+                    help="check_invariants() every N sim steps")
+    # ---- service model
+    ap.add_argument("--num-params", type=float, default=8e9)
+    ap.add_argument("--layers", type=int, default=32)
+    ap.add_argument("--hidden", type=int, default=4096)
+    ap.add_argument("--kv-heads", type=int, default=8)
+    ap.add_argument("--head-dim", type=int, default=128)
+    ap.add_argument("--kv-mode", default="fp16",
+                    choices=("fp16", "int8", "int8_seg"))
+    ap.add_argument("--hw-profile", default=None,
+                    help="hardware profile JSON (default: obs/mfu "
+                         "resolution chain)")
+    # ---- output
+    ap.add_argument("--sample", type=int, default=0,
+                    help="RunLog/trace request sampling 1-in-N "
+                         "(0 = HETU_TPU_RUNLOG_SERVE_SAMPLE)")
+    ap.add_argument("--runlog", default=None,
+                    help="write the sampled serve/span stream here "
+                         "(readable by tools_serving_report.py)")
+    ap.add_argument("--chrome-trace", default=None,
+                    help="write the sampled requests' per-slot Perfetto "
+                         "timeline here")
+    ap.add_argument("--json", dest="json_out", action="store_true",
+                    help="print the report as JSON (byte-identical per "
+                         "seed) instead of text")
+    args = ap.parse_args(argv)
+
+    from hetu_tpu.obs.mfu import load_hardware_profile
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.serving.fleet import (FleetConfig, FleetSimulator,
+                                        analytic_models, fleet_workload)
+    from hetu_tpu.serving.request import SLOClass, parse_quotas
+
+    classes = ([SLOClass.parse(s) for s in args.slo_class]
+               if args.slo_class else None)
+    reqs = fleet_workload(
+        args.requests, rate_per_s=args.rate, burst=args.burst,
+        tenants=[t for t in args.tenants.split(",") if t],
+        slo_classes=classes,
+        prompt_lens=_pair(args.prompt_lens, "prompt-lens"),
+        max_new=_pair(args.max_new, "max-new"),
+        shared_prefix_len=args.shared_prefix, seed=args.seed)
+    svc, cost = analytic_models(
+        num_params=args.num_params, num_layers=args.layers,
+        hidden_size=args.hidden, num_kv_heads=args.kv_heads,
+        head_dim=args.head_dim, page_size=args.page_size,
+        kv_mode=args.kv_mode,
+        hw=load_hardware_profile(args.hw_profile))
+    cfg = FleetConfig(
+        num_slots=args.slots, page_size=args.page_size,
+        max_len=args.max_len, prefill_chunk=args.prefill_chunk,
+        num_pages=args.pages, prefix_cache=args.prefix_cache,
+        preempt=args.preempt, quotas=parse_quotas(args.quotas),
+        invariant_every=args.invariant_every, sample=args.sample)
+
+    log_path = args.runlog
+    if log_path is None and args.chrome_trace:
+        import tempfile
+        log_path = os.path.join(
+            tempfile.mkdtemp(prefix="hetu_fleet_"), "fleet.jsonl")
+    run_log = RunLog(log_path) if log_path else None
+    sim = FleetSimulator(svc, config=cfg, cost_model=cost,
+                         run_log=run_log)
+    rep = sim.run(reqs)
+    if run_log is not None:
+        run_log.close()
+
+    if args.chrome_trace:
+        from hetu_tpu.obs.trace import serving_trace
+        serving_trace(RunLog.read(log_path),
+                      pid="fleet").save(args.chrome_trace)
+        print(f"chrome trace -> {args.chrome_trace} "
+              f"(1-in-{rep['sample']} requests)", file=sys.stderr)
+    if log_path:
+        print(f"runlog -> {log_path}", file=sys.stderr)
+
+    if args.json_out:
+        print(json.dumps(rep, indent=2, sort_keys=True))
+    else:
+        print(render_text(rep))
+    return 0 if (rep["completed"] == rep["requests"]
+                 and rep["invariants"]["ok"]) else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

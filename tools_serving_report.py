@@ -20,7 +20,13 @@ decoding prints the **acceptance-rate** line (drafts accepted /
 proposed, from the done events), the radix prefix cache prints the
 **cache-hit** line (admissions hit + prefill tokens eliminated, from
 the admit events), and preemptive admission prints victim/preemptor
-class counts.
+class counts.  Multi-tenant runs (Request.tenant stamped on the serve
+events) add the per-tenant attainment/goodput table and — when the
+engine priced requests through a `serving/costs.py` CostLedger — the
+per-tenant cost roll-up (prefill/decode FLOPs, KV page-seconds,
+resident byte-seconds, wire bytes).  Sampled RunLogs
+(HETU_TPU_RUNLOG_SERVE_SAMPLE > 1) are re-weighted by the stamped
+``sample_weight`` so totals and attainment stay unbiased.
 
 Pure host-side file munging: no device contact, safe when the TPU
 tunnel is down.  See docs/serving.md (SLO classes) and
@@ -62,12 +68,13 @@ def main(argv=None) -> int:
     rows = rep.pop("per_request", None)
     print(slo_report.render_text(rep))
     if rows:
-        hdr = (f"{'rid':>5} {'class':>10} {'ttft':>8} {'e2e':>8} "
-               f"{'toks':>5} {'stall':>9} {'slo':>4}")
+        hdr = (f"{'rid':>5} {'tenant':>10} {'class':>10} {'ttft':>8} "
+               f"{'e2e':>8} {'toks':>5} {'stall':>9} {'slo':>4}")
         print(hdr)
         print("-" * len(hdr))
         for r in rows:
-            print(f"{r['rid']:>5} {r['slo_class']:>10} "
+            print(f"{r['rid']:>5} {str(r.get('tenant') or '-'):>10} "
+                  f"{r['slo_class']:>10} "
                   f"{(r['ttft_s'] or 0):>8.4f} {(r['e2e_s'] or 0):>8.4f} "
                   f"{r['tokens']:>5} {str(r.get('stall_reason') or '-'):>9} "
                   f"{'ok' if r['slo_ok'] else 'MISS':>4}")

@@ -21,8 +21,8 @@ import threading
 from typing import Optional
 
 from hetu_tpu.chaos.inject import (corrupt_latest,  # noqa: F401
-                                   corrupt_step, maybe_slow_step,
-                                   newest_step)
+                                   corrupt_step, maybe_chaos_serving,
+                                   maybe_slow_step, newest_step)
 from hetu_tpu.chaos.plan import (CORRUPT_MODES, KINDS,  # noqa: F401
                                  FaultPlan, FaultSpec)
 
@@ -68,4 +68,4 @@ def reset():
 __all__ = ["FaultPlan", "FaultSpec", "KINDS", "CORRUPT_MODES",
            "get_plan", "install", "reset",
            "corrupt_step", "corrupt_latest", "newest_step",
-           "maybe_slow_step"]
+           "maybe_slow_step", "maybe_chaos_serving"]

@@ -19,7 +19,8 @@ import time
 from typing import Any, Dict, List, Optional
 
 from hetu_tpu import chaos
-from hetu_tpu.chaos.inject import corrupt_step, maybe_slow_step, newest_step
+from hetu_tpu.chaos.inject import (corrupt_step, maybe_chaos_serving,
+                                   maybe_slow_step, newest_step)
 from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
 from hetu_tpu.obs.metrics import get_registry
 from hetu_tpu.utils.logging import get_logger
@@ -31,7 +32,8 @@ _REPORT_COUNTERS = (
     "chaos.injected_rpc_drop", "chaos.injected_rpc_delay",
     "chaos.injected_rpc_dup", "chaos.injected_heartbeat_stall",
     "chaos.injected_worker_kill", "chaos.injected_ckpt_corrupt",
-    "chaos.injected_slow_worker",
+    "chaos.injected_slow_worker", "chaos.injected_engine_kill",
+    "chaos.injected_reshard_storm", "chaos.injected_decode_stall",
     "rpc.disconnects", "rpc.reconnects", "rpc.reattaches",
     "rpc.heartbeat_lost", "rpc.workers_lost",
     "rpc.telemetry_pushes", "rpc.telemetry_push_failures",
@@ -336,6 +338,11 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
                            requests: int = 18, rate: float = 60.0,
                            burst: int = 6, num_slots: int = 2,
                            num_pages: int = 10, preempt: bool = False,
+                           retry_budget: int = 0,
+                           deadline_s: Optional[float] = None,
+                           brownout: bool = False,
+                           brownout_page_high: float = 0.95,
+                           brownout_streak: int = 4,
                            seed: int = 0) -> Dict[str, Any]:
     """The serving chaos scenario (the PR 7 follow-up): a seeded
     burst-arrival trace through the REAL continuous-batching engine
@@ -356,7 +363,18 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     priority 2: when the decode slowdown piles bulk decodes onto every
     slot, arriving gold requests evict-and-requeue the bulk occupants —
     the report's `preemptions` section shows who was bumped, and gold's
-    attainment holds while bulk pays."""
+    attainment holds while bulk pays.
+
+    The serving FAULT kinds ride the same hook (`maybe_chaos_serving`):
+    an ``engine_kill`` spec fails the engine over mid-run — with
+    ``retry_budget`` > 0 (the ``serve-failover`` schedule) every
+    in-flight request requeues under the ``replica_lost`` stall reason
+    and replays token-identically — and a ``reshard_storm`` spec forces
+    hot tier flips.  ``deadline_s`` arms the bulk class's deadline and
+    ``brownout=True`` (the ``serve-brownout`` schedule) arms
+    sustained-pressure shedding; the recovery report then carries the
+    failover/deadline/brownout sections (retry counts, per-class
+    attainment) from `serving/slo_report.py`."""
     import jax
     import jax.numpy as jnp
     from hetu_tpu import serving
@@ -372,8 +390,8 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     params = model.init(jax.random.key(seed))
 
     classes = [serving.SLOClass("gold", ttft_s=0.5, token_gap_s=0.25,
-                                priority=2 if preempt else 0),
-               serving.SLOClass("bulk")]
+                                priority=2 if preempt or brownout else 0),
+               serving.SLOClass("bulk", deadline_s=deadline_s)]
     arrivals = serving.bursty_arrivals(requests, rate, burst=burst,
                                        seed=seed)
     reqs = serving.synthetic_requests(
@@ -390,16 +408,24 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
         model, params,
         serving.ServeConfig(num_slots=num_slots, page_size=8, max_len=32,
                             prefill_chunk=8, num_pages=num_pages,
-                            preempt=preempt),
+                            preempt=preempt, retry_budget=retry_budget,
+                            deadline=deadline_s is not None,
+                            brownout=brownout,
+                            brownout_page_high=brownout_page_high,
+                            brownout_streak=brownout_streak),
         registry=registry, run_log=run_log, tracer=tracer, health=health)
     eng.warmup()
 
-    # the engine's own run() loop with the slow-decode injection hooked
-    # at each step boundary (inside the timed window): the sleep
-    # inflates the virtual clock exactly like a straggling decode step
-    # would, so spans/TTFT/detectors all see it
-    results = eng.run(reqs,
-                      on_step=lambda idx: maybe_slow_step(plan, 0, idx))
+    # the engine's own run() loop with the chaos injections hooked at
+    # each step boundary (inside the timed window): the slow/stall
+    # sleep inflates the virtual clock exactly like a straggling decode
+    # step would, and the serving fault kinds (engine_kill,
+    # reshard_storm) fire through maybe_chaos_serving
+    def _on_step(idx: int):
+        maybe_slow_step(plan, 0, idx)
+        maybe_chaos_serving(plan, eng, idx, rank=0)
+
+    results = eng.run(reqs, on_step=_on_step)
     run_log.close()
 
     records = RunLog.read(log_path)
@@ -407,6 +433,18 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     snap = registry.snapshot()
     detectors = {r["name"]: r["value"] for r in snap["counters"]
                  if r["name"].startswith("health.")}
+    reasons: Dict[str, int] = {}
+    for r in results:
+        reasons[r.finished_reason] = reasons.get(r.finished_reason, 0) + 1
+    fault_names = ("serve.failovers", "serve.replica_requeues",
+                   "serve.retry_exhausted", "serve.deadline_exceeded",
+                   "serve.brownout_shed", "serve.kv_repages",
+                   "serve.reshards")
+    faults = {}
+    for rec in snap["counters"]:
+        if rec["name"] in fault_names:
+            faults[rec["name"]] = faults.get(rec["name"], 0) \
+                + rec["value"]
     return {
         "completed": len(results) == len(reqs),
         "requests": len(results),
@@ -414,6 +452,8 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
         "injected": plan.summary(),
         "detectors": detectors,
         "preemptions": eng.scheduler.preempted,
+        "finished_reasons": dict(sorted(reasons.items())),
+        "faults": faults,
         "slo": report,
         "runlog": log_path,
     }
@@ -531,6 +571,29 @@ def named_plan(name: str, **kw) -> FaultPlan:
                       count=kw.get("count", 16),
                       delay_s=kw.get("delay_s", 0.25)),
         ])
+    if name == "serve-failover":
+        # the failover scenario (run_serving_chaos_demo with
+        # retry_budget > 0): the engine replica dies mid-decode; every
+        # in-flight request requeues under its retry budget
+        # (stall reason replica_lost), re-prefills against the warm
+        # radix cache and replays its exact token stream — the report's
+        # failover section carries requeue/retry counts per class
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="engine_kill", rank=0,
+                      at_step=kw.get("at_step", 6)),
+        ])
+    if name == "serve-brownout":
+        # the brownout scenario (run_serving_chaos_demo with
+        # brownout=True and a tight page pool): a decode-stall window
+        # piles queued bulk work onto sustained page pressure until the
+        # shed policy fires — the report's brownout section names the
+        # shed class and HETU_TPU_HEALTH meters brownout_shed anomalies
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="decode_stall", rank=0,
+                      at_step=kw.get("at_step", 3),
+                      count=kw.get("count", 12),
+                      delay_s=kw.get("delay_s", 0.2)),
+        ])
     if name == "fleet-storm":
         # the fleet scenario (run_fleet_chaos_demo): a multi-tenant
         # burst storm through the discrete-event fleet simulator with a
@@ -553,4 +616,5 @@ def named_plan(name: str, **kw) -> FaultPlan:
         ])
     raise ValueError(f"unknown schedule {name!r}; known: "
                      "kill-partition-corrupt, partition, corrupt, stall, "
-                     "slow, serve-burst, serve-preempt, fleet-storm")
+                     "slow, serve-burst, serve-preempt, serve-failover, "
+                     "serve-brownout, fleet-storm")

@@ -2,9 +2,15 @@
 
 * `ckpt_corrupt` — deterministic byte-level damage to an on-disk
   checkpoint step;
-* `slow_worker` — per-step delay inflation at a training-step injection
-  point (`maybe_slow_step`), the hardware-skew-free way to fake a
-  straggling host for the cluster straggler detector.
+* `slow_worker` / `decode_stall` — per-step delay inflation at a
+  training- or engine-step injection point (`maybe_slow_step`), the
+  hardware-skew-free way to fake a straggling host / a decode-clock
+  stall window;
+* `engine_kill` / `reshard_storm` — the serving faults
+  (`maybe_chaos_serving`): fail the engine over at a scheduled step
+  (in-flight requests requeue under HETU_TPU_SERVE_RETRY) or pin the
+  LoadAdaptiveMesh onto a flip-flopping tier for a window (exercising
+  KV re-paging, HETU_TPU_SERVE_KV_REPAGE).
 
 Checkpoint-corruption details (the `ckpt_corrupt` fault kind):
 
@@ -25,16 +31,47 @@ from typing import List, Optional, Tuple
 
 
 def maybe_slow_step(plan, rank: Optional[int], step: int) -> float:
-    """Apply any scheduled `slow_worker` delay for (rank, step): sleeps
-    the plan's per-step inflation and returns the seconds slept (0.0 when
-    no plan / no matching spec — the identity hot path is one None
-    check).  Call it at the top of a training step."""
+    """Apply any scheduled `slow_worker` / `decode_stall` delay for
+    (rank, step): sleeps the plan's per-step inflation and returns the
+    seconds slept (0.0 when no plan / no matching spec — the identity
+    hot path is one None check).  Call it at the top of a training step
+    (slow_worker) or from the serving `on_step` hook (decode_stall)."""
     if plan is None:
         return 0.0
     delay = plan.step_delay(rank, step)
     if delay > 0:
         time.sleep(delay)
     return delay
+
+
+def maybe_chaos_serving(plan, engine, step: int,
+                        rank: Optional[int] = None) -> dict:
+    """Apply the serving fault kinds for engine step `step` (the
+    `on_step` hook of `ServingEngine.run`; no plan / nothing scheduled
+    = one None check, zero side effects).  Returns what fired:
+    ``{"killed": bool, "forced_tier": Optional[int]}``.
+
+    * `engine_kill` — one-shot: `engine.fail_over()` requeues every
+      in-flight request (retry budget HETU_TPU_SERVE_RETRY, stall
+      reason `replica_lost`); seeded sampling then replays each
+      survivor token-identically.
+    * `reshard_storm` — each covered step pins the engine's
+      LoadAdaptiveMesh onto tier ``offset % num_tiers``, so the next
+      step's reshard hook fires a hot switch (and, with
+      HETU_TPU_SERVE_KV_REPAGE, a KV re-page) regardless of load.
+    """
+    out = {"killed": False, "forced_tier": None}
+    if plan is None:
+        return out
+    if plan.should_kill_engine(step, rank):
+        engine.fail_over()
+        out["killed"] = True
+    off = plan.reshard_storm_offset(step, rank)
+    if off is not None and getattr(engine, "reshard", None) is not None:
+        tier = off % len(engine.reshard.tiers)
+        engine.reshard.force_tier(tier)
+        out["forced_tier"] = tier
+    return out
 
 
 def _step_files(step_dir: str) -> List[Tuple[str, int]]:

@@ -20,6 +20,22 @@ consult at well-defined injection points —
                                      rank (a straggling host, faked), so
                                      the cluster straggler detector is
                                      testable without real hardware skew
+    engine_kill                      the serving engine/fleet replica —
+                                     dies at a given ENGINE step: every
+                                     in-flight request loses its slot and
+                                     re-enters the queue under the
+                                     HETU_TPU_SERVE_RETRY budget
+                                     (docs/fault_tolerance.md); `rank`
+                                     selects the fleet replica
+    reshard_storm                    the serving reshard hook — forces a
+                                     LoadAdaptiveMesh tier flip every step
+                                     of a window, exercising KV re-paging
+                                     under repeated hot switches
+    decode_stall                     the serving engine step — the
+                                     slow_worker shape on the decode
+                                     clock: a deterministic per-step
+                                     delay window (a compile storm, a
+                                     straggling reshard, faked)
 
 Everything is deterministic given the plan: trigger windows are counted in
 *matching calls* (not wall time), and probabilistic faults draw from one
@@ -42,7 +58,8 @@ import threading
 from typing import Any, Dict, List, Optional
 
 KINDS = ("rpc_drop", "rpc_delay", "rpc_dup",
-         "heartbeat_stall", "worker_kill", "ckpt_corrupt", "slow_worker")
+         "heartbeat_stall", "worker_kill", "ckpt_corrupt", "slow_worker",
+         "engine_kill", "reshard_storm", "decode_stall")
 _WIRE_KINDS = ("rpc_drop", "rpc_delay", "rpc_dup")
 CORRUPT_MODES = ("flip", "truncate", "delete")
 
@@ -62,9 +79,12 @@ class FaultSpec:
                  seeded stream — deterministic)
     delay_s      rpc_delay: added latency per fired call
     at_step      worker_kill / ckpt_corrupt: trigger once the observed
-                 training step reaches this value; slow_worker: first
-                 slowed step (with `count` following steps slowed and
-                 `delay_s` added per step)
+                 training step reaches this value; slow_worker /
+                 decode_stall: first slowed step (with `count` following
+                 steps slowed and `delay_s` added per step);
+                 engine_kill: the engine step the replica dies at;
+                 reshard_storm: first stormed engine step (`count`
+                 steps force a tier flip each)
     at_beat      heartbeat_stall: fire at this beat index
     stall_s      heartbeat_stall: how long the beat thread freezes
     mode         ckpt_corrupt: flip | truncate | delete
@@ -192,15 +212,17 @@ class FaultPlan:
         return stall
 
     def step_delay(self, rank: Optional[int], step: int) -> float:
-        """Seconds of slow_worker delay to inflate this training step by
-        (0.0 = none).  Deterministic: the window is [at_step, at_step +
-        count) in observed training steps, the delay a fixed delay_s per
-        step — a faked straggling host the straggler detector must catch.
-        Overlapping specs stack (their delays sum)."""
+        """Seconds of slow_worker / decode_stall delay to inflate this
+        step by (0.0 = none).  Deterministic: the window is [at_step,
+        at_step + count) in observed steps, the delay a fixed delay_s
+        per step — a faked straggling host (training) or a decode-clock
+        stall window (serving) the detectors must catch.  Overlapping
+        specs stack (their delays sum)."""
         total = 0.0
+        fired_kinds = []
         with self._lock:
             for spec in self.faults:
-                if spec.kind != "slow_worker":
+                if spec.kind not in ("slow_worker", "decode_stall"):
                     continue
                 if not self._rank_matches(spec, rank):
                     continue
@@ -208,8 +230,10 @@ class FaultPlan:
                 if start <= step < start + spec.count and spec.delay_s > 0:
                     spec.injected += 1
                     total += spec.delay_s
-        if total > 0:
-            _reg().inc("chaos.injected_slow_worker")
+                    if spec.kind not in fired_kinds:
+                        fired_kinds.append(spec.kind)
+        for kind in fired_kinds:
+            _reg().inc(f"chaos.injected_{kind}")
         return total
 
     def should_kill(self, rank: Optional[int], step: int) -> bool:
@@ -229,6 +253,68 @@ class FaultPlan:
                 return False
         _reg().inc("chaos.injected_worker_kill")
         return True
+
+    def should_kill_engine(self, step: int,
+                           rank: Optional[int] = None) -> bool:
+        """One-shot: True when an engine_kill spec has its at_step
+        reached on the ENGINE-step clock (the serving harness then
+        fails the engine over; `rank` selects a fleet replica)."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "engine_kill" or spec.done:
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                if step >= (spec.at_step or 0):
+                    spec.done = True
+                    spec.injected += 1
+                    break
+            else:
+                return False
+        _reg().inc("chaos.injected_engine_kill")
+        return True
+
+    def engine_down(self, step: int,
+                    rank: Optional[int] = None) -> bool:
+        """Is the (replica's) engine inside an engine_kill down-window
+        at this step?  The window is [at_step, at_step + count): count=1
+        (the default) means the recovery replica takes over by the next
+        step.  The fleet simulator suspends admissions while down (the
+        live single-engine harness recovers instantly — its fail_over
+        IS the recovery replica).  Pure read: no latch, no counter."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "engine_kill":
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                start = spec.at_step or 0
+                if start <= step < start + max(spec.count, 1):
+                    return True
+        return False
+
+    def reshard_storm_offset(self, step: int,
+                             rank: Optional[int] = None) -> Optional[int]:
+        """The storm-window offset of this engine step (0-based), or
+        None when no reshard_storm spec covers it.  The serving harness
+        forces the LoadAdaptiveMesh onto tier ``offset % num_tiers``
+        each covered step — a deterministic flip-flop that exercises KV
+        re-paging under repeated hot switches."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "reshard_storm":
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                start = spec.at_step if spec.at_step is not None else 0
+                if start <= step < start + spec.count:
+                    spec.injected += 1
+                    off = step - start
+                    break
+            else:
+                return None
+        _reg().inc("chaos.injected_reshard_storm")
+        return off
 
     def take_ckpt_corrupt(self,
                           newest_step: Optional[int]) -> Optional[FaultSpec]:

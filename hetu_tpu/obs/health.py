@@ -279,10 +279,14 @@ class ServingHealthMonitor(_MonitorBase):
     * ``page_exhaustion_imminent`` — page-pool utilization at or above
       ``page_high`` for ``page_streak`` consecutive steps while
       requests queue: the next admissions will all stall ``no_pages``.
+    * ``brownout_shed`` — not a detector: the engine's brownout policy
+      (HETU_TPU_SERVE_BROWNOUT) reports each shed through
+      :meth:`note_brownout`, so load-shedding rides the same anomaly
+      stream, counters, and cooldown as the organic detectors.
     """
 
     KINDS = ("ttft_regression", "queue_depth_blowup",
-             "page_exhaustion_imminent")
+             "page_exhaustion_imminent", "brownout_shed")
 
     def __init__(self, runlog=None, registry=None, source=None,
                  warmup: int = 8, alpha: float = 0.2,
@@ -337,6 +341,20 @@ class ServingHealthMonitor(_MonitorBase):
                            float(page_util), self.page_high, t, fired)
         else:
             self._page_hot = 0
+        return fired
+
+    def note_brownout(self, step: int, *, shed: int, page_util: float,
+                      t: Optional[float] = None) -> List[Dict[str, Any]]:
+        """The engine's brownout policy shed `shed` queued requests at
+        engine step `step` (HETU_TPU_SERVE_BROWNOUT) — meter it as a
+        ``brownout_shed`` anomaly (value = requests shed, baseline =
+        the page utilization that tripped the policy).  Per-kind
+        cooldown applies like any detector, so a sustained brownout
+        logs at the cooldown cadence, not every step."""
+        t = time.time() if t is None else t
+        fired: List[Dict[str, Any]] = []
+        self._fire("brownout_shed", step, float(shed), float(page_util),
+                   t, fired)
         return fired
 
 

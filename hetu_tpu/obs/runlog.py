@@ -23,12 +23,14 @@ Kind fields:
                   baseline — online health-detector firings
     straggler     stragglers (flagged ranks), workers (per-rank
                   ratio/z) — the cluster straggler report transitions
-    serve         event (admit | done | preempt | reshard | report) +
-                  the serving SLO fields (hetu_tpu/serving,
+    serve         event (admit | done | preempt | reshard | report |
+                  failover | retry | evict | expired | shed) + the
+                  serving SLO fields (hetu_tpu/serving,
                   docs/serving.md); every event also stamps `now`
                   (driver-clock seconds — the engine's virtual clock,
                   matching span t0/t1); per-request events (admit/done/
-                  preempt) carry `tenant` and, on a sampled RunLog
+                  preempt/retry/evict/expired/shed) carry `tenant` and,
+                  on a sampled RunLog
                   (HETU_TPU_RUNLOG_SERVE_SAMPLE > 1), `sample_weight`
                   (how many requests the sampled record stands for —
                   slo_report re-weights by it):
@@ -49,18 +51,32 @@ Kind fields:
                   slo_class (the victim's), tenant, tokens_discarded,
                   queue_depth — one per HETU_TPU_SERVE_PREEMPT
                   evict-and-requeue;
-                  reshard: tier, strategy, pause_s; report: requests,
-                  tokens, elapsed_s, tokens_per_s
+                  reshard: tier, strategy, pause_s (+ kv_repage=true
+                  when HETU_TPU_SERVE_KV_REPAGE migrated the pool);
+                  report: requests, tokens, elapsed_s, tokens_per_s;
+                  failover: requeued, exhausted, queue_depth — one per
+                  engine fail_over (chaos engine_kill);
+                  retry: req, slot, attempt, tokens_discarded — a
+                  request requeued under HETU_TPU_SERVE_RETRY
+                  (stall reason replica_lost);
+                  evict/expired/shed: req, reason (retry_exhausted |
+                  deadline_exceeded | brownout_shed), tokens, e2e_s,
+                  retries, preemptions, queue_depth (+ the cost fields
+                  for live casualties) — fault terminations
+                  (HETU_TPU_SERVE_RETRY / _DEADLINE / _BROWNOUT)
     span          the serving flight recorder (HETU_TPU_SERVE_TRACE,
                   hetu_tpu/serving/tracing.py, schema owned by
                   obs/spans.py): span_schema (version), span (queued |
-                  prefill | decode | reshard_pause | done | evicted),
-                  trace (trace id), req, slot, slo_class, t0, t1
+                  prefill | decode | reshard_pause | done | evicted |
+                  deadline_exceeded), trace (trace id), req, slot,
+                  slo_class, t0, t1
                   (driver-clock seconds; spans of one request tile
-                  [arrival, done] — durations sum to its e2e_s), plus
+                  [arrival, done] — durations sum to its e2e_s;
+                  requeued attempts stamp attempt >= 2), plus
                   per-kind attrs: queued carries reason
-                  (none|no_slot|no_pages|preempted|quota_exceeded — the
-                  scheduler's reserve-on-admit stall attribution,
+                  (none|no_slot|no_pages|preempted|quota_exceeded|
+                  replica_lost|brownout_shed — the scheduler's
+                  reserve-on-admit stall attribution,
                   obs/spans.py STALL_REASONS), prefill carries
                   chunk (+ last on the TTFT chunk), decode carries
                   tokens/segment/end, reshard_pause carries tier, the

@@ -17,9 +17,13 @@ RunLog gives every training step a record:
                    pauses, so batch-composition changes are visible as
                    segment boundaries; carries the tokens emitted in it
     reshard_pause  the window a LoadAdaptiveMesh reshard froze decode
-    done/evicted   the zero-duration terminal span (exactly one per
+    done/evicted/deadline_exceeded
+                   the zero-duration terminal span (exactly one per
                    request): ``done`` carries the finish reason and
-                   token count, ``evicted`` marks a preemption
+                   token count, ``evicted`` marks a terminal eviction
+                   (a retry budget exhausted after a replica loss, a
+                   brownout shed), ``deadline_exceeded`` marks an SLO
+                   deadline expiry (HETU_TPU_SERVE_DEADLINE)
 
 Spans are recorded as schema-versioned ``span`` RunLog records
 (``span_schema`` field; see obs/runlog.py) by
@@ -48,16 +52,24 @@ from typing import Any, Dict, Iterable, List, Optional
 SPAN_SCHEMA = 1
 
 SPAN_KINDS = ("queued", "prefill", "decode", "reshard_pause",
-              "done", "evicted")
-TERMINAL_KINDS = ("done", "evicted")
+              "done", "evicted", "deadline_exceeded")
+TERMINAL_KINDS = ("done", "evicted", "deadline_exceeded")
 #: ``preempted`` marks a RE-queued span: the request was evicted by a
 #: higher-priority admission (HETU_TPU_SERVE_PREEMPT) and waits again —
 #: same trace, so the tiling/reconciliation contract still holds.
+#: ``replica_lost`` is the failover twin: the serving engine (replica)
+#: died mid-flight (chaos ``engine_kill``) and the request re-entered
+#: the queue under its retry budget (HETU_TPU_SERVE_RETRY) — same
+#: trace, new ``attempt``.
 #: ``quota_exceeded`` means the head request's TENANT was over its
 #: admission quota (slots or pages; HETU_TPU_SERVE_QUOTAS) even though
 #: the pool itself could have served it.
+#: ``brownout_shed`` stamps the final queued span of a request the
+#: sustained-pressure brownout policy shed (HETU_TPU_SERVE_BROWNOUT) —
+#: lowest-priority tenants first; the terminal span is ``evicted``
+#: with ``reason="brownout_shed"``.
 STALL_REASONS = ("none", "no_slot", "no_pages", "preempted",
-                 "quota_exceeded")
+                 "quota_exceeded", "replica_lost", "brownout_shed")
 
 #: span-record fields that are structure, not attrs
 _CORE_FIELDS = ("schema", "kind", "t", "span_schema", "span", "trace",

@@ -19,7 +19,8 @@ from hetu_tpu.serving.costs import (COST_FIELDS,  # noqa: F401
 from hetu_tpu.serving.engine import ServeConfig, ServingEngine  # noqa: F401
 from hetu_tpu.serving.fleet import (FleetConfig,  # noqa: F401
                                     FleetSimulator, ServiceModel,
-                                    analytic_models, fleet_workload)
+                                    analytic_models, attainment_delta,
+                                    fleet_workload)
 from hetu_tpu.serving.kv_pool import (PagePool,  # noqa: F401
                                       PoolArrays, kv_bytes_per_token)
 from hetu_tpu.serving.prefix_cache import (RadixPrefixCache,  # noqa: F401
@@ -44,7 +45,7 @@ from hetu_tpu.serving.tracing import (RequestTracer,  # noqa: F401
 __all__ = [
     "ServingEngine", "ServeConfig",
     "FleetSimulator", "FleetConfig", "ServiceModel", "analytic_models",
-    "fleet_workload",
+    "attainment_delta", "fleet_workload",
     "CostModel", "CostLedger", "COST_FIELDS", "aggregate_costs",
     "PagePool", "PoolArrays", "kv_bytes_per_token",
     "RadixPrefixCache", "maybe_prefix_cache",

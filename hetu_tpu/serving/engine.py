@@ -66,7 +66,7 @@ from hetu_tpu.obs.metrics import MetricsRegistry, get_registry
 from hetu_tpu.obs.runlog import RunLog, default_runlog_path
 from hetu_tpu.serving.kv_pool import PagePool, PoolArrays
 from hetu_tpu.serving.request import (Request, RequestResult,
-                                      rid_sampled)
+                                      RequestStats, rid_sampled)
 from hetu_tpu.serving.scheduler import Scheduler
 from hetu_tpu.serving.tracing import maybe_tracer
 from hetu_tpu.utils.logging import get_logger
@@ -127,6 +127,30 @@ class ServeConfig:
     #: counters stay exact.  1 (default) = every event, byte-identical
     #: RunLog to the pre-sampling engine
     serve_sample: int = 1
+    # -- the fault-tolerance layer (docs/fault_tolerance.md; all
+    #    default-off, all host-side policy: the compiled programs are
+    #    byte-identical at any setting — registered identity contracts)
+    #: per-request retry budget after a replica death
+    #: (HETU_TPU_SERVE_RETRY): fail_over() requeues each in-flight
+    #: request up to this many times ('replica_lost' stall reason);
+    #: past the budget it terminates as 'retry_exhausted'.  0 = no
+    #: retries
+    retry_budget: int = 0
+    #: enforce SLOClass.deadline_s (HETU_TPU_SERVE_DEADLINE): each step
+    #: sweeps queued and live requests, expiring any older than its
+    #: class deadline as 'deadline_exceeded'
+    deadline: bool = False
+    #: sustained-pressure brownout shedding (HETU_TPU_SERVE_BROWNOUT):
+    #: page utilization >= brownout_page_high with >= brownout_queue_min
+    #: queued for brownout_streak consecutive steps sheds the
+    #: lowest-priority queued band ('brownout_shed')
+    brownout: bool = False
+    brownout_page_high: float = 0.95
+    brownout_queue_min: int = 1
+    brownout_streak: int = 4
+    #: migrate the KV pool through LoadAdaptiveMesh tier changes
+    #: (HETU_TPU_SERVE_KV_REPAGE, serving/reshard.py reshard_pool)
+    kv_repage: bool = False
 
     def __post_init__(self):
         if self.max_len % self.page_size:
@@ -155,6 +179,16 @@ class ServeConfig:
         if self.serve_sample < 1:
             raise ValueError(f"serve_sample must be >= 1, "
                              f"got {self.serve_sample}")
+        if self.retry_budget < 0:
+            raise ValueError(f"retry_budget must be >= 0, "
+                             f"got {self.retry_budget}")
+        if not 0.0 < self.brownout_page_high <= 1.0:
+            raise ValueError(f"brownout_page_high must be in (0, 1], "
+                             f"got {self.brownout_page_high}")
+        if self.brownout_streak < 1 or self.brownout_queue_min < 1:
+            raise ValueError(
+                "brownout_streak and brownout_queue_min must be >= 1, "
+                f"got {self.brownout_streak}/{self.brownout_queue_min}")
         if self.num_pages == 0:
             self.num_pages = self.num_slots * (self.max_len
                                                // self.page_size)
@@ -189,6 +223,10 @@ class ServeConfig:
             preempt=flags.bool_flag("HETU_TPU_SERVE_PREEMPT"),
             quotas=parse_quotas(flags.str_flag("HETU_TPU_SERVE_QUOTAS")),
             serve_sample=flags.int_flag("HETU_TPU_RUNLOG_SERVE_SAMPLE"),
+            retry_budget=flags.int_flag("HETU_TPU_SERVE_RETRY"),
+            deadline=flags.bool_flag("HETU_TPU_SERVE_DEADLINE"),
+            brownout=flags.bool_flag("HETU_TPU_SERVE_BROWNOUT"),
+            kv_repage=flags.bool_flag("HETU_TPU_SERVE_KV_REPAGE"),
         )
         vals.update(overrides)
         return ServeConfig(**vals)
@@ -226,7 +264,8 @@ class ServingEngine:
                                    max_len=self.config.max_len,
                                    prefix_cache=self.prefix_cache,
                                    lookahead=self.config.lookahead,
-                                   quotas=self.config.quotas)
+                                   quotas=self.config.quotas,
+                                   retry_budget=self.config.retry_budget)
         # per-request cost ledger (serving/costs.py): when a CostModel
         # rides along, every done event carries analytic cost_* fields
         # (prefill/decode FLOPs, page-seconds, KV byte-seconds, wire
@@ -257,6 +296,16 @@ class ServingEngine:
         #: whole run, not just the last incarnation
         self._preempt_counts = {}
         self._carried_stats = {}
+        #: fault-termination results produced OUTSIDE step() — fail_over
+        #: runs between steps (the run() on_step hook), so its
+        #: retry-exhausted casualties park here until the next step
+        #: drains them into its finished list
+        self._fault_results: List[RequestResult] = []
+        #: consecutive steps at brownout pressure (the shed streak)
+        self._brownout_hot = 0
+        #: driver-clock time at the end of the last step — the default
+        #: timestamp for between-step fault events (fail_over)
+        self._last_clock = 0.0
         self.reshard = reshard
         self._registry = registry if registry is not None else get_registry()
         if run_log is None:
@@ -666,6 +715,13 @@ class ServingEngine:
             return now + (time.perf_counter() - t0)
 
         finished: List[RequestResult] = []
+        if self._fault_results:
+            finished.extend(self._fault_results)
+            self._fault_results.clear()
+        if self.config.deadline:
+            # before admissions: an expired queued request must not
+            # grab a slot on the step it dies
+            self._expire_deadlines(clock(), finished)
         while True:
             t_adm = clock()
             adm = self.scheduler.admit_next(t_adm)
@@ -724,7 +780,7 @@ class ServingEngine:
                     tokens[i] = self.scheduler.slots[i].generated[-1]
                 nxt, pool_tree = self._run_decode(
                     self.params, self.pool.arrays.tree(),
-                    jnp.asarray(self.scheduler.page_table),
+                    self._decode_table(active),
                     jnp.asarray(tokens), jnp.asarray(positions),
                     *sample_args)
                 nxt = np.asarray(nxt)
@@ -784,6 +840,8 @@ class ServingEngine:
             self.health.observe_step(
                 self.steps_done, queue_depth=self.scheduler.queue_depth,
                 page_util=self.pool.utilization, t=clock())
+        if self.config.brownout:
+            self._maybe_brownout(clock(), finished)
 
         if self.reshard is not None:
             tier = self.reshard.observe(self.scheduler.queue_depth)
@@ -791,6 +849,13 @@ class ServingEngine:
                 t_pause0 = clock()
                 with self._registry.timer("serve.reshard_s"):
                     self.params = self.reshard.reshard(self.params, tier)
+                    if self.config.kv_repage:
+                        # the KV pool rides the same hot switch
+                        # (HETU_TPU_SERVE_KV_REPAGE): in-flight requests
+                        # keep their cache across the tier change
+                        self.pool.arrays = self.reshard.reshard_pool(
+                            self.pool.arrays, tier)
+                        self._registry.inc("serve.kv_repages")
                 t_pause1 = clock()
                 self._registry.inc("serve.reshards")
                 if self.tracer is not None:
@@ -803,8 +868,192 @@ class ServingEngine:
                                 strategy=self.reshard.describe(tier),
                                 now=t_pause1,
                                 pause_s=t_pause1 - t_pause0,
-                                queue_depth=self.scheduler.queue_depth)
+                                queue_depth=self.scheduler.queue_depth,
+                                **({"kv_repage": True}
+                                   if self.config.kv_repage else {}))
+        self._last_clock = clock()
         return finished
+
+    # ----------------------------------------------------------- faults
+    def _finish_faulted(self, req, now: float, finished, *, reason: str,
+                        event: str, tokens, st=None, slot=None):
+        """Terminate `req` with a fault outcome (`deadline_exceeded`,
+        `brownout_shed`, `retry_exhausted`): the _maybe_finish
+        bookkeeping — carried-stats folding, ledger cost, counters, a
+        sampled serve event — for a request the model did not finish.
+        `st`/`slot` identify a live incarnation (whose ledger entry
+        closes); queued casualties pass neither and cost nothing."""
+        stats = st.stats if st is not None else RequestStats(
+            arrival_t=req.arrival_t)
+        stats.done_t = now
+        stats.preemptions = self._preempt_counts.pop(req.rid, 0)
+        stats.retries = self.scheduler.retries.pop(req.rid, 0)
+        carried = self._carried_stats.pop(req.rid, None)
+        if carried is not None:
+            stats.spec_proposed += carried["spec_proposed"]
+            stats.spec_accepted += carried["spec_accepted"]
+            stats.prefill_chunks += carried["prefill_chunks"]
+        res = RequestResult(rid=req.rid, tokens=list(tokens),
+                            finished_reason=reason, stats=stats)
+        self._registry.inc(f"serve.{reason}")
+        self._registry.inc(f"serve.{reason}_class",
+                           slo_class=req.slo.name)
+        cost = {}
+        if self.ledger is not None and st is not None:
+            cost = self.ledger.finish(
+                req.rid, now, prompt_len=req.prompt_len,
+                shared_tokens=stats.shared_prefix_tokens,
+                tokens_out=len(res.tokens))
+        if self._sampled(req.rid):
+            self._log_serve(
+                event=event, req=req.rid, reason=reason,
+                tokens=len(res.tokens), e2e_s=stats.e2e_s, now=now,
+                slo_class=req.slo.name, tenant=req.tenant,
+                retries=stats.retries, preemptions=stats.preemptions,
+                queue_depth=self.scheduler.queue_depth,
+                **({"slot": slot} if slot is not None else {}),
+                **cost, **self._weight_fields())
+        finished.append(res)
+        return res
+
+    def fail_over(self, now: Optional[float] = None) -> dict:
+        """The serving replica dies and a recovery replica takes over
+        on the spot (the chaos `engine_kill` injection point, called
+        between steps from the run() on_step hook): every in-flight
+        request loses its slot, pages, and partial output.  A request
+        with retry budget left (HETU_TPU_SERVE_RETRY) re-enters the
+        queue behind a `replica_lost` stall span and re-prefills on
+        re-admission (cheap under a warm radix prefix cache); greedy
+        argmax and the (seed, position)-keyed sampler are pure
+        functions of the prompt, so the replayed stream is
+        token-identical to the undisturbed run — the same purity the
+        preempt path already relies on.  Over-budget requests
+        terminate as `retry_exhausted`, surfacing through the next
+        step()'s results.  Params, pool, and compiled programs
+        survive (the recovery replica inherits them); what is tested
+        is the REQUEST-state recovery.  Returns
+        ``{"requeued": [rids], "exhausted": [rids]}``."""
+        now = self._last_clock if now is None else now
+        requeued: List[int] = []
+        exhausted: List[int] = []
+        self._registry.inc("serve.failovers")
+        for i in list(self.scheduler.active_slots()):
+            st = self.scheduler.slots[i]
+            req = st.request
+            if (self.scheduler.retries.get(req.rid, 0)
+                    < self.config.retry_budget):
+                # the accrued work counters survive the requeue (the
+                # preempt carry discipline); the ledger bills the
+                # discarded incarnation — it re-runs on re-admission
+                carried = self._carried_stats.setdefault(
+                    req.rid, {"spec_proposed": 0, "spec_accepted": 0,
+                              "prefill_chunks": 0})
+                carried["spec_proposed"] += st.stats.spec_proposed
+                carried["spec_accepted"] += st.stats.spec_accepted
+                carried["prefill_chunks"] += st.stats.prefill_chunks
+                if self.ledger is not None:
+                    self.ledger.on_preempt(req.rid, now,
+                                           ctx_start=st.shared_tokens,
+                                           tokens_cached=st.pos)
+                self.scheduler.requeue_lost(i)
+                self._registry.inc("serve.replica_requeues")
+                self._registry.inc("serve.replica_requeues_class",
+                                   slo_class=req.slo.name)
+                if self.tracer is not None:
+                    self.tracer.on_replica_lost(req, i, now)
+                if self._sampled(req.rid):
+                    self._log_serve(
+                        event="retry", req=req.rid, slot=i, now=now,
+                        attempt=self.scheduler.retries[req.rid] + 1,
+                        slo_class=req.slo.name, tenant=req.tenant,
+                        tokens_discarded=len(st.generated),
+                        **self._weight_fields())
+                requeued.append(req.rid)
+            else:
+                if self.tracer is not None:
+                    self.tracer.on_finish(
+                        req, i, "retry_exhausted", now,
+                        tokens=len(st.generated),
+                        e2e_s=now - float(req.arrival_t), evicted=True)
+                tokens = list(st.generated)
+                self.scheduler.release(i)
+                self._finish_faulted(req, now, self._fault_results,
+                                     reason="retry_exhausted",
+                                     event="evict", tokens=tokens,
+                                     st=st, slot=i)
+                exhausted.append(req.rid)
+        self._log_serve(event="failover", now=now,
+                        requeued=len(requeued),
+                        exhausted=len(exhausted),
+                        queue_depth=self.scheduler.queue_depth)
+        return {"requeued": requeued, "exhausted": exhausted}
+
+    def _expire_deadlines(self, now: float, finished):
+        """Terminate every queued or live request older than its SLO
+        class deadline (HETU_TPU_SERVE_DEADLINE) as `deadline_exceeded`
+        — a real terminal outcome: traced, costed, counted, and
+        returned through run() like any finish."""
+        for req in [r for r in self.scheduler.queue
+                    if r.slo.deadline_s is not None
+                    and now - r.arrival_t > r.slo.deadline_s]:
+            if not self.scheduler.drop_queued(req):
+                continue
+            if self.tracer is not None:
+                self.tracer.on_expire(req, now,
+                                      e2e_s=now - float(req.arrival_t))
+            self._finish_faulted(req, now, finished,
+                                 reason="deadline_exceeded",
+                                 event="expired", tokens=[])
+        for i in list(self.scheduler.active_slots()):
+            st = self.scheduler.slots[i]
+            req = st.request
+            d = req.slo.deadline_s
+            if d is None or now - req.arrival_t <= d:
+                continue
+            if self.tracer is not None:
+                self.tracer.on_expire(req, now,
+                                      tokens=len(st.generated),
+                                      e2e_s=now - float(req.arrival_t))
+            tokens = list(st.generated)
+            self.scheduler.release(i)
+            self._finish_faulted(req, now, finished,
+                                 reason="deadline_exceeded",
+                                 event="expired", tokens=tokens,
+                                 st=st, slot=i)
+
+    def _maybe_brownout(self, now: float, finished):
+        """Sustained-pressure shedding (HETU_TPU_SERVE_BROWNOUT): page
+        utilization >= brownout_page_high with >= brownout_queue_min
+        queued for brownout_streak consecutive steps sheds the
+        LOWEST-priority queued band as `brownout_shed` (the preempt
+        priority order: smaller SLOClass.priority = less important),
+        metered through the health monitor when one is attached.
+        Deterministic by construction — driven only by pool and queue
+        state, never the wall clock."""
+        c = self.config
+        if not (self.pool.utilization >= c.brownout_page_high
+                and self.scheduler.queue_depth >= c.brownout_queue_min):
+            self._brownout_hot = 0
+            return
+        self._brownout_hot += 1
+        if self._brownout_hot < c.brownout_streak:
+            return
+        self._brownout_hot = 0
+        lowest = min(r.slo.priority for r in self.scheduler.queue)
+        shed = [r for r in self.scheduler.queue
+                if r.slo.priority == lowest]
+        for req in shed:
+            if not self.scheduler.drop_queued(req):
+                continue
+            if self.tracer is not None:
+                self.tracer.on_shed(req, now)
+            self._finish_faulted(req, now, finished,
+                                 reason="brownout_shed", event="shed",
+                                 tokens=[])
+        if self.health is not None:
+            self.health.note_brownout(self.steps_done, shed=len(shed),
+                                      page_util=self.pool.utilization,
+                                      t=now)
 
     # --------------------------------------------------------- sampling
     def _sample_args(self, active):
@@ -823,6 +1072,21 @@ class ServingEngine:
             top_ps[i] = sp.top_p
         return (jnp.asarray(seeds), jnp.asarray(temps),
                 jnp.asarray(top_ks), jnp.asarray(top_ps))
+
+    def _decode_table(self, active):
+        """Page-table input for the decode batch: only decoding slots'
+        rows are real; prefilling/empty rows are pinned to the null
+        page.  The scheduler's table is populated at ADMISSION, so a
+        still-prefilling slot's row already points at live pages — and
+        under the radix prefix cache its first page is a COW-shared
+        prefix page.  The ride-along (token 0, position 0) write for
+        such a row must land in the null page, not in `table[slot][0]`
+        row 0, or it silently corrupts position 0 of the shared prefix
+        for every reader."""
+        table = np.zeros_like(self.scheduler.page_table)
+        for i in active:
+            table[i] = self.scheduler.page_table[i]
+        return jnp.asarray(table)
 
     # ------------------------------------------------------ spec decode
     def _spec_decode_step(self, active, positions, sample_args):
@@ -848,7 +1112,7 @@ class ServingEngine:
             tokens[i, 1:] = self.drafter.propose(ctx, k)
         targets, n_emit, pool_tree = self._run_verify(
             self.params, self.pool.arrays.tree(),
-            jnp.asarray(self.scheduler.page_table),
+            self._decode_table(active),
             jnp.asarray(tokens), jnp.asarray(positions), *sample_args)
         targets = np.asarray(targets)
         n_emit = np.asarray(n_emit)
@@ -1060,6 +1324,7 @@ class ServingEngine:
                                   tokens=len(res.tokens),
                                   e2e_s=st.stats.e2e_s)
         st.stats.preemptions = self._preempt_counts.pop(req.rid, 0)
+        st.stats.retries = self.scheduler.retries.pop(req.rid, 0)
         carried = self._carried_stats.pop(req.rid, None)
         if carried is not None:
             # work spent before each preemption belongs to this run
@@ -1089,6 +1354,8 @@ class ServingEngine:
                 queue_depth=self.scheduler.queue_depth,
                 slot_occupancy=self.scheduler.occupancy,
                 page_util=self.pool.utilization,
+                **({"retries": st.stats.retries}
+                   if st.stats.retries else {}),
                 **cost, **self._weight_fields())
         finished.append(res)
 
@@ -1121,6 +1388,10 @@ class ServingEngine:
                 continue
             t0 = time.perf_counter()
             if on_step is not None:
+                # chaos hooks fire here (maybe_chaos_serving /
+                # maybe_slow_step); give between-step fault events a
+                # current driver timestamp
+                self._last_clock = max(self._last_clock, now)
                 on_step(step_idx)
             results.extend(self.step(now))
             now += time.perf_counter() - t0

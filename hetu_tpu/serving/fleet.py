@@ -21,8 +21,14 @@ What is simulated vs real:
   policy decision is made by the production code path.
 * MODELED: step durations (`ServiceModel` roofline) and token values
   (requests always finish by length; no logits exist).  A chaos
-  `FaultPlan`'s ``slow_worker`` windows inflate the modeled step time
-  exactly like the engine's on_step hook inflates the wall clock.
+  `FaultPlan`'s ``slow_worker``/``decode_stall`` windows inflate the
+  modeled step time exactly like the engine's on_step hook inflates
+  the wall clock, and its ``engine_kill`` specs drive replica
+  death/rejoin: at ``at_step`` every in-flight request is requeued
+  under the retry budget (or terminated ``retry_exhausted``), and
+  admissions stay suspended for the spec's ``count``-step down-window
+  until the replica rejoins.  Deadlines and brownout shedding run the
+  same policy code shape as the live engine (docs/fault_tolerance.md).
 
 Accounting is EXACT regardless of RunLog sampling: per-(tenant, class)
 aggregates (attainment, goodput, latency reservoirs, stall and cost
@@ -160,6 +166,18 @@ class FleetConfig:
     #: serve-event/span sampling: 1-in-N requests reach the RunLog/
     #: tracer; 0 = read HETU_TPU_RUNLOG_SERVE_SAMPLE (default 1 = all)
     sample: int = 0
+    # -- the fault-tolerance layer (same knobs as ServeConfig)
+    #: replica-death requeues allowed per request before it terminates
+    #: ``retry_exhausted`` (chaos engine_kill; 0 = no retries)
+    retry_budget: int = 0
+    #: enforce SLOClass.deadline_s (expired requests terminate
+    #: ``deadline_exceeded``)
+    deadline: bool = False
+    #: sustained-pressure shedding of the lowest-priority queued band
+    brownout: bool = False
+    brownout_page_high: float = 0.95
+    brownout_queue_min: int = 1
+    brownout_streak: int = 4
 
 
 class _Bucket:
@@ -167,8 +185,8 @@ class _Bucket:
     regardless of RunLog sampling."""
 
     __slots__ = ("requests", "tokens", "slo_ok", "goodput_tokens",
-                 "preemptions", "stalls", "ttft", "e2e", "queue_wait",
-                 "costs")
+                 "preemptions", "retries", "faults", "stalls", "ttft",
+                 "e2e", "queue_wait", "costs")
 
     def __init__(self):
         self.requests = 0
@@ -176,6 +194,8 @@ class _Bucket:
         self.slo_ok = 0
         self.goodput_tokens = 0
         self.preemptions = 0
+        self.retries = 0
+        self.faults: Dict[str, int] = {}
         self.stalls: Dict[str, int] = {}
         # seeded reservoirs: deterministic percentiles at any count
         self.ttft = Histogram()
@@ -246,7 +266,8 @@ class FleetSimulator:
         self.sched = Scheduler(num_slots=cfg.num_slots, pool=self.pool,
                                max_len=cfg.max_len,
                                prefix_cache=self.prefix_cache,
-                               quotas=cfg.quotas)
+                               quotas=cfg.quotas,
+                               retry_budget=cfg.retry_budget)
         self.ledger = (CostLedger(cost_model)
                        if cost_model is not None else None)
         if cfg.sample:
@@ -264,6 +285,9 @@ class FleetSimulator:
         self._first_reason: Dict[int, str] = {}
         self._enter_seq: Dict[int, int] = {}
         self._preempt_counts: Dict[int, int] = {}
+        #: sticky requeue attribution per rid (preempted/replica_lost) —
+        #: the reason the next admission's queued span carries
+        self._requeue_reason: Dict[int, str] = {}
         self._stall_seq = 0
         self._stall_reason = "none"
         self.stall_steps: Dict[str, int] = {}
@@ -273,6 +297,17 @@ class FleetSimulator:
         self.tokens_out = 0
         self.prefill_chunks = 0
         self.preemptions = 0
+        # fault-layer accounting (chaos engine_kill / deadlines /
+        # brownout): `faulted` counts every fault termination — the
+        # run-loop progress check includes it, so a sweep that only
+        # expires requests still counts as progress
+        self.failovers = 0
+        self.replica_requeues = 0
+        self.retry_exhausted = 0
+        self.expired = 0
+        self.shed = 0
+        self.faulted = 0
+        self._brownout_hot = 0
         self.steps = 0
         self.invariant_checks = 0
         self._start = 0.0
@@ -309,10 +344,12 @@ class FleetSimulator:
         instead of walking the whole queue every stalled step: a stall
         event is global to the FIFO queue, so 'the last stall observed
         while this request was queued' is exactly 'the last global stall
-        if any occurred after it entered'.  ``preempted`` is sticky,
-        matching RequestTracer.on_stall."""
-        if rid in self._preempt_counts:
-            return "preempted"
+        if any occurred after it entered'.  A requeue reason
+        (``preempted`` / ``replica_lost``) is sticky — latest requeue
+        wins — matching RequestTracer.on_stall."""
+        requeue = self._requeue_reason.get(rid)
+        if requeue is not None:
+            return requeue
         if self._stall_seq > self._enter_seq.get(rid, self._stall_seq):
             return self._stall_reason
         return "none"
@@ -358,6 +395,7 @@ class FleetSimulator:
         tokens_discarded = len(st.generated)
         self.sched.preempt(victim)
         self._enter_seq[rid] = self._stall_seq
+        self._requeue_reason[rid] = "preempted"
         b = self._bucket(req.tenant, req.slo.name)
         b.preemptions += 1
         if self._sampled(rid):
@@ -419,6 +457,8 @@ class FleetSimulator:
         tokens = len(st.generated)
         self.sched.release(slot_idx)
         st.stats.preemptions = self._preempt_counts.pop(rid, 0)
+        st.stats.retries = self.sched.retries.pop(rid, 0)
+        self._requeue_reason.pop(rid, None)
         reason_first = self._first_reason.pop(rid, "none")
         cost = None
         if self.ledger is not None:
@@ -439,6 +479,7 @@ class FleetSimulator:
         b = self._bucket(req.tenant, slo.name)
         b.requests += 1
         b.tokens += tokens
+        b.retries += st.stats.retries
         b.stalls[reason_first] = b.stalls.get(reason_first, 0) + 1
         if ok:
             b.slo_ok += 1
@@ -469,23 +510,182 @@ class FleetSimulator:
                       queue_depth=self.sched.queue_depth,
                       slot_occupancy=self.sched.occupancy,
                       page_util=self.pool.utilization,
+                      **({"retries": st.stats.retries}
+                         if st.stats.retries else {}),
                       **dict(cost or {}), **self._weight_fields())
+
+    # ----------------------------------------------------------- faults
+    def _terminate_fault(self, req, st, now: float, *, reason: str,
+                         event: str, slot: Optional[int] = None):
+        """Terminal fault accounting shared by retry exhaustion,
+        deadline expiry and brownout shedding: the request counts in
+        its bucket's ``requests`` with ``slo_ok`` unset — attainment
+        degrades by construction — and its latencies stay out of the
+        reservoirs (they summarize finished requests)."""
+        rid = req.rid
+        tokens = len(st.generated) if st is not None else 0
+        cost = None
+        if self.ledger is not None and st is not None:
+            st.stats.done_t = now
+            cost = self.ledger.finish(
+                rid, now, prompt_len=req.prompt_len,
+                shared_tokens=st.stats.shared_prefix_tokens,
+                tokens_out=tokens)
+        preempts = self._preempt_counts.pop(rid, 0)
+        retries = self.sched.retries.pop(rid, 0)
+        self._requeue_reason.pop(rid, None)
+        self._first_reason.pop(rid, None)
+        self._enter_seq.pop(rid, None)
+        b = self._bucket(req.tenant, req.slo.name)
+        b.requests += 1
+        b.tokens += tokens
+        b.retries += retries
+        b.faults[reason] = b.faults.get(reason, 0) + 1
+        self.faulted += 1
+        if self._sampled(rid):
+            self._log(event=event, req=rid, reason=reason,
+                      tokens=tokens, e2e_s=now - req.arrival_t, now=now,
+                      slo_class=req.slo.name, tenant=req.tenant,
+                      retries=retries, preemptions=preempts,
+                      queue_depth=self.sched.queue_depth,
+                      **({"slot": slot} if slot is not None else {}),
+                      **dict(cost or {}), **self._weight_fields())
+
+    def _fail_over(self, now: float):
+        """The replica serving every live slot died (chaos
+        ``engine_kill``): requeue each in-flight request under its
+        retry budget — the deterministic replay regenerates the same
+        tokens — or terminate it ``retry_exhausted`` past the budget.
+        Mirrors ServeEngine.fail_over on the analytic clock."""
+        sched = self.sched
+        self.failovers += 1
+        requeued: List[int] = []
+        exhausted: List[int] = []
+        for i in list(sched.active_slots()):
+            st = sched.slots[i]
+            req = st.request
+            rid = req.rid
+            if sched.retries.get(rid, 0) < self.cfg.retry_budget:
+                if self.ledger is not None:
+                    self.ledger.on_preempt(rid, now,
+                                           ctx_start=st.shared_tokens,
+                                           tokens_cached=st.pos)
+                tokens_discarded = len(st.generated)
+                sched.requeue_lost(i)
+                self._enter_seq[rid] = self._stall_seq
+                self._requeue_reason[rid] = "replica_lost"
+                self.replica_requeues += 1
+                requeued.append(rid)
+                if self._sampled(rid):
+                    self.tracer.on_replica_lost(req, i, now)
+                    self._log(event="retry", req=rid, slot=i,
+                              attempt=sched.retries[rid] + 1,
+                              tokens_discarded=tokens_discarded,
+                              slo_class=req.slo.name, tenant=req.tenant,
+                              now=now,
+                              queue_depth=sched.queue_depth,
+                              **self._weight_fields())
+            else:
+                tokens = len(st.generated)
+                if self._sampled(rid):
+                    self.tracer.on_finish(req, i, "retry_exhausted",
+                                          now, tokens=tokens,
+                                          e2e_s=now - req.arrival_t,
+                                          evicted=True)
+                sched.release(i)
+                self.retry_exhausted += 1
+                exhausted.append(rid)
+                self._terminate_fault(req, st, now,
+                                      reason="retry_exhausted",
+                                      event="evict", slot=i)
+        self._log(event="failover", requeued=len(requeued),
+                  exhausted=len(exhausted), now=now,
+                  queue_depth=sched.queue_depth)
+
+    def _expire_deadlines(self, now: float):
+        """Terminate every request past its SLO deadline (queued and
+        live) as ``deadline_exceeded`` — same sweep order as
+        ServeEngine._expire_deadlines."""
+        sched = self.sched
+        for req in [r for r in sched.queue
+                    if r.slo.deadline_s is not None
+                    and now - r.arrival_t > r.slo.deadline_s]:
+            if not sched.drop_queued(req):
+                continue
+            if self._sampled(req.rid):
+                self.tracer.on_expire(req, now,
+                                      e2e_s=now - req.arrival_t)
+            self.expired += 1
+            self._terminate_fault(req, None, now,
+                                  reason="deadline_exceeded",
+                                  event="expired")
+        for i in list(sched.active_slots()):
+            st = sched.slots[i]
+            req = st.request
+            d = req.slo.deadline_s
+            if d is None or now - req.arrival_t <= d:
+                continue
+            if self._sampled(req.rid):
+                self.tracer.on_expire(req, now,
+                                      tokens=len(st.generated),
+                                      e2e_s=now - req.arrival_t)
+            sched.release(i)
+            self.expired += 1
+            self._terminate_fault(req, st, now,
+                                  reason="deadline_exceeded",
+                                  event="expired", slot=i)
+
+    def _maybe_brownout(self, now: float):
+        """Sustained page+queue pressure sheds the lowest-priority
+        queued band (same policy shape as ServeEngine._maybe_brownout:
+        ``brownout_streak`` consecutive hot steps arm it, one shed per
+        trigger, streak resets after)."""
+        cfg = self.cfg
+        sched = self.sched
+        hot = (self.pool.utilization >= cfg.brownout_page_high
+               and sched.queue_depth >= cfg.brownout_queue_min)
+        if not hot:
+            self._brownout_hot = 0
+            return
+        self._brownout_hot += 1
+        if self._brownout_hot < cfg.brownout_streak:
+            return
+        self._brownout_hot = 0
+        min_pri = min(r.slo.priority for r in sched.queue)
+        for req in [r for r in sched.queue
+                    if r.slo.priority == min_pri]:
+            if not sched.drop_queued(req):
+                continue
+            if self._sampled(req.rid):
+                self.tracer.on_shed(req, now)
+            self.shed += 1
+            self._terminate_fault(req, None, now,
+                                  reason="brownout_shed", event="shed")
 
     # ------------------------------------------------------------- step
     def _step(self, now: float, step_idx: int) -> float:
         """One engine-step equivalent at virtual time `now`; returns the
         modeled step duration."""
         sched = self.sched
-        while True:
-            adm = sched.admit_next(now)
-            if adm is None:
-                if (self.cfg.preempt and sched.queue
-                        and self._try_preempt(now)):
-                    continue
-                break
-            slot_idx, st = adm
-            self._on_admit(slot_idx, st, now)
-        if sched.queue:
+        plan = self.fault_plan
+        down = False
+        if plan is not None:
+            if plan.should_kill_engine(step_idx):
+                self._fail_over(now)
+            down = plan.engine_down(step_idx)
+        if self.cfg.deadline:
+            self._expire_deadlines(now)
+        if not down:
+            while True:
+                adm = sched.admit_next(now)
+                if adm is None:
+                    if (self.cfg.preempt and sched.queue
+                            and self._try_preempt(now)):
+                        continue
+                    break
+                slot_idx, st = adm
+                self._on_admit(slot_idx, st, now)
+        if not down and sched.queue:
             reason = sched.last_stall or "none"
             self._stall_seq += 1
             self._stall_reason = reason
@@ -517,8 +717,14 @@ class FleetSimulator:
                          and self._sampled(sched.slots[i].request.rid)]
             if survivors:
                 self.tracer.on_split(survivors, now, "evict")
-        if self.fault_plan is not None:
-            dt += self.fault_plan.step_delay(0, step_idx)
+        if self.cfg.brownout:
+            self._maybe_brownout(now)
+        if plan is not None:
+            dt += plan.step_delay(0, step_idx)
+        if down:
+            # the down-window must consume virtual time even with every
+            # slot drained, else the rejoin step never arrives
+            dt = max(dt, self.service.step_overhead_s)
         return dt
 
     # -------------------------------------------------------------- run
@@ -541,7 +747,7 @@ class FleetSimulator:
                     break
                 now = max(now, reqs[i].arrival_t)
                 continue
-            before = (sched.admitted, self.completed)
+            before = (sched.admitted, self.completed, self.faulted)
             dt = self._step(now, self.steps)
             self.steps += 1
             if every and self.steps % every == 0:
@@ -552,8 +758,8 @@ class FleetSimulator:
                 # event: admit/finish must have moved, else we are
                 # wedged (a quota no request can ever satisfy is
                 # rejected at submit, so this is a genuine bug)
-                if (sched.admitted, self.completed) == before \
-                        and i >= n:
+                if (sched.admitted, self.completed,
+                        self.faulted) == before and i >= n:
                     raise RuntimeError(
                         f"fleet sim wedged at step {self.steps}: queue "
                         f"depth {sched.queue_depth}, stall "
@@ -581,6 +787,17 @@ class FleetSimulator:
         reg.inc("serve.tokens_out", value=self.tokens_out)
         reg.inc("serve.prefill_chunks", value=self.prefill_chunks)
         reg.inc("serve.preemptions", value=self.preemptions)
+        if self.failovers:
+            reg.inc("serve.failovers", value=self.failovers)
+        if self.replica_requeues:
+            reg.inc("serve.replica_requeues",
+                    value=self.replica_requeues)
+        if self.retry_exhausted:
+            reg.inc("serve.retry_exhausted", value=self.retry_exhausted)
+        if self.expired:
+            reg.inc("serve.deadline_exceeded", value=self.expired)
+        if self.shed:
+            reg.inc("serve.brownout_shed", value=self.shed)
         for reason, c in sorted(self.stall_steps.items()):
             reg.inc("serve.admission_stalls", value=c, reason=reason)
         for t, peaks in sorted(self.quota_peaks.items()):
@@ -622,6 +839,12 @@ class FleetSimulator:
             "e2e_s": _hist_summary(b.e2e),
             "queue_wait_s": _hist_summary(b.queue_wait),
         }
+        # fault fields only when nonzero: a no-fault run's report stays
+        # byte-identical to the pre-fault-layer schema
+        if b.retries:
+            out["retries"] = b.retries
+        if b.faults:
+            out["faults"] = dict(sorted(b.faults.items()))
         if any(b.costs.values()):
             out["cost"] = dict(b.costs)
         return out
@@ -644,6 +867,9 @@ class FleetSimulator:
                 m.slo_ok += b.slo_ok
                 m.goodput_tokens += b.goodput_tokens
                 m.preemptions += b.preemptions
+                m.retries += b.retries
+                for k, v in b.faults.items():
+                    m.faults[k] = m.faults.get(k, 0) + v
                 for k, v in b.stalls.items():
                     m.stalls[k] = m.stalls.get(k, 0) + v
                 for k, v in b.costs.items():
@@ -679,6 +905,14 @@ class FleetSimulator:
             "steps": self.steps,
             "admitted": self.sched.admitted,
             "preemptions": self.preemptions,
+            "faults": {
+                "failovers": self.failovers,
+                "replica_requeues": self.replica_requeues,
+                "retry_exhausted": self.retry_exhausted,
+                "deadline_exceeded": self.expired,
+                "brownout_shed": self.shed,
+                "faulted": self.faulted,
+            },
             "prefill_chunks": self.prefill_chunks,
             "stall_steps": dict(sorted(self.stall_steps.items())),
             "stall_breakdown": dict(sorted(stall_breakdown.items())),
@@ -698,6 +932,28 @@ class FleetSimulator:
             out["prefix_cache"] = {
                 k: v for k, v in self.prefix_cache.stats().items()}
         return out
+
+
+def attainment_delta(report: Dict[str, Any],
+                     baseline: Dict[str, Any]) -> Dict[str, Any]:
+    """Per-tenant / per-class SLO-attainment degradation of a faulted
+    fleet run against its no-fault baseline (two `report()` payloads
+    from the same workload).  ``delta`` < 0 means the faults cost that
+    tenant attainment; tools_fleet.py and the chaos recovery reports
+    surface it."""
+    out: Dict[str, Any] = {"tenants": {}, "classes": {}}
+    for key in ("tenants", "classes"):
+        for name, sec in report.get(key, {}).items():
+            base = baseline.get(key, {}).get(name)
+            if base is None:
+                continue
+            a = sec.get("slo_attainment")
+            b = base.get("slo_attainment")
+            if a is None or b is None:
+                continue
+            out[key][name] = {"attainment": a, "baseline": b,
+                              "delta": a - b}
+    return out
 
 
 def fleet_workload(n: int, *, rate_per_s: float, burst: int = 0,

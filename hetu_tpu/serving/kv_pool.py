@@ -106,6 +106,26 @@ class PoolArrays:
         return PoolArrays(*t) if len(t) == 4 else PoolArrays(t[0], t[1])
 
 
+def repage_arrays(arrays: PoolArrays, mesh) -> PoolArrays:
+    """Re-place a pool's device arrays onto `mesh`, replicated — the KV
+    side of a LoadAdaptiveMesh tier change (HETU_TPU_SERVE_KV_REPAGE).
+
+    Every leaf (fp payload, or int8 payload + f32 scales) rides the same
+    `switch_tree` device_put program a params hot-switch uses; values
+    are untouched, only placement moves, so decode after the migration
+    is byte-identical to decode without it.  donate=True: the engine is
+    the pool's only owner and commits the result straight back (the old
+    buffers would be dead after the next donated decode step anyway),
+    so the switch never holds two live copies of the cache."""
+    from jax.sharding import NamedSharding, PartitionSpec
+
+    from hetu_tpu.parallel.switch import switch_tree
+    dst = NamedSharding(mesh, PartitionSpec())
+    tree = arrays.tree()
+    new = switch_tree(tree, tuple(dst for _ in tree), donate=True)
+    return PoolArrays.from_tree(new)
+
+
 class PagePool:
     """Host-side allocator + device-side page arrays.
 

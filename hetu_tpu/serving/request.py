@@ -30,16 +30,24 @@ class SLOClass:
     (HETU_TPU_SERVE_PREEMPT): under slot/page pressure a queued request
     of a STRICTLY higher priority may evict-and-requeue the
     lowest-priority live slot.  0 (default) = every class equal —
-    preemption can never fire between default-priority classes."""
+    preemption can never fire between default-priority classes.
+
+    ``deadline_s`` is an end-to-end wall budget from ARRIVAL: when
+    deadline enforcement is on (HETU_TPU_SERVE_DEADLINE) a request
+    still unfinished ``deadline_s`` after it arrived terminates as
+    ``deadline_exceeded`` — a real terminal span, costed in the
+    ledger.  None (default) = no deadline; with the flag unset the
+    engine never even inspects it."""
     name: str = "default"
     ttft_s: Optional[float] = None       # arrival -> first token target
     token_gap_s: Optional[float] = None  # mean inter-token gap target
     priority: int = 0
+    deadline_s: Optional[float] = None   # arrival -> done hard budget
 
     def __post_init__(self):
         if not self.name:
             raise ValueError("SLO class needs a name")
-        for fld in ("ttft_s", "token_gap_s"):
+        for fld in ("ttft_s", "token_gap_s", "deadline_s"):
             v = getattr(self, fld)
             if v is not None and v <= 0:
                 raise ValueError(f"SLO class {self.name!r}: {fld} must "
@@ -48,19 +56,21 @@ class SLOClass:
     def to_dict(self) -> dict:
         return {"name": self.name, "ttft_s": self.ttft_s,
                 "token_gap_s": self.token_gap_s,
-                "priority": self.priority}
+                "priority": self.priority,
+                "deadline_s": self.deadline_s}
 
     @staticmethod
     def parse(spec: str) -> "SLOClass":
-        """``name[:ttft_s[:token_gap_s[:priority]]]`` (empty/'-' = no
-        target) — the CLI surface: ``--slo-class gold:0.2:0.05:2``.
-        Extra fields and non-numeric targets are loud errors: a
-        silently dropped field would run a different contract than the
-        user typed."""
+        """``name[:ttft_s[:token_gap_s[:priority[:deadline_s]]]]``
+        (empty/'-' = no target) — the CLI surface:
+        ``--slo-class gold:0.2:0.05:2:30``.  Extra fields and
+        non-numeric targets are loud errors: a silently dropped field
+        would run a different contract than the user typed."""
         parts = spec.split(":")
-        if not parts[0] or len(parts) > 4:
-            raise ValueError(f"bad SLO class spec {spec!r}; want "
-                             "name[:ttft_s[:token_gap_s[:priority]]]")
+        if not parts[0] or len(parts) > 5:
+            raise ValueError(
+                f"bad SLO class spec {spec!r}; want "
+                "name[:ttft_s[:token_gap_s[:priority[:deadline_s]]]]")
 
         def num(i, what, cast=float):
             if len(parts) <= i or parts[i] in ("", "-"):
@@ -75,7 +85,8 @@ class SLOClass:
         prio = num(3, "priority", int)
         return SLOClass(parts[0], num(1, "ttft_s"),
                         num(2, "token_gap_s"),
-                        prio if prio is not None else 0)
+                        prio if prio is not None else 0,
+                        num(4, "deadline_s"))
 
 
 DEFAULT_SLO = SLOClass()
@@ -259,6 +270,9 @@ class RequestStats:
     #: times this request was evicted-and-requeued by a higher-priority
     #: admission (HETU_TPU_SERVE_PREEMPT)
     preemptions: int = 0
+    #: times this request re-entered the queue after its serving
+    #: replica died (chaos ``engine_kill``; budget HETU_TPU_SERVE_RETRY)
+    retries: int = 0
 
     @property
     def queue_wait_s(self) -> Optional[float]:
@@ -287,7 +301,11 @@ class RequestResult:
     """What the engine hands back when a request completes."""
     rid: int
     tokens: List[int]                  # generated ids (EOS included)
-    finished_reason: str               # "eos" | "length"
+    #: "eos" | "length" on the happy path; fault terminations use
+    #: "deadline_exceeded" (HETU_TPU_SERVE_DEADLINE), "brownout_shed"
+    #: (HETU_TPU_SERVE_BROWNOUT) and "retry_exhausted" (an engine_kill
+    #: past the HETU_TPU_SERVE_RETRY budget)
+    finished_reason: str
     stats: RequestStats = dataclasses.field(default_factory=RequestStats)
 
     @property

@@ -16,9 +16,20 @@ Hysteresis: a tier change needs `patience` consecutive observations on
 the other side of the boundary, so one bursty step cannot thrash the
 mesh back and forth (same strike discipline as the straggler hook).
 
-Known limit (docs/serving.md): the KV pool stays on its original
-placement — only params move.  Re-paging the pool across meshes is the
-natural next step once a multi-slice serving mesh exists to test on.
+KV re-paging (``HETU_TPU_SERVE_KV_REPAGE``, docs/serving.md): by
+default only params move — the KV pool keeps its original placement
+(the pre-existing behavior, and the identity contract of the flag).
+With the flag set the engine also routes the pool arrays (fp or int8
+payload + scales) through :meth:`LoadAdaptiveMesh.reshard_pool`, the
+same device_put switch program, replicated onto the destination tier's
+mesh — so in-flight requests survive a scale-up/down with their cache
+intact and their token streams byte-identical.  Page tables are
+host-side numpy, re-uploaded every step, so they migrate for free.
+
+Chaos (`reshard_storm`): :meth:`LoadAdaptiveMesh.force_tier` lets the
+fault-injection harness pin the next observation's outcome, bypassing
+the hysteresis — a deterministic tier flip-flop that exercises the
+re-paging path without shaping the workload around the thresholds.
 """
 from __future__ import annotations
 
@@ -54,7 +65,9 @@ class LoadAdaptiveMesh:
         self._handles: List[Optional[StrategyHandle]] = [None] * len(tiers)
         self._pending_tier: Optional[int] = None
         self._strikes = 0
+        self._forced: Optional[int] = None
         self.reshards = 0
+        self.pool_reshards = 0
 
     def handle(self, tier: int) -> StrategyHandle:
         h = self._handles[tier]
@@ -70,9 +83,26 @@ class LoadAdaptiveMesh:
                 tier = i
         return tier
 
+    def force_tier(self, tier: int):
+        """Pin the NEXT observation's outcome to `tier`, bypassing the
+        hysteresis — the chaos `reshard_storm` injection point.  A
+        forced flip to the already-active tier is a no-op (observe
+        still returns None: nothing to reshard)."""
+        if not 0 <= tier < len(self.tiers):
+            raise ValueError(f"tier {tier} out of range "
+                             f"[0, {len(self.tiers)})")
+        self._forced = tier
+
     def observe(self, queue_depth: int) -> Optional[int]:
         """Feed one load observation; returns the new tier id when the
         strike budget commits a change, else None."""
+        if self._forced is not None:
+            want, self._forced = self._forced, None
+            self._pending_tier, self._strikes = None, 0
+            if want == self.active_tier:
+                return None
+            self.active_tier = want
+            return want
         want = self.tier_for(queue_depth)
         if want == self.active_tier:
             self._pending_tier, self._strikes = None, 0
@@ -100,6 +130,24 @@ class LoadAdaptiveMesh:
             f"serving reshard -> tier {tier} "
             f"({self.tiers[tier][1].describe()})")
         return new_params
+
+    def reshard_pool(self, pool_arrays, tier: int):
+        """Migrate the paged KV pool onto tier's mesh
+        (HETU_TPU_SERVE_KV_REPAGE): every pool leaf — fp payload, or
+        int8 payload + f32 scales — is device_put replicated over the
+        destination mesh through the same switch program the params
+        ride.  Returns the migrated PoolArrays; the caller MUST commit
+        it back (the decode program donates the pool tree, so the old
+        arrays are dead after the next step either way).  Page tables
+        never appear here: they are host-resident numpy, re-uploaded
+        each step, so a tier change migrates them for free."""
+        from hetu_tpu.serving.kv_pool import repage_arrays
+        dst = self.handle(tier)
+        migrated = repage_arrays(pool_arrays, dst.mesh)
+        self.pool_reshards += 1
+        logger.info(f"serving KV re-page -> tier {tier} "
+                    f"({self.tiers[tier][1].describe()})")
+        return migrated
 
     def describe(self, tier: Optional[int] = None) -> str:
         t = self.active_tier if tier is None else tier

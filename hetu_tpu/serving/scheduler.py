@@ -64,7 +64,8 @@ class Scheduler:
 
     def __init__(self, *, num_slots: int, pool: PagePool, max_len: int,
                  prefix_cache=None, lookahead: int = 0,
-                 quotas: Optional[Dict[str, TenantQuota]] = None):
+                 quotas: Optional[Dict[str, TenantQuota]] = None,
+                 retry_budget: int = 0):
         if max_len % pool.page_size:
             raise ValueError(f"max_len {max_len} must be a multiple of "
                              f"page_size {pool.page_size}")
@@ -86,6 +87,13 @@ class Scheduler:
         self.admitted = 0
         self.released = 0
         self.preempted = 0
+        #: failover accounting (HETU_TPU_SERVE_RETRY): how many times
+        #: each rid re-entered the queue after a replica loss, and the
+        #: budget check_invariants() holds every rid to (0 = no budget
+        #: configured — requeue_lost is then never legal)
+        self.retry_budget = retry_budget
+        self.retries: Dict[int, int] = {}
+        self.replica_requeues = 0
         self._admit_seq = 0
         # live per-tenant usage, maintained at admit/release (the quota
         # check reads these instead of rescanning the slots each time);
@@ -276,6 +284,37 @@ class Scheduler:
         self.queue.append(st.request)
         return st.request
 
+    # -------------------------------------------------------- failover
+    def requeue_lost(self, slot_idx: int) -> Request:
+        """Requeue a live slot whose serving replica died (chaos
+        ``engine_kill``): same mechanics as :meth:`preempt` — pages
+        released, the original request re-queued at the back, a
+        deterministic re-prefill/decode regenerates the same tokens —
+        but billed against the per-rid retry budget
+        (HETU_TPU_SERVE_RETRY).  The CALLER checks the budget before
+        requeueing (past it, the request terminates instead);
+        `check_invariants` then holds every count to the budget."""
+        st = self.slots[slot_idx]
+        if st is None:
+            raise ValueError(f"slot {slot_idx} is not live")
+        rid = st.request.rid
+        self.release(slot_idx)
+        self.released -= 1          # a failover is not a completion
+        self.replica_requeues += 1
+        self.retries[rid] = self.retries.get(rid, 0) + 1
+        self.queue.append(st.request)
+        return st.request
+
+    def drop_queued(self, req: Request) -> bool:
+        """Remove a still-queued request (a deadline expiry or a
+        brownout shed terminates it without ever admitting); False when
+        it is not in the queue (already admitted or never submitted)."""
+        try:
+            self.queue.remove(req)
+        except ValueError:
+            return False
+        return True
+
     # ------------------------------------------------------ invariants
     def check_invariants(self):
         """The memory-pool correctness contract (fuzz-tested):
@@ -291,7 +330,14 @@ class Scheduler:
         * the null page is never owned and never free-listed,
         * every live position fits its reservation,
         * the incremental per-tenant usage counters match a fresh scan
-          of the live slots, and no quota'd tenant exceeds its caps."""
+          of the live slots, and no quota'd tenant exceeds its caps,
+        * a requeued request is REALLY requeued: no rid is both queued
+          and live in a slot (its pages were released before it
+          re-entered the queue — the refcount-after-requeue rule, which
+          the partition/refcount checks above then hold to zero leak),
+        * no rid's replica-loss requeue count exceeds the configured
+          retry budget (HETU_TPU_SERVE_RETRY), and with no budget
+          configured no requeue ever happened."""
         owners: Dict[int, int] = {}
         writers: Dict[int, List[int]] = {}   # slots holding p UNSHARED
         tslots: Dict[str, int] = {}
@@ -376,3 +422,14 @@ class Scheduler:
                  if self.pool.refcount[p] > 0 and p not in owners]
         if stray:
             raise AssertionError(f"refcounted pages with no owner: {stray}")
+        live_rids = {st.request.rid for st in self.slots if st is not None}
+        both = live_rids & {r.rid for r in self.queue}
+        if both:
+            raise AssertionError(
+                f"requests both queued and live in a slot: {sorted(both)}")
+        over = {rid: n for rid, n in self.retries.items()
+                if n > max(self.retry_budget, 0)}
+        if over:
+            raise AssertionError(
+                f"replica-loss requeues over the retry budget "
+                f"{self.retry_budget}: {over}")

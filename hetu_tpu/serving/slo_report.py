@@ -27,7 +27,12 @@ The report answers the questions aggregate histograms cannot:
 * **reconciliation** — per request, queued + prefill + decode + pause
   span durations vs the recorded ``e2e_s`` (the acceptance property:
   within one engine-step quantum; exact by the tracer's tiling
-  construction).
+  construction),
+* **fault accounting** — the failover / deadline / brownout sections
+  (docs/fault_tolerance.md): replica deaths and per-class retry counts
+  (HETU_TPU_SERVE_RETRY), deadline expiries and the tokens they
+  discarded (HETU_TPU_SERVE_DEADLINE), and brownout sheds per class
+  (HETU_TPU_SERVE_BROWNOUT).
 
 Span-derived fields degrade gracefully: with ``HETU_TPU_SERVE_TRACE``
 unset there are no span records, and the report still renders the
@@ -71,6 +76,14 @@ def collect(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "reshards": [r for r in serves if r.get("event") == "reshard"],
         "reports": [r for r in serves if r.get("event") == "report"],
         "preempts": [r for r in serves if r.get("event") == "preempt"],
+        # the fault-tolerance layer's events (docs/fault_tolerance.md):
+        # engine failovers, per-request replica-loss requeues, and the
+        # three fault terminations (retry_exhausted rides `evict`,
+        # deadline_exceeded rides `expired`, brownout_shed rides `shed`)
+        "failovers": [r for r in serves if r.get("event") == "failover"],
+        "retries": [r for r in serves if r.get("event") == "retry"],
+        "faults": [r for r in serves
+                   if r.get("event") in ("evict", "expired", "shed")],
         "traces": collect_traces(records),
         "anomalies": [r for r in records if r.get("kind") == "anomaly"],
     }
@@ -309,6 +322,77 @@ def preemption_report(collected: Dict[str, Any]
             "preemptor_classes": by}
 
 
+def failover_report(collected: Dict[str, Any]
+                    ) -> Optional[Dict[str, Any]]:
+    """Replica-death recovery accounting (from the ``failover`` and
+    ``retry`` events plus the ``done`` events' folded retry counts):
+    engine failovers, requests requeued under HETU_TPU_SERVE_RETRY,
+    budget exhaustions, and which classes paid the retries.  None when
+    the run never failed over."""
+    fo = collected["failovers"]
+    retries = collected["retries"]
+    if not fo and not retries:
+        return None
+    by_cls: Dict[str, float] = {}
+    for r in retries:
+        k = str(r.get("slo_class", "default"))
+        by_cls[k] = by_cls.get(k, 0) + _weight(r)
+    finished_retried = sum(
+        _weight(d) for d in collected["dones"] if d.get("retries"))
+    exhausted = [f for f in collected["faults"]
+                 if f.get("reason") == "retry_exhausted"]
+    return {
+        "failovers": len(fo),
+        "requeued": sum(int(f.get("requeued") or 0) for f in fo),
+        "retry_exhausted": _int_if_whole(
+            sum(_weight(f) for f in exhausted)),
+        "retried_by_class": {k: _int_if_whole(v)
+                             for k, v in sorted(by_cls.items())},
+        "finished_after_retry": _int_if_whole(finished_retried),
+    }
+
+
+def deadline_report(collected: Dict[str, Any]
+                    ) -> Optional[Dict[str, Any]]:
+    """Deadline enforcement (HETU_TPU_SERVE_DEADLINE, from the
+    ``expired`` events): requests expired per class and the decode
+    tokens discarded with them.  None when nothing expired."""
+    exp = [f for f in collected["faults"] if f.get("event") == "expired"]
+    if not exp:
+        return None
+    by_cls: Dict[str, float] = {}
+    for f in exp:
+        k = str(f.get("slo_class", "default"))
+        by_cls[k] = by_cls.get(k, 0) + _weight(f)
+    return {
+        "expired": _int_if_whole(sum(_weight(f) for f in exp)),
+        "by_class": {k: _int_if_whole(v)
+                     for k, v in sorted(by_cls.items())},
+        "tokens_discarded": _int_if_whole(
+            sum((f.get("tokens") or 0) * _weight(f) for f in exp)),
+    }
+
+
+def brownout_report(collected: Dict[str, Any]
+                    ) -> Optional[Dict[str, Any]]:
+    """Brownout shedding (HETU_TPU_SERVE_BROWNOUT, from the ``shed``
+    events): queued requests shed per class — always the
+    lowest-priority band present at each firing.  None when the policy
+    never fired."""
+    shed = [f for f in collected["faults"] if f.get("event") == "shed"]
+    if not shed:
+        return None
+    by_cls: Dict[str, float] = {}
+    for f in shed:
+        k = str(f.get("slo_class", "default"))
+        by_cls[k] = by_cls.get(k, 0) + _weight(f)
+    return {
+        "shed": _int_if_whole(sum(_weight(f) for f in shed)),
+        "by_class": {k: _int_if_whole(v)
+                     for k, v in sorted(by_cls.items())},
+    }
+
+
 def stall_breakdown(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     """How queued time attributes across the scheduler's stall reasons
     (span-traced runs only): request counts and total queued seconds per
@@ -392,6 +476,15 @@ def serving_report(records: Iterable[Dict[str, Any]], *,
     pre = preemption_report(collected)
     if pre is not None:
         out["preemptions"] = pre
+    fo = failover_report(collected)
+    if fo is not None:
+        out["failover"] = fo
+    dl = deadline_report(collected)
+    if dl is not None:
+        out["deadline"] = dl
+    bo = brownout_report(collected)
+    if bo is not None:
+        out["brownout"] = bo
     if collected["anomalies"]:
         by_kind: Dict[str, int] = {}
         for a in collected["anomalies"]:
@@ -502,6 +595,25 @@ def render_text(report: Dict[str, Any]) -> str:
                             sorted(pre["victim_classes"].items()))
         lines.append(f"preemptions: {pre['preemptions']} "
                      f"(victims by class: {victims})")
+    fo = report.get("failover")
+    if fo:
+        retried = ", ".join(f"{k}={v}" for k, v in
+                            fo["retried_by_class"].items())
+        lines.append(
+            f"failover: {fo['failovers']} replica deaths, "
+            f"{fo['requeued']} requests requeued"
+            + (f" ({retried})" if retried else "")
+            + f", {fo['retry_exhausted']} over budget, "
+            f"{fo['finished_after_retry']} finished after retry")
+    dl = report.get("deadline")
+    if dl:
+        by = ", ".join(f"{k}={v}" for k, v in dl["by_class"].items())
+        lines.append(f"deadlines: {dl['expired']} expired ({by}); "
+                     f"{dl['tokens_discarded']} tokens discarded")
+    bo = report.get("brownout")
+    if bo:
+        by = ", ".join(f"{k}={v}" for k, v in bo["by_class"].items())
+        lines.append(f"brownout: {bo['shed']} queued requests shed ({by})")
     if report.get("anomalies"):
         lines.append("anomalies: " + ", ".join(
             f"{k}={n}" for k, n in sorted(report["anomalies"].items())))

@@ -14,10 +14,18 @@ closed —
     prefill  [prev_end, chunk_end]               one per chunk; last ends at TTFT
     decode   [prev_end, boundary]                split at evictions/reshard pauses
     reshard_pause [pause_t0, pause_t1]
-    done/evicted  [t, t]                         zero-duration terminal
+    done/evicted/deadline_exceeded [t, t]        zero-duration terminal
 
 so ``sum(durations) == done_t - arrival_t == e2e_s`` by construction
 (`slo_report` property-tests the reconciliation).
+
+A request may be RE-queued inside the same trace — by a preemption
+(``preempted``) or by an engine failover (``replica_lost``,
+HETU_TPU_SERVE_RETRY).  Each requeue bumps the per-request ``attempt``
+index (first admission = attempt 1); spans emitted on later attempts
+carry an ``attempt`` attr so readers reconcile per-attempt instead of
+corrupting the first attempt's tiling.  Attempt-1 spans stay
+byte-identical to the pre-failover schema (no attr stamped).
 
 Gated by ``HETU_TPU_SERVE_TRACE`` (`maybe_tracer`): unset means the
 engine holds no tracer and does zero per-step tracing work — a single
@@ -37,7 +45,8 @@ class _Open:
     """Per-request open state between span boundaries."""
 
     __slots__ = ("rid", "trace", "slo_class", "slot", "phase", "last_t",
-                 "stall_reason", "seg_tokens", "seg_index", "chunks")
+                 "stall_reason", "seg_tokens", "seg_index", "chunks",
+                 "attempt")
 
     def __init__(self, rid: int, trace: str, slo_class: str,
                  arrival_t: float):
@@ -51,6 +60,7 @@ class _Open:
         self.seg_tokens = 0              # tokens in the open decode seg
         self.seg_index = 0
         self.chunks = 0
+        self.attempt = 1                 # bumped on every requeue
 
 
 class RequestTracer:
@@ -79,6 +89,9 @@ class RequestTracer:
     # ------------------------------------------------------------- emit
     def _emit(self, st: _Open, kind: str, t0: float, t1: float,
               **attrs: Any):
+        if st.attempt > 1:
+            # attempt-1 spans keep the pre-failover record shape
+            attrs.setdefault("attempt", st.attempt)
         span = Span(kind=kind, t0=t0, t1=t1, rid=st.rid, trace=st.trace,
                     slot=st.slot, slo_class=st.slo_class, attrs=attrs)
         self.spans_emitted += 1
@@ -109,12 +122,14 @@ class RequestTracer:
         reserve-on-admit reason on every still-queued request (the
         LAST observed reason wins — it names what the request was
         actually waiting on when it finally mattered).  A `preempted`
-        stamp is sticky: the request is back in the queue BECAUSE it
-        was evicted, and that attribution must survive later stalls."""
+        (or `replica_lost`) stamp is sticky: the request is back in the
+        queue BECAUSE it was evicted / its replica died, and that
+        attribution must survive later stalls."""
         for rid in rids:
             st = self._open.get(rid)
             if (st is not None and st.phase == "queued"
-                    and st.stall_reason != "preempted"):
+                    and st.stall_reason not in ("preempted",
+                                                "replica_lost")):
                 st.stall_reason = reason
 
     def on_admit(self, req, slot: int, now: float,
@@ -177,30 +192,49 @@ class RequestTracer:
             if st is not None:
                 self._close_segment(st, now, end=why)
 
-    def on_preempt(self, req, slot: int, now: float, *,
-                   by: Optional[int] = None):
-        """A higher-priority admission evicted this request
-        (HETU_TPU_SERVE_PREEMPT): close the open decode segment (or the
-        partial prefill), and re-enter the QUEUED phase inside the SAME
-        trace with the sticky ``preempted`` stall reason — the
-        re-admission emits a second queued span, so the tiling (and the
-        span-vs-e2e reconciliation) stays exact across the requeue."""
+    def _requeue(self, req, slot: int, now: float, *, reason: str,
+                 end: str):
+        """Close the open decode segment (or the partial prefill) and
+        re-enter the QUEUED phase inside the SAME trace with a sticky
+        stall reason — the re-admission emits another queued span, so
+        the tiling (and the span-vs-e2e reconciliation) stays exact
+        across the requeue.  Bumps the ``attempt`` index: every span
+        emitted from here on carries ``attempt`` so readers reconcile
+        per-attempt."""
         st = self._open.get(req.rid)
         if st is None:
             return
         st.slot = slot
         if st.phase == "decode":
-            self._close_segment(st, now, end="preempt")
+            self._close_segment(st, now, end=end)
         elif st.phase == "prefill" and now > st.last_t:
             self._emit(st, "prefill", st.last_t, now, chunk=st.chunks,
                        discarded=True)
             st.last_t = now
         st.phase = "queued"
-        st.stall_reason = "preempted"
+        st.stall_reason = reason
         st.slot = None
         st.chunks = 0
         st.seg_tokens = 0
         st.seg_index = 0
+        st.attempt += 1
+
+    def on_preempt(self, req, slot: int, now: float, *,
+                   by: Optional[int] = None):
+        """A higher-priority admission evicted this request
+        (HETU_TPU_SERVE_PREEMPT); stall reason ``preempted``."""
+        self._requeue(req, slot, now, reason="preempted", end="preempt")
+
+    def on_replica_lost(self, req, slot: int, now: float):
+        """The engine (replica) serving this request died (chaos
+        ``engine_kill``) and the request re-entered the queue under its
+        retry budget (HETU_TPU_SERVE_RETRY); stall reason
+        ``replica_lost``.  The warm radix prefix cache makes the
+        re-prefill cheap and seeded sampling replays the exact token
+        stream — the trace shows the failover as a requeue boundary,
+        not a fresh trace."""
+        self._requeue(req, slot, now, reason="replica_lost",
+                      end="replica_lost")
 
     def on_pause(self, rids: Iterable[int], t0: float, t1: float,
                  **attrs: Any):
@@ -214,24 +248,73 @@ class RequestTracer:
             self._emit(st, "reshard_pause", t0, t1, **attrs)
             st.last_t = t1
 
-    def on_finish(self, req, slot: int, reason: str, now: float, *,
-                  tokens: Optional[int] = None, e2e_s=None,
-                  evicted: bool = False):
-        """Terminal: close the open decode segment and emit the
-        zero-duration ``done`` (or ``evicted``) span."""
-        st = self._open.pop(req.rid, None)
-        if st is None:
-            return
-        st.slot = slot
-        self._close_segment(st, now, end="finish")
-        kind = "evicted" if evicted else "done"
-        self._emit(st, kind, now, now, reason=reason, tokens=tokens,
-                   e2e_s=e2e_s, chunks=st.chunks)
+    def _finalize(self, st: _Open, kind: str, now: float, **attrs: Any):
+        """Emit the zero-duration terminal span and retire the trace."""
+        self._emit(st, kind, now, now, **attrs)
         if self.keep and st.rid in self._kept:
             self.traces[st.rid] = self._kept.pop(st.rid)
             while len(self.traces) > self.max_kept:
                 # dicts iterate in insertion order: drop the oldest
                 self.traces.pop(next(iter(self.traces)))
+
+    def on_finish(self, req, slot: int, reason: str, now: float, *,
+                  tokens: Optional[int] = None, e2e_s=None,
+                  evicted: bool = False):
+        """Terminal: close the open decode segment and emit the
+        zero-duration ``done`` (or ``evicted``) span.  A mid-prefill
+        eviction (a retry-exhausted failover) tiles its partial
+        prefill as discarded so the trace still covers [arrival,
+        terminal] exactly."""
+        st = self._open.pop(req.rid, None)
+        if st is None:
+            return
+        st.slot = slot
+        if st.phase == "prefill":
+            if now > st.last_t:
+                self._emit(st, "prefill", st.last_t, now,
+                           chunk=st.chunks, discarded=True)
+                st.last_t = now
+        else:
+            self._close_segment(st, now, end="finish")
+        kind = "evicted" if evicted else "done"
+        self._finalize(st, kind, now, reason=reason, tokens=tokens,
+                       e2e_s=e2e_s, chunks=st.chunks)
+
+    def on_expire(self, req, now: float, *, tokens: int = 0,
+                  e2e_s=None):
+        """The request's SLO deadline expired (HETU_TPU_SERVE_DEADLINE):
+        tile the trace up to ``now`` from whatever phase it was in —
+        the un-admitted queued wait, a discarded partial prefill, or
+        the open decode segment — then emit the zero-duration
+        ``deadline_exceeded`` terminal span."""
+        st = self._open.pop(req.rid, None)
+        if st is None:
+            return
+        if st.phase == "queued":
+            self._emit(st, "queued", st.last_t, now,
+                       reason=st.stall_reason)
+        elif st.phase == "prefill" and now > st.last_t:
+            self._emit(st, "prefill", st.last_t, now, chunk=st.chunks,
+                       discarded=True)
+        else:
+            self._close_segment(st, now, end="expire")
+        self._finalize(st, "deadline_exceeded", now,
+                       reason="deadline_exceeded", tokens=tokens,
+                       e2e_s=e2e_s, chunks=st.chunks)
+
+    def on_shed(self, req, now: float):
+        """The brownout policy shed this still-queued request
+        (HETU_TPU_SERVE_BROWNOUT): close its queued span with the
+        ``brownout_shed`` stall reason and emit the ``evicted``
+        terminal carrying the same reason."""
+        st = self._open.pop(req.rid, None)
+        if st is None:
+            return
+        st.stall_reason = "brownout_shed"
+        self._emit(st, "queued", st.last_t, now, reason="brownout_shed")
+        self._finalize(st, "evicted", now, reason="brownout_shed",
+                       tokens=0, e2e_s=now - float(req.arrival_t),
+                       chunks=st.chunks)
 
     # ------------------------------------------------------------ debug
     def open_requests(self) -> List[int]:

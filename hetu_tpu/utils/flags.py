@@ -320,6 +320,52 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "deterministic.  Pure host-side bookkeeping: the compiled "
          "prefill/decode programs are byte-identical with the flag on "
          "or off (registered identity contract)", identity="1"),
+    Flag("HETU_TPU_SERVE_RETRY", "int", 0,
+         "per-request retry budget after a serving replica death (chaos "
+         "engine_kill): in-flight requests re-enter the queue with the "
+         "'replica_lost' stall reason and a bumped attempt index, up to "
+         "this many times; past the budget they terminate as "
+         "'retry_exhausted'.  Seeded sampling replays each survivor to "
+         "the exact token stream of the undisturbed run "
+         "(docs/fault_tolerance.md).  0 (default) = no retries: a "
+         "killed replica's in-flight requests terminate.  Host-side "
+         "failover policy only — the decode program is byte-identical "
+         "at any value (registered identity contract)",
+         identity="3", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_DEADLINE", "bool", False,
+         "enforce SLOClass deadlines (serving/request.py deadline_s, "
+         "the 5th --slo-class field): each engine step sweeps queued "
+         "AND live requests, terminating any older than its class "
+         "deadline as 'deadline_exceeded' — a real terminal span, "
+         "costed in the ledger and reported by slo_report.  Unset "
+         "(default) = deadlines never inspected.  Host-side policy "
+         "only — decode program byte-identical (registered identity "
+         "contract)",
+         identity="1", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_BROWNOUT", "bool", False,
+         "sustained-pressure brownout shedding: when KV page "
+         "utilization sits at the high watermark with a backed-up "
+         "queue for a streak of steps (the page_exhaustion_imminent "
+         "detector's signals), the engine sheds the lowest-priority "
+         "queued requests ('brownout_shed' stall reason, 'evicted' "
+         "terminal span), lowest-priority tenants first, and meters "
+         "the shed through the HETU_TPU_HEALTH serving detectors.  "
+         "Unset (default) = never shed.  Host-side policy only — "
+         "decode program byte-identical (registered identity "
+         "contract)",
+         identity="1", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_KV_REPAGE", "bool", False,
+         "migrate the paged KV pool through a LoadAdaptiveMesh tier "
+         "change (serving/reshard.py reshard_pool): the pool arrays "
+         "(fp or int8 payload+scales) are device_put onto the "
+         "destination tier's mesh alongside the params, so in-flight "
+         "requests survive a scale-up/down token-identically; page "
+         "tables are host-resident and re-uploaded each step, so they "
+         "migrate for free.  Unset (default) keeps the pre-existing "
+         "params-only reshard (the pool stays on its original "
+         "placement).  Pure data movement between steps — the decode "
+         "program is byte-identical (registered identity contract)",
+         identity="1", identity_programs=("decode",)),
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "Pallas fused-kernel layer routing (ops/pallas: flash attention, "
          "residual+RMS/LayerNorm, SwiGLU, rotary, blockwise quantize, "

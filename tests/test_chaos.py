@@ -48,6 +48,39 @@ def test_plan_json_roundtrip(tmp_path):
     assert loaded.to_dict() == plan.to_dict()
 
 
+def test_serving_fault_kinds_json_roundtrip(tmp_path):
+    """The serving fault kinds (engine_kill / reshard_storm /
+    decode_stall) survive the schedule JSON roundtrip with their
+    step-clock fields, and the injection queries honor them: the kill
+    latches one-shot, the down-window is a pure read over [at_step,
+    at_step+count), and the decode stall rides the slow-path delay."""
+    plan = FaultPlan([FaultSpec(kind="engine_kill", rank=0, at_step=4,
+                                count=3),
+                      FaultSpec(kind="reshard_storm", at_step=6,
+                                count=2),
+                      FaultSpec(kind="decode_stall", at_step=8, count=4,
+                                delay_s=0.25)], seed=3)
+    p = tmp_path / "serve_sched.json"
+    p.write_text(json.dumps(plan.to_dict()))
+    loaded = FaultPlan.load(str(p))
+    assert loaded.to_dict() == plan.to_dict()
+    # one-shot kill latch at the spec's rank...
+    assert not loaded.should_kill_engine(3, rank=0)
+    assert loaded.should_kill_engine(4, rank=0)
+    assert not loaded.should_kill_engine(5, rank=0)
+    # ...but the down-window stays a pure read over [at_step, +count)
+    assert loaded.engine_down(4, rank=0)
+    assert loaded.engine_down(6, rank=0)
+    assert not loaded.engine_down(7, rank=0)
+    # a rank-pinned spec does NOT match a rank-less query (the fleet
+    # passes rank=None; the live harness must pass its rank)
+    assert not loaded.engine_down(4)
+    # decode_stall inflates the step like slow_worker, inside its window
+    assert loaded.step_delay(None, 8) == 0.25
+    assert loaded.step_delay(None, 11) == 0.25
+    assert loaded.step_delay(None, 12) == 0.0
+
+
 def test_plan_rejects_unknown_kind_and_fields(tmp_path):
     with pytest.raises(ValueError):
         FaultSpec(kind="rpc_explode")

@@ -92,6 +92,12 @@ def test_every_env_read_is_registered():
     # the explicit expert-parallel MoE dispatch (nn/moe_dispatch.py,
     # docs/moe.md)
     assert "HETU_TPU_MOE_DISPATCH" in flags.REGISTRY
+    # the serving fault-tolerance surface (docs/fault_tolerance.md):
+    # engine failover retries, deadlines, brownout shedding, KV
+    # re-paging across reshards
+    for name in ("HETU_TPU_SERVE_RETRY", "HETU_TPU_SERVE_DEADLINE",
+                 "HETU_TPU_SERVE_BROWNOUT", "HETU_TPU_SERVE_KV_REPAGE"):
+        assert name in flags.REGISTRY
 
 
 def test_identity_contract_table():
@@ -140,9 +146,20 @@ def test_identity_contract_table():
                  "HETU_TPU_SERVE_PREEMPT", "HETU_TPU_SERVE_QUOTAS",
                  "HETU_TPU_RUNLOG_SERVE_SAMPLE"):
         assert flags.identity_contract_programs(name) == ("decode",)
+    # the serving fault-tolerance flags: all host-side policy, each
+    # contracted at a SETTABLE value (retry sweeps a nonzero budget —
+    # the budget only gates requeue bookkeeping, never the program)
+    # and restricted to the decode program
+    assert table["HETU_TPU_SERVE_RETRY"] == "3"
+    assert table["HETU_TPU_SERVE_DEADLINE"] == "1"
+    assert table["HETU_TPU_SERVE_BROWNOUT"] == "1"
+    assert table["HETU_TPU_SERVE_KV_REPAGE"] == "1"
+    for name in ("HETU_TPU_SERVE_RETRY", "HETU_TPU_SERVE_DEADLINE",
+                 "HETU_TPU_SERVE_BROWNOUT", "HETU_TPU_SERVE_KV_REPAGE"):
+        assert flags.identity_contract_programs(name) == ("decode",)
     # unrestricted contracts sweep everything
     assert flags.identity_contract_programs("HETU_TPU_PALLAS") is None
-    assert len(table) >= 22
+    assert len(table) >= 26
     # flags with NO contract must stay contract-free: these genuinely
     # change program shapes, so an identity entry would be a lie the
     # sweep turns into a tier-1 failure

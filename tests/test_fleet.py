@@ -14,7 +14,8 @@ from hetu_tpu.obs.runlog import RunLog
 from hetu_tpu.serving.costs import COST_FIELDS
 from hetu_tpu.serving.fleet import (FLEET_SCHEMA, FleetConfig,
                                     FleetSimulator, ServiceModel,
-                                    analytic_models, fleet_workload)
+                                    analytic_models, attainment_delta,
+                                    fleet_workload)
 from hetu_tpu.serving.request import SLOClass, parse_quotas, rid_sampled
 
 #: one tiny chip profile so tests never depend on the repo-root JSON
@@ -216,6 +217,91 @@ def test_fleet_chaos_storm_inflates_virtual_time():
     assert storm["trace_check"]["max_residual_s"] < 1e-9
 
 
+def test_fleet_replica_kill_10k_zero_violations_attainment_delta():
+    """The robustness acceptance bar at 10^4: one replica killed
+    mid-run (engine_kill with a 20-step down-window).  Zero invariant
+    violations, every non-expired request finishes (budget 2 means one
+    kill can never exhaust anyone), the requeues land in the per-tenant
+    buckets, and the per-tenant attainment delta vs the no-fault run is
+    reported through `attainment_delta`."""
+    from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
+    n = 10_000
+    svc, cost = _models()
+    calm = FleetSimulator(svc, config=_config(retry_budget=2),
+                          cost_model=cost).run(_workload(n))
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="engine_kill", at_step=200, count=20)])
+    sim = FleetSimulator(svc, config=_config(retry_budget=2),
+                         cost_model=cost, fault_plan=plan)
+    rep = sim.run(_workload(n))
+
+    assert rep["invariants"]["ok"]
+    assert rep["trace_check"]["max_residual_s"] < 1e-9
+    # no deadlines in this workload: every request must finish
+    assert rep["completed"] == n and rep["faults"]["faulted"] == 0
+    assert rep["faults"]["failovers"] == 1
+    assert rep["faults"]["replica_requeues"] >= 1
+    assert rep["faults"]["retry_exhausted"] == 0
+    # the requeues are attributed to tenant buckets as retries
+    assert (sum(t.get("retries", 0) for t in rep["tenants"].values())
+            == rep["faults"]["replica_requeues"])
+    # the attainment degradation report: every tenant and class row
+    # carries (attainment, baseline, delta) with exact arithmetic
+    delta = attainment_delta(rep, calm)
+    assert set(delta["tenants"]) == set(rep["tenants"])
+    assert set(delta["classes"]) == set(rep["classes"])
+    for section in ("tenants", "classes"):
+        for name, row in delta[section].items():
+            assert row["attainment"] == \
+                rep[section][name]["slo_attainment"]
+            assert row["baseline"] == \
+                calm[section][name]["slo_attainment"]
+            assert row["delta"] == pytest.approx(
+                row["attainment"] - row["baseline"])
+    # a no-fault report stays byte-free of fault keys in its buckets
+    assert all("faults" not in t for t in calm["tenants"].values())
+
+
+def test_fleet_deadline_expiry_and_brownout_shed_accounting():
+    """Deadline + brownout through the fleet sim: expired and shed
+    requests are REAL terminal outcomes — counted in their buckets
+    (attainment degrades by construction), completed + faulted
+    partitions the workload, and the fault breakdown reconciles with
+    the per-bucket rows."""
+    svc, cost = _models()
+    # bulk gets a deadline tight enough that queue wait alone expires a
+    # chunk of the class
+    wl = _workload(2000, slo_classes=[
+        SLOClass("gold", ttft_s=0.5, token_gap_s=0.25, priority=2),
+        SLOClass("bulk", deadline_s=0.01)])
+    rep = FleetSimulator(svc, config=_config(deadline=True),
+                         cost_model=cost).run(wl)
+    expired = rep["faults"]["deadline_exceeded"]
+    assert expired > 0
+    assert rep["completed"] + rep["faults"]["faulted"] == 2000
+    assert rep["invariants"]["ok"]
+    # only the deadline'd class expires, and the bucket rows reconcile
+    assert "faults" not in rep["classes"]["gold"]
+    assert rep["classes"]["bulk"]["faults"]["deadline_exceeded"] == expired
+    assert (sum(t.get("faults", {}).get("deadline_exceeded", 0)
+                for t in rep["tenants"].values()) == expired)
+    # faulted requests still count toward their bucket's request total
+    assert sum(c["requests"] for c in rep["classes"].values()) == 2000
+
+    # sustained page pressure with a starved pool browns out the
+    # lowest-priority band first
+    repb = FleetSimulator(
+        svc, config=_config(num_slots=4, brownout=True,
+                            brownout_page_high=0.3, brownout_streak=2),
+        cost_model=cost).run(_workload(500))
+    shed = repb["faults"]["brownout_shed"]
+    assert shed > 0
+    assert repb["completed"] + repb["faults"]["faulted"] == 500
+    assert repb["invariants"]["ok"]
+    assert (sum(t.get("faults", {}).get("brownout_shed", 0)
+                for t in repb["tenants"].values()) == shed)
+
+
 def test_tools_fleet_json_schema_and_exit(tmp_path, capsys):
     """tools_fleet.py smoke: the pinned --json schema keys, exit 0 on a
     complete+invariant-clean run, and the chrome-trace artifact."""
@@ -291,3 +377,35 @@ def test_fleet_million_requests_acceptance():
     assert rep["trace_check"]["max_residual_s"] < 1e-6
     assert sim.ledger.open_count == 0
     assert sum(t["requests"] for t in rep["tenants"].values()) == n
+
+
+@pytest.mark.slow
+def test_fleet_million_requests_replica_kill_acceptance():
+    """The robustness bar at 10^6: same acceptance run with one
+    replica killed mid-flight (a 50-step down-window).  All in-flight
+    work requeues under budget, every request still finishes, and the
+    sampled invariant sweeps stay clean through the failover."""
+    from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
+    n = 1_000_000
+    svc, cost = analytic_models(num_params=1e9, num_layers=8,
+                                hidden_size=1024, num_kv_heads=4,
+                                head_dim=64, page_size=8, hw=HW)
+    cfg = FleetConfig(num_slots=256, page_size=8, max_len=32,
+                      prefill_chunk=16, preempt=False,
+                      quotas=parse_quotas("free:64:1024"),
+                      invariant_every=5000, sample=1000,
+                      retry_budget=2)
+    reqs = fleet_workload(n, rate_per_s=20_000.0, burst=64,
+                          tenants=("acme", "bigco", "free"),
+                          prompt_lens=(4, 16), max_new=(2, 6), seed=0)
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="engine_kill", at_step=5000, count=50)])
+    sim = FleetSimulator(svc, config=cfg, cost_model=cost,
+                         fault_plan=plan)
+    rep = sim.run(reqs)
+    assert rep["completed"] == n and rep["faults"]["faulted"] == 0
+    assert rep["faults"]["failovers"] == 1
+    assert rep["faults"]["replica_requeues"] >= 1
+    assert rep["invariants"]["ok"]
+    assert rep["trace_check"]["max_residual_s"] < 1e-6
+    assert sim.ledger.open_count == 0

@@ -83,24 +83,30 @@ def test_quantize_heads_roundtrip_error_bound():
 
 # ----------------------------------------------------------- scheduler
 def test_scheduler_admit_evict_fuzz_invariants():
-    """Randomized arrival/EOS churn: the memory invariants (no page
-    aliasing, exact live+free partition, table mirrors) hold after every
-    transition — AND so do the flight recorder's span-event invariants
-    (a RequestTracer rides the same churn): every admitted request ends
-    with exactly one terminal span, spans are ordered/non-overlapping,
-    and queued spans carry a reserve-on-admit stall reason."""
+    """Randomized arrival/EOS churn — now with random engine kills
+    (requeue_lost under a retry budget / retry_exhausted past it),
+    deadline expiries and brownout sheds of queued requests: the memory
+    invariants (no page aliasing, exact live+free partition, table
+    mirrors, retry counts within budget, refcounts exact after a
+    requeue) hold after every transition — AND so do the flight
+    recorder's span-event invariants (a RequestTracer rides the same
+    churn): every terminated request ends with exactly one terminal
+    span, spans are ordered/non-overlapping, and queued spans carry a
+    reserve-on-admit stall reason."""
     from hetu_tpu.serving.tracing import RequestTracer
     rng = np.random.default_rng(7)
     pool = _pool(num_pages=10, page_size=4)
-    sched = Scheduler(num_slots=3, pool=pool, max_len=16)
+    sched = Scheduler(num_slots=3, pool=pool, max_len=16,
+                      retry_budget=2)
     tracer = RequestTracer()
     rid = 0
-    admits = 0
+    finished: set = set()
+    requeues = 0
     now = 0.0
     for _ in range(400):
         now += 0.01                      # strictly monotone fake clock
         op = rng.random()
-        if op < 0.45:
+        if op < 0.40:
             plen = int(rng.integers(1, 10))
             mnew = int(rng.integers(1, 16 - plen + 1))
             req = Request(rid=rid, prompt=np.ones(plen, np.int32),
@@ -108,18 +114,48 @@ def test_scheduler_admit_evict_fuzz_invariants():
             sched.submit(req)
             tracer.on_submit(req)
             rid += 1
-        elif op < 0.8:
+        elif op < 0.72:
             adm = sched.admit_next(now=now)
             if adm is not None:
                 slot_idx, st = adm
                 st.pos = st.request.prompt_len   # prefill done
                 tracer.on_admit(st.request, slot_idx, now)
                 tracer.on_first_token(st.request, slot_idx, now, chunk=1)
-                admits += 1
             elif sched.queue:
                 assert sched.last_stall in ("no_slot", "no_pages")
                 tracer.on_stall([r.rid for r in sched.queue],
                                 sched.last_stall)
+        elif op < 0.82:
+            # replica death on a random live slot: requeue under the
+            # budget, terminate retry_exhausted past it
+            live = sched.active_slots()
+            if live:
+                i = int(rng.choice(live))
+                st = sched.slots[i]
+                req = st.request
+                if sched.retries.get(req.rid, 0) < 2:
+                    sched.requeue_lost(i)
+                    tracer.on_replica_lost(req, i, now)
+                    requeues += 1
+                else:
+                    sched.release(i)
+                    tracer.on_finish(req, i, "retry_exhausted", now,
+                                     tokens=0,
+                                     e2e_s=now - req.arrival_t,
+                                     evicted=True)
+                    sched.retries.pop(req.rid, None)
+                    finished.add(req.rid)
+        elif op < 0.88:
+            # deadline expiry / brownout shed of a random queued request
+            if sched.queue:
+                req = sched.queue[int(rng.integers(len(sched.queue)))]
+                assert sched.drop_queued(req)
+                sched.retries.pop(req.rid, None)
+                if rng.random() < 0.5:
+                    tracer.on_expire(req, now, e2e_s=now - req.arrival_t)
+                else:
+                    tracer.on_shed(req, now)
+                finished.add(req.rid)
         else:
             live = sched.active_slots()
             if live:
@@ -127,22 +163,27 @@ def test_scheduler_admit_evict_fuzz_invariants():
                 st = sched.slots[i]
                 tracer.on_token(st.request, now)
                 sched.release(i)
+                sched.retries.pop(st.request.rid, None)
                 tracer.on_finish(st.request, i, "eos", now,
                                  tokens=1, e2e_s=now - st.request.arrival_t)
+                finished.add(st.request.rid)
         sched.check_invariants()
+    assert requeues > 0, "fuzz never exercised requeue_lost"
     # drain: everything releasable, pool fully recovered
     now += 0.01
     for i in sched.active_slots():
         st = sched.slots[i]
         sched.release(i)
+        sched.retries.pop(st.request.rid, None)
         tracer.on_finish(st.request, i, "eos", now,
                          tokens=0, e2e_s=now - st.request.arrival_t)
+        finished.add(st.request.rid)
     sched.check_invariants()
     assert pool.free_count == pool.num_pages
 
     # span-event invariants over the whole churn
-    assert len(tracer.traces) == admits, \
-        "every admit must end in exactly one terminal span"
+    assert set(tracer.traces) == finished, \
+        "every terminated request must end in exactly one terminal span"
     for tr in tracer.traces.values():
         tr.validate()        # ordered, non-overlapping, queued reason,
         #                      exactly one terminal

@@ -1,0 +1,219 @@
+"""Serving-survives-chaos acceptance (tier-1, CPU, seeded): the
+combined schedule — engine_kill mid-decode + a reshard storm over live
+KV + deadline expiry — completes every SURVIVING request token-identical
+to the undisturbed run (greedy AND sampled), with exact span tiling per
+attempt; plus KV re-paging parity across both pool dtypes and a
+prefix-cache-shared chain."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import serving
+from hetu_tpu.chaos.inject import maybe_chaos_serving
+from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.obs.metrics import MetricsRegistry
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+def _tiers(model):
+    from hetu_tpu.core.mesh import MeshConfig
+    from hetu_tpu.parallel.strategy import ParallelStrategy
+    return serving.LoadAdaptiveMesh(
+        lambda st: model,
+        [(0, ParallelStrategy(mesh=MeshConfig(dp=1, tp=1))),
+         (3, ParallelStrategy(mesh=MeshConfig(dp=1, tp=1)))],
+        patience=1)
+
+
+def _requests(vocab_size, *, sampling=None, deadline_bulk=None, n=8,
+              shared_prefix_len=0, seed=11):
+    classes = [serving.SLOClass("gold", priority=2),
+               serving.SLOClass("bulk", deadline_s=deadline_bulk)]
+    return serving.synthetic_requests(
+        n, vocab_size=vocab_size, prompt_lens=(3, 10), max_new=(4, 8),
+        slo_classes=classes, sampling=sampling,
+        shared_prefix_len=shared_prefix_len, seed=seed)
+
+
+def _cfg(**kw):
+    base = dict(num_slots=2, page_size=8, max_len=32, prefill_chunk=8)
+    base.update(kw)
+    return serving.ServeConfig(**base)
+
+
+@pytest.mark.parametrize("mode", ["greedy", "sampled"])
+def test_combined_chaos_survivors_token_identical(tiny_llama, mode):
+    """THE acceptance scenario: one seeded schedule kills the engine
+    mid-decode (every in-flight request requeues under its retry
+    budget), storms the adaptive mesh through a tier flip while KV
+    pages are live (HETU_TPU_SERVE_KV_REPAGE semantics), and expires
+    the bulk class's deadline.  Every surviving request's token stream
+    is byte-identical to the undisturbed run — greedy and sampled —
+    and every kept trace tiles exactly per attempt."""
+    model, params = tiny_llama
+    sampling = (serving.SamplingParams(temperature=0.8, top_k=16,
+                                       seed=77)
+                if mode == "sampled" else None)
+    sample_on = {"sampling": True} if mode == "sampled" else {}
+
+    # undisturbed run: no faults, no deadline — every request finishes
+    base = serving.ServingEngine(
+        model, params, _cfg(**sample_on), registry=MetricsRegistry())
+    base_res = base.run(_requests(model.config.vocab_size,
+                                  sampling=sampling))
+    gold_tokens = {r.rid: r.tokens for r in base_res}
+    assert all(r.finished_reason in ("length", "eos") for r in base_res)
+
+    # the chaos run: kill at step 4, storm tiers over steps 6..8,
+    # bulk deadline expires immediately (deterministic: every bulk
+    # request terminates deadline_exceeded, gold must survive intact)
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="engine_kill", rank=0, at_step=4),
+        FaultSpec(kind="reshard_storm", rank=0, at_step=6, count=3),
+    ])
+    tracer = serving.RequestTracer()
+    eng = serving.ServingEngine(
+        model, params,
+        _cfg(retry_budget=2, deadline=True, kv_repage=True, **sample_on),
+        registry=MetricsRegistry(), tracer=tracer,
+        reshard=_tiers(model))
+    res = eng.run(_requests(model.config.vocab_size, sampling=sampling,
+                            deadline_bulk=1e-6),
+                  on_step=lambda i: maybe_chaos_serving(plan, eng, i,
+                                                        rank=0))
+    assert len(res) == len(base_res)
+
+    by_reason: dict = {}
+    for r in res:
+        by_reason.setdefault(r.finished_reason, []).append(r)
+    assert by_reason.get("deadline_exceeded"), "no deadline expired"
+    survivors = [r for r in res
+                 if r.finished_reason in ("length", "eos")]
+    assert survivors, "every request faulted — nothing to replay"
+    for r in survivors:
+        assert r.tokens == gold_tokens[r.rid], \
+            f"rid {r.rid} diverged after failover/reshard ({mode})"
+
+    # the kill fired and requeued work; the storm re-paged live KV
+    snap = {c["name"]: c["value"]
+            for c in eng._registry.snapshot()["counters"]}
+    assert snap.get("serve.failovers", 0) == 1
+    assert snap.get("serve.replica_requeues", 0) >= 1
+    assert snap.get("serve.kv_repages", 0) >= 1
+
+    # span tiling exact per attempt: every trace validates, reconciles
+    # within one step quantum, and at least one survivor shows a
+    # second attempt (the replica_lost requeue boundary)
+    retried = 0
+    for tr in tracer.traces.values():
+        tr.validate()
+        e2e = tr.terminal.attrs.get("e2e_s")
+        if e2e is not None:
+            assert tr.reconcile(e2e) <= 0.25
+        if any(s.attrs.get("attempt", 1) >= 2 for s in tr.spans):
+            retried += 1
+    assert retried >= 1, "no trace carries the retry attempt index"
+
+
+@pytest.mark.parametrize("quant", ["none", "int8"])
+def test_kv_repage_parity_both_dtypes_prefix_chain(tiny_llama, quant):
+    """KV re-paging parity: a forced tier storm with live paged KV —
+    payload AND int8 scales migrated through the hot-switch machinery,
+    with a radix-prefix-cache-shared chain riding the same pool —
+    produces byte-identical tokens to the undisturbed run."""
+    model, params = tiny_llama
+    mk = lambda: _requests(model.config.vocab_size, n=6,
+                           shared_prefix_len=8, seed=3)
+
+    base = serving.ServingEngine(
+        model, params, _cfg(kv_quant=quant, prefix_cache=True),
+        registry=MetricsRegistry())
+    gold = {r.rid: r.tokens for r in base.run(mk())}
+
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="reshard_storm", rank=0, at_step=2, count=4),
+    ])
+    eng = serving.ServingEngine(
+        model, params,
+        _cfg(kv_quant=quant, prefix_cache=True, kv_repage=True),
+        registry=MetricsRegistry(), reshard=_tiers(model))
+    res = eng.run(mk(),
+                  on_step=lambda i: maybe_chaos_serving(plan, eng, i,
+                                                        rank=0))
+    snap = {c["name"]: c["value"]
+            for c in eng._registry.snapshot()["counters"]}
+    assert snap.get("serve.kv_repages", 0) >= 1, "storm never re-paged"
+    assert eng.prefix_cache is not None and \
+        eng.prefix_cache.stats()["hits"] >= 1, "prefix chain never hit"
+    for r in res:
+        assert r.tokens == gold[r.rid], \
+            f"rid {r.rid} diverged across re-page (quant={quant})"
+
+
+def test_failover_replay_after_prefix_cache_warm(tiny_llama):
+    """Failover with a warm radix cache: the re-prefill after a
+    replica death admits through the shared-prefix fast path and still
+    replays the identical stream."""
+    model, params = tiny_llama
+    mk = lambda: _requests(model.config.vocab_size, n=6,
+                           shared_prefix_len=8, seed=9)
+    base = serving.ServingEngine(
+        model, params, _cfg(prefix_cache=True),
+        registry=MetricsRegistry())
+    gold = {r.rid: r.tokens for r in base.run(mk())}
+
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="engine_kill", rank=0, at_step=5),
+    ])
+    eng = serving.ServingEngine(
+        model, params, _cfg(prefix_cache=True, retry_budget=2),
+        registry=MetricsRegistry())
+    res = eng.run(mk(),
+                  on_step=lambda i: maybe_chaos_serving(plan, eng, i,
+                                                        rank=0))
+    snap = {c["name"]: c["value"]
+            for c in eng._registry.snapshot()["counters"]}
+    assert snap.get("serve.replica_requeues", 0) >= 1
+    for r in res:
+        assert r.finished_reason in ("length", "eos")
+        assert r.tokens == gold[r.rid]
+
+
+def test_retry_budget_exhaustion_terminates(tiny_llama):
+    """Past the retry budget a re-killed request terminates as
+    ``retry_exhausted`` (a real terminal result, spans tiled) instead
+    of looping forever."""
+    model, params = tiny_llama
+    tracer = serving.RequestTracer()
+    eng = serving.ServingEngine(
+        model, params, _cfg(num_slots=1, retry_budget=1),
+        registry=MetricsRegistry(), tracer=tracer)
+    # each spec is a one-shot latch; four kills on CONSECUTIVE steps
+    # wrap the single-slot round-robin (rid0, rid1, rid2, then rid0
+    # again) so the fourth kill re-hits a request already at its
+    # budget of one retry
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="engine_kill", rank=0, at_step=s)
+        for s in (3, 4, 5, 6)])
+    res = eng.run(_requests(model.config.vocab_size, n=3, seed=21),
+                  on_step=lambda i: maybe_chaos_serving(plan, eng, i,
+                                                        rank=0))
+    assert len(res) == 3
+    reasons = sorted(r.finished_reason for r in res)
+    assert "retry_exhausted" in reasons
+    for tr in tracer.traces.values():
+        tr.validate()
+    snap = {c["name"]: c["value"]
+            for c in eng._registry.snapshot()["counters"]}
+    assert snap.get("serve.retry_exhausted", 0) >= 1
+    assert eng.scheduler.retries == {}, "retry ledger leaked"

@@ -418,6 +418,59 @@ def test_chaos_serve_preempt_scenario(tmp_path):
     assert report["slo"]["reconciliation"]["max_residual_s"] <= 1e-6
 
 
+def test_chaos_serve_failover_flake_checked(tmp_path):
+    """The serve-failover schedule through the real engine, run at five
+    different workload seeds (the flake check): the kill fires exactly
+    once, every in-flight request requeues under its budget and replays
+    to completion (no retry_exhausted, all `length` finishes), and the
+    report's failover section carries the retry accounting per class."""
+    from hetu_tpu.chaos.harness import named_plan, run_serving_chaos_demo
+    for seed in range(5):
+        plan = named_plan("serve-failover")
+        report = run_serving_chaos_demo(
+            str(tmp_path / f"s{seed}"), plan, requests=10, rate=80.0,
+            burst=5, retry_budget=2, seed=seed)
+        assert report["completed"], f"seed {seed} lost requests"
+        assert report["faults"]["serve.failovers"] == 1
+        fo = report["slo"]["failover"]
+        assert fo["failovers"] == 1
+        assert fo["requeued"] >= 1, f"seed {seed}: kill hit empty slots"
+        assert fo["retry_exhausted"] == 0
+        assert fo["finished_after_retry"] == fo["requeued"]
+        assert sum(fo["retried_by_class"].values()) == fo["requeued"]
+        assert report["finished_reasons"] == {"length": 10}
+        assert report["slo"]["reconciliation"]["max_residual_s"] <= 1e-6
+
+
+def test_chaos_serve_brownout_flake_checked(tmp_path):
+    """The serve-brownout schedule: a decode-stall window over a
+    starved pool trips the sustained-pressure policy at every one of
+    five seeds — queued low-priority requests terminate `brownout_shed`
+    (real terminal outcomes: completed + shed partitions the workload),
+    the report's brownout section attributes the sheds per class, and
+    the health detectors metered the shedding."""
+    from hetu_tpu.chaos.harness import named_plan, run_serving_chaos_demo
+    for seed in range(5):
+        plan = named_plan("serve-brownout")
+        report = run_serving_chaos_demo(
+            str(tmp_path / f"s{seed}"), plan, requests=18, rate=80.0,
+            burst=6, brownout=True, brownout_page_high=0.5,
+            brownout_streak=2, num_pages=8, seed=seed)
+        reasons = report["finished_reasons"]
+        shed = reasons.get("brownout_shed", 0)
+        assert shed >= 1, f"seed {seed}: pressure never tripped"
+        assert shed + reasons.get("length", 0) \
+            + reasons.get("eos", 0) == 18
+        bo = report["slo"]["brownout"]
+        assert bo["shed"] == shed
+        assert sum(bo["by_class"].values()) == shed
+        # the lowest-priority band pays first
+        assert bo["by_class"].get("bulk", 0) >= 1
+        assert report["faults"]["serve.brownout_shed"] == shed
+        assert any("brownout" in k for k in report["detectors"]), \
+            "health detectors missed the shed burst"
+
+
 def test_cli_serving_trace_and_report(tmp_path, capsys):
     """CLI smoke (mirrors test_cli_self_is_clean): one tools_serving.py
     --trace run with classes + chrome trace, then

@@ -8,7 +8,7 @@ recovery report.
 
 Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
 partition, corrupt, stall, slow, serve-burst, serve-preempt,
-fleet-storm.  A path argument loads a
+serve-failover, serve-brownout, fleet-storm.  A path argument loads a
 FaultPlan JSON (docs/fault_tolerance.md has the schema — the same format
 the HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs
 with HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster
@@ -26,6 +26,16 @@ scenario with SLO-class preemptive admission armed (gold at priority 2):
 the slowdown pins bulk decodes on every slot and arriving gold requests
 evict-and-requeue them — the report's `slo.preemptions` section names
 the victims.
+
+`--schedule serve-failover` kills the engine replica mid-decode: every
+in-flight request requeues under its retry budget (stall reason
+`replica_lost`), re-prefills against the warm radix cache and replays
+its exact token stream — the report's `slo.failover` section carries
+requeue / retry-exhaustion counts and per-class attainment shows what
+the death cost.  `--schedule serve-brownout` stalls decode over a tight
+page pool until the sustained-pressure shed policy drops the
+lowest-priority queued band (`slo.brownout`, with `brownout_shed`
+anomalies metered through the serving health detectors).
 
 `--schedule fleet-storm` scales the serving scenario to fleet size: a
 multi-tenant burst storm through the discrete-event fleet simulator
@@ -100,13 +110,22 @@ def main(argv=None) -> int:
             requests=args.requests or 5000,
             rate=args.rate or 2000.0,
             burst=args.burst or 16)
-    elif args.schedule in ("serve-burst", "serve-preempt"):
+    elif args.schedule in ("serve-burst", "serve-preempt",
+                           "serve-failover", "serve-brownout"):
         # the serving scenario has its own knobs; the training demo's
         # --steps/--workers do not apply to it
+        extra = {}
+        if args.schedule == "serve-failover":
+            extra = dict(retry_budget=2)
+        elif args.schedule == "serve-brownout":
+            # tight pool + low shed threshold so the stall window
+            # reliably arms the policy at demo scale
+            extra = dict(brownout=True, brownout_page_high=0.5,
+                         brownout_streak=2, num_pages=8)
         report = run_serving_chaos_demo(
             workdir, plan, requests=args.requests or 18,
             rate=args.rate or 60.0, burst=args.burst or 6,
-            preempt=args.schedule == "serve-preempt")
+            preempt=args.schedule == "serve-preempt", **extra)
     else:
         report = run_chaos_demo(workdir, plan, num_steps=args.steps,
                                 workers=args.workers)

@@ -130,7 +130,8 @@ def summarize(records) -> dict:
             srv["slo_attainment"] = rep["slo_attainment"]
             for k in ("goodput_tokens_per_s", "stall_breakdown",
                       "reconciliation", "spec_decode", "prefix_cache",
-                      "preemptions", "tenants", "costs"):
+                      "preemptions", "tenants", "costs",
+                      "failover", "deadline", "brownout"):
                 if rep.get(k) is not None:
                     srv[k] = rep[k]
         out["serving"] = srv

@@ -7,9 +7,10 @@ attainment, goodput and stall attribution from a serving RunLog.
     python tools_serving_report.py /tmp/serve.jsonl --json
     python tools_serving_report.py /tmp/serve.jsonl --per-request --json
 
-Reads the ``serve`` events (admit/done/preempt/reshard/report) and —
-when the run traced with ``HETU_TPU_SERVE_TRACE`` — the ``span``
-records, all through the ONE reader in `hetu_tpu/serving/slo_report.py`
+Reads the ``serve`` events (admit/done/preempt/reshard/report plus the
+fault kinds failover/retry/evict/expired/shed) and — when the run
+traced with ``HETU_TPU_SERVE_TRACE`` — the ``span`` records, all
+through the ONE reader in `hetu_tpu/serving/slo_report.py`
 (the same module `tools_obs_report.py`'s serving section uses; there is
 no second RunLog parser).  With spans present the report adds stall
 attribution (`no_slot` vs `no_pages` vs `preempted` queue time) and the
@@ -24,7 +25,13 @@ class counts.  Multi-tenant runs (Request.tenant stamped on the serve
 events) add the per-tenant attainment/goodput table and — when the
 engine priced requests through a `serving/costs.py` CostLedger — the
 per-tenant cost roll-up (prefill/decode FLOPs, KV page-seconds,
-resident byte-seconds, wire bytes).  Sampled RunLogs
+resident byte-seconds, wire bytes).  Runs that took faults add the
+fault sections: **failover** (replica deaths, requeues under the retry
+budget, retry exhaustion, requests that finished after a retry),
+**deadline** (``deadline_exceeded`` terminations per class, tokens
+discarded) and **brownout** (sustained-pressure sheds per class) — the
+`tools_chaos.py` serve-failover / serve-brownout recovery reports carry
+the same sections.  Sampled RunLogs
 (HETU_TPU_RUNLOG_SERVE_SAMPLE > 1) are re-weighted by the stamped
 ``sample_weight`` so totals and attainment stay unbiased.
 

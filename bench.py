@@ -197,10 +197,12 @@ def _hardware_free_kernels(batch: int = 8, seq: int = 2048):
     (residual+RMSNorm >= 3x at the config's bf16 activations).
     Hardware-free like the comm/serving records (docs/kernels.md)."""
     from hetu_tpu.obs.mfu import kernel_roofline, load_hardware_profile
-    from hetu_tpu.ops.pallas.traffic import report_for_config
+    from hetu_tpu.ops.pallas.traffic import (fused_verify_chain,
+                                             report_for_config)
     cfg = _bench_config()
+    hw = load_hardware_profile()
     traffic = report_for_config(cfg, batch=batch, seq=seq)
-    roof = kernel_roofline(traffic, hw=load_hardware_profile())
+    roof = kernel_roofline(traffic, hw=hw)
     rec = {}
     for name, rt in traffic.items():
         rr = roof[name]
@@ -212,6 +214,22 @@ def _hardware_free_kernels(batch: int = 8, seq: int = 2048):
             "unfused_s": rr["unfused_s"],
             "per_step_multiplier": rt["per_step_multiplier"],
         }
+    # the whole fused verify step (paged_verify x layers + one sampling
+    # epilogue) vs the gather path — the acceptance gate pins >= 2x at
+    # the bench spec-decode profile (k=4, int8 pages)
+    fc = fused_verify_chain(
+        8, 4, 16, 16, cfg.num_key_value_heads, cfg.head_dim,
+        cfg.hidden_size, cfg.vocab_size,
+        num_layers=cfg.num_hidden_layers, quant="int8")
+    hbm = float(hw["hbm_gbps"]) * 1e9
+    rec["fused_verify_chain"] = {
+        "fused_bytes": round(fc["fused_bytes"], 1),
+        "unfused_bytes": round(fc["gather_bytes"], 1),
+        "reduction": round(fc["reduction"], 3),
+        "fused_s": fc["fused_bytes"] / hbm,
+        "unfused_s": fc["gather_bytes"] / hbm,
+        "per_step_multiplier": 1,
+    }
     return rec
 
 
@@ -285,7 +303,7 @@ def _hardware_free_serving(slots: int = 8, ctx: int = 2048, *,
     # positions (qk + pv, 2 * 2 * ctx * hidden)
     flops_tok = 2.0 * n + 4.0 * L * ctx * cfg.hidden_size
     kv = {m: kv_bytes_per_token(L, n_kv, hd, m) * ctx
-          for m in ("fp32", "fp16", "int8")}
+          for m in ("fp32", "fp16", "int8", "int4")}
 
     def tokens_per_s(kv_mode):
         # one batched decode step: params (bf16) read once, each slot
@@ -298,9 +316,11 @@ def _hardware_free_serving(slots: int = 8, ctx: int = 2048, *,
         "slots": slots, "context": ctx,
         "decode_tokens_per_s": round(tokens_per_s("fp16"), 1),
         "decode_tokens_per_s_int8_kv": round(tokens_per_s("int8"), 1),
+        "decode_tokens_per_s_int4_kv": round(tokens_per_s("int4"), 1),
         "kv_bytes_per_seq": {m: round(v, 1) for m, v in kv.items()},
         "kv_ratio_int8_vs_fp32": round(kv["fp32"] / kv["int8"], 3),
         "kv_ratio_int8_vs_fp16": round(kv["fp16"] / kv["int8"], 3),
+        "kv_ratio_int4_vs_fp32": round(kv["fp32"] / kv["int4"], 3),
     }
     # speculative decoding at the measured-acceptance operating point
     # (0.7 per-draft acceptance is the Hetis/Medusa-class regime for an
@@ -310,6 +330,18 @@ def _hardware_free_serving(slots: int = 8, ctx: int = 2048, *,
         n_params=n, flops_per_token=flops_tok,
         step_bytes=2.0 * n + slots * kv["fp16"], slots=slots,
         k=4, acceptance=0.7, peak_flops=peak, hbm_bytes_per_s=hbm)
+    # HETU_TPU_SPEC_DECODE=model: a resident-int8 draft model at ~1/20
+    # the target params raises per-draft acceptance (the stochastic p/q
+    # rule accepts on distribution overlap, not exact match) and pays k
+    # sequential batched draft forwards per verify step
+    n_draft = n / 20.0
+    rec["spec_decode_model"] = roofline_report(
+        n_params=n, flops_per_token=flops_tok,
+        step_bytes=2.0 * n + slots * kv["fp16"], slots=slots,
+        k=4, acceptance=0.85, peak_flops=peak, hbm_bytes_per_s=hbm,
+        draft_flops_per_step=slots * 4 * 2.0 * n_draft,
+        draft_bytes_per_step=4 * 1.0 * n_draft)
+    rec["spec_decode_model"]["draft_params_frac"] = 0.05
     rec["prefix_cache"] = _prefix_cache_flops(cfg, measure_hlo=measure_hlo)
     return rec
 

@@ -27,10 +27,15 @@ comm/wire.py):
   each slice over the fast intra links, exchange only the 1/slice shard
   across slices, all-gather back inside the slice — the slow inter-slice
   links move slice_devices-fold fewer bytes (wire.two_level_sync_bytes).
-  The two-level path is stateless-quantize only (int8/int4): its four
-  quantize points have different shapes than the flat path's two, so EF
-  residual state cannot be carried across the mode switch — requesting
-  both raises loudly.
+  The hierarchical schedule has FOUR quantize points, each with its own
+  error-feedback residual in the "-ef" modes: the full-buffer intra
+  scatter reuses the flat path's per-replica "a2a" [dp, L] residual and
+  the inter gather's sub-shard re-quantize reuses the per-shard "ag"
+  [L] one (same shapes); the two chunk-sized points get their own
+  "tl_inter"/"tl_intra" [dp, L/slice_devices] residuals (split over dp,
+  like "a2a").  The tl_* entries exist only while a topology routes —
+  switching HETU_TPU_COMM_TOPOLOGY mid-run changes the optimizer-state
+  structure, like any other program-shape knob.
 
 * The hetero-DP cross-mesh bridge (`bridge_compress` /
   `bridge_accumulate`) — quantize-before-`jax.device_put`
@@ -132,11 +137,20 @@ def _sync_bucket(flat, axis_name: str, dp: int, block_size: int,
 
 
 def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
-                           bits: int, topo: Topology):
-    """Hierarchical twin of `_sync_bucket` (no EF): intra-slice quantized
+                           bits: int, topo: Topology,
+                           ef_a2a=None, ef_inter=None, ef_ag=None,
+                           ef_intra=None):
+    """Hierarchical twin of `_sync_bucket`: intra-slice quantized
     reduce-scatter -> inter-slice quantized all-reduce of the 1/k shard
     (a2a + re-quantized gather) -> intra-slice quantized all-gather.
-    Inter-slice links carry only L/k elements instead of L."""
+    Inter-slice links carry only L/k elements instead of L.
+
+    Each of the four quantize points carries an optional error-feedback
+    residual (all four or none): ef_a2a local [1, L] (stage 1, the flat
+    path's per-replica shape), ef_inter local [1, L/k] (stage 2),
+    ef_ag local [L/dp] (stage 3, the flat path's per-shard shape),
+    ef_intra local [1, L/k] (stage 4).  Returns
+    (full [L], new_a2a, new_inter, new_ag, new_intra)."""
     intra, inter = topo.groups(dp)
     k = topo.slice_devices
     m = dp // k
@@ -149,22 +163,27 @@ def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
 
     from hetu_tpu.obs import numerics as _numerics
 
-    def q_rows(x, rows, nblk):
-        q, s = quantize_blockwise(x, block_size, bits=bits)
+    def q_point(x, rows, nblk, ef):
+        """One quantize point: ef_quantize when a residual rides (the
+        residual IS the exact quantization error), stateless otherwise.
+        The hierarchical schedule's four points accumulate into ONE
+        numerics scope (the per-point split is a wire detail)."""
+        q, s, nr = ef_quantize(x, ef, block_size, bits=bits)
         if _numerics.active():
-            # the hierarchical schedule's four quantize points accumulate
-            # into ONE scope (the per-point split is a wire detail)
-            _numerics.tap_quant_error(
-                "grad_sync/two_level", x, x - dequantize_blockwise(q, s))
+            sig = x if ef is None else x + ef
+            _numerics.tap_quant_error("grad_sync/two_level", sig, nr)
         return (_maybe_pack(q.reshape(rows, nblk, block_size), bits),
-                s.reshape(rows, nblk))
+                s.reshape(rows, nblk), nr)
 
     def dq_sum(q, s):
         q = _maybe_unpack(q, bits)
         return jnp.sum(jax.vmap(dequantize_blockwise)(q, s), axis=0)
 
     # stage 1: intra-slice reduce-scatter (fast links, full buffer)
-    q, s = q_rows(flat, k, nblk_c)
+    q, s, new_a2a = q_point(flat, k, nblk_c,
+                            None if ef_a2a is None else ef_a2a[0])
+    if ef_a2a is not None:
+        new_a2a = new_a2a[None]                      # keep the [1, L] lane
     q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
                        axis_index_groups=intra)
     s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
@@ -172,17 +191,19 @@ def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
     shard = dq_sum(q, s)                              # [chunk], slice-summed
 
     # stage 2: inter-slice all-reduce of the 1/k shard (slow links)
-    q, s = q_rows(shard, m, nblk_s)
+    q, s, new_inter = q_point(shard, m, nblk_s,
+                              None if ef_inter is None else ef_inter[0])
+    if ef_inter is not None:
+        new_inter = new_inter[None]                  # [1, chunk]
     q = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
                        axis_index_groups=inter)
     s = lax.all_to_all(s, axis_name, split_axis=0, concat_axis=0,
                        axis_index_groups=inter)
     sub_sum = dq_sum(q, s)                            # [sub], globally summed
-    q2, s2 = quantize_blockwise(sub_sum, block_size, bits=bits)
+    q2, s2, new_ag = ef_quantize(sub_sum, ef_ag, block_size, bits=bits)
     if _numerics.active():
-        _numerics.tap_quant_error(
-            "grad_sync/two_level", sub_sum,
-            sub_sum - dequantize_blockwise(q2, s2))
+        sig = sub_sum if ef_ag is None else sub_sum + ef_ag
+        _numerics.tap_quant_error("grad_sync/two_level", sig, new_ag)
     qg = lax.all_gather(_maybe_pack(q2, bits), axis_name, axis=0,
                         axis_index_groups=inter)
     sg = lax.all_gather(s2, axis_name, axis=0, axis_index_groups=inter)
@@ -190,18 +211,22 @@ def _sync_bucket_two_level(flat, axis_name: str, dp: int, block_size: int,
         _maybe_unpack(qg, bits), sg).reshape(chunk)   # [chunk], global sum
 
     # stage 3: intra-slice all-gather of the finished shard (fast links)
-    q3, s3 = quantize_blockwise(shard_full, block_size, bits=bits)
+    q3, s3, new_intra = ef_quantize(
+        shard_full, None if ef_intra is None else ef_intra[0],
+        block_size, bits=bits)
     if _numerics.active():
-        _numerics.tap_quant_error(
-            "grad_sync/two_level", shard_full,
-            shard_full - dequantize_blockwise(q3, s3))
+        sig = (shard_full if ef_intra is None
+               else shard_full + ef_intra[0])
+        _numerics.tap_quant_error("grad_sync/two_level", sig, new_intra)
+    if ef_intra is not None:
+        new_intra = new_intra[None]                  # [1, chunk]
     qg = lax.all_gather(_maybe_pack(q3.reshape(nblk_c, block_size), bits),
                         axis_name, axis=0, axis_index_groups=intra)
     sg = lax.all_gather(s3, axis_name, axis=0, axis_index_groups=intra)
     full = jax.vmap(dequantize_blockwise)(
         _maybe_unpack(qg, bits),
         sg.reshape(k, nblk_c)).reshape(L)
-    return full
+    return full, new_a2a, new_inter, new_ag, new_intra
 
 
 def quantized_grad_sync(grads, axis_name: str, dp: int, plan: BucketPlan,
@@ -213,9 +238,11 @@ def quantized_grad_sync(grads, axis_name: str, dp: int, plan: BucketPlan,
 
     ef_state: {} for the stateless modes; for "-ef" modes a dict
     {"a2a": [local [1, L] per bucket], "ag": [local [L//dp] per bucket]}
-    (the local view of `ef_init`'s global arrays).  topology: a slice
-    Topology that `applies(dp)` routes every bucket through the two-level
-    scheme (stateless modes only).  Returns (synced grads, new ef_state
+    (the local view of `ef_init`'s global arrays), plus
+    {"tl_inter"/"tl_intra": [local [1, L//slice_devices] per bucket]}
+    when a two-level topology routes (ef_init's `topology=` arm).
+    topology: a slice Topology that `applies(dp)` routes every bucket
+    through the two-level scheme.  Returns (synced grads, new ef_state
     of the same structure)."""
     if mode not in COMPRESSED_MODES:
         raise ValueError(f"mode {mode!r} does not compress; caller should "
@@ -223,19 +250,32 @@ def quantized_grad_sync(grads, axis_name: str, dp: int, plan: BucketPlan,
     ef = uses_error_feedback(mode)
     bits = mode_bits(mode)
     two_level = topology is not None and topology.applies(dp)
-    if two_level and ef:
+    if ef and two_level and not {"tl_inter", "tl_intra"} <= set(ef_state):
         raise ValueError(
-            "two-level topology routing composes with the stateless "
-            "modes only (int8/int4): the hierarchical schedule has "
-            "different quantize points than the flat path, so EF "
-            "residual state cannot carry across — set "
-            "HETU_TPU_GRAD_COMPRESS=int8 or HETU_TPU_COMM_TOPOLOGY=flat")
+            "two-level EF sync needs the per-stage chunk residuals "
+            "'tl_inter'/'tl_intra' in ef_state — build it with "
+            "ef_init(plan, dp, topology=...); a flat-layout EF state "
+            "cannot carry across the hierarchical schedule's extra "
+            "quantize points")
     flats = plan.pack(grads)
-    out, new_a2a, new_ag = [], [], []
+    out = []
+    new_state = ({"a2a": [], "tl_inter": [], "ag": [], "tl_intra": []}
+                 if (ef and two_level) else
+                 {"a2a": [], "ag": []} if ef else {})
     for i, flat in enumerate(flats):
         if two_level:
-            out.append(_sync_bucket_two_level(
-                flat, axis_name, dp, block_size, bits, topology))
+            full, na, ni, ng, nt = _sync_bucket_two_level(
+                flat, axis_name, dp, block_size, bits, topology,
+                ef_a2a=ef_state["a2a"][i] if ef else None,
+                ef_inter=ef_state["tl_inter"][i] if ef else None,
+                ef_ag=ef_state["ag"][i] if ef else None,
+                ef_intra=ef_state["tl_intra"][i] if ef else None)
+            out.append(full)
+            if ef:
+                new_state["a2a"].append(na)
+                new_state["tl_inter"].append(ni)
+                new_state["ag"].append(ng)
+                new_state["tl_intra"].append(nt)
             continue
         ea = ef_state["a2a"][i] if ef else None
         eg = ef_state["ag"][i] if ef else None
@@ -243,35 +283,49 @@ def quantized_grad_sync(grads, axis_name: str, dp: int, plan: BucketPlan,
                                     bits)
         out.append(full)
         if ef:
-            new_a2a.append(na)
-            new_ag.append(ng)
-    new_state = {"a2a": new_a2a, "ag": new_ag} if ef else {}
+            new_state["a2a"].append(na)
+            new_state["ag"].append(ng)
     return plan.unpack(out), new_state
 
 
-def ef_init(plan: BucketPlan, dp: int) -> Dict[str, List[jnp.ndarray]]:
+def ef_init(plan: BucketPlan, dp: int, topology: Optional[Topology] = None
+            ) -> Dict[str, List[jnp.ndarray]]:
     """GLOBAL error-feedback state for `quantized_grad_sync`: per bucket a
     [dp, L] per-replica residual (split over dp outside the shard_map) and
-    an [L] per-shard residual (split over dp)."""
-    return {
+    an [L] per-shard residual (split over dp).  Pass `topology` only when
+    it routes (`applies(dp)`): the two-level schedule's two extra chunk
+    points add per-replica [dp, L/slice_devices] residuals."""
+    state = {
         "a2a": [jnp.zeros((dp, L), jnp.float32) for L in plan.sizes],
         "ag": [jnp.zeros((L,), jnp.float32) for L in plan.sizes],
     }
+    if topology is not None:
+        k = topology.slice_devices
+        state["tl_inter"] = [jnp.zeros((dp, L // k), jnp.float32)
+                             for L in plan.sizes]
+        state["tl_intra"] = [jnp.zeros((dp, L // k), jnp.float32)
+                             for L in plan.sizes]
+    return state
 
 
-def ef_specs(plan: BucketPlan, axis: str = "dp"
-             ) -> Dict[str, List[P]]:
+def ef_specs(plan: BucketPlan, axis: str = "dp",
+             topology: Optional[Topology] = None) -> Dict[str, List[P]]:
     """PartitionSpecs matching `ef_init`'s layout (shard_map in/out specs
     and NamedSharding construction)."""
-    return {
+    specs = {
         "a2a": [P(axis, None) for _ in plan.sizes],
         "ag": [P(axis) for _ in plan.sizes],
     }
+    if topology is not None:
+        specs["tl_inter"] = [P(axis, None) for _ in plan.sizes]
+        specs["tl_intra"] = [P(axis, None) for _ in plan.sizes]
+    return specs
 
 
-def ef_shardings(plan: BucketPlan, mesh, axis: str = "dp"):
+def ef_shardings(plan: BucketPlan, mesh, axis: str = "dp",
+                 topology: Optional[Topology] = None):
     return jax.tree.map(lambda spec: NamedSharding(mesh, spec),
-                        ef_specs(plan, axis),
+                        ef_specs(plan, axis, topology),
                         is_leaf=lambda x: isinstance(x, P))
 
 

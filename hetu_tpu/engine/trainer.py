@@ -148,7 +148,6 @@ class Trainer:
                 "DP sync's ring schedule); running the plain f32 sync")
         if (self._grad_compress != "none"
                 and _flags.str_flag("HETU_TPU_COMM_TOPOLOGY") == "two_level"):
-            from hetu_tpu.comm.grad_sync import uses_error_feedback
             from hetu_tpu.comm.topology import load_topology
             topo = load_topology()
             if topo is None:
@@ -156,11 +155,6 @@ class Trainer:
                     "HETU_TPU_COMM_TOPOLOGY=two_level needs a `topology` "
                     "section in the hardware profile "
                     "(hardware_profile_v5e.json / HETU_TPU_HW_PROFILE)")
-            if uses_error_feedback(self._grad_compress):
-                raise ValueError(
-                    "HETU_TPU_COMM_TOPOLOGY=two_level composes with the "
-                    "stateless compress modes only (int8/int4); "
-                    f"got HETU_TPU_GRAD_COMPRESS={self._grad_compress!r}")
             if topo.applies(self.strategy.dp):
                 self._comm_topology = topo
             else:
@@ -304,7 +298,9 @@ class Trainer:
                     # the EF residuals ride in the optimizer-state pytree:
                     # they checkpoint, donate and reshard with the moments
                     from hetu_tpu.optim.optimizer import ef_state_entry
-                    ef0, ef_sh = ef_state_entry(self._bucket_plan, mesh, dp)
+                    ef0, ef_sh = ef_state_entry(
+                        self._bucket_plan, mesh, dp,
+                        topology=self._comm_topology)
                     self.opt_state["ef"] = ef0
                     self._sshard = dict(self._sshard, ef=ef_sh)
             if self._zero_compress != "none":
@@ -860,7 +856,9 @@ class Trainer:
 
         batch_specs = jax.tree.map(
             lambda v: P(*([None, "dp"] + [None] * (v.ndim - 2))), batches)
-        especs = (ef_specs(self._bucket_plan) if ef_state else {})
+        especs = (ef_specs(self._bucket_plan,
+                           topology=self._comm_topology)
+                  if ef_state else {})
         fn = shard_map(
             body, mesh=self.mesh,
             in_specs=(P(), batch_specs, P(), P(), especs),

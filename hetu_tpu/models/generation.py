@@ -79,6 +79,17 @@ def _is_gpt(model) -> bool:
     return hasattr(model.model, "wte")
 
 
+def lm_head_weight(model, params):
+    """The lm_head slice as a [hidden, vocab] matrix — the weight
+    operand of the fused sampling epilogue (serving/sampling.
+    sample_hidden).  Matches `model.logits`: tied embeddings transpose
+    the token-embedding table, untied models carry an explicit head."""
+    if model.config.tie_word_embeddings:
+        key = "wte" if _is_gpt(model) else "embed"
+        return params["model"][key]["weight"].T
+    return params["lm_head"]
+
+
 def _check_context_length(config, max_len: int):
     """Past the trained context, GPT's jnp.take on wpe (and LLaMA's RoPE
     table lookup) would silently clamp to the last position — fail loudly
@@ -304,32 +315,80 @@ def _paged_write(pool, table, positions, t):
     return pool.at[page, positions % ps].set(t.astype(pool.dtype))
 
 
-def _paged_write_q(pool, scale, table, positions, t):
-    """The int8-page form of `_paged_write`: quantize the token's
-    head-vectors through the SAME blockwise primitives the gather path
-    uses (comm/compress -> the fused Pallas quant kernel when routed),
-    so pool contents are bit-identical across the two decode programs;
-    write payload + per-head-vector f32 scale."""
-    from hetu_tpu.comm.compress import quantize_blockwise
-    ps = pool.shape[1]
-    S = positions.shape[0]
+def _quantize_head_vectors(t, bits: int):
+    """Quantize [..., hd] head-vectors for a paged pool: int8 through
+    the SAME blockwise primitives the gather path uses (comm/compress ->
+    the fused Pallas quant kernel when routed), int4 through the shared
+    `ops/quantization` nibble packer — so pool contents are
+    bit-identical across the decode programs.  Returns (payload
+    [..., hd or hd//2], scales [...])."""
     hd = t.shape[-1]
     x32 = t.astype(jnp.float32)
-    q, s = quantize_blockwise(x32, block_size=hd)
-    q = q.reshape(t.shape)
-    s = s.reshape(t.shape[:-1])
+    if bits == 4:
+        from hetu_tpu.ops.quantization import quantize_int4
+        q, s = quantize_int4(x32, block_size=hd)
+        q = q.reshape(t.shape[:-1] + (hd // 2,))
+    else:
+        from hetu_tpu.comm.compress import quantize_blockwise
+        q, s = quantize_blockwise(x32, block_size=hd)
+        q = q.reshape(t.shape)
+    return q, s.reshape(t.shape[:-1])
+
+
+def _paged_write_q(pool, scale, table, positions, t, *, bits: int = 8):
+    """The quantized-page form of `_paged_write` (int8, or int4 nibble
+    payloads with ``bits=4``): write payload + per-head-vector f32
+    scale."""
+    ps = pool.shape[1]
+    S = positions.shape[0]
+    q, s = _quantize_head_vectors(t, bits)
     page = table[jnp.arange(S), positions // ps]
     off = positions % ps
-    return pool.at[page, off].set(q), scale.at[page, off].set(s)
+    return pool.at[page, off].set(q.astype(pool.dtype)), \
+        scale.at[page, off].set(s)
+
+
+def _token_block_pages(table, positions, C, ps):
+    """Page ids + offsets for a C-token block at positions[s] + i.
+    Block positions past the table's reach land in the null page (id 0)
+    — the same redirect `serving/kv_pool.write_tokens` applies — and
+    inactive slots' zeroed table rows point there already."""
+    S = positions.shape[0]
+    mp = table.shape[1]
+    pos = positions[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    pidx = pos // ps
+    safe = pidx < mp
+    page = jnp.where(
+        safe, table[jnp.arange(S)[:, None], jnp.clip(pidx, 0, mp - 1)], 0)
+    return page, pos % ps
+
+
+def _paged_write_tokens(pool, table, positions, t):
+    """Scatter a C-token block's K (or V) [S, C, n_kv, hd] into ONE
+    layer's page array — the verify-step sibling of `_paged_write`."""
+    ps = pool.shape[1]
+    page, off = _token_block_pages(table, positions, t.shape[1], ps)
+    return pool.at[page, off].set(t.astype(pool.dtype))
+
+
+def _paged_write_tokens_q(pool, scale, table, positions, t, *,
+                          bits: int = 8):
+    """Quantized-page form of `_paged_write_tokens`."""
+    ps = pool.shape[1]
+    q, s = _quantize_head_vectors(t, bits)
+    page, off = _token_block_pages(table, positions, t.shape[1], ps)
+    return pool.at[page, off].set(q.astype(pool.dtype)), \
+        scale.at[page, off].set(s)
 
 
 def _decode_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
-                           positions, k_scale, v_scale):
+                           positions, k_scale, v_scale, kv_quant):
     from hetu_tpu.ops.pallas.paged_attention import paged_attention
     c = model.config
     mp_ = params["model"]
     b = tokens.shape[0]
     quant = k_scale is not None
+    bits = 4 if kv_quant == "int4" else 8
     x = _gpt_embed(model, mp_, tokens[:, None], positions[:, None])
     block = model.model.block
     att = block.attn
@@ -348,15 +407,18 @@ def _decode_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
             + lp["attn"]["bqkv"].astype(h.dtype)
         q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
         if quant:
-            kp, ksc = _paged_write_q(kp, ksc, table, positions, k[:, 0])
-            vp, vsc = _paged_write_q(vp, vsc, table, positions, v[:, 0])
+            kp, ksc = _paged_write_q(kp, ksc, table, positions, k[:, 0],
+                                     bits=bits)
+            vp, vsc = _paged_write_q(vp, vsc, table, positions, v[:, 0],
+                                     bits=bits)
         else:
             kp = _paged_write(kp, table, positions, k[:, 0])
             vp = _paged_write(vp, table, positions, v[:, 0])
         with jax.named_scope("pallas_paged_attention"):
             attn = paged_attention(q[:, 0], kp, vp, table, positions,
                                    softmax_scale=scale,
-                                   k_scale=ksc, v_scale=vsc)
+                                   k_scale=ksc, v_scale=vsc,
+                                   quant=kv_quant)
         h = h + att.o_proj(lp["attn"]["o_proj"],
                            attn.reshape(b, 1, nh * hd))
         h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
@@ -371,7 +433,8 @@ def _decode_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
 
 
 def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
-                      positions, *, k_scale=None, v_scale=None):
+                      positions, *, k_scale=None, v_scale=None,
+                      kv_quant=None):
     """One decode step attending DIRECTLY over a paged KV pool — the
     gather-free form of `decode_step_slots` (ops/pallas/paged_attention;
     serving engine's HETU_TPU_PALLAS decode program).
@@ -388,7 +451,10 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
     f32 scales [L, P, page_size, n_kv] as k_scale/v_scale: the token
     write quantizes through the shared blockwise primitives and the
     kernel dequantizes pages in-VMEM; the return gains
-    (..., new_k_scale, new_v_scale)."""
+    (..., new_k_scale, new_v_scale).  int4 pools
+    (``HETU_TPU_KV_QUANT=int4``) additionally pass ``kv_quant="int4"``
+    — uint8 nibble payloads of head dim hd//2, the
+    `ops/quantization.pack_nibbles` storage layout."""
     c = model.config
     if not c.use_scan:
         raise ValueError("generation requires use_scan=True (stacked layer "
@@ -396,12 +462,15 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
     if (k_scale is None) != (v_scale is None):
         raise ValueError("pass both k_scale and v_scale, or neither")
     quant = k_scale is not None
+    if kv_quant is None:
+        kv_quant = "int8" if quant else None
+    bits = 4 if kv_quant == "int4" else 8
     positions = positions.astype(jnp.int32)
     table = table.astype(jnp.int32)
     if _is_gpt(model):
         return _decode_step_paged_gpt(model, params, tokens, k_pool,
                                       v_pool, table, positions,
-                                      k_scale, v_scale)
+                                      k_scale, v_scale, kv_quant)
     from hetu_tpu.ops.pallas.paged_attention import paged_attention
     mp_ = params["model"]
     b = tokens.shape[0]
@@ -427,15 +496,18 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
         v = qkv[..., att.group + 1, :]
         q, k = ops.apply_rotary_qk(q, k, cos, sin, positions[:, None])
         if quant:
-            kp, ksc = _paged_write_q(kp, ksc, table, positions, k[:, 0])
-            vp, vsc = _paged_write_q(vp, vsc, table, positions, v[:, 0])
+            kp, ksc = _paged_write_q(kp, ksc, table, positions, k[:, 0],
+                                     bits=bits)
+            vp, vsc = _paged_write_q(vp, vsc, table, positions, v[:, 0],
+                                     bits=bits)
         else:
             kp = _paged_write(kp, table, positions, k[:, 0])
             vp = _paged_write(vp, table, positions, v[:, 0])
         with jax.named_scope("pallas_paged_attention"):
             attn = paged_attention(q[:, 0], kp, vp, table, positions,
                                    softmax_scale=scale,
-                                   k_scale=ksc, v_scale=vsc)
+                                   k_scale=ksc, v_scale=vsc,
+                                   quant=kv_quant)
         h = h + att.o_proj(layer_params["attn"]["o_proj"],
                            attn.reshape(b, 1, att.n_q * c.head_dim))
         mlp_out = block.mlp(layer_params["mlp"],
@@ -451,6 +523,150 @@ def decode_step_paged(model, params, tokens, k_pool, v_pool, table,
     hidden = model.model.final_norm(mp_["final_norm"], x)
     logits = model.logits(params, hidden)[:, 0, :]
     return (logits,) + tuple(pools)
+
+
+def _verify_step_paged_gpt(model, params, tokens, k_pool, v_pool, table,
+                           positions, k_scale, v_scale, kv_quant,
+                           return_hidden):
+    from hetu_tpu.ops.pallas.paged_attention import paged_verify
+    c = model.config
+    mp_ = params["model"]
+    S, C = tokens.shape
+    quant = k_scale is not None
+    bits = 4 if kv_quant == "int4" else 8
+    qpos = positions[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    x = _gpt_embed(model, mp_, tokens, qpos)
+    block = model.model.block
+    att = block.attn
+    nh, hd = c.num_attention_heads, c.head_dim
+    scale = hd ** -0.5
+
+    def body(h, xs):
+        if quant:
+            lp, kp, vp, ksc, vsc = xs
+        else:
+            lp, kp, vp = xs
+            ksc = vsc = None
+        hn = block.ln1(lp["ln1"], h)
+        qkv = jnp.einsum("bsh,hngd->bsngd", hn,
+                         lp["attn"]["wqkv"].astype(h.dtype)) \
+            + lp["attn"]["bqkv"].astype(h.dtype)
+        q, k, v = qkv[..., 0, :], qkv[..., 1, :], qkv[..., 2, :]
+        if quant:
+            kp, ksc = _paged_write_tokens_q(kp, ksc, table, positions, k,
+                                            bits=bits)
+            vp, vsc = _paged_write_tokens_q(vp, vsc, table, positions, v,
+                                            bits=bits)
+        else:
+            kp = _paged_write_tokens(kp, table, positions, k)
+            vp = _paged_write_tokens(vp, table, positions, v)
+        with jax.named_scope("pallas_paged_verify"):
+            attn = paged_verify(
+                q.reshape(S, C, nh, hd), kp, vp, table, positions,
+                softmax_scale=scale, k_scale=ksc, v_scale=vsc,
+                quant=kv_quant)
+        h = h + att.o_proj(lp["attn"]["o_proj"],
+                           attn.reshape(S, C, nh * hd))
+        h = h + block.mlp(lp["mlp"], block.ln2(lp["ln2"], h))
+        return h, ((kp, vp, ksc, vsc) if quant else (kp, vp))
+
+    xs = ((mp_["blocks"], k_pool, v_pool, k_scale, v_scale) if quant
+          else (mp_["blocks"], k_pool, v_pool))
+    x, pools = lax.scan(body, x, xs)
+    hidden = model.model.final_ln(mp_["final_ln"], x)
+    if return_hidden:
+        return (hidden,) + tuple(pools)
+    return (model.logits(params, hidden),) + tuple(pools)
+
+
+def verify_step_paged(model, params, tokens, k_pool, v_pool, table,
+                      positions, *, k_scale=None, v_scale=None,
+                      kv_quant=None, return_hidden: bool = False):
+    """The speculative VERIFY step attending DIRECTLY over a paged KV
+    pool — `verify_step_slots` without the gather (ops/pallas/
+    paged_attention.paged_verify: all k+1 query positions walk the
+    slot's pages in one launch with per-position causal masks).
+
+    tokens: [S, C] int32 (last emitted token + k drafts per slot);
+    positions: [S] int32 — token i of the block sits at positions[s]+i.
+    The block's K/V are scattered into each slot's pages BEFORE the
+    kernel runs (write-then-attend, exactly like the dense path), and
+    the updated pools return: (logits [S, C, vocab], *new_pools).
+    Quantized pools pass scales (+ ``kv_quant="int4"`` for nibble
+    pages) exactly as `decode_step_paged`.
+
+    ``return_hidden=True`` returns the final-norm HIDDEN states
+    [S, C, hidden] instead of logits — the fused sampling epilogue
+    (serving/sampling.sample_hidden_grid) consumes them directly so the
+    [S, C, vocab] logits plane never materializes in HBM."""
+    c = model.config
+    if not c.use_scan:
+        raise ValueError("generation requires use_scan=True (stacked layer "
+                         "params)")
+    if (k_scale is None) != (v_scale is None):
+        raise ValueError("pass both k_scale and v_scale, or neither")
+    quant = k_scale is not None
+    if kv_quant is None:
+        kv_quant = "int8" if quant else None
+    bits = 4 if kv_quant == "int4" else 8
+    positions = positions.astype(jnp.int32)
+    table = table.astype(jnp.int32)
+    if _is_gpt(model):
+        return _verify_step_paged_gpt(model, params, tokens, k_pool,
+                                      v_pool, table, positions, k_scale,
+                                      v_scale, kv_quant, return_hidden)
+    from hetu_tpu.ops.pallas.paged_attention import paged_verify
+    mp_ = params["model"]
+    S, C = tokens.shape
+    qpos = positions[:, None] + jnp.arange(C, dtype=jnp.int32)[None, :]
+    x = model.model.embed(mp_["embed"], tokens).astype(c.compute_dtype)
+    cos, sin = ops.build_rope_cache(c.max_position_embeddings, c.head_dim,
+                                    c.rope_theta)
+    block = model.model.layers.block
+    att = block.attn
+    scale = c.head_dim ** -0.5
+
+    def body(h, xs):
+        if quant:
+            layer_params, kp, vp, ksc, vsc = xs
+        else:
+            layer_params, kp, vp = xs
+            ksc = vsc = None
+        hn = block.input_norm(layer_params["input_norm"], h)
+        qkv = jnp.einsum("bsh,hkgd->bskgd", hn,
+                         layer_params["attn"]["wqkv"].astype(h.dtype))
+        q = qkv[..., : att.group, :].reshape(S, C, att.n_q, c.head_dim)
+        k = qkv[..., att.group, :]
+        v = qkv[..., att.group + 1, :]
+        q, k = ops.apply_rotary_qk(q, k, cos, sin, qpos)
+        if quant:
+            kp, ksc = _paged_write_tokens_q(kp, ksc, table, positions, k,
+                                            bits=bits)
+            vp, vsc = _paged_write_tokens_q(vp, vsc, table, positions, v,
+                                            bits=bits)
+        else:
+            kp = _paged_write_tokens(kp, table, positions, k)
+            vp = _paged_write_tokens(vp, table, positions, v)
+        with jax.named_scope("pallas_paged_verify"):
+            attn = paged_verify(q, kp, vp, table, positions,
+                                softmax_scale=scale, k_scale=ksc,
+                                v_scale=vsc, quant=kv_quant)
+        h = h + att.o_proj(layer_params["attn"]["o_proj"],
+                           attn.reshape(S, C, att.n_q * c.head_dim))
+        mlp_out = block.mlp(layer_params["mlp"],
+                            block.post_norm(layer_params["post_norm"], h))
+        if isinstance(mlp_out, tuple):  # MoE
+            mlp_out = mlp_out[0]
+        h = h + mlp_out
+        return h, ((kp, vp, ksc, vsc) if quant else (kp, vp))
+
+    xs = ((mp_["layers"]["layers"], k_pool, v_pool, k_scale, v_scale)
+          if quant else (mp_["layers"]["layers"], k_pool, v_pool))
+    x, pools = lax.scan(body, x, xs)
+    hidden = model.model.final_norm(mp_["final_norm"], x)
+    if return_hidden:
+        return (hidden,) + tuple(pools)
+    return (model.logits(params, hidden),) + tuple(pools)
 
 
 def _extend_cache_gpt(model, params, tokens, cache, start,

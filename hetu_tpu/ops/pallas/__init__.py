@@ -13,6 +13,14 @@ The fused-kernel layer (docs/kernels.md):
                        compressed collectives (quantization.cu, EQuARX)
   * paged_attention  — decode attention directly over the serving KV
                        pool's page tables (gather-free decode)
+  * paged_verify     — the multi-query sibling: k+1 speculative query
+                       positions per slot attend the same pages in one
+                       launch (spec-decode verification)
+  * sample           — fused last-layer epilogue: lm_head matmul +
+                       temperature/top-k/top-p filter + Gumbel draw per
+                       row without materializing [rows, vocab] logits
+  * adam             — fused AdamW moment + parameter update, one launch
+                       per flat parameter leaf (FusedAdam.cu)
 
 Every kernel follows the flash-attention pattern: a shape gate that
 EXACTLY mirrors the kernel's own entry validation (`compatible()` /
@@ -32,7 +40,8 @@ from __future__ import annotations
 from typing import FrozenSet, Optional
 
 #: every routable kernel name (the HETU_TPU_PALLAS_KERNELS vocabulary)
-KERNEL_NAMES = ("flash", "norm", "swiglu", "rotary", "quant", "paged_attn")
+KERNEL_NAMES = ("flash", "norm", "swiglu", "rotary", "quant", "paged_attn",
+                "paged_verify", "sample", "adam")
 
 
 def _interpret() -> bool:

@@ -178,22 +178,148 @@ def paged_attn_traffic(slots: int, max_pages: int, page_size: int,
     1 byte/elem + one f32 scale per head-vector): the kernel's read is
     the quantized payload, while the gather fallback additionally
     materializes the DEQUANTIZED dense view at the compute width — the
-    in-kernel dequantize earns its keep on top of the payload cut."""
+    in-kernel dequantize earns its keep on top of the payload cut.
+    ``quant="int4"`` halves the payload again (two values per byte,
+    same per-head-vector f32 scale)."""
     elems = 2.0 * slots * max_pages * page_size * kv_heads * head_dim
     e = float(elem_bytes)
-    if quant == "int8":
-        cache_q = elems * (1.0 + 4.0 / head_dim)    # payload + scales
+    if quant in ("int8", "int4"):
+        cache_q = elems * _kv_payload_bytes(quant, head_dim)
         chain: Chain = [
             ("gather_pages", cache_q, elems * e),   # dequantized dense
             ("attend_dense", elems * e, 0.0),
         ]
-        return _report("paged_attn_int8", chain, cache_q, 0.0)
+        return _report(f"paged_attn_{quant}", chain, cache_q, 0.0)
     cache = elems * e
     chain = [
         ("gather_pages", cache, cache),
         ("attend_dense", cache, 0.0),
     ]
     return _report("paged_attn", chain, cache, 0.0)
+
+
+def _kv_payload_bytes(quant: str, head_dim: int) -> float:
+    """Quantized-page bytes per cache ELEMENT (payload + the f32
+    per-head-vector scale amortized over head_dim) — mirrors
+    serving/kv_pool.kv_bytes_per_token."""
+    payload = 0.5 if quant == "int4" else 1.0
+    return payload + _F32 / head_dim
+
+
+def paged_verify_traffic(slots: int, k: int, max_pages: int,
+                         page_size: int, kv_heads: int, head_dim: int, *,
+                         elem_bytes: float = 4.0,
+                         quant: str = "none") -> Dict[str, Any]:
+    """Multi-query verify decode (ops/pallas/paged_attention.paged_verify)
+    vs the gather path: the fallback gathers every slot's pages into a
+    dense [S, max_len] view and attends the k+1 query positions against
+    it — the SAME three passes over the cache bytes as single-query
+    decode (the dense view doesn't get cheaper because more queries read
+    it).  The kernel DMAs each scheduled page once and shares it across
+    all k+1 query positions in VMEM, so its cache read is IDENTICAL to
+    the single-token kernel's: the verify step's extra queries ride
+    free.  Quantized pages ("int8"/"int4") keep the payload cut on top;
+    the gather fallback still materializes the dequantized dense view at
+    the compute width."""
+    if k < 1:
+        raise ValueError(f"spec_k must be >= 1, got {k}")
+    elems = 2.0 * slots * max_pages * page_size * kv_heads * head_dim
+    e = float(elem_bytes)
+    # the k+1 query/output vectors are noise next to the cache bytes but
+    # the model counts them (auditable, not rounded away)
+    qio = float(slots) * (k + 1) * kv_heads * head_dim * e
+    if quant in ("int8", "int4"):
+        cache_q = elems * _kv_payload_bytes(quant, head_dim)
+        chain: Chain = [
+            ("gather_pages", cache_q, elems * e),
+            ("attend_dense", elems * e + qio, qio),
+        ]
+        return _report(f"paged_verify_{quant}", chain, cache_q + qio, qio)
+    cache = elems * e
+    chain = [
+        ("gather_pages", cache, cache),
+        ("attend_dense", cache + qio, qio),
+    ]
+    return _report("paged_verify", chain, cache + qio, qio)
+
+
+def sample_traffic(rows: int, hidden: int, vocab: int, *,
+                   elem_bytes: float = 2.0) -> Dict[str, Any]:
+    """Fused sampling epilogue (ops/pallas/sample.py) vs the unfused
+    verify tail: lm_head matmul materializing the [rows, vocab] f32
+    logit grid in HBM, then the filter chain over it (temperature scale,
+    the top-k/top-p sort + masks of serving/sampling.filtered_logits),
+    the Gumbel add and the argmax.  The kernel streams vocab tiles
+    through VMEM — hidden and the lm_head weight are read once, only
+    the [rows] token ids ever hit HBM."""
+    e = float(elem_bytes)
+    nv = float(rows) * vocab
+    h_in = float(rows) * hidden * e
+    w = float(hidden) * vocab * e
+    toks = float(rows) * _F32
+    chain: Chain = [
+        ("lm_head_matmul", h_in + w, _F32 * nv),
+        ("temp_scale", _F32 * nv, _F32 * nv),
+        ("topk_sort", _F32 * nv, _F32 * nv),
+        ("topk_mask", 2 * _F32 * nv, _F32 * nv),
+        ("softmax_cumsum", _F32 * nv, _F32 * nv),
+        ("topp_mask", 2 * _F32 * nv, _F32 * nv),
+        ("gumbel_add", _F32 * nv, _F32 * nv),
+        ("argmax", _F32 * nv, toks),
+    ]
+    return _report("sample", chain, h_in + w, toks)
+
+
+def adam_traffic(n_params: int, *, param_bytes: float = 4.0
+                 ) -> Dict[str, Any]:
+    """Fused AdamW update (ops/pallas/adam.py) vs the XLA op chain of
+    optim/optimizer.AdamW.update: per step the chain materializes the
+    two moment updates, the bias-corrected mhat/vhat, the denominator
+    and the final update — each a params-sized f32 round trip.  The
+    kernel reads p/g/m/v once and writes p'/m'/v' once."""
+    n = float(n_params)
+    pb = float(param_bytes)
+    chain: Chain = [
+        ("m_update", 2 * _F32 * n, _F32 * n),        # b1*m + (1-b1)*g
+        ("v_update", 2 * _F32 * n, _F32 * n),        # b2*v + (1-b2)*g^2
+        ("mhat", _F32 * n, _F32 * n),
+        ("vhat", _F32 * n, _F32 * n),
+        ("denom", _F32 * n, _F32 * n),               # sqrt(vhat) + eps
+        ("update", 2 * _F32 * n + pb * n, pb * n),   # mhat/denom + wd*p
+    ]
+    return _report("adam", chain,
+                   pb * n + 3 * _F32 * n,            # p + g + m + v
+                   pb * n + 2 * _F32 * n)            # p' + m' + v'
+
+
+def fused_verify_chain(slots: int, k: int, max_pages: int, page_size: int,
+                       kv_heads: int, head_dim: int, hidden: int,
+                       vocab: int, *, num_layers: int = 1,
+                       elem_bytes: float = 2.0,
+                       quant: str = "int8") -> Dict[str, Any]:
+    """The WHOLE fused verify step vs the gather path: per layer the
+    multi-query cache read (paged_verify vs gather+dense attend), plus
+    ONE sampling epilogue over the [slots*(k+1)] verify rows (fused
+    in-VMEM sample vs HBM logits + filter chain).  This is the number
+    the acceptance gate pins: >= 2x fewer HBM bytes than the gather
+    path at k=4 (docs/kernels.md)."""
+    pv = paged_verify_traffic(slots, k, max_pages, page_size, kv_heads,
+                              head_dim, elem_bytes=elem_bytes, quant=quant)
+    sm = sample_traffic(slots * (k + 1), hidden, vocab,
+                        elem_bytes=elem_bytes)
+    gather = pv["unfused_bytes"] * num_layers + sm["unfused_bytes"]
+    fused = pv["fused_bytes"] * num_layers + sm["fused_bytes"]
+    return {
+        "kernel": "fused_verify_chain",
+        "k": k, "slots": slots, "num_layers": num_layers, "quant": quant,
+        "gather_bytes": gather,
+        "fused_bytes": fused,
+        "reduction": gather / fused if fused else float("inf"),
+        "paged_verify": {kk: pv[kk] for kk in
+                         ("unfused_bytes", "fused_bytes", "reduction")},
+        "sample": {kk: sm[kk] for kk in
+                   ("unfused_bytes", "fused_bytes", "reduction")},
+    }
 
 
 # ---------------------------------------------------------------------------
@@ -208,7 +334,9 @@ def kernel_traffic_report(*, batch: int, seq: int, hidden: int,
                           quant_elems: Optional[int] = None,
                           quant_block: int = 1024,
                           serve_slots: int = 8, serve_pages: int = 16,
-                          serve_page_size: int = 16
+                          serve_page_size: int = 16, spec_k: int = 4,
+                          vocab: Optional[int] = None,
+                          n_params: Optional[int] = None
                           ) -> Dict[str, Dict[str, Any]]:
     """Per-kernel fused-vs-unfused bytes for ONE forward pass of a
     transformer stack shaped like the arguments (per-step: every count
@@ -244,7 +372,7 @@ def kernel_traffic_report(*, batch: int, seq: int, hidden: int,
     q.pop("chain", None)
     q["per_step_multiplier"] = 1
     out["quant"] = q
-    for quant in ("none", "int8"):
+    for quant in ("none", "int8", "int4"):
         p = paged_attn_traffic(serve_slots, serve_pages, serve_page_size,
                                kv_heads, head_dim, elem_bytes=elem_bytes,
                                quant=quant)
@@ -255,6 +383,29 @@ def kernel_traffic_report(*, batch: int, seq: int, hidden: int,
         p["per_step_multiplier"] = num_layers
         p.pop("chain", None)
         out[p["kernel"]] = p
+    # the fused verify-and-sample decode path (spec decode at spec_k)
+    pv = paged_verify_traffic(serve_slots, spec_k, serve_pages,
+                              serve_page_size, kv_heads, head_dim,
+                              elem_bytes=elem_bytes, quant="int8")
+    for k in ("unfused_bytes", "unfused_read_bytes",
+              "unfused_write_bytes", "fused_bytes",
+              "fused_read_bytes", "fused_write_bytes"):
+        pv[k] = pv[k] * num_layers
+    pv["per_step_multiplier"] = num_layers
+    pv.pop("chain", None)
+    out["paged_verify"] = pv
+    v = vocab if vocab is not None else 32 * hidden
+    sm = sample_traffic(serve_slots * (spec_k + 1), hidden, v,
+                        elem_bytes=elem_bytes)
+    sm["per_step_multiplier"] = 1
+    sm.pop("chain", None)
+    out["sample"] = sm
+    pn = n_params if n_params is not None else \
+        num_layers * (4 * hidden * hidden + 3 * hidden * intermediate)
+    ad = adam_traffic(pn)
+    ad["per_step_multiplier"] = 1
+    ad.pop("chain", None)
+    out["adam"] = ad
     return out
 
 
@@ -267,9 +418,11 @@ def report_for_config(cfg, *, batch: int, seq: int,
         elem_bytes = float(jnp.dtype(cfg.compute_dtype).itemsize)
     kv = getattr(cfg, "num_key_value_heads", cfg.num_attention_heads)
     kind = "rms" if hasattr(cfg, "rms_norm_eps") else "ln"
+    n_params = cfg.num_params() if hasattr(cfg, "num_params") else None
     return kernel_traffic_report(
         batch=batch, seq=seq, hidden=cfg.hidden_size,
         intermediate=cfg.intermediate_size,
         num_layers=cfg.num_hidden_layers,
         q_heads=cfg.num_attention_heads, kv_heads=kv,
-        head_dim=cfg.head_dim, elem_bytes=elem_bytes, norm_kind=kind)
+        head_dim=cfg.head_dim, elem_bytes=elem_bytes, norm_kind=kind,
+        vocab=cfg.vocab_size, n_params=n_params)

@@ -110,8 +110,18 @@ class AdamW(Optimizer):
         lr = self._lr(step)
         c1 = 1.0 - self.b1 ** step.astype(jnp.float32)
         c2 = 1.0 - self.b2 ** step.astype(jnp.float32)
+        from hetu_tpu.ops import pallas as _pl
+        from hetu_tpu.ops.pallas import adam as _padam
 
         def upd(p, g, m, v):
+            # fused Adam kernel (ops/pallas/adam.py): one read of
+            # p/g/m/v, one write of p'/m'/v' per lane-aligned leaf;
+            # ragged leaves (biases, gains) keep the XLA chain below
+            if _pl.resolve_route("adam", _padam.compatible(p.shape)):
+                with jax.named_scope("pallas_adam"):
+                    return _padam.adam_update(
+                        p, g, m, v, lr, c1, c2, b1=self.b1, b2=self.b2,
+                        eps=self.eps, weight_decay=self.weight_decay)
             g = g.astype(jnp.float32)
             m = self.b1 * m + (1.0 - self.b1) * g
             v = self.b2 * v + (1.0 - self.b2) * jnp.square(g)
@@ -183,16 +193,18 @@ def zero_shardings(param_shardings, abstract_params, mesh, axis: str = "dp"):
 # compressed-grad-sync error-feedback state (HETU_TPU_GRAD_COMPRESS=int8-ef)
 # ---------------------------------------------------------------------------
 
-def ef_state_entry(bucket_plan, mesh, dp: int, axis: str = "dp"):
+def ef_state_entry(bucket_plan, mesh, dp: int, axis: str = "dp",
+                   topology=None):
     """(initial EF residuals, their shardings) for the optimizer-state
     pytree's "ef" entry — the quantized DP sync's error-feedback memory
     (comm/grad_sync.py) rides in the SAME state dict as Adam's moments so
     it checkpoints, donates and reshards with them.  Residual layout:
     per-replica [dp, L] (split over dp) + per-shard [L] (split over dp)
-    per bucket."""
+    per bucket; a routing two-level `topology` adds the hierarchical
+    schedule's two chunk-sized per-replica residuals."""
     from hetu_tpu.comm.grad_sync import ef_init, ef_shardings
-    shardings = ef_shardings(bucket_plan, mesh, axis)
-    state = jax.jit(lambda: ef_init(bucket_plan, dp),
+    shardings = ef_shardings(bucket_plan, mesh, axis, topology)
+    state = jax.jit(lambda: ef_init(bucket_plan, dp, topology),
                     out_shardings=shardings)()
     return state, shardings
 

@@ -88,7 +88,7 @@ class ServeConfig:
     max_len: int = 256
     prefill_chunk: int = 32
     num_pages: int = 0
-    kv_quant: str = "none"           # "none" (exact, default) | "int8"
+    kv_quant: str = "none"      # "none" (exact, default) | "int8" | "int4"
     # MoE serving (HETU_TPU_MOE_DISPATCH, serving/experts.py): int8/int4
     # store the stacked [E, ...] expert weights resident-quantized
     # (KV-pool-style blockwise payloads + f32 scales, dequantized inside
@@ -103,7 +103,10 @@ class ServeConfig:
     #: PRNG keys; greedy rows stay argmax bit-for-bit
     sampling: bool = False
     #: speculative decoding (HETU_TPU_SPEC_DECODE, spec_decode.py):
-    #: "none" | "ngram" — verify spec_k drafts + 1 in one batched step
+    #: "none" | "ngram" | "model" — verify spec_k drafts + 1 in one
+    #: batched step; "model" runs a resident-quantized small draft
+    #: model (pass draft_model=/draft_params= to the engine) and
+    #: verifies with the full stochastic p/q rejection rule
     spec_decode: str = "none"
     spec_k: int = 4
     #: radix prefix cache (HETU_TPU_SERVE_PREFIX_CACHE,
@@ -163,17 +166,17 @@ class ServeConfig:
             # leaning on out-of-bounds semantics)
             raise ValueError(f"max_len {self.max_len} must be a multiple "
                              f"of prefill_chunk {self.prefill_chunk}")
-        if self.kv_quant not in ("none", "int8"):
+        if self.kv_quant not in ("none", "int8", "int4"):
             raise ValueError(f"kv_quant {self.kv_quant!r} invalid; "
-                             "choices: ('none', 'int8')")
+                             "choices: ('none', 'int8', 'int4')")
         if self.moe_dispatch not in ("gspmd", "fp32", "int8", "int4"):
             raise ValueError(
                 f"moe_dispatch {self.moe_dispatch!r} invalid; choices: "
                 "('gspmd', 'fp32', 'int8', 'int4')")
-        if self.spec_decode not in ("none", "ngram"):
+        if self.spec_decode not in ("none", "ngram", "model"):
             raise ValueError(
                 f"spec_decode {self.spec_decode!r} invalid; choices: "
-                "('none', 'ngram')")
+                "('none', 'ngram', 'model')")
         if self.spec_decode != "none" and self.spec_k < 1:
             raise ValueError(f"spec_k must be >= 1, got {self.spec_k}")
         if self.serve_sample < 1:
@@ -239,7 +242,8 @@ class ServingEngine:
                  *, run_log: Optional[RunLog] = None,
                  registry: Optional[MetricsRegistry] = None,
                  reshard=None, tracer=None, health=None,
-                 telemetry=None, drafter=None, cost_model=None):
+                 telemetry=None, drafter=None, draft_model=None,
+                 draft_params=None, cost_model=None):
         self.model = model
         self.params = params
         self.config = config or ServeConfig.from_flags()
@@ -276,8 +280,9 @@ class ServingEngine:
             self.ledger = CostLedger(cost_model)
         # speculative decoding (serving/spec_decode.py): host drafter +
         # the batched verify program built below; `drafter=` overrides
-        # the config mode with any Drafter instance (a small draft
-        # model plugs in here)
+        # the config mode with any Drafter instance.  spec_decode=
+        # 'model' builds a ModelDrafter from draft_model/draft_params
+        # (resident-quantized; verified with the stochastic p/q rule)
         from hetu_tpu.serving.spec_decode import make_drafter
         if drafter is not None and self.config.spec_decode == "none":
             # the reservation lookahead and the verify program are both
@@ -286,9 +291,17 @@ class ServingEngine:
             raise ValueError("a custom drafter needs spec_decode set "
                              "(e.g. ServeConfig(spec_decode='ngram')) so "
                              "the verify program and page lookahead exist")
+        draft_kw = ({"model": draft_model, "params": draft_params}
+                    if self.config.spec_decode == "model"
+                    and draft_model is not None else {})
         self.drafter = (drafter if drafter is not None
-                        else make_drafter(self.config.spec_decode))
+                        else make_drafter(self.config.spec_decode,
+                                          **draft_kw))
         self.spec = self.drafter is not None
+        #: stochastic drafters report their proposal distribution and
+        #: are verified with the full p/q rejection rule in-graph
+        self.spec_stochastic = bool(
+            self.spec and getattr(self.drafter, "stochastic", False))
         #: per-rid preemption counts + the work counters accrued before
         #: each requeue (requests survive requeues; their SlotState —
         #: and its RequestStats — does not): folded back into the final
@@ -387,22 +400,30 @@ class ServingEngine:
         """Route the decode program through the gather-free Pallas
         paged-attention kernel (ops/pallas/paged_attention) when the
         HETU_TPU_PALLAS surface and the kernel's shape gate allow.
-        int8 pages dequantize IN-KERNEL (the scales ride in as extra
-        operands).  Speculative decoding keeps the gather path — the
-        verify step is multi-query, outside the decode kernel's
-        single-token shape.  Evaluated once at build: the decision is
-        static, like every other program shape."""
-        if self.spec:
-            return False
+        int8/int4 pages dequantize IN-KERNEL (the scales ride in as
+        extra operands; int4 pages store packed nibble pairs, so the
+        stored head dim is head_dim // 2).  Speculative decoding routes
+        the multi-query `paged_verify` kernel instead — same pages, k+1
+        causally-masked query positions per slot per launch.  Evaluated
+        once at build: the decision is static, like every other program
+        shape."""
         from hetu_tpu.ops.pallas import paged_attention as _pa
         from hetu_tpu.ops.pallas import resolve_route
         c = self.model.config
         S = self.config.num_slots
-        q_shape = (S, c.num_attention_heads, c.head_dim)
+        hd_p = (self.pool.head_dim // 2 if self.pool.quant == "int4"
+                else self.pool.head_dim)
         pool_shape = (self.config.num_pages + 1, self.config.page_size,
-                      self.pool.num_kv_heads, self.pool.head_dim)
-        ok = _pa.compatible(q_shape, pool_shape,
-                            (S, self.scheduler.max_pages), (S,),
+                      self.pool.num_kv_heads, hd_p)
+        table_shape = (S, self.scheduler.max_pages)
+        if self.spec:
+            q_shape = (S, self.config.spec_k + 1,
+                       c.num_attention_heads, c.head_dim)
+            ok = _pa.verify_compatible(q_shape, pool_shape, table_shape,
+                                       (S,), quant=self.pool.quant)
+            return resolve_route("paged_verify", ok)
+        q_shape = (S, c.num_attention_heads, c.head_dim)
+        ok = _pa.compatible(q_shape, pool_shape, table_shape, (S,),
                             quant=self.pool.quant)
         return resolve_route("paged_attn", ok)
 
@@ -433,15 +454,16 @@ class ServingEngine:
                           *sample_args):
                 # gather-free: the kernel walks the page table directly;
                 # this token's K/V are scattered inside the step (the
-                # write_token scatter is folded into the program).  int8
-                # pools carry (k, v, k_scale, v_scale) — the kernel
-                # dequantizes pages in-VMEM
+                # write_token scatter is folded into the program).
+                # int8/int4 pools carry (k, v, k_scale, v_scale) — the
+                # kernel dequantizes pages in-VMEM
                 quant = len(pool_tree) == 4
                 ks = pool_tree[2] if quant else None
                 vs = pool_tree[3] if quant else None
                 logits, *new_pools = decode_step_paged(
                     model, params, tokens, pool_tree[0], pool_tree[1],
-                    table, positions, k_scale=ks, v_scale=vs)
+                    table, positions, k_scale=ks, v_scale=vs,
+                    kv_quant=pool.quant if quant else None)
                 nxt = pick_token(logits, positions, sample_args)
                 return nxt, tuple(new_pools)
         else:
@@ -462,32 +484,109 @@ class ServingEngine:
             return pool.write_pages(pool_tree, pages_row, ks, vs)
 
         # speculative-decoding verify (serving/spec_decode.py): score
-        # the last token + k drafts in one multi-query forward
-        # (models/generation.verify_step_slots), scatter the block's
-        # K/V, and compute the sample-then-match acceptance in-graph —
-        # the host only reads [S, k+1] target tokens and [S] emit
-        # counts, never the logits
+        # the last token + k drafts in one multi-query forward —
+        # `verify_step_paged` (the fused Pallas kernel chain) when the
+        # paged_verify route is on, the gather machinery
+        # (models/generation.verify_step_slots) otherwise — scatter the
+        # block's K/V, and compute the acceptance in-graph; the host
+        # only reads [S, k+1] target tokens and [S] emit counts, never
+        # the logits.  When the fused `sample` kernel also routes, the
+        # paged forward returns last-layer HIDDEN rows and the lm_head
+        # matmul + filter + draw fuse into one epilogue launch — the
+        # [S, k+1, vocab] logits plane never touches HBM.
         K1 = self.config.spec_k + 1
+        verify_paged = self.spec and self.decode_paged
+        stochastic = self.spec_stochastic
+        self.verify_fused_sample = False
+        if verify_paged and not stochastic:
+            from hetu_tpu.ops.pallas import resolve_route
+            from hetu_tpu.ops.pallas import sample as _psample
+            mc = model.config
+            self.verify_fused_sample = resolve_route(
+                "sample", _psample.compatible(
+                    (self.config.num_slots * K1, mc.hidden_size),
+                    (mc.hidden_size, mc.vocab_size)))
+        fused_sample = self.verify_fused_sample
 
-        def verify_fn(params, pool_tree, table, tokens, positions,
-                      *sample_args):
+        def verify_forward(params, pool_tree, table, tokens, positions,
+                           pos_grid, want_hidden):
+            """-> (logits_or_hidden [S, K1, ...], new pool tree)."""
+            quant = len(pool_tree) == 4
+            if verify_paged:
+                from hetu_tpu.models.generation import verify_step_paged
+                ks = pool_tree[2] if quant else None
+                vs = pool_tree[3] if quant else None
+                out, *new_pools = verify_step_paged(
+                    model, params, tokens, pool_tree[0], pool_tree[1],
+                    table, positions, k_scale=ks, v_scale=vs,
+                    kv_quant=pool.quant if quant else None,
+                    return_hidden=want_hidden)
+                return out, tuple(new_pools)
             from hetu_tpu.models.generation import verify_step_slots
             ck, cv = pool.gather(pool_tree, table)
             logits, _, (kc, vc) = verify_step_slots(
                 model, params, tokens, (ck, cv), positions)
-            pos_grid = positions[:, None] + jnp.arange(K1, dtype=jnp.int32)
             new_tree = pool.write_tokens(pool_tree, table, pos_grid,
                                          kc, vc)
+            return logits, new_tree
+
+        def full_sample_args(tokens, sample_args):
+            """The per-slot sampling vectors, or the all-greedy ones
+            when the engine runs without HETU_TPU_SERVE_SAMPLE (the
+            fused/stochastic epilogues take them unconditionally;
+            temp 0 rows argmax, so greedy stays greedy)."""
             if sampling_on:
-                from hetu_tpu.serving.sampling import sample_token_grid
-                seeds, temps, top_ks, top_ps = sample_args
-                targets = sample_token_grid(logits, seeds, pos_grid + 1,
-                                            temps, top_ks, top_ps)
-            else:
-                targets = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-            match = (targets[:, :-1] == tokens[:, 1:]).astype(jnp.int32)
-            n_emit = jnp.cumprod(match, axis=1).sum(axis=1) + 1   # [S]
-            return targets, n_emit.astype(jnp.int32), new_tree
+                return sample_args
+            S = tokens.shape[0]
+            return (jnp.zeros((S,), jnp.uint32),
+                    jnp.zeros((S,), jnp.float32),
+                    jnp.zeros((S,), jnp.int32),
+                    jnp.zeros((S,), jnp.float32))
+
+        if stochastic:
+            def verify_fn(params, pool_tree, table, tokens, positions,
+                          q_probs, *sample_args):
+                from hetu_tpu.serving.spec_decode import stochastic_verify
+                pos_grid = positions[:, None] + jnp.arange(
+                    K1, dtype=jnp.int32)
+                logits, new_tree = verify_forward(
+                    params, pool_tree, table, tokens, positions,
+                    pos_grid, False)
+                seeds, temps, top_ks, top_ps = full_sample_args(
+                    tokens, sample_args)
+                targets, n_emit = stochastic_verify(
+                    logits, q_probs, tokens[:, 1:], seeds, pos_grid + 1,
+                    temps, top_ks, top_ps)
+                return targets, n_emit, new_tree
+        else:
+            def verify_fn(params, pool_tree, table, tokens, positions,
+                          *sample_args):
+                pos_grid = positions[:, None] + jnp.arange(
+                    K1, dtype=jnp.int32)
+                out, new_tree = verify_forward(
+                    params, pool_tree, table, tokens, positions,
+                    pos_grid, fused_sample)
+                if fused_sample:
+                    from hetu_tpu.models.generation import lm_head_weight
+                    from hetu_tpu.serving.sampling import \
+                        sample_hidden_grid
+                    seeds, temps, top_ks, top_ps = full_sample_args(
+                        tokens, sample_args)
+                    targets = sample_hidden_grid(
+                        out, lm_head_weight(model, params), seeds,
+                        pos_grid + 1, temps, top_ks, top_ps)
+                elif sampling_on:
+                    from hetu_tpu.serving.sampling import \
+                        sample_token_grid
+                    seeds, temps, top_ks, top_ps = sample_args
+                    targets = sample_token_grid(out, seeds, pos_grid + 1,
+                                                temps, top_ks, top_ps)
+                else:
+                    targets = jnp.argmax(out, axis=-1).astype(jnp.int32)
+                match = (targets[:, :-1] == tokens[:, 1:]) \
+                    .astype(jnp.int32)
+                n_emit = jnp.cumprod(match, axis=1).sum(axis=1) + 1  # [S]
+                return targets, n_emit.astype(jnp.int32), new_tree
 
         # prefix-cache prime (serving/prefix_cache.py): gather a slot's
         # resident shared-prefix pages into the dense prefill scratch so
@@ -636,9 +735,15 @@ class ServingEngine:
         sample_args = self._sample_args([]) if self.config.sampling else ()
         if self.spec:
             toks2 = jnp.zeros((S, self.config.spec_k + 1), jnp.int32)
+            extra = ()
+            if self.spec_stochastic:
+                extra = (jnp.full(
+                    (S, self.config.spec_k,
+                     self.model.config.vocab_size),
+                    1.0 / self.model.config.vocab_size, jnp.float32),)
             nxt, _, tree = self._run_verify(
                 self.params, self.pool.arrays.tree(), table, toks2, pos,
-                *sample_args)
+                *extra, *sample_args)
         else:
             nxt, tree = self._run_decode(
                 self.params, self.pool.arrays.tree(), table, toks, pos,
@@ -1092,11 +1197,16 @@ class ServingEngine:
     def _spec_decode_step(self, active, positions, sample_args):
         """One speculative decode step over the active slots: draft k
         tokens per slot on the host, verify all k+1 in ONE batched
-        forward, accept by sample-then-match (serving/spec_decode.py).
-        Returns {slot: emitted tokens} (>= 1 per active slot)."""
+        forward, accept by sample-then-match — or by the full
+        stochastic p/q rejection rule when the drafter reports its
+        proposal distribution (serving/spec_decode.py).  Returns
+        {slot: emitted tokens} (>= 1 per active slot)."""
         S, k = self.config.num_slots, self.config.spec_k
         w = getattr(self.drafter, "window", None)
         tokens = np.zeros((S, k + 1), np.int32)
+        q_probs = (np.zeros((S, k, self.model.config.vocab_size),
+                            np.float32)
+                   if self.spec_stochastic else None)
         for i in active:
             st = self.scheduler.slots[i]
             # hand the drafter only the trailing window it reads —
@@ -1109,11 +1219,20 @@ class ServingEngine:
             else:
                 ctx = st.request.prompt.tolist() + st.generated
             tokens[i, 0] = st.generated[-1]
-            tokens[i, 1:] = self.drafter.propose(ctx, k)
+            if q_probs is not None:
+                sp = st.request.sampling
+                tokens[i, 1:], q_probs[i] = \
+                    self.drafter.propose_with_probs(
+                        ctx, k, seed=sp.seed & 0xFFFFFFFF,
+                        start_pos=int(positions[i]) + 1)
+            else:
+                tokens[i, 1:] = self.drafter.propose(ctx, k)
+        extra = ((jnp.asarray(q_probs),) if q_probs is not None else ())
         targets, n_emit, pool_tree = self._run_verify(
             self.params, self.pool.arrays.tree(),
             self._decode_table(active),
-            jnp.asarray(tokens), jnp.asarray(positions), *sample_args)
+            jnp.asarray(tokens), jnp.asarray(positions), *extra,
+            *sample_args)
         targets = np.asarray(targets)
         n_emit = np.asarray(n_emit)
         self.pool.arrays = PoolArrays.from_tree(pool_tree)

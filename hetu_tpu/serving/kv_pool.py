@@ -19,13 +19,16 @@ It is never read either — gathers beyond a sequence's length are masked
 by the position mask in `models/generation._attend_cached`, so null-page
 garbage cannot reach attention.
 
-Quantized page mode (``HETU_TPU_KV_QUANT=int8``): pages store blockwise
-int8 values + one f32 absmax scale per head-vector (block = head_dim),
-reusing `comm/compress.py`'s collective quantization primitives.  Bytes
-per element drop 4 -> 1 + 4/hd (~3.88x smaller at hd=128, ~3.76x at
-hd=64, vs the fp32 exact path the CPU tests decode with; ~1.94x vs a
-bf16/fp16 cache).  The exact fp path is the default and stores pages in
-the model's compute dtype — byte-identical semantics to `init_cache`.
+Quantized page modes (``HETU_TPU_KV_QUANT=int8|int4``): pages store
+blockwise values + one f32 absmax scale per head-vector (block =
+head_dim).  int8 reuses `comm/compress.py`'s collective quantization
+primitives; bytes per element drop 4 -> 1 + 4/hd (~3.88x smaller at
+hd=128 vs fp32).  int4 packs two values per byte through the shared
+`ops/quantization.pack_nibbles` storage layout (even index = LOW
+nibble, +8 offset): 4 -> 0.5 + 4/hd (~7.53x smaller at hd=128), with
+both paged Pallas kernels unpacking the nibbles in-VMEM.  The exact fp
+path is the default and stores pages in the model's compute dtype —
+byte-identical semantics to `init_cache`.
 
 Host side (allocator, free list) is plain Python; device side
 (gather/scatter) is pure-functional jax, jitted by the engine.
@@ -55,36 +58,51 @@ def kv_bytes_per_token(num_layers: int, num_kv_heads: int, head_dim: int,
     elems = 2.0 * num_layers * num_kv_heads * head_dim
     if mode == "int8":
         return elems * (1.0 + 4.0 / head_dim)
+    if mode == "int4":
+        return elems * (0.5 + 4.0 / head_dim)
     try:
         return elems * _ELEM_BYTES[mode]
     except KeyError:
         raise ValueError(f"unknown kv mode {mode!r}; "
-                         f"known: {sorted(_ELEM_BYTES)} + ['int8']")
+                         f"known: {sorted(_ELEM_BYTES)} + ['int8', 'int4']")
 
 
-def quantize_heads(x):
-    """[..., hd] f32 -> (int8 [..., hd], scales f32 [...]): one absmax
-    scale per head-vector (comm/compress blockwise with block = hd)."""
+def quantize_heads(x, bits: int = 8):
+    """[..., hd] f32 -> (payload, scales f32 [...]): one absmax scale
+    per head-vector (block = hd).  int8 payload is [..., hd] via the
+    comm/compress blockwise primitives; ``bits=4`` packs nibbles to a
+    [..., hd//2] uint8 payload via the shared `ops/quantization`
+    storage layout."""
     hd = x.shape[-1]
+    if bits == 4:
+        from hetu_tpu.ops.quantization import quantize_int4
+        q, s = quantize_int4(x, block_size=hd)
+        return q.reshape(x.shape[:-1] + (hd // 2,)), s.reshape(x.shape[:-1])
     q, s = quantize_blockwise(x, block_size=hd)
     return q.reshape(x.shape), s.reshape(x.shape[:-1])
 
 
-def dequantize_heads(q, s):
+def dequantize_heads(q, s, bits: int = 8):
     """Inverse of `quantize_heads`."""
+    if bits == 4:
+        from hetu_tpu.ops.quantization import dequantize_int4
+        hd = q.shape[-1] * 2
+        shape = q.shape[:-1] + (hd,)
+        return dequantize_int4(q.reshape(-1, q.shape[-1]),
+                               s.reshape(-1), shape)
     return dequantize_blockwise(q.reshape(-1, q.shape[-1]),
                                 s.reshape(-1)).reshape(q.shape)
 
 
-def _tap_kv_snr(x32, q, s):
-    """Numerics SNR tap at the int8 KV-page quantize site
+def _tap_kv_snr(x32, q, s, bits: int = 8):
+    """Numerics SNR tap at the quantized KV-page write site
     (obs/numerics.py, HETU_TPU_NUMERICS): the exact roundtrip error of
     the tokens just written.  Only traced when the serving engine
     installed a collector around the program build."""
     from hetu_tpu.obs import numerics as _numerics
     if _numerics.active():
         _numerics.tap_quant_error("kv_pages", x32,
-                                  x32 - dequantize_heads(q, s))
+                                  x32 - dequantize_heads(q, s, bits))
 
 
 @dataclasses.dataclass
@@ -138,9 +156,12 @@ class PagePool:
                  num_kv_heads: int, head_dim: int,
                  dtype=jnp.float32, quant: str = "none",
                  device_arrays: bool = True):
-        if quant not in ("none", "int8"):
+        if quant not in ("none", "int8", "int4"):
             raise ValueError(f"kv quant mode {quant!r} invalid; "
-                             "choices: ('none', 'int8')")
+                             "choices: ('none', 'int8', 'int4')")
+        if quant == "int4" and head_dim % 2:
+            raise ValueError(f"int4 pages need an even head_dim, "
+                             f"got {head_dim}")
         if num_pages < 1:
             raise ValueError("need at least one usable page")
         self.num_layers = num_layers
@@ -150,6 +171,8 @@ class PagePool:
         self.head_dim = head_dim
         self.dtype = dtype
         self.quant = quant
+        #: payload bit width of the stored pages (8 also covers fp modes)
+        self.quant_bits = 4 if quant == "int4" else 8
         shape = (num_layers, num_pages + 1, page_size, num_kv_heads,
                  head_dim)
         if not device_arrays:
@@ -158,6 +181,13 @@ class PagePool:
             # thing, but no device memory is ever touched — a 10^6-page
             # pool costs one numpy array, not gigabytes of jnp.zeros
             self.arrays = None
+        elif quant == "int4":
+            pshape = shape[:-1] + (head_dim // 2,)
+            self.arrays = PoolArrays(
+                k=jnp.zeros(pshape, jnp.uint8),
+                v=jnp.zeros(pshape, jnp.uint8),
+                k_scale=jnp.zeros(shape[:-1], jnp.float32),
+                v_scale=jnp.zeros(shape[:-1], jnp.float32))
         elif quant == "int8":
             self.arrays = PoolArrays(
                 k=jnp.zeros(shape, jnp.int8), v=jnp.zeros(shape, jnp.int8),
@@ -244,7 +274,10 @@ class PagePool:
         M = mp * self.page_size
 
         def dense(pool, scale):
-            g = pool[:, table]              # [L, S, mp, ps, n_kv, hd]
+            g = pool[:, table]       # [L, S, mp, ps, n_kv, hd(/2)]
+            if self.quant == "int4":
+                from hetu_tpu.ops.quantization import unpack_nibbles
+                g = unpack_nibbles(g, even_high=False).astype(jnp.int32) - 8
             g = g.reshape(L, S, M, self.num_kv_heads, self.head_dim)
             if scale is None:
                 return g
@@ -267,8 +300,8 @@ class PagePool:
             if scale is None:
                 return pool.at[:, page, off].set(toks.astype(pool.dtype)), None
             x32 = toks.astype(jnp.float32)
-            q, s = quantize_heads(x32)
-            _tap_kv_snr(x32, q, s)
+            q, s = quantize_heads(x32, self.quant_bits)
+            _tap_kv_snr(x32, q, s, self.quant_bits)
             return (pool.at[:, page, off].set(q),
                     scale.at[:, page, off].set(s))
 
@@ -298,8 +331,8 @@ class PagePool:
             if scale is None:
                 return pool.at[:, page, off].set(toks.astype(pool.dtype)), None
             x32 = toks.astype(jnp.float32)
-            q, s = quantize_heads(x32)
-            _tap_kv_snr(x32, q, s)
+            q, s = quantize_heads(x32, self.quant_bits)
+            _tap_kv_snr(x32, q, s, self.quant_bits)
             return (pool.at[:, page, off].set(q),
                     scale.at[:, page, off].set(s))
 
@@ -323,8 +356,8 @@ class PagePool:
             if scale is None:
                 return pool.at[:, pages_row].set(x.astype(pool.dtype)), None
             x32 = x.astype(jnp.float32)
-            q, s = quantize_heads(x32)
-            _tap_kv_snr(x32, q, s)
+            q, s = quantize_heads(x32, self.quant_bits)
+            _tap_kv_snr(x32, q, s, self.quant_bits)
             return (pool.at[:, pages_row].set(q),
                     scale.at[:, pages_row].set(s))
 

@@ -27,11 +27,21 @@ Filter semantics match ``models/generation.generate``'s sampler (HF
 conventions): top-k first, nucleus over the renormalized top-k
 distribution, the max-probability token always survives.  One
 descending full-vocab sort serves both filters per row.
+
+The DRAW is Gumbel-argmax over a counter-based hash of the key's raw
+words (`ops/pallas/sample.hash_uniform` — shared verbatim with the
+fused sampling kernel, so the in-kernel epilogue and this XLA path pick
+identical tokens for identical (seed, position) keys).  `sample_hidden`
+is the fused entry: it takes last-layer HIDDEN rows plus the lm_head
+slice and routes the whole matmul+filter+draw to the Pallas kernel when
+enabled, never materializing the [rows, vocab] logits in HBM.
 """
 from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+
+from hetu_tpu.ops.pallas.sample import gumbel
 
 #: the filter mask value (matches generate()'s sampler)
 _NEG = -1e30
@@ -46,6 +56,13 @@ def slot_keys(seeds, positions):
         return jax.random.fold_in(jax.random.key(seed), pos)
     return jax.vmap(one)(seeds.astype(jnp.uint32),
                          positions.astype(jnp.uint32))
+
+
+def key_words(seeds, positions):
+    """[S, 2] uint32 — the raw key data of `slot_keys`, the form the
+    hash-based draw (and the fused sampling kernel) consumes."""
+    return jax.random.key_data(slot_keys(seeds, positions)) \
+        .astype(jnp.uint32)
 
 
 def filtered_logits(logits, temps, top_ks, top_ps):
@@ -90,11 +107,13 @@ def sample_tokens(logits, seeds, positions, temps, top_ks, top_ps):
     positions (the key-derivation input).  Rows with temperature 0 take
     ``argmax`` of the UNFILTERED logits — exactly the greedy program's
     token.  Returns [S] int32."""
+    V = logits.shape[-1]
     greedy_tok = jnp.argmax(logits, axis=-1).astype(jnp.int32)
     filt = filtered_logits(logits, temps, top_ks, top_ps)
-    keys = slot_keys(seeds, positions)
-    sampled = jax.vmap(
-        lambda k, row: jax.random.categorical(k, row))(keys, filt)
+    words = key_words(seeds, positions)
+    idx = jnp.arange(V, dtype=jnp.uint32)[None, :]
+    g = gumbel(words[:, 0:1], words[:, 1:2], idx)
+    sampled = jnp.argmax(filt + g, axis=-1)
     return jnp.where(temps > 0, sampled.astype(jnp.int32), greedy_tok)
 
 
@@ -111,4 +130,38 @@ def sample_token_grid(logits, seeds, positions, temps, top_ks, top_ps):
     rep = lambda x: jnp.repeat(x, C)  # noqa: E731 — [S] -> [S*C]
     toks = sample_tokens(flat, rep(seeds), positions.reshape(-1),
                          rep(temps), rep(top_ks), rep(top_ps))
+    return toks.reshape(S, C)
+
+
+def sample_hidden(hidden, w, seeds, positions, temps, top_ks, top_ps):
+    """The fused last-layer epilogue: last-layer hidden rows [R, H] +
+    lm_head slice w [H, V] -> one token per row, WITHOUT materializing
+    the [R, V] logits in HBM when the Pallas `sample` kernel routes
+    (ops/pallas/sample.py).  The XLA fallback computes the same math
+    (matmul -> filtered_logits -> hash-Gumbel argmax), so the routed
+    and unrouted paths pick identical tokens — the flag only moves
+    bytes, never the distribution."""
+    from hetu_tpu.ops import pallas as _pl
+    from hetu_tpu.ops.pallas import sample as _ps
+    if _pl.resolve_route("sample", _ps.compatible(hidden.shape, w.shape)):
+        words = key_words(seeds, positions)
+        with jax.named_scope("pallas_fused_sample"):
+            return _ps.fused_sample(hidden, w, words,
+                                    temps.astype(jnp.float32),
+                                    top_ks.astype(jnp.int32),
+                                    top_ps.astype(jnp.float32))
+    logits = hidden.astype(jnp.float32) @ w.astype(jnp.float32)
+    return sample_tokens(logits, seeds, positions, temps, top_ks, top_ps)
+
+
+def sample_hidden_grid(hidden, w, seeds, positions, temps, top_ks,
+                       top_ps):
+    """`sample_hidden` over the spec-decode verify grid: hidden
+    [S, C, H], positions [S, C]; per-slot params broadcast over C.
+    Returns [S, C] int32."""
+    S, C, H = hidden.shape
+    rep = lambda x: jnp.repeat(x, C)  # noqa: E731 — [S] -> [S*C]
+    toks = sample_hidden(hidden.reshape(S * C, H), w, rep(seeds),
+                         positions.reshape(-1), rep(temps), rep(top_ks),
+                         rep(top_ps))
     return toks.reshape(S, C)

@@ -210,10 +210,13 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "paged-KV-cache page mode (serving/kv_pool.py): int8 stores "
          "pages as blockwise int8 + one f32 absmax scale per head-vector "
          "(comm/compress primitives; ~3.9x smaller than the fp32 exact "
-         "cache at hd=128, ~1.9x vs bf16).  none (default) stores exact "
-         "pages in the model compute dtype — byte-identical semantics to "
+         "cache at hd=128, ~1.9x vs bf16); int4 packs two values per "
+         "byte under the same per-head-vector scale (~7.5x vs fp32 at "
+         "hd=128 — decode parity within the documented tolerance, "
+         "docs/serving.md).  none (default) stores exact pages in the "
+         "model compute dtype — byte-identical semantics to "
          "models/generation.init_cache",
-         choices=("none", "int8"), identity="none"),
+         choices=("none", "int8", "int4"), identity="none"),
     Flag("HETU_TPU_SERVE_SLOTS", "int", 8,
          "serving engine decode-slot count (the static batch dimension "
          "of the continuous-batching decode program)"),
@@ -249,9 +252,13 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "rejection rule for a deterministic drafter, so greedy output "
          "is token-identical to sequential generate() and sampled "
          "output matches the non-speculative distribution (and seed).  "
-         "none (default) builds the single-token decode program "
-         "byte-identical to unset",
-         choices=("none", "ngram"), identity="none",
+         "model runs a resident-quantized draft model (the engine's "
+         "draft_model/draft_params kwargs) with the full stochastic p/q "
+         "rejection rule: accept with prob min(1, p/q), residual "
+         "resample on rejection — the output distribution is exactly "
+         "the target's for ANY drafter.  none (default) builds the "
+         "single-token decode program byte-identical to unset",
+         choices=("none", "ngram", "model"), identity="none",
          identity_programs=("decode",)),
     Flag("HETU_TPU_SPEC_K", "int", 4,
          "draft tokens per speculative decode step (the verify "
@@ -369,7 +376,8 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "Pallas fused-kernel layer routing (ops/pallas: flash attention, "
          "residual+RMS/LayerNorm, SwiGLU, rotary, blockwise quantize, "
-         "paged-attention decode — docs/kernels.md): auto (shape-gated, "
+         "paged-attention decode, multi-query verify, fused sampling "
+         "epilogue, fused AdamW — docs/kernels.md): auto (shape-gated, "
          "TPU only), 1 (force the kernels; unsupported shapes raise), "
          "0 (force the XLA compositions — byte-identical to the seed "
          "lowering, tested)",
@@ -377,8 +385,9 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
     Flag("HETU_TPU_PALLAS_KERNELS", "str", "",
          "restrict WHICH Pallas kernels participate in HETU_TPU_PALLAS "
          "routing: comma list over {flash, norm, swiglu, rotary, quant, "
-         "paged_attn}, or 'all' (default: empty = all) / 'none' — lets "
-         "one kernel be bisected out without losing the rest",
+         "paged_attn, paged_verify, sample, adam}, or 'all' (default: "
+         "empty = all) / 'none' — lets one kernel be bisected out "
+         "without losing the rest",
          identity="all"),
     Flag("HETU_TPU_CP_SPLIT", "str", "sym",
          "default context-parallel split pattern "

@@ -186,13 +186,25 @@ def test_two_level_sync_matches_psum():
                                atol=0.08 * np.abs(ref).max())
 
 
-def test_two_level_rejects_error_feedback():
+def test_two_level_error_feedback_state_layout():
+    """Two-level EF composes (the old stateless-only reject is
+    retired): the state carries one residual per quantize point —
+    ef_init's `topology=` arm adds the per-stage chunk residuals — and
+    a flat-layout state still fails loudly (it cannot carry across the
+    hierarchical schedule's extra quantize points)."""
     from hetu_tpu.comm import BucketPlan
-    from hetu_tpu.comm.grad_sync import quantized_grad_sync
+    from hetu_tpu.comm.grad_sync import ef_init, ef_specs, quantized_grad_sync
     topo = Topology(slice_devices=4, intra_gbps=45.0, inter_gbps=6.25)
     plan = BucketPlan.build({"w": jax.ShapeDtypeStruct((64,), jnp.float32)},
                             multiple=8 * 256)
-    with pytest.raises(ValueError, match="stateless"):
+    st = ef_init(plan, 8, topology=topo)
+    assert set(st) == {"a2a", "tl_inter", "ag", "tl_intra"}
+    (L,) = plan.sizes
+    assert st["tl_inter"][0].shape == (8, L // 4)
+    assert st["tl_intra"][0].shape == (8, L // 4)
+    sp = ef_specs(plan, topology=topo)
+    assert set(sp) == set(st)
+    with pytest.raises(ValueError, match="tl_inter"):
         quantized_grad_sync({"w": jnp.zeros((64,))}, "dp", 8, plan,
                             "int8-ef", {"a2a": [], "ag": []}, topology=topo)
 

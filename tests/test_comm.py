@@ -562,15 +562,38 @@ def test_trainer_two_level_sync_trains_close_to_flat(tmp_path, monkeypatch):
     assert abs(lt[-1] - lf[-1]) / lf[-1] < 0.05, (lt[-1], lf[-1])
 
 
-def test_trainer_two_level_rejects_ef(tmp_path, monkeypatch):
+def test_trainer_two_level_ef_state_carries_chunk_residuals(tmp_path,
+                                                            monkeypatch):
+    # EF + two_level used to raise; the hierarchical schedule now carries
+    # residuals at all four quantize points: "a2a"/"ag" reuse the flat
+    # layout, "tl_inter"/"tl_intra" add the chunk-sized points
     monkeypatch.setenv("HETU_TPU_HW_PROFILE", _topo_profile(tmp_path))
     monkeypatch.setenv("HETU_TPU_COMM_TOPOLOGY", "two_level")
-    monkeypatch.setenv("HETU_TPU_GRAD_COMPRESS", "int8-ef")
-    cfg = LlamaConfig.tiny(remat=False)
-    st = ParallelStrategy(mesh=MeshConfig(dp=8), zero=False)
-    tc = TrainingConfig(global_batch_size=8, micro_batch_size=1, seq_len=64)
-    with pytest.raises(ValueError, match="stateless"):
-        Trainer(LlamaLMHeadModel(cfg, st), tc, st)
+    tr = _trainer("int8-ef", monkeypatch, dp=8)
+    assert tr._comm_topology is not None
+    ef = tr.opt_state["ef"]
+    assert set(ef) == {"a2a", "tl_inter", "ag", "tl_intra"}
+    k = tr._comm_topology.slice_devices
+    for L, ti, tx in zip(tr._bucket_plan.sizes, ef["tl_inter"],
+                         ef["tl_intra"]):
+        assert ti.shape == (8, L // k) and tx.shape == (8, L // k)
+    tr.train_step(_batch())
+    live = max(float(jnp.abs(x).max())
+               for x in tr.opt_state["ef"]["tl_inter"])
+    assert live > 0  # the residual memory is actually fed back
+
+
+@pytest.mark.slow
+def test_trainer_two_level_ef_trains_close_to_flat_ef(tmp_path, monkeypatch):
+    monkeypatch.setenv("HETU_TPU_HW_PROFILE", _topo_profile(tmp_path))
+    hb = _batch()
+    flat = _trainer("int8-ef", monkeypatch, dp=8)
+    lf = [float(flat.train_step(hb)["loss"]) for _ in range(6)]
+    monkeypatch.setenv("HETU_TPU_COMM_TOPOLOGY", "two_level")
+    two = _trainer("int8-ef", monkeypatch, dp=8)
+    lt = [float(two.train_step(hb)["loss"]) for _ in range(6)]
+    assert lt[-1] < lt[0] - 0.3
+    assert abs(lt[-1] - lf[-1]) / lf[-1] < 0.05, (lt[-1], lf[-1])
 
 
 def test_trainer_two_level_flag_flat_is_hlo_identical(tmp_path, monkeypatch):

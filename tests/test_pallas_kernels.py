@@ -267,6 +267,83 @@ def test_paged_attention_int8_parity():
                                           positions.shape, quant="int4")
 
 
+def test_paged_attention_int4_parity():
+    """int4 pages: the kernel's in-VMEM nibble unpack + dequantize
+    matches the gather path's dequantize-then-attend over the SAME
+    packed pool (pool head dim hd//2, one f32 scale per head-vector)."""
+    from hetu_tpu.serving.kv_pool import quantize_heads, dequantize_heads
+    rng = np.random.default_rng(7)
+    S, P, ps, n_kv, nq, hd = 3, 9, 8, 2, 4, 128
+    kp32 = jnp.asarray(rng.standard_normal((P, ps, n_kv, hd),
+                                           dtype=np.float32))
+    vp32 = jnp.asarray(rng.standard_normal((P, ps, n_kv, hd),
+                                           dtype=np.float32))
+    q = jnp.asarray(rng.standard_normal((S, nq, hd), dtype=np.float32))
+    kq, ks = quantize_heads(kp32, bits=4)
+    vq, vs = quantize_heads(vp32, bits=4)
+    assert kq.shape == (P, ps, n_kv, hd // 2) and kq.dtype == jnp.uint8
+    table = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [6, 7, 8, 0]],
+                        jnp.int32)
+    positions = jnp.asarray([20, 9, 17], jnp.int32)
+    out = paged_attention.paged_attention(q, kq, vq, table, positions,
+                                          k_scale=ks, v_scale=vs,
+                                          quant="int4")
+    ref = _dense_paged_reference(q, dequantize_heads(kq, ks, bits=4),
+                                 dequantize_heads(vq, vs, bits=4), table,
+                                 positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=FWD_TOL)
+    # the packed pool is an int4 pool, NOT an int8 one
+    assert paged_attention.compatible(q.shape, kq.shape, table.shape,
+                                      positions.shape, quant="int4")
+    assert not paged_attention.compatible(q.shape, kq.shape, table.shape,
+                                          positions.shape, quant="int8")
+
+
+def _dense_verify_reference(q, kp, vp, table, positions):
+    """[S, C, nq, hd] multi-query verify reference: query j of slot s
+    sits at global position positions[s] + j and attends causally."""
+    S, C, nq, hd = q.shape
+    return jnp.stack([
+        jnp.stack([_dense_paged_reference(
+            q[si:si + 1, j], kp, vp, table[si:si + 1],
+            positions[si:si + 1] + j)[0] for j in range(C)])
+        for si in range(S)])
+
+
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
+def test_paged_verify_parity(quant):
+    """Multi-query verify kernel vs the per-position dense reference:
+    the k+1 query positions share one pass over the pages with
+    per-position causal masking — all three page modes."""
+    from hetu_tpu.serving.kv_pool import quantize_heads, dequantize_heads
+    rng = np.random.default_rng(9)
+    S, C, P, ps, n_kv, nq, hd = 3, 3, 9, 8, 2, 4, 128
+    kp32 = jnp.asarray(rng.standard_normal((P, ps, n_kv, hd),
+                                           dtype=np.float32))
+    vp32 = jnp.asarray(rng.standard_normal((P, ps, n_kv, hd),
+                                           dtype=np.float32))
+    q = jnp.asarray(rng.standard_normal((S, C, nq, hd), dtype=np.float32))
+    table = jnp.asarray([[1, 2, 3, 0], [4, 5, 0, 0], [6, 7, 8, 0]],
+                        jnp.int32)
+    positions = jnp.asarray([18, 7, 15], jnp.int32)
+    if quant == "none":
+        out = paged_attention.paged_verify(q, kp32, vp32, table, positions)
+        ref = _dense_verify_reference(q, kp32, vp32, table, positions)
+    else:
+        bits = 8 if quant == "int8" else 4
+        kq, ks = quantize_heads(kp32, bits=bits)
+        vq, vs = quantize_heads(vp32, bits=bits)
+        out = paged_attention.paged_verify(q, kq, vq, table, positions,
+                                           k_scale=ks, v_scale=vs,
+                                           quant=quant)
+        ref = _dense_verify_reference(
+            q, dequantize_heads(kq, ks, bits=bits),
+            dequantize_heads(vq, vs, bits=bits), table, positions)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               atol=FWD_TOL)
+
+
 # ---------------------------------------------------------------------------
 # gate/kernel drift: the gate's verdict must MATCH what the kernel
 # actually accepts (satellite 2 — extended to every kernel's gate)
@@ -345,6 +422,55 @@ def test_gate_drift_paged(shapes):
     pos = jnp.zeros(pos_s, jnp.int32)
     assert paged_attention.compatible(qs, pool_s, ts, pos_s) == _accepts(
         paged_attention.paged_attention, q, kp, kp, table, pos)
+
+
+@pytest.mark.parametrize("shapes", [
+    ((3, 3, 4, 128), (9, 8, 2, 128), (3, 4), (3,)),
+    ((3, 3, 4, 64), (9, 8, 2, 64), (3, 4), (3,)),    # hd unaligned
+    ((3, 3, 3, 128), (9, 8, 2, 128), (3, 4), (3,)),  # heads not divisible
+    ((2, 3, 4, 128), (9, 8, 2, 128), (3, 4), (3,)),  # table/slot mismatch
+    ((3, 4, 128), (9, 8, 2, 128), (3, 4), (3,)),     # missing C dim
+])
+def test_gate_drift_paged_verify(shapes):
+    qs, pool_s, ts, pos_s = shapes
+    q = jnp.zeros(qs, jnp.float32)
+    kp = jnp.zeros(pool_s, jnp.float32)
+    table = jnp.zeros(ts, jnp.int32)
+    pos = jnp.zeros(pos_s, jnp.int32)
+    assert paged_attention.verify_compatible(qs, pool_s, ts, pos_s) == \
+        _accepts(paged_attention.paged_verify, q, kp, kp, table, pos)
+
+
+@pytest.mark.parametrize("hw", [
+    ((8, 128), (128, 256)),
+    ((8, 100), (100, 256)),     # hidden unaligned
+    ((8, 128), (128, 200)),     # vocab unaligned
+    ((8, 128), (64, 256)),      # hidden dim mismatch
+])
+def test_gate_drift_sample(hw):
+    from hetu_tpu.ops.pallas import sample as psample
+    hs, ws = hw
+    h = jnp.zeros(hs, jnp.float32)
+    w = jnp.zeros(ws, jnp.float32)
+    R = hs[0]
+    words = jnp.zeros((R, 2), jnp.uint32)
+    t = jnp.ones((R,), jnp.float32)
+    k = jnp.zeros((R,), jnp.int32)
+    p = jnp.zeros((R,), jnp.float32)
+    assert psample.compatible(hs, ws) == _accepts(
+        psample.fused_sample, h, w, words, t, k, p)
+
+
+@pytest.mark.parametrize("shape", [
+    (8, 128), (256,), (2, 3, 128), (3, 100), (5,),
+])
+def test_gate_drift_adam(shape):
+    from hetu_tpu.ops.pallas import adam as padam
+    x = jnp.zeros(shape, jnp.float32)
+    assert padam.compatible(shape) == _accepts(
+        lambda p, g, m, v: padam.adam_update(
+            p, g, m, v, 1e-3, 0.5, 0.5, b1=0.9, b2=0.95, eps=1e-8,
+            weight_decay=0.01), x, x, x, x)
 
 
 @pytest.mark.parametrize("sq,sk,d", [
@@ -440,6 +566,73 @@ def test_model_forced_pallas_parity():
     assert abs(float(l0) - float(l1)) < 1e-4
     for a, b in zip(jax.tree.leaves(g0), jax.tree.leaves(g1)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), atol=2e-3)
+
+
+def test_fused_sample_token_identity(monkeypatch):
+    """The fused sampling epilogue picks the IDENTICAL tokens as the XLA
+    path (both consume the same hash-Gumbel words and the same exact
+    logit values via the bisection over the monotone uint32 image) —
+    greedy rows, top-k, top-p and plain-temperature rows alike."""
+    from hetu_tpu.ops.pallas import sample as psample
+    from hetu_tpu.serving import sampling
+    rng = np.random.default_rng(11)
+    R, H, V = 10, 128, 256
+    hidden = jnp.asarray(rng.standard_normal((R, H)), jnp.float32)
+    w = jnp.asarray(rng.standard_normal((H, V)) * 0.2, jnp.float32)
+    seeds = jnp.arange(R, dtype=jnp.uint32) + 3
+    positions = jnp.arange(R, dtype=jnp.int32) + 5
+    temps = jnp.asarray([0.0, 1.0, 0.8, 0.0, 1.2, 1.0, 0.5, 1.0, 1.0,
+                         0.9], jnp.float32)
+    top_ks = jnp.asarray([0, 0, 20, 0, 5, 0, 0, 50, 0, 3], jnp.int32)
+    top_ps = jnp.asarray([0.0, 0.9, 0.0, 0.0, 0.95, 0.5, 0.0, 0.0, 0.8,
+                          1.0], jnp.float32)
+    monkeypatch.setenv("HETU_TPU_PALLAS", "0")
+    ref = sampling.sample_hidden(hidden, w, seeds, positions, temps,
+                                 top_ks, top_ps)
+    monkeypatch.setenv("HETU_TPU_PALLAS", "1")
+    assert psample.compatible(hidden.shape, w.shape)
+    out = sampling.sample_hidden(hidden, w, seeds, positions, temps,
+                                 top_ks, top_ps)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(ref))
+    # greedy rows are exactly the logits argmax
+    logits = np.asarray(hidden @ w)
+    np.testing.assert_array_equal(np.asarray(out)[[0, 3]],
+                                  logits.argmax(-1)[[0, 3]])
+
+
+def test_adam_kernel_parity(monkeypatch):
+    """Fused AdamW matches the XLA chain over two steps (the bias
+    corrections move) on lane-aligned f32 and bf16 leaves to 1 ulp —
+    the expression is identical but the compiled kernel body may
+    contract multiply-adds into FMAs where the op-by-op chain doesn't.
+    Ragged leaves keep the XLA path under auto routing and raise loudly
+    under the forced flag (the repo-wide forced-route convention)."""
+    from hetu_tpu.optim.optimizer import AdamW
+    from hetu_tpu.ops.pallas import adam as padam
+    params = {"w": _rand((8, 128), 1),
+              "e": _rand((256,), 2).astype(jnp.bfloat16)}
+    grads = {"w": _rand((8, 128), 3) * 0.1,
+             "e": (_rand((256,), 4) * 0.1).astype(jnp.bfloat16)}
+    opt = AdamW(lr=1e-2, weight_decay=0.01)
+    monkeypatch.setenv("HETU_TPU_PALLAS", "0")
+    p0, s0 = opt.update(grads, opt.init(params), params)
+    p0, s0 = opt.update(grads, s0, p0)
+    monkeypatch.setenv("HETU_TPU_PALLAS", "1")
+    monkeypatch.setenv("HETU_TPU_PALLAS_KERNELS", "adam")
+    p1, s1 = opt.update(grads, opt.init(params), params)
+    p1, s1 = opt.update(grads, s1, p1)
+    for a, b in zip(jax.tree.leaves((p0, s0["m"], s0["v"])),
+                    jax.tree.leaves((p1, s1["m"], s1["v"]))):
+        np.testing.assert_allclose(np.asarray(a, np.float32),
+                                   np.asarray(b, np.float32),
+                                   rtol=3e-7, atol=1e-8)
+    # ragged leaf: auto gate says no, forced flag raises loudly
+    assert not padam.compatible((5,))
+    with pytest.raises(ValueError, match="lane-aligned"):
+        opt.update({"w": grads["w"], "e": grads["e"],
+                    "b": _rand((5,), 5)},
+                   opt.init({**params, "b": _rand((5,), 5)}),
+                   {**params, "b": _rand((5,), 5)})
 
 
 # ---------------------------------------------------------------------------
@@ -622,15 +815,20 @@ def test_kernel_traffic_acceptance():
                                 intermediate=4096, num_layers=12,
                                 q_heads=12, kv_heads=12, head_dim=128)
     assert set(rep) == {"norm", "swiglu", "rotary", "flash", "quant",
-                        "paged_attn", "paged_attn_int8"}
+                        "paged_attn", "paged_attn_int8",
+                        "paged_attn_int4", "paged_verify", "sample",
+                        "adam"}
     for r in rep.values():
         assert r["fused_bytes"] > 0
         assert r["unfused_bytes"] > r["fused_bytes"]
     # the int8-page kernel reads ~1/elem_bytes the cache payload of the
-    # fp kernel AND skips the dequantized dense round trip
+    # fp kernel AND skips the dequantized dense round trip; int4 halves
+    # the payload again
     assert rep["paged_attn_int8"]["fused_bytes"] < \
         rep["paged_attn"]["fused_bytes"]
     assert rep["paged_attn_int8"]["reduction"] >= 3.0
+    assert rep["paged_attn_int4"]["fused_bytes"] < \
+        rep["paged_attn_int8"]["fused_bytes"]
     roof = kernel_roofline(rep)
     assert roof["norm"]["speedup"] >= 3.0
     assert all(v["fused_s"] > 0 for v in roof.values())
@@ -638,14 +836,18 @@ def test_kernel_traffic_acceptance():
 
 def test_bench_detail_kernels_record():
     """bench.py's detail.kernels producer (the tools_bench_kernels
-    section): all six kernels, norm >= 3x."""
+    section): every kernel row, norm >= 3x, and the fused verify chain
+    acceptance gate (>= 2x fewer HBM bytes than gather at k=4)."""
     import bench
     rec = bench._hardware_free_kernels(batch=2, seq=512)
     assert set(rec) == {"norm", "swiglu", "rotary", "flash", "quant",
-                        "paged_attn", "paged_attn_int8"}
+                        "paged_attn", "paged_attn_int8",
+                        "paged_attn_int4", "paged_verify", "sample",
+                        "adam", "fused_verify_chain"}
     assert rec["norm"]["reduction"] >= 3.0
     assert rec["paged_attn"]["reduction"] >= 3.0
     assert rec["paged_attn_int8"]["reduction"] >= 3.0
+    assert rec["fused_verify_chain"]["reduction"] >= 2.0
     from tools_bench_kernels import kernel_section
     assert kernel_section(2, 512) == rec
 

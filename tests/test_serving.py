@@ -67,8 +67,25 @@ def test_kv_bytes_analytic():
     assert fp32 / int8 >= 3.5
     fp16 = kv_bytes_per_token(12, 12, 128, "fp16")
     assert 1.8 <= fp16 / int8 <= 2.0
+    # nibble-packed int4 pages: >= 7x smaller than fp32 (the ISSUE
+    # floor; 7.53x at head_dim 128 with the per-head f32 scale counted)
+    int4 = kv_bytes_per_token(12, 12, 128, "int4")
+    assert fp32 / int4 >= 7.0
+    assert 1.8 <= int8 / int4 <= 2.0
     with pytest.raises(ValueError):
         kv_bytes_per_token(2, 2, 16, "fp8")
+
+
+def test_quantize_heads_int4_roundtrip_error_bound():
+    """bits=4 packs two codes per byte: payload is [..., head_dim//2]
+    uint8, round-trip error within the 4-bit grid (absmax/14)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(2, 5, 3, 16)) * 3.0, jnp.float32)
+    q, s = quantize_heads(x, bits=4)
+    assert q.shape == (2, 5, 3, 8) and q.dtype == jnp.uint8
+    back = dequantize_heads(q, s, bits=4)
+    bound = np.abs(np.asarray(x)).max(axis=-1, keepdims=True) / 14.0 + 1e-6
+    assert (np.abs(np.asarray(back) - np.asarray(x)) <= bound).all()
 
 
 def test_quantize_heads_roundtrip_error_bound():

@@ -125,10 +125,11 @@ def test_combined_chaos_survivors_token_identical(tiny_llama, mode):
     assert retried >= 1, "no trace carries the retry attempt index"
 
 
-@pytest.mark.parametrize("quant", ["none", "int8"])
+@pytest.mark.parametrize("quant", ["none", "int8", "int4"])
 def test_kv_repage_parity_both_dtypes_prefix_chain(tiny_llama, quant):
     """KV re-paging parity: a forced tier storm with live paged KV —
-    payload AND int8 scales migrated through the hot-switch machinery,
+    payload AND quantized scales migrated through the hot-switch
+    machinery (int4's nibble-packed half-width payload included),
     with a radix-prefix-cache-shared chain riding the same pool —
     produces byte-identical tokens to the undisturbed run."""
     model, params = tiny_llama

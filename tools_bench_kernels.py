@@ -50,7 +50,8 @@ def main(argv=None) -> int:
     ap.add_argument("--chain", metavar="KERNEL", default=None,
                     help="print one kernel's unfused op chain (norm, "
                          "swiglu, rotary, quant, flash, paged_attn, "
-                         "paged_attn_int8)")
+                         "paged_attn_int8, paged_attn_int4, "
+                         "paged_verify, sample, adam)")
     args = ap.parse_args(argv)
 
     if args.chain:
@@ -76,6 +77,15 @@ def main(argv=None) -> int:
             "paged_attn_int8": lambda: t.paged_attn_traffic(
                 8, 16, 16, cfg.num_key_value_heads, cfg.head_dim,
                 quant="int8"),
+            "paged_attn_int4": lambda: t.paged_attn_traffic(
+                8, 16, 16, cfg.num_key_value_heads, cfg.head_dim,
+                quant="int4"),
+            "paged_verify": lambda: t.paged_verify_traffic(
+                8, 4, 16, 16, cfg.num_key_value_heads, cfg.head_dim,
+                quant="int8"),
+            "sample": lambda: t.sample_traffic(
+                8 * 5, cfg.hidden_size, cfg.vocab_size),
+            "adam": lambda: t.adam_traffic(cfg.num_params()),
         }
         if args.chain not in builders:
             print(f"unknown kernel {args.chain!r}; "

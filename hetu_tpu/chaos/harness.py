@@ -34,6 +34,8 @@ _REPORT_COUNTERS = (
     "chaos.injected_worker_kill", "chaos.injected_ckpt_corrupt",
     "chaos.injected_slow_worker", "chaos.injected_engine_kill",
     "chaos.injected_reshard_storm", "chaos.injected_decode_stall",
+    "chaos.injected_shipment_drop", "chaos.injected_shipment_dup",
+    "chaos.injected_shipment_delay", "chaos.injected_prefill_kill",
     "rpc.disconnects", "rpc.reconnects", "rpc.reattaches",
     "rpc.heartbeat_lost", "rpc.workers_lost",
     "rpc.telemetry_pushes", "rpc.telemetry_push_failures",
@@ -459,6 +461,202 @@ def run_serving_chaos_demo(workdir: str, plan: FaultPlan, *,
     }
 
 
+def run_disagg_chaos_demo(workdir: str, plan: FaultPlan, *,
+                          requests: int = 16, rate: float = 60.0,
+                          burst: int = 6, num_slots: int = 2,
+                          retry_budget: int = 3,
+                          ship_timeout: int = 4, ship_retry: int = 2,
+                          ship_quant: str = "none",
+                          fallback: bool = True,
+                          seed: int = 0) -> Dict[str, Any]:
+    """The ``disagg-storm`` scenario: a burst-arrival trace through the
+    REAL disaggregated pair — a PrefillWorker tier feeding a decode
+    ServingEngine over the acked at-least-once shipment channel
+    (serving/disagg.py, tiny llama on CPU) — while the plan's
+    ``shipment_drop``/``shipment_dup``/``shipment_delay`` kinds mangle
+    the wire and its ``prefill_kill`` specs drop the tier mid-run.
+
+    Every request that survives to ``length``/``eos`` must be
+    TOKEN-IDENTICAL to the single-engine colocated run of the same
+    trace (the report carries the check): re-sent shipments dedupe on
+    seq, lost ones re-prefill under the retry budget, and a dead tier
+    degrades to colocated chunked prefill (stall reason
+    ``prefill_tier_down``) until the down-window passes.  The recovery
+    report carries the shipment/degraded counters plus the per-class
+    SLO sections from `serving/slo_report.py`."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu import serving
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.obs.metrics import MetricsRegistry
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.serving import slo_report
+    from hetu_tpu.serving.disagg import DisaggCoordinator, PrefillWorker
+
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(seed))
+    classes = [serving.SLOClass("gold", ttft_s=0.5, priority=2),
+               serving.SLOClass("bulk")]
+
+    def _reqs():
+        arrivals = serving.bursty_arrivals(requests, rate, burst=burst,
+                                           seed=seed)
+        return serving.synthetic_requests(
+            requests, vocab_size=cfg.vocab_size, prompt_lens=(3, 16),
+            max_new=(3, 8), arrivals=arrivals, slo_classes=classes,
+            seed=seed)
+
+    def _cfg(**kw):
+        return serving.ServeConfig(num_slots=num_slots, page_size=8,
+                                   max_len=32, prefill_chunk=8, **kw)
+
+    # the colocated golden: same trace, one engine, no tiers
+    base = serving.ServingEngine(model, params, _cfg(),
+                                 registry=MetricsRegistry())
+    gold = {r.rid: r.tokens for r in base.run(_reqs())}
+
+    registry = MetricsRegistry()
+    log_path = os.path.join(workdir, "disagg_chaos.jsonl")
+    run_log = RunLog(log_path)
+    tracer = serving.RequestTracer(run_log=run_log, registry=registry)
+    decode = serving.ServingEngine(
+        model, params, _cfg(retry_budget=retry_budget),
+        registry=registry, run_log=run_log, tracer=tracer)
+    worker = PrefillWorker(model, params, prefill_chunk=8, max_len=32,
+                           registry=registry)
+    coord = DisaggCoordinator(worker, decode, plan=plan,
+                              ship_timeout=ship_timeout,
+                              ship_retry=ship_retry,
+                              ship_quant=ship_quant, fallback=fallback)
+    results = coord.run(_reqs())
+    run_log.close()
+
+    reasons: Dict[str, int] = {}
+    mismatches = []
+    for r in results:
+        reasons[r.finished_reason] = reasons.get(r.finished_reason, 0) + 1
+        if r.finished_reason in ("length", "eos") \
+                and r.tokens != gold.get(r.rid):
+            mismatches.append(r.rid)
+    snap = registry.snapshot()
+    names = ("serve.ship_sent", "serve.ship_acked",
+             "serve.ship_dedups", "serve.ship_resends",
+             "serve.disagg_reprefills", "serve.colocated_prefills",
+             "serve.prefill_tier_kills", "serve.degraded_entries",
+             "serve.retry_exhausted", "serve.tier_prefill_chunks")
+    faults: Dict[str, float] = {}
+    for rec in snap["counters"]:
+        if rec["name"] in names or rec["name"].startswith("chaos."):
+            faults[rec["name"]] = faults.get(rec["name"], 0) \
+                + rec["value"]
+    return {
+        "completed": len(results) == requests,
+        "requests": len(results),
+        "token_identical": not mismatches,
+        "mismatched_rids": mismatches,
+        "injected": plan.summary(),
+        "finished_reasons": dict(sorted(reasons.items())),
+        "faults": faults,
+        "disagg": coord.summary(),
+        "slo": slo_report.serving_report(RunLog.read(log_path)),
+        "runlog": log_path,
+    }
+
+
+def run_frontend_chaos_demo(workdir: str, plan: FaultPlan, *,
+                            requests: int = 16, rate: float = 60.0,
+                            burst: int = 6, replicas: int = 2,
+                            num_slots: int = 2, retry_budget: int = 2,
+                            hedge_after: int = 0,
+                            seed: int = 0) -> Dict[str, Any]:
+    """The ``frontend-partition`` scenario: the multi-replica frontend
+    (serving/frontend.py) routing a burst trace over N real engines
+    while the plan's ``engine_kill`` windows partition replicas away
+    mid-run.  The frontend detects each death from the health digest,
+    fails the replica over, drains its queue and reroutes every pulled
+    request to the survivors; rejoin happens when the window passes.
+    Survivors must be token-identical to the single-engine run (decode
+    math is row-independent, so the replica a request lands on never
+    changes its stream).  With ``hedge_after`` > 0 stuck queued
+    requests are hedged to a second replica and the duplicate result
+    is deduped by rid."""
+    import jax
+    import jax.numpy as jnp
+    from hetu_tpu import serving
+    from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+    from hetu_tpu.obs.metrics import MetricsRegistry
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.serving import slo_report
+    from hetu_tpu.serving.frontend import Frontend
+
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    params = model.init(jax.random.key(seed))
+    classes = [serving.SLOClass("gold", ttft_s=0.5, priority=2),
+               serving.SLOClass("bulk")]
+
+    def _reqs():
+        arrivals = serving.bursty_arrivals(requests, rate, burst=burst,
+                                           seed=seed)
+        return serving.synthetic_requests(
+            requests, vocab_size=cfg.vocab_size, prompt_lens=(3, 16),
+            max_new=(3, 8), arrivals=arrivals, slo_classes=classes,
+            seed=seed)
+
+    def _cfg(**kw):
+        return serving.ServeConfig(num_slots=num_slots, page_size=8,
+                                   max_len=32, prefill_chunk=8, **kw)
+
+    base = serving.ServingEngine(model, params, _cfg(),
+                                 registry=MetricsRegistry())
+    gold = {r.rid: r.tokens for r in base.run(_reqs())}
+
+    registry = MetricsRegistry()
+    log_path = os.path.join(workdir, "frontend_chaos.jsonl")
+    run_log = RunLog(log_path)
+    engines = [serving.ServingEngine(
+        model, params, _cfg(retry_budget=retry_budget),
+        registry=registry, run_log=run_log if i == 0 else None)
+        for i in range(replicas)]
+    fe = Frontend(engines, plan=plan, hedge_after=hedge_after,
+                  registry=registry)
+    results = fe.run(_reqs())
+    run_log.close()
+
+    reasons: Dict[str, int] = {}
+    mismatches = []
+    for r in results:
+        reasons[r.finished_reason] = reasons.get(r.finished_reason, 0) + 1
+        if r.finished_reason in ("length", "eos") \
+                and r.tokens != gold.get(r.rid):
+            mismatches.append(r.rid)
+    snap = registry.snapshot()
+    faults: Dict[str, float] = {}
+    for rec in snap["counters"]:
+        if rec["name"].startswith(("chaos.", "serve.frontend",
+                                   "serve.hedge", "serve.failovers",
+                                   "serve.replica_requeues",
+                                   "serve.retry_exhausted")):
+            faults[rec["name"]] = faults.get(rec["name"], 0) \
+                + rec["value"]
+    return {
+        "completed": len(results) == requests,
+        "requests": len(results),
+        "token_identical": not mismatches,
+        "mismatched_rids": mismatches,
+        "injected": plan.summary(),
+        "finished_reasons": dict(sorted(reasons.items())),
+        "faults": faults,
+        "frontend": fe.summary(),
+        "replicas": fe.digests(),
+        "slo": slo_report.serving_report(RunLog.read(log_path)),
+        "runlog": log_path,
+    }
+
+
 def run_fleet_chaos_demo(workdir: str, plan: FaultPlan, *,
                          requests: int = 5000, rate: float = 2000.0,
                          burst: int = 16, num_slots: int = 16,
@@ -606,6 +804,42 @@ def named_plan(name: str, **kw) -> FaultPlan:
                       count=kw.get("count", 200),
                       delay_s=kw.get("delay_s", 0.02)),
         ])
+    if name == "disagg-storm":
+        # the disaggregated scenario (run_disagg_chaos_demo): the
+        # prefill->decode shipment wire drops, duplicates and delays
+        # KV shipments while two prefill_kill specs drop the tier —
+        # once one-shot, once with a down-window long enough that new
+        # arrivals degrade to colocated chunked prefill.  Survivors
+        # stay token-identical to the colocated run (the report pins
+        # it); the dedupe/resend/re-prefill counters account for every
+        # mangled shipment.
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="shipment_drop", op="ship",
+                      after_calls=kw.get("after_calls", 1), count=2,
+                      prob=1.0),
+            FaultSpec(kind="shipment_dup", op="ship", after_calls=4,
+                      count=2, prob=1.0),
+            FaultSpec(kind="shipment_delay", op="ship", after_calls=7,
+                      count=2, prob=1.0,
+                      delay_s=kw.get("delay_s", 2.0)),
+            FaultSpec(kind="shipment_drop", op="ack", after_calls=2,
+                      count=2, prob=1.0),
+            FaultSpec(kind="prefill_kill",
+                      at_step=kw.get("at_step", 6)),
+            FaultSpec(kind="prefill_kill", at_step=9,
+                      count=kw.get("count", 4)),
+        ])
+    if name == "frontend-partition":
+        # the frontend scenario (run_frontend_chaos_demo): replica 1
+        # partitions away for a window mid-run — the frontend's health
+        # check fails it over, drains its queue onto the survivors and
+        # rejoins it when the window passes; survivors replay
+        # token-identically under the retry budget
+        return FaultPlan(seed=kw.get("seed", 0), faults=[
+            FaultSpec(kind="engine_kill", rank=kw.get("rank", 1),
+                      at_step=kw.get("at_step", 3),
+                      count=kw.get("count", 4)),
+        ])
     if name == "stall":
         # a heartbeat stall longer than the server timeout: the classic
         # long-XLA-compile false positive — the stalled worker is declared
@@ -617,4 +851,5 @@ def named_plan(name: str, **kw) -> FaultPlan:
     raise ValueError(f"unknown schedule {name!r}; known: "
                      "kill-partition-corrupt, partition, corrupt, stall, "
                      "slow, serve-burst, serve-preempt, serve-failover, "
-                     "serve-brownout, fleet-storm")
+                     "serve-brownout, fleet-storm, disagg-storm, "
+                     "frontend-partition")

@@ -10,7 +10,13 @@
   (`maybe_chaos_serving`): fail the engine over at a scheduled step
   (in-flight requests requeue under HETU_TPU_SERVE_RETRY) or pin the
   LoadAdaptiveMesh onto a flip-flopping tier for a window (exercising
-  KV re-paging, HETU_TPU_SERVE_KV_REPAGE).
+  KV re-paging, HETU_TPU_SERVE_KV_REPAGE);
+* `prefill_kill` — the disaggregated-tier fault
+  (`maybe_chaos_disagg`): kill the prefill tier at a scheduled
+  coordinator step (in-flight prefills are lost; decode replicas fall
+  back to colocated chunked prefill for the down-window).  The
+  shipment_* wire kinds are consulted by the shipment channel itself
+  (`FaultPlan.shipment_fault`), not here.
 
 Checkpoint-corruption details (the `ckpt_corrupt` fault kind):
 
@@ -71,6 +77,32 @@ def maybe_chaos_serving(plan, engine, step: int,
         tier = off % len(engine.reshard.tiers)
         engine.reshard.force_tier(tier)
         out["forced_tier"] = tier
+    return out
+
+
+def maybe_chaos_disagg(plan, coordinator, step: int,
+                       rank: Optional[int] = None) -> dict:
+    """Apply the disaggregated-tier fault kinds for coordinator step
+    `step` (called from the coordinator's step loop; no plan = one None
+    check).  Returns what fired:
+    ``{"prefill_killed": bool, "prefill_down": bool}``.
+
+    * `prefill_kill` — one-shot: `coordinator.kill_prefill_tier()`
+      drops every in-flight prefill (their shipments never arrive, so
+      the at-least-once timeout re-prefills each under the shipment
+      retry budget).
+    * the `prefill_down` window — while True, the coordinator routes
+      new admissions through colocated chunked prefill on the decode
+      tier (stall reason `prefill_tier_down`, metered as degraded-mode
+      seconds) and auto-recovers when the window passes.
+    """
+    out = {"prefill_killed": False, "prefill_down": False}
+    if plan is None:
+        return out
+    if plan.should_kill_prefill(step, rank):
+        coordinator.kill_prefill_tier()
+        out["prefill_killed"] = True
+    out["prefill_down"] = plan.prefill_down(step, rank)
     return out
 
 

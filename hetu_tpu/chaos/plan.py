@@ -36,6 +36,21 @@ consult at well-defined injection points —
                                      clock: a deterministic per-step
                                      delay window (a compile storm, a
                                      straggling reshard, faked)
+    shipment_drop / shipment_dup /   the disaggregated prefill→decode KV
+    shipment_delay                   shipment wire (one ship or ack
+                                     exchange; op "ship" | "ack" | "*")
+                                     — a lost, duplicated, or delayed
+                                     shipment the at-least-once protocol
+                                     must absorb (docs/serving.md,
+                                     "Disaggregated serving")
+    prefill_kill                     the prefill tier — dies at a given
+                                     COORDINATOR step: in-flight
+                                     prefills are lost (their shipments
+                                     never arrive → timeout →
+                                     re-prefill), and decode replicas
+                                     fall back to colocated chunked
+                                     prefill for the spec's
+                                     ``count``-step down-window
 
 Everything is deterministic given the plan: trigger windows are counted in
 *matching calls* (not wall time), and probabilistic faults draw from one
@@ -59,8 +74,11 @@ from typing import Any, Dict, List, Optional
 
 KINDS = ("rpc_drop", "rpc_delay", "rpc_dup",
          "heartbeat_stall", "worker_kill", "ckpt_corrupt", "slow_worker",
-         "engine_kill", "reshard_storm", "decode_stall")
+         "engine_kill", "reshard_storm", "decode_stall",
+         "shipment_drop", "shipment_dup", "shipment_delay",
+         "prefill_kill")
 _WIRE_KINDS = ("rpc_drop", "rpc_delay", "rpc_dup")
+_SHIP_KINDS = ("shipment_drop", "shipment_dup", "shipment_delay")
 CORRUPT_MODES = ("flip", "truncate", "delete")
 
 
@@ -69,7 +87,8 @@ class FaultSpec:
     """One scheduled fault.  Schedule fields (set by the plan author):
 
     kind         one of KINDS
-    op           rpc op pattern for rpc_* kinds ("*" = any op)
+    op           rpc op pattern for rpc_* kinds; shipment op pattern
+                 ("ship" | "ack") for shipment_* kinds ("*" = any op)
     rank         restrict to one client rank (None = any rank)
     after_calls  skip this many matching calls before firing (rpc_* /
                  heartbeat_stall: matching beats via at_beat instead)
@@ -77,12 +96,16 @@ class FaultSpec:
                  count > 1 models a partition that eats several messages)
     prob         per-match firing probability (drawn from the plan's
                  seeded stream — deterministic)
-    delay_s      rpc_delay: added latency per fired call
+    delay_s      rpc_delay / shipment_delay: added latency per fired
+                 call (shipment_delay: virtual seconds the delivery is
+                 deferred by)
     at_step      worker_kill / ckpt_corrupt: trigger once the observed
                  training step reaches this value; slow_worker /
                  decode_stall: first slowed step (with `count` following
                  steps slowed and `delay_s` added per step);
                  engine_kill: the engine step the replica dies at;
+                 prefill_kill: the coordinator step the prefill tier
+                 dies at (`count` steps of down-window before rejoin);
                  reshard_storm: first stormed engine step (`count`
                  steps force a tier flip each)
     at_beat      heartbeat_stall: fire at this beat index
@@ -191,6 +214,76 @@ class FaultPlan:
         if fired is not None:
             _reg().inc(f"chaos.injected_{fired.kind}", op=op)
         return fired
+
+    def shipment_fault(self, op: str,
+                       rank: Optional[int] = None) -> Optional[FaultSpec]:
+        """Consulted by the disaggregated shipment channel once per
+        ship/ack exchange (op is "ship" or "ack").  Same matching-call
+        window semantics as `wire_fault`: every matching shipment_*
+        spec's counter advances, the first covering spec fires; None =
+        deliver the shipment untouched.  `rank` selects the decode
+        replica the shipment is bound for."""
+        fired = None
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind not in _SHIP_KINDS:
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                if spec.op != "*" and spec.op != op:
+                    continue
+                idx = spec.seen
+                spec.seen += 1
+                if idx < spec.after_calls or \
+                        idx >= spec.after_calls + spec.count:
+                    continue
+                if spec.prob < 1.0 and self._rng.random() >= spec.prob:
+                    continue
+                if fired is None:
+                    spec.injected += 1
+                    fired = spec
+        if fired is not None:
+            _reg().inc(f"chaos.injected_{fired.kind}", op=op)
+        return fired
+
+    def should_kill_prefill(self, step: int,
+                            rank: Optional[int] = None) -> bool:
+        """One-shot: True when a prefill_kill spec has its at_step
+        reached on the COORDINATOR-step clock (the disagg layer then
+        drops every in-flight prefill; their shipments never arrive and
+        the timeout path re-prefills them)."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "prefill_kill" or spec.done:
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                if step >= (spec.at_step or 0):
+                    spec.done = True
+                    spec.injected += 1
+                    break
+            else:
+                return False
+        _reg().inc("chaos.injected_prefill_kill")
+        return True
+
+    def prefill_down(self, step: int,
+                     rank: Optional[int] = None) -> bool:
+        """Is the prefill tier inside a prefill_kill down-window at this
+        step?  The window is [at_step, at_step + count): while down,
+        decode replicas run colocated chunked prefill (the graceful
+        degradation path) and the tier rejoins when the window passes.
+        Pure read: no latch, no counter."""
+        with self._lock:
+            for spec in self.faults:
+                if spec.kind != "prefill_kill":
+                    continue
+                if not self._rank_matches(spec, rank):
+                    continue
+                start = spec.at_step or 0
+                if start <= step < start + max(spec.count, 1):
+                    return True
+        return False
 
     def heartbeat_stall(self, beat: int, rank: Optional[int]) -> float:
         """Seconds the heartbeat loop should freeze before this beat

@@ -24,7 +24,8 @@ Kind fields:
     straggler     stragglers (flagged ranks), workers (per-rank
                   ratio/z) — the cluster straggler report transitions
     serve         event (admit | done | preempt | reshard | report |
-                  failover | retry | evict | expired | shed) + the
+                  failover | retry | evict | expired | shed | ship |
+                  degraded | replica | hedge | hedge_win) + the
                   serving SLO fields (hetu_tpu/serving,
                   docs/serving.md); every event also stamps `now`
                   (driver-clock seconds — the engine's virtual clock,
@@ -58,12 +59,28 @@ Kind fields:
                   engine fail_over (chaos engine_kill);
                   retry: req, slot, attempt, tokens_discarded — a
                   request requeued under HETU_TPU_SERVE_RETRY
-                  (stall reason replica_lost);
+                  (stall reason replica_lost); disaggregated
+                  re-prefills stamp ship=true (the shipment was lost/
+                  timed out, stall reason shipment_wait);
                   evict/expired/shed: req, reason (retry_exhausted |
                   deadline_exceeded | brownout_shed), tokens, e2e_s,
                   retries, preemptions, queue_depth (+ the cost fields
                   for live casualties) — fault terminations
-                  (HETU_TPU_SERVE_RETRY / _DEADLINE / _BROWNOUT)
+                  (HETU_TPU_SERVE_RETRY / _DEADLINE / _BROWNOUT);
+                  ship: req, seq, attempt, resend, quant — one per KV
+                  shipment sent on the prefill->decode wire
+                  (HETU_TPU_SERVE_DISAGG, serving/disagg.py);
+                  degraded: state (enter | exit), queue_depth on enter,
+                  degraded_s on exit — the colocated-fallback window
+                  while the prefill tier is down;
+                  replica: replica, state (drain | rejoin | down) —
+                  frontend replica health transitions
+                  (serving/frontend.py);
+                  hedge: req, primary, hedge, waited_steps — a hedged
+                  re-dispatch fired (HETU_TPU_SERVE_HEDGE);
+                  hedge_win: req, primary, hedge, tokens — the hedge
+                  copy finished first (the primary's duplicate stream
+                  is withdrawn and its tokens discarded)
     span          the serving flight recorder (HETU_TPU_SERVE_TRACE,
                   hetu_tpu/serving/tracing.py, schema owned by
                   obs/spans.py): span_schema (version), span (queued |
@@ -75,7 +92,8 @@ Kind fields:
                   requeued attempts stamp attempt >= 2), plus
                   per-kind attrs: queued carries reason
                   (none|no_slot|no_pages|preempted|quota_exceeded|
-                  replica_lost|brownout_shed — the scheduler's
+                  replica_lost|brownout_shed|prefill_tier_down|
+                  shipment_wait — the scheduler's
                   reserve-on-admit stall attribution,
                   obs/spans.py STALL_REASONS), prefill carries
                   chunk (+ last on the TTFT chunk), decode carries

@@ -68,8 +68,16 @@ TERMINAL_KINDS = ("done", "evicted", "deadline_exceeded")
 #: sustained-pressure brownout policy shed (HETU_TPU_SERVE_BROWNOUT) —
 #: lowest-priority tenants first; the terminal span is ``evicted``
 #: with ``reason="brownout_shed"``.
+#: ``prefill_tier_down`` is the disaggregated-serving degradation stamp
+#: (HETU_TPU_SERVE_DISAGG, serving/disagg.py): the request's prefill
+#: tier was dead, so it queued for COLOCATED chunked prefill on the
+#: decode replica instead — sticky, like the other fault stamps.
+#: ``shipment_wait`` marks a queued span that waited on a prefill-tier
+#: KV shipment (a dropped/delayed wire exchange under chaos) rather
+#: than on decode capacity.
 STALL_REASONS = ("none", "no_slot", "no_pages", "preempted",
-                 "quota_exceeded", "replica_lost", "brownout_shed")
+                 "quota_exceeded", "replica_lost", "brownout_shed",
+                 "prefill_tier_down", "shipment_wait")
 
 #: span-record fields that are structure, not attrs
 _CORE_FIELDS = ("schema", "kind", "t", "span_schema", "span", "trace",

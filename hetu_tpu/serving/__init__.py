@@ -16,7 +16,14 @@ unset cannot perturb any training program.
 from hetu_tpu.serving.costs import (COST_FIELDS,  # noqa: F401
                                     CostLedger, CostModel,
                                     aggregate_costs)
-from hetu_tpu.serving.engine import ServeConfig, ServingEngine  # noqa: F401
+from hetu_tpu.serving.disagg import (DisaggCoordinator,  # noqa: F401
+                                     PrefillWorker, Shipment,
+                                     ShipmentChannel, pack_shipment,
+                                     unpack_shipment)
+from hetu_tpu.serving.engine import (ServeConfig,  # noqa: F401
+                                     ServingEngine,
+                                     first_token_from_logits)
+from hetu_tpu.serving.frontend import Frontend  # noqa: F401
 from hetu_tpu.serving.fleet import (FleetConfig,  # noqa: F401
                                     FleetSimulator, ServiceModel,
                                     analytic_models, attainment_delta,
@@ -43,7 +50,9 @@ from hetu_tpu.serving.tracing import (RequestTracer,  # noqa: F401
                                       maybe_tracer)
 
 __all__ = [
-    "ServingEngine", "ServeConfig",
+    "ServingEngine", "ServeConfig", "first_token_from_logits",
+    "DisaggCoordinator", "PrefillWorker", "Shipment", "ShipmentChannel",
+    "pack_shipment", "unpack_shipment", "Frontend",
     "FleetSimulator", "FleetConfig", "ServiceModel", "analytic_models",
     "attainment_delta", "fleet_workload",
     "CostModel", "CostLedger", "COST_FIELDS", "aggregate_costs",

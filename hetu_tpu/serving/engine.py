@@ -74,6 +74,28 @@ from hetu_tpu.utils.logging import get_logger
 logger = get_logger("serving.engine")
 
 
+def first_token_from_logits(req, logits_row, position: int, *,
+                            sampling: bool) -> int:
+    """The TTFT token from a final prefill chunk's logits row: argmax
+    (the default), or the seeded sampler for sampling requests — the
+    (seed, position) key derivation every sampling site shares.  A pure
+    function of (request, logits, position): the engine's colocated
+    prefill and the disaggregated prefill tier (serving/disagg.py) both
+    call it, which is what makes the two paths token-identical."""
+    if not (sampling and req.sampling.temperature > 0):
+        return int(np.argmax(np.asarray(logits_row)))
+    from hetu_tpu.serving.sampling import sample_tokens
+    sp = req.sampling
+    tok = sample_tokens(
+        jnp.asarray(logits_row)[None],
+        jnp.asarray([sp.seed & 0xFFFFFFFF], jnp.uint32),
+        jnp.asarray([position], jnp.int32),
+        jnp.asarray([sp.temperature], jnp.float32),
+        jnp.asarray([sp.top_k], jnp.int32),
+        jnp.asarray([sp.top_p], jnp.float32))
+    return int(np.asarray(tok)[0])
+
+
 @dataclasses.dataclass
 class ServeConfig:
     """Engine shape knobs (all static: they pick the compiled programs).
@@ -779,6 +801,93 @@ class ServingEngine:
         if self.tracer is not None:
             self.tracer.on_submit(req)
 
+    def note_remote_submit(self, req: Request,
+                           now: Optional[float] = None):
+        """Account a request whose PREFILL runs on a remote tier
+        (serving/disagg.py): the submission counters and the tracer's
+        queued span open here — on the decode replica that will own the
+        request — but the request does NOT enter the scheduler queue
+        (it admits via `adopt_prefilled` when its KV shipment lands, or
+        re-enters through `submit` on colocation fallback)."""
+        if req.sampling.temperature > 0 and not self.config.sampling:
+            raise ValueError(
+                f"request {req.rid} asks for sampling (temperature "
+                f"{req.sampling.temperature}) but the engine was built "
+                "greedy-only — set HETU_TPU_SERVE_SAMPLE=1 / "
+                "ServeConfig(sampling=True)")
+        if now is not None:
+            req.arrival_t = now
+        self._registry.inc("serve.requests_submitted")
+        self._registry.inc("serve.requests_submitted_class",
+                           slo_class=req.slo.name)
+        if self.tracer is not None:
+            self.tracer.on_submit(req)
+
+    def adopt_prefilled(self, req: Request, ks, vs, t1: int,
+                        now: float) -> bool:
+        """Adopt a prefill-tier KV shipment (serving/disagg.py): admit
+        `req` straight into a free slot (`admit_direct` — it never
+        queues), scatter the shipped scratch K/V into its pages through
+        the SAME write program colocated prefill uses, seed the stream
+        with the shipped first token, and join the decode batch.  The
+        shipment carries the full [L, max_len, n_kv, hd] scratch the
+        prefill tier computed with the identical chunk program, so pool
+        content — and therefore every subsequent decode token — is
+        byte-identical to the single-engine run.  False = no slot/
+        reservation/quota headroom right now; the caller retries next
+        step (the shipment stays pending, the dedupe seq unburned)."""
+        adm = self.scheduler.admit_direct(req, now)
+        if adm is None:
+            reason = self.scheduler.last_stall or "none"
+            self._registry.inc("serve.admission_stalls", reason=reason)
+            if self.tracer is not None:
+                self.tracer.on_stall([req.rid], reason)
+            return False
+        slot_idx, st = adm
+        if self.ledger is not None:
+            self.ledger.on_admit(req.rid, len(st.pages), now)
+        if self.tracer is not None:
+            self.tracer.on_admit(req, slot_idx, now, shared_tokens=0)
+        pages_row = np.full(self.scheduler.max_pages, PagePool.NULL_PAGE,
+                            np.int32)
+        pages_row[: len(st.pages)] = st.pages
+        tree = self._run_write(self.pool.arrays.tree(),
+                               jnp.asarray(pages_row),
+                               jnp.asarray(ks), jnp.asarray(vs))
+        self.pool.arrays = PoolArrays.from_tree(tree)
+        st.prefilling = False
+        st.pos = req.prompt_len
+        st.generated.append(int(t1))
+        st.stats.first_token_t = now
+        ttft = st.stats.ttft_s
+        self._registry.observe("serve.ttft_s", ttft)
+        self._registry.observe("serve.ttft_s_class", ttft,
+                               slo_class=req.slo.name)
+        if st.stats.queue_wait_s is not None:
+            self._registry.observe("serve.queue_wait_s",
+                                   st.stats.queue_wait_s)
+        self._registry.inc("serve.tokens_out")
+        self._registry.inc("serve.disagg_adoptions")
+        if self.tracer is not None:
+            self.tracer.on_first_token(req, slot_idx, now, chunk=0)
+        if self.health is not None:
+            self.health.observe_ttft(ttft, step=self.steps_done, t=now)
+        if self._sampled(req.rid):
+            self._log_serve(event="admit", req=req.rid,
+                            slot=slot_idx, prompt_len=req.prompt_len,
+                            chunks=0, ttft_s=ttft,
+                            queue_wait_s=st.stats.queue_wait_s, now=now,
+                            slo_class=req.slo.name, tenant=req.tenant,
+                            shared_tokens=0, disagg=True,
+                            queue_depth=self.scheduler.queue_depth,
+                            page_util=self.pool.utilization,
+                            **self._weight_fields())
+        # a max_new=1 request finishes at adoption: park its result with
+        # the between-step fault results; the next step() drains them
+        self._maybe_finish(slot_idx, st, int(t1), now,
+                           self._fault_results)
+        return True
+
     def _sampled(self, rid: int) -> bool:
         """Does `rid` emit per-request serve events?  Deterministic
         hashed 1-in-N (HETU_TPU_RUNLOG_SERVE_SAMPLE, request.py
@@ -1291,22 +1400,10 @@ class ServingEngine:
         return True
 
     def _first_token(self, req, logits_row, position: int) -> int:
-        """The TTFT token from the final prefill chunk's logits: argmax
-        (the default), or the seeded sampler for sampling requests —
-        same (seed, position) key derivation as the decode program, so
-        the whole stream is one deterministic sequence."""
-        if not (self.config.sampling and req.sampling.temperature > 0):
-            return int(np.argmax(np.asarray(logits_row)))
-        from hetu_tpu.serving.sampling import sample_tokens
-        sp = req.sampling
-        tok = sample_tokens(
-            jnp.asarray(logits_row)[None],
-            jnp.asarray([sp.seed & 0xFFFFFFFF], jnp.uint32),
-            jnp.asarray([position], jnp.int32),
-            jnp.asarray([sp.temperature], jnp.float32),
-            jnp.asarray([sp.top_k], jnp.int32),
-            jnp.asarray([sp.top_p], jnp.float32))
-        return int(np.asarray(tok)[0])
+        """The TTFT token from the final prefill chunk's logits — the
+        shared pure helper, keyed by this engine's sampling config."""
+        return first_token_from_logits(req, logits_row, position,
+                                       sampling=self.config.sampling)
 
     # ---------------------------------------------------------- prefill
     def _start_prefill(self, slot_idx: int, st, now: float):

@@ -41,6 +41,7 @@ virtual clock — same seed + trace, byte-identical `tools_fleet.py
 """
 from __future__ import annotations
 
+import collections
 import dataclasses
 import math
 from typing import Any, Dict, List, Optional, Sequence
@@ -53,7 +54,8 @@ from hetu_tpu.serving.scheduler import Scheduler
 from hetu_tpu.serving.tracing import RequestTracer
 
 #: bump when the `tools_fleet.py --json` report shape changes
-FLEET_SCHEMA = 1
+#: (2: faults.tokens_discarded + the two-tier `disagg` section)
+FLEET_SCHEMA = 2
 
 
 @dataclasses.dataclass(frozen=True)
@@ -178,6 +180,24 @@ class FleetConfig:
     brownout_page_high: float = 0.95
     brownout_queue_min: int = 1
     brownout_streak: int = 4
+    # -- disaggregated prefill/decode tiers (serving/disagg.py on the
+    #    analytic clock: prompts prefill on a separate tier that runs
+    #    CONCURRENTLY with decode, and finished KV ships over an acked
+    #    at-least-once wire driven by the chaos shipment_* kinds)
+    disagg: bool = False
+    #: prefill-tier width (concurrent prefills); 0 = num_slots
+    prefill_slots: int = 0
+    #: modeled one-way wire latency per shipment delivery
+    ship_latency_s: float = 500e-6
+    #: virtual seconds before an un-acked shipment retransmits (and,
+    #: past ``ship_retry`` resends, the request re-prefills under the
+    #: retry budget)
+    ship_timeout_s: float = 0.05
+    ship_retry: int = 2
+    #: dead prefill tier: True (default) degrades to colocated chunked
+    #: prefill on the decode tier; False is the naive model — arrivals
+    #: wait out the outage (the comparison baseline)
+    fallback: bool = True
 
 
 class _Bucket:
@@ -308,6 +328,42 @@ class FleetSimulator:
         self.shed = 0
         self.faulted = 0
         self._brownout_hot = 0
+        #: tokens emitted (counted in tokens_out) whose work was later
+        #: discarded — a preemption or a replica-death requeue threw the
+        #: partial stream away and the replay re-emits it.  The exact
+        #: reconciliation: tokens_out == sum(bucket tokens) + this.
+        self.tokens_discarded = 0
+        # ---- disaggregated prefill tier (cfg.disagg)
+        self._pf_slots = cfg.prefill_slots or cfg.num_slots
+        self._pf_arrivals: List[Request] = []
+        self._pf_queue: collections.deque = collections.deque()
+        self._pf_live: Dict[int, list] = {}   # rid -> [req, chunks, att]
+        self._pf_awaiting: Dict[int, dict] = {}
+        #: rids with a FINITE deadline (shipped, or lost to a tier
+        #: kill) — the per-step timeout scan walks only these, not the
+        #: whole awaiting backlog (O(queue) x O(steps) at fleet scale);
+        #: a dict, not a set, so iteration order is insertion order and
+        #: the determinism golden holds
+        self._pf_armed: Dict[int, None] = {}
+        self._pf_wire: List[dict] = []        # in-flight shipments
+        self._pf_finished: set = set()
+        self._pf_seq = 0
+        self._pf_degraded = False
+        self._pf_degraded_t0 = 0.0
+        self.tier_prefill_chunks = 0
+        self.ship_sent = 0
+        self.ship_dropped = 0
+        self.ship_duped = 0
+        self.ship_delayed = 0
+        self.ship_dedups = 0
+        self.ship_resends = 0
+        self.adoptions = 0
+        self.reprefills = 0
+        self.colocated = 0
+        self.prefill_kills = 0
+        self.degraded_entries = 0
+        self.degraded_steps = 0
+        self.degraded_s = 0.0
         self.steps = 0
         self.invariant_checks = 0
         self._start = 0.0
@@ -332,7 +388,13 @@ class FleetSimulator:
 
     # -------------------------------------------------------- lifecycle
     def _submit(self, req: Request):
-        self.sched.submit(req)
+        if self.cfg.disagg:
+            # two-tier intake: the request heads to the prefill tier
+            # (or the colocation fallback) at the next sim step —
+            # submission accounting and the queued span open here
+            self._pf_arrivals.append(req)
+        else:
+            self.sched.submit(req)
         self.submitted += 1
         self._enter_seq[req.rid] = self._stall_seq
         if self._sampled(req.rid):
@@ -393,6 +455,7 @@ class FleetSimulator:
             self.ledger.on_preempt(rid, now, ctx_start=st.shared_tokens,
                                    tokens_cached=st.pos)
         tokens_discarded = len(st.generated)
+        self.tokens_discarded += tokens_discarded
         self.sched.preempt(victim)
         self._enter_seq[rid] = self._stall_seq
         self._requeue_reason[rid] = "preempted"
@@ -456,6 +519,10 @@ class FleetSimulator:
         st.stats.done_t = now
         tokens = len(st.generated)
         self.sched.release(slot_idx)
+        if self.cfg.disagg:
+            self._pf_finished.add(rid)
+            self._pf_awaiting.pop(rid, None)
+            self.sched.ship_forget(rid)
         st.stats.preemptions = self._preempt_counts.pop(rid, 0)
         st.stats.retries = self.sched.retries.pop(rid, 0)
         self._requeue_reason.pop(rid, None)
@@ -536,6 +603,10 @@ class FleetSimulator:
         self._requeue_reason.pop(rid, None)
         self._first_reason.pop(rid, None)
         self._enter_seq.pop(rid, None)
+        if self.cfg.disagg:
+            self._pf_finished.add(rid)
+            self._pf_awaiting.pop(rid, None)
+            self.sched.ship_forget(rid)
         b = self._bucket(req.tenant, req.slo.name)
         b.requests += 1
         b.tokens += tokens
@@ -571,6 +642,7 @@ class FleetSimulator:
                                            ctx_start=st.shared_tokens,
                                            tokens_cached=st.pos)
                 tokens_discarded = len(st.generated)
+                self.tokens_discarded += tokens_discarded
                 sched.requeue_lost(i)
                 self._enter_seq[rid] = self._stall_seq
                 self._requeue_reason[rid] = "replica_lost"
@@ -662,6 +734,259 @@ class FleetSimulator:
             self._terminate_fault(req, None, now,
                                   reason="brownout_shed", event="shed")
 
+    # ------------------------------------------- disaggregated tier
+    def _pf_route(self, req: Request, attempt: int, now: float):
+        """Queue `req` on the prefill tier.  The shipment deadline is
+        armed only once a shipment exists (or a tier kill loses the
+        prefill) — a healthy tier's queue wait is not a wire fault."""
+        self._pf_queue.append((req, attempt))
+        self._pf_awaiting[req.rid] = {
+            "req": req, "attempt": attempt, "deadline": math.inf,
+            "shipped": False, "seq": None, "resends": 0}
+
+    def _fallback_colocate(self, req: Request, now: float):
+        """Colocated chunked prefill on the decode tier (graceful
+        degradation): the request enters the REAL scheduler queue with
+        the sticky ``prefill_tier_down`` stall stamp, and the normal
+        admission path prefills it on the decode clock."""
+        self._pf_awaiting.pop(req.rid, None)
+        self.sched.submit(req)
+        self.colocated += 1
+        self._enter_seq.setdefault(req.rid, self._stall_seq)
+        self._requeue_reason[req.rid] = "prefill_tier_down"
+
+    def _kill_prefill_tier(self, now: float):
+        """Chaos ``prefill_kill``: every queued and in-flight prefill
+        on the tier is lost; their pending entries' timeouts fire THIS
+        step, so the recovery path (re-prefill under the retry budget,
+        or colocation while degraded) runs immediately."""
+        lost = list(self._pf_live) + [r.rid for r, _ in self._pf_queue]
+        self._pf_live.clear()
+        self._pf_queue.clear()
+        self.prefill_kills += 1
+        for rid in lost:
+            p = self._pf_awaiting.get(rid)
+            if p is not None and not p["shipped"]:
+                p["deadline"] = now
+                self._pf_armed[rid] = None
+
+    def _pf_send(self, rid: int, p: dict, now: float):
+        """Put (or re-put) rid's shipment on the modeled wire, driving
+        the chaos shipment_* kinds exactly like the real channel."""
+        self.ship_sent += 1
+        plan = self.fault_plan
+        spec = plan.shipment_fault("ship") if plan is not None else None
+        due = now + self.cfg.ship_latency_s
+        if spec is not None and spec.kind == "shipment_drop":
+            self.ship_dropped += 1
+            return                  # the timeout machinery recovers it
+        if spec is not None and spec.kind == "shipment_delay":
+            due += spec.delay_s
+            self.ship_delayed += 1
+        entry = {"due": due, "rid": rid, "seq": p["seq"],
+                 "attempt": p["attempt"]}
+        self._pf_wire.append(entry)
+        if spec is not None and spec.kind == "shipment_dup":
+            self._pf_wire.append(dict(entry))
+            self.ship_duped += 1
+
+    def _pf_reprefill(self, rid: int, p: dict, now: float):
+        """Shipment unrecoverable (resends exhausted, or the tier died
+        holding the prefill): re-prefill under the decode retry budget
+        — the same `scheduler.retries` ledger replica failover bills —
+        or terminate ``retry_exhausted`` past it."""
+        req = p["req"]
+        retries = self.sched.retries.get(rid, 0)
+        if retries >= self.cfg.retry_budget:
+            self._pf_awaiting.pop(rid, None)
+            self._pf_finished.add(rid)
+            self.retry_exhausted += 1
+            if self._sampled(rid):
+                self.tracer.on_finish(req, -1, "retry_exhausted", now,
+                                      tokens=0,
+                                      e2e_s=now - req.arrival_t,
+                                      evicted=True)
+            self._terminate_fault(req, None, now,
+                                  reason="retry_exhausted",
+                                  event="evict")
+            return
+        self.sched.retries[rid] = retries + 1
+        self.reprefills += 1
+        self._requeue_reason[rid] = "shipment_wait"
+        if self._sampled(rid):
+            self._log(event="retry", req=rid, attempt=retries + 1,
+                      ship=True, tokens_discarded=0, now=now,
+                      slo_class=req.slo.name, tenant=req.tenant,
+                      **self._weight_fields())
+        if self._pf_degraded and self.cfg.fallback:
+            self._pf_awaiting.pop(rid, None)
+            self._fallback_colocate(req, now)
+        else:
+            self._pf_awaiting.pop(rid, None)
+            self._pf_route(req, p["attempt"] + 1, now)
+
+    def _pf_adopt(self, rid: int, req: Request, now: float) -> bool:
+        """Deliver one shipment: the dedupe gate, then direct admission
+        and the first-token emission — the sim's `adopt_prefilled` on
+        the analytic clock.  False = no decode capacity; the caller
+        requeues the delivery."""
+        sched = self.sched
+        adm = sched.admit_direct(req, now)
+        if adm is None:
+            reason = sched.last_stall or "none"
+            self._requeue_reason.setdefault(rid, reason)
+            return False
+        slot_idx, st = adm
+        reason = self._queued_reason(rid)
+        self._first_reason.setdefault(rid, reason)
+        self._enter_seq.pop(rid, None)
+        self._requeue_reason.pop(rid, None)
+        if self.ledger is not None:
+            self.ledger.on_admit(rid, len(st.pages), now)
+        t = req.tenant
+        peaks = self.quota_peaks.get(t)
+        if peaks is None:
+            peaks = self.quota_peaks[t] = {"slots": 0, "pages": 0}
+        peaks["slots"] = max(peaks["slots"],
+                             sched.tenant_slots.get(t, 0))
+        peaks["pages"] = max(peaks["pages"],
+                             sched.tenant_pages.get(t, 0))
+        st.prefilling = False
+        st.pos = req.prompt_len
+        st.generated.append(0)      # the shipped first token (modeled)
+        self.tokens_out += 1
+        self.adoptions += 1
+        st.stats.first_token_t = now
+        if self._sampled(rid):
+            if reason != "none":
+                self.tracer.on_stall([rid], reason)
+            self.tracer.on_admit(req, slot_idx, now, shared_tokens=0)
+            self.tracer.on_first_token(req, slot_idx, now, chunk=0)
+            self._log(event="admit", req=rid, slot=slot_idx,
+                      prompt_len=req.prompt_len, chunks=0,
+                      ttft_s=st.stats.ttft_s,
+                      queue_wait_s=st.stats.queue_wait_s, now=now,
+                      slo_class=req.slo.name, tenant=req.tenant,
+                      shared_tokens=0, disagg=True,
+                      queue_depth=sched.queue_depth,
+                      page_util=self.pool.utilization,
+                      **self._weight_fields())
+        if len(st.generated) >= req.max_new_tokens:
+            self._finish(slot_idx, st, now)
+        return True
+
+    def _disagg_step(self, now: float, step_idx: int) -> float:
+        """One prefill-tier step (runs CONCURRENTLY with decode: the
+        caller takes max(tier dt, decode dt)): chaos, degraded-state
+        transitions, arrival routing, one chunk per live prefill, wire
+        deliveries with the dedupe gate, ack/timeout processing."""
+        plan = self.fault_plan
+        sched = self.sched
+        pf_down = False
+        if plan is not None:
+            if plan.should_kill_prefill(step_idx):
+                self._kill_prefill_tier(now)
+            pf_down = plan.prefill_down(step_idx)
+        if pf_down and not self._pf_degraded:
+            self._pf_degraded = True
+            self._pf_degraded_t0 = now
+            self.degraded_entries += 1
+            self._log(event="degraded", state="enter", now=now,
+                      queue_depth=sched.queue_depth)
+        elif not pf_down and self._pf_degraded:
+            self._pf_degraded = False
+            span = now - self._pf_degraded_t0
+            self.degraded_s += span
+            self._log(event="degraded", state="exit", now=now,
+                      degraded_s=span)
+        if self._pf_degraded:
+            self.degraded_steps += 1
+        # route arrivals: degraded+fallback -> colocate; degraded
+        # without fallback (the naive baseline) -> wait out the outage
+        if self._pf_arrivals:
+            if not self._pf_degraded:
+                for req in self._pf_arrivals:
+                    self._pf_route(req, 0, now)
+                self._pf_arrivals.clear()
+            elif self.cfg.fallback:
+                for req in self._pf_arrivals:
+                    self._fallback_colocate(req, now)
+                self._pf_arrivals.clear()
+        dt = 0.0
+        if not pf_down:
+            while len(self._pf_live) < self._pf_slots \
+                    and self._pf_queue:
+                req, attempt = self._pf_queue.popleft()
+                if req.rid in self._pf_awaiting:
+                    self._pf_live[req.rid] = [req, 0, attempt]
+            for rid in list(self._pf_live):
+                ent = self._pf_live[rid]
+                req, done, attempt = ent
+                C = self.cfg.prefill_chunk
+                s = done * C
+                dt += self.service.prefill_chunk_s(C, s)
+                ent[1] = done + 1
+                self.tier_prefill_chunks += 1
+                if s + C < math.ceil(req.prompt_len / C) * C:
+                    continue
+                del self._pf_live[rid]
+                p = self._pf_awaiting.get(rid)
+                if p is None:
+                    continue        # terminated while prefilling
+                self._pf_seq += 1
+                p["shipped"] = True
+                p["seq"] = self._pf_seq
+                p["deadline"] = now + self.cfg.ship_timeout_s
+                self._pf_armed[rid] = None
+                self._pf_send(rid, p, now)
+        # wire deliveries due by now, in send order
+        due = [e for e in self._pf_wire if e["due"] <= now]
+        if due:
+            self._pf_wire = [e for e in self._pf_wire
+                             if e["due"] > now]
+            for e in due:
+                rid = e["rid"]
+                if rid in self._pf_finished \
+                        or rid not in self._pf_awaiting:
+                    self.ship_dedups += 1   # late duplicate
+                    continue
+                if not sched.apply_shipment(rid, e["seq"]):
+                    self.ship_dedups += 1
+                    continue
+                p = self._pf_awaiting[rid]
+                if self._pf_adopt(rid, p["req"], now):
+                    self._pf_awaiting.pop(rid, None)   # implicit ack
+                else:
+                    # no decode capacity: un-burn the seq, redeliver
+                    # next step, hold the sender timer
+                    sched.unapply_shipment(rid, e["seq"])
+                    e["due"] = now + self.service.step_overhead_s
+                    self._pf_wire.append(e)
+                    p["deadline"] = now + self.cfg.ship_timeout_s
+        # timeouts: resend up to the budget, then re-prefill — walking
+        # only the ARMED entries; the unshipped backlog has deadline=inf
+        # and never needs the scan
+        for rid in list(self._pf_armed):
+            p = self._pf_awaiting.get(rid)
+            if p is None or p["deadline"] == math.inf:
+                del self._pf_armed[rid]     # resolved or re-queued
+                continue
+            if now < p["deadline"]:
+                continue
+            if p["shipped"] and p["resends"] < self.cfg.ship_retry:
+                p["resends"] += 1
+                self.ship_resends += 1
+                p["deadline"] = now + self.cfg.ship_timeout_s
+                self._pf_send(rid, p, now)
+            else:
+                self._pf_reprefill(rid, p, now)
+        if dt == 0.0 and (self._pf_wire or self._pf_awaiting
+                          or self._pf_arrivals or self._pf_queue):
+            # the tier is waiting on wire/timeout events: virtual time
+            # must advance or the deliveries never come due
+            dt = self.service.step_overhead_s
+        return dt
+
     # ------------------------------------------------------------- step
     def _step(self, now: float, step_idx: int) -> float:
         """One engine-step equivalent at virtual time `now`; returns the
@@ -675,6 +1000,12 @@ class FleetSimulator:
             down = plan.engine_down(step_idx)
         if self.cfg.deadline:
             self._expire_deadlines(now)
+        pf_dt = 0.0
+        if self.cfg.disagg:
+            # the prefill tier steps CONCURRENTLY with decode:
+            # adoption/colocation it performs is visible to this step's
+            # admission loop, and the step consumes max(tier, decode)
+            pf_dt = self._disagg_step(now, step_idx)
         if not down:
             while True:
                 adm = sched.admit_next(now)
@@ -719,6 +1050,7 @@ class FleetSimulator:
                 self.tracer.on_split(survivors, now, "evict")
         if self.cfg.brownout:
             self._maybe_brownout(now)
+        dt = max(dt, pf_dt)     # disagg tiers overlap in wall-clock
         if plan is not None:
             dt += plan.step_delay(0, step_idx)
         if down:
@@ -742,7 +1074,10 @@ class FleetSimulator:
                 self._submit(reqs[i])
                 i += 1
             if not any(s is not None for s in sched.slots) \
-                    and not sched.queue:
+                    and not sched.queue \
+                    and not (self._pf_arrivals or self._pf_queue
+                             or self._pf_live or self._pf_wire
+                             or self._pf_awaiting):
                 if i >= n:
                     break
                 now = max(now, reqs[i].arrival_t)
@@ -766,6 +1101,10 @@ class FleetSimulator:
                         f"{sched.last_stall!r}, no progress possible")
                 dt = self.service.step_overhead_s
             now += dt
+        if self._pf_degraded:
+            # outage reached end-of-run: flush the open degraded span
+            self.degraded_s += now - self._pf_degraded_t0
+            self._pf_degraded = False
         self._end = now
         sched.check_invariants()
         self.invariant_checks += 1
@@ -798,6 +1137,32 @@ class FleetSimulator:
             reg.inc("serve.deadline_exceeded", value=self.expired)
         if self.shed:
             reg.inc("serve.brownout_shed", value=self.shed)
+        if self.tokens_discarded:
+            reg.inc("serve.tokens_discarded",
+                    value=self.tokens_discarded)
+        if self.cfg.disagg:
+            # same counter names the live DisaggCoordinator flushes, so
+            # one reader (slo_report/tools_obs_report) covers both
+            reg.inc("serve.tier_prefill_chunks",
+                    value=self.tier_prefill_chunks)
+            reg.inc("serve.ship_sent", value=self.ship_sent)
+            reg.inc("serve.ship_acked", value=self.adoptions)
+            if self.ship_dedups:
+                reg.inc("serve.ship_dedups", value=self.ship_dedups)
+            if self.ship_resends:
+                reg.inc("serve.ship_resends", value=self.ship_resends)
+            if self.reprefills:
+                reg.inc("serve.disagg_reprefills",
+                        value=self.reprefills)
+            if self.colocated:
+                reg.inc("serve.colocated_prefills",
+                        value=self.colocated)
+            if self.prefill_kills:
+                reg.inc("serve.prefill_tier_kills",
+                        value=self.prefill_kills)
+            if self.degraded_entries:
+                reg.inc("serve.degraded_entries",
+                        value=self.degraded_entries)
         for reason, c in sorted(self.stall_steps.items()):
             reg.inc("serve.admission_stalls", value=c, reason=reason)
         for t, peaks in sorted(self.quota_peaks.items()):
@@ -912,6 +1277,7 @@ class FleetSimulator:
                 "deadline_exceeded": self.expired,
                 "brownout_shed": self.shed,
                 "faulted": self.faulted,
+                "tokens_discarded": self.tokens_discarded,
             },
             "prefill_chunks": self.prefill_chunks,
             "stall_steps": dict(sorted(self.stall_steps.items())),
@@ -926,6 +1292,29 @@ class FleetSimulator:
             "sample": self.sample,
             "service_model": self.service.to_dict(),
         }
+        if self.cfg.disagg:
+            # two-tier section only when the tier exists: colocated
+            # runs keep the pre-disagg payload byte-identical
+            out["disagg"] = {
+                "prefill_slots": self._pf_slots,
+                "tier_prefill_chunks": self.tier_prefill_chunks,
+                "shipments": {
+                    "sent": self.ship_sent,
+                    "dropped": self.ship_dropped,
+                    "duped": self.ship_duped,
+                    "delayed": self.ship_delayed,
+                    "dedups": self.ship_dedups,
+                    "resends": self.ship_resends,
+                },
+                "adoptions": self.adoptions,
+                "reprefills": self.reprefills,
+                "colocated_prefills": self.colocated,
+                "prefill_kills": self.prefill_kills,
+                "degraded_entries": self.degraded_entries,
+                "degraded_steps": self.degraded_steps,
+                "degraded_s": self.degraded_s,
+                "fallback": self.cfg.fallback,
+            }
         if costs is not None:
             out["costs"] = costs
         if self.prefix_cache is not None:

@@ -94,6 +94,15 @@ class Scheduler:
         self.retry_budget = retry_budget
         self.retries: Dict[int, int] = {}
         self.replica_requeues = 0
+        #: disaggregated-shipment dedupe (docs/serving.md): seqs whose
+        #: KV shipment was already adopted (a redelivery of any of them
+        #: must NOT allocate — at-least-once delivery made idempotent),
+        #: the per-rid apply history for live requests (popped by
+        #: `ship_forget` at finish; check_invariants holds it dup-free),
+        #: and how many deliveries the dedupe gate absorbed
+        self.ship_seqs: set = set()
+        self.ship_applied: Dict[int, List[int]] = {}
+        self.ship_dedups = 0
         self._admit_seq = 0
         # live per-tenant usage, maintained at admit/release (the quota
         # check reads these instead of rescanning the slots each time);
@@ -223,6 +232,59 @@ class Scheduler:
         self.tenant_pages[t] = self.tenant_pages.get(t, 0) + len(pages)
         return slot_idx, st
 
+    def admit_direct(self, req: Request,
+                     now: float) -> Optional[Tuple[int, SlotState]]:
+        """Admit `req` into a free slot WITHOUT it ever entering the
+        FIFO queue — the disaggregated adoption path (serving/disagg.py):
+        the prefill tier already computed this request's KV, so the
+        decode engine admits it the moment its shipment lands instead
+        of queueing it behind colocated prefills.  Same reserve-on-admit
+        and quota rules as `admit_next`; no prefix-cache match (the
+        shipment carries the full prompt KV).  Returns None (with
+        `last_stall` set) when no slot / reservation / quota headroom —
+        the caller retries next step, the shipment stays pending."""
+        if self._reserve_tokens(req) > self.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {req.prompt_len} + max_new "
+                f"{req.max_new_tokens} exceeds max_len {self.max_len}")
+        live = {st.request.rid for st in self.slots if st is not None}
+        if req.rid in live or any(r.rid == req.rid for r in self.queue):
+            raise ValueError(
+                f"request {req.rid} is already live or queued — a "
+                "double adoption would alias its pages")
+        free = self.free_slots()
+        if not free:
+            self.last_stall = "no_slot"
+            return None
+        if not self._quota_admits(req):
+            self.last_stall = "quota_exceeded"
+            return None
+        need = self.pool.pages_for(self._reserve_tokens(req))
+        fresh = self.pool.alloc(need)
+        if fresh is None and self.prefix_cache is not None:
+            self.prefix_cache.evict(need - self.pool.free_count,
+                                    require_free=True)
+            fresh = self.pool.alloc(need)
+        if fresh is None:
+            self.last_stall = "no_pages"
+            return None
+        self.last_stall = None
+        slot_idx = free[0]
+        self._admit_seq += 1
+        st = SlotState(request=req, pages=fresh, pos=0,
+                       stats=RequestStats(arrival_t=req.arrival_t,
+                                          admit_t=now),
+                       admit_seq=self._admit_seq)
+        self.slots[slot_idx] = st
+        row = self.page_table[slot_idx]
+        row[:] = PagePool.NULL_PAGE
+        row[: len(fresh)] = fresh
+        self.admitted += 1
+        t = req.tenant
+        self.tenant_slots[t] = self.tenant_slots.get(t, 0) + 1
+        self.tenant_pages[t] = self.tenant_pages.get(t, 0) + len(fresh)
+        return slot_idx, st
+
     def _quota_admits(self, req: Request) -> bool:
         """Would admitting `req` keep its tenant within quota?  Checked
         BEFORE the pool is touched, so a quota stall never pins shared
@@ -305,6 +367,45 @@ class Scheduler:
         self.queue.append(st.request)
         return st.request
 
+    # ------------------------------------------------- disagg shipments
+    def apply_shipment(self, rid: int, seq: int) -> bool:
+        """The at-least-once dedupe gate for a delivered KV shipment
+        (serving/disagg.py): True = first delivery, the caller may
+        adopt it (`admit_direct` + KV write); False = a redelivery (the
+        seq was already adopted, or the rid is already live from an
+        earlier attempt) — the caller MUST drop it without touching the
+        pool.  Double-delivered shipments therefore can never alias
+        pages: the second delivery never allocates."""
+        if seq in self.ship_seqs:
+            self.ship_dedups += 1
+            return False
+        if any(st is not None and st.request.rid == rid
+               for st in self.slots):
+            self.ship_dedups += 1
+            return False
+        self.ship_seqs.add(seq)
+        self.ship_applied.setdefault(rid, []).append(seq)
+        return True
+
+    def unapply_shipment(self, rid: int, seq: int):
+        """Roll back an `apply_shipment` grant whose adoption could not
+        land (no slot / reservation / quota headroom): the seq is
+        un-burned so the SAME delivery can retry next step without
+        counting as a dedupe."""
+        self.ship_seqs.discard(seq)
+        seqs = self.ship_applied.get(rid)
+        if seqs is not None:
+            if seq in seqs:
+                seqs.remove(seq)
+            if not seqs:
+                del self.ship_applied[rid]
+
+    def ship_forget(self, rid: int):
+        """Drop the per-rid apply history once `rid` finished (the seq
+        set stays — late redeliveries of a finished request still hit
+        the dedupe gate)."""
+        self.ship_applied.pop(rid, None)
+
     def drop_queued(self, req: Request) -> bool:
         """Remove a still-queued request (a deadline expiry or a
         brownout shed terminates it without ever admitting); False when
@@ -337,7 +438,14 @@ class Scheduler:
           the partition/refcount checks above then hold to zero leak),
         * no rid's replica-loss requeue count exceeds the configured
           retry budget (HETU_TPU_SERVE_RETRY), and with no budget
-          configured no requeue ever happened."""
+          configured no requeue ever happened,
+        * no rid is live in TWO slots (a double-delivered disagg
+          shipment adopted twice would put one request in two slots
+          with two page sets — the aliasing the `apply_shipment`
+          dedupe gate exists to prevent),
+        * the shipment-dedupe books are coherent: no rid's applied-seq
+          history holds a duplicate, and every applied seq is in the
+          global seq set."""
         owners: Dict[int, int] = {}
         writers: Dict[int, List[int]] = {}   # slots holding p UNSHARED
         tslots: Dict[str, int] = {}
@@ -422,11 +530,28 @@ class Scheduler:
                  if self.pool.refcount[p] > 0 and p not in owners]
         if stray:
             raise AssertionError(f"refcounted pages with no owner: {stray}")
-        live_rids = {st.request.rid for st in self.slots if st is not None}
+        slot_rids = [st.request.rid for st in self.slots
+                     if st is not None]
+        live_rids = set(slot_rids)
+        if len(slot_rids) != len(live_rids):
+            dups = sorted({r for r in slot_rids
+                           if slot_rids.count(r) > 1})
+            raise AssertionError(
+                f"requests live in TWO slots (double-adopted "
+                f"shipment?): {dups}")
         both = live_rids & {r.rid for r in self.queue}
         if both:
             raise AssertionError(
                 f"requests both queued and live in a slot: {sorted(both)}")
+        for rid, seqs in self.ship_applied.items():
+            if len(set(seqs)) != len(seqs):
+                raise AssertionError(
+                    f"rid {rid} adopted a shipment seq twice: {seqs}")
+            missing = [s for s in seqs if s not in self.ship_seqs]
+            if missing:
+                raise AssertionError(
+                    f"rid {rid} applied seqs {missing} missing from "
+                    "the global dedupe set")
         over = {rid: n for rid, n in self.retries.items()
                 if n > max(self.retry_budget, 0)}
         if over:

@@ -32,7 +32,13 @@ The report answers the questions aggregate histograms cannot:
   (docs/fault_tolerance.md): replica deaths and per-class retry counts
   (HETU_TPU_SERVE_RETRY), deadline expiries and the tokens they
   discarded (HETU_TPU_SERVE_DEADLINE), and brownout sheds per class
-  (HETU_TPU_SERVE_BROWNOUT).
+  (HETU_TPU_SERVE_BROWNOUT),
+* **disaggregated serving** — the ``disagg`` section
+  (HETU_TPU_SERVE_DISAGG): KV shipments/resends on the prefill->decode
+  wire, re-prefills per class and degraded-mode (colocated-fallback)
+  seconds; and the ``frontend`` section: replica down/drain/rejoin
+  transitions plus hedged re-dispatches and hedge wins
+  (HETU_TPU_SERVE_HEDGE).
 
 Span-derived fields degrade gracefully: with ``HETU_TPU_SERVE_TRACE``
 unset there are no span records, and the report still renders the
@@ -84,6 +90,16 @@ def collect(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "retries": [r for r in serves if r.get("event") == "retry"],
         "faults": [r for r in serves
                    if r.get("event") in ("evict", "expired", "shed")],
+        # the disaggregated-serving layer (serving/disagg.py): KV
+        # shipments on the prefill->decode wire and the degraded-mode
+        # (colocated-fallback) enter/exit transitions
+        "ships": [r for r in serves if r.get("event") == "ship"],
+        "degraded": [r for r in serves if r.get("event") == "degraded"],
+        # the multi-replica frontend (serving/frontend.py): replica
+        # state changes and hedged re-dispatches
+        "replicas": [r for r in serves if r.get("event") == "replica"],
+        "hedges": [r for r in serves
+                   if r.get("event") in ("hedge", "hedge_win")],
         "traces": collect_traces(records),
         "anomalies": [r for r in records if r.get("kind") == "anomaly"],
     }
@@ -393,6 +409,66 @@ def brownout_report(collected: Dict[str, Any]
     }
 
 
+def disagg_report(collected: Dict[str, Any]
+                  ) -> Optional[Dict[str, Any]]:
+    """Disaggregated prefill/decode accounting (serving/disagg.py, from
+    the ``ship``/``degraded``/``retry`` events): KV shipments over the
+    acked wire with their resend tally, re-prefills billed to the retry
+    budget (``retry`` events carrying ``ship=True``) per class, and the
+    degraded-mode (colocated-fallback) entries with their metered
+    seconds.  None when the run never shipped or degraded — colocated
+    logs keep their report shape."""
+    ships = collected["ships"]
+    degraded = collected["degraded"]
+    if not ships and not degraded:
+        return None
+    reprefills = [r for r in collected["retries"] if r.get("ship")]
+    by_cls: Dict[str, float] = {}
+    for r in reprefills:
+        k = str(r.get("slo_class", "default"))
+        by_cls[k] = by_cls.get(k, 0) + _weight(r)
+    entries = sum(1 for d in degraded if d.get("state") == "enter")
+    degraded_s = sum(float(d.get("degraded_s") or 0.0)
+                     for d in degraded if d.get("state") == "exit")
+    return {
+        "shipments": len(ships),
+        "resends": sum(1 for s in ships if s.get("resend")),
+        "reprefills": _int_if_whole(
+            sum(_weight(r) for r in reprefills)),
+        "reprefills_by_class": {k: _int_if_whole(v)
+                                for k, v in sorted(by_cls.items())},
+        "degraded_entries": entries,
+        "degraded_s": degraded_s,
+    }
+
+
+def frontend_report(collected: Dict[str, Any]
+                    ) -> Optional[Dict[str, Any]]:
+    """Multi-replica frontend accounting (serving/frontend.py, from the
+    ``replica``/``hedge``/``hedge_win`` events): replica health
+    transitions (down / drain / rejoin) and hedged re-dispatches with
+    how many the hedge copy actually won.  None when the log carries no
+    frontend events — single-replica logs keep their report shape."""
+    replicas = collected["replicas"]
+    hedges = collected["hedges"]
+    if not replicas and not hedges:
+        return None
+    states: Dict[str, int] = {}
+    for r in replicas:
+        k = str(r.get("state", "unknown"))
+        states[k] = states.get(k, 0) + 1
+    hedged = [h for h in hedges if h.get("event") == "hedge"]
+    wins = [h for h in hedges if h.get("event") == "hedge_win"]
+    return {
+        "replica_events": dict(sorted(states.items())),
+        "replicas_down": states.get("down", 0),
+        "hedges": len(hedged),
+        "hedge_wins": len(wins),
+        "hedge_waited_steps": _pcts(
+            [h.get("waited_steps") for h in hedged]),
+    }
+
+
 def stall_breakdown(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     """How queued time attributes across the scheduler's stall reasons
     (span-traced runs only): request counts and total queued seconds per
@@ -485,6 +561,12 @@ def serving_report(records: Iterable[Dict[str, Any]], *,
     bo = brownout_report(collected)
     if bo is not None:
         out["brownout"] = bo
+    dg = disagg_report(collected)
+    if dg is not None:
+        out["disagg"] = dg
+    fe = frontend_report(collected)
+    if fe is not None:
+        out["frontend"] = fe
     if collected["anomalies"]:
         by_kind: Dict[str, int] = {}
         for a in collected["anomalies"]:
@@ -614,6 +696,23 @@ def render_text(report: Dict[str, Any]) -> str:
     if bo:
         by = ", ".join(f"{k}={v}" for k, v in bo["by_class"].items())
         lines.append(f"brownout: {bo['shed']} queued requests shed ({by})")
+    dg = report.get("disagg")
+    if dg:
+        by = ", ".join(f"{k}={v}" for k, v in
+                       dg["reprefills_by_class"].items())
+        lines.append(
+            f"disagg: {dg['shipments']} KV shipments "
+            f"({dg['resends']} resent), {dg['reprefills']} re-prefills"
+            + (f" ({by})" if by else "")
+            + f"; degraded {dg['degraded_entries']}x for "
+            f"{dg['degraded_s']:.3g}s")
+    fe = report.get("frontend")
+    if fe:
+        ev = ", ".join(f"{k}={v}" for k, v in
+                       fe["replica_events"].items())
+        lines.append(
+            f"frontend: replica events [{ev}], {fe['hedges']} hedges, "
+            f"{fe['hedge_wins']} hedge wins")
     if report.get("anomalies"):
         lines.append("anomalies: " + ", ".join(
             f"{k}={n}" for k, n in sorted(report["anomalies"].items())))

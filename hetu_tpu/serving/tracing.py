@@ -121,15 +121,17 @@ class RequestTracer:
         """The scheduler declined admission this step; stamp the
         reserve-on-admit reason on every still-queued request (the
         LAST observed reason wins — it names what the request was
-        actually waiting on when it finally mattered).  A `preempted`
-        (or `replica_lost`) stamp is sticky: the request is back in the
-        queue BECAUSE it was evicted / its replica died, and that
-        attribution must survive later stalls."""
+        actually waiting on when it finally mattered).  A `preempted`,
+        `replica_lost`, or `prefill_tier_down` stamp is sticky: the
+        request is back in the queue BECAUSE it was evicted / its
+        replica died / its prefill tier went down, and that attribution
+        must survive later stalls."""
         for rid in rids:
             st = self._open.get(rid)
             if (st is not None and st.phase == "queued"
                     and st.stall_reason not in ("preempted",
-                                                "replica_lost")):
+                                                "replica_lost",
+                                                "prefill_tier_down")):
                 st.stall_reason = reason
 
     def on_admit(self, req, slot: int, now: float,
@@ -264,12 +266,19 @@ class RequestTracer:
         zero-duration ``done`` (or ``evicted``) span.  A mid-prefill
         eviction (a retry-exhausted failover) tiles its partial
         prefill as discarded so the trace still covers [arrival,
-        terminal] exactly."""
+        terminal] exactly; a still-QUEUED finish (a disaggregated
+        re-prefill that exhausted the retry budget before any
+        admission) tiles the queued wait the same way on_expire
+        does."""
         st = self._open.pop(req.rid, None)
         if st is None:
             return
         st.slot = slot
-        if st.phase == "prefill":
+        if st.phase == "queued":
+            self._emit(st, "queued", st.last_t, now,
+                       reason=st.stall_reason)
+            st.last_t = now
+        elif st.phase == "prefill":
             if now > st.last_t:
                 self._emit(st, "prefill", st.last_t, now,
                            chunk=st.chunks, discarded=True)

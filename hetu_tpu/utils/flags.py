@@ -373,6 +373,45 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "placement).  Pure data movement between steps — the decode "
          "program is byte-identical (registered identity contract)",
          identity="1", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_DISAGG", "bool", False,
+         "disaggregated prefill/decode serving (serving/disagg.py): "
+         "prompts prefill on a separate tier running the SAME chunk "
+         "program, and the finished scratch KV ships to the decode "
+         "tier over an acked at-least-once channel (seq-numbered "
+         "shipments, receiver-side dedupe before any page allocation, "
+         "timeout -> resend -> re-prefill under HETU_TPU_SERVE_RETRY). "
+         "A dead prefill tier degrades to colocated chunked prefill "
+         "('prefill_tier_down' stall reason, metered degraded-mode "
+         "seconds), auto-recovering.  Host-side orchestration only: "
+         "chunk, write, and decode programs are the engine's own, so "
+         "the decode program is byte-identical with the flag on or "
+         "off (registered identity contract) and exact-wire streams "
+         "are token-identical to the colocated run",
+         identity="1", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_SHIP_QUANT", "str", "none",
+         "wire quantization for prefill->decode KV shipments "
+         "(serving/disagg.py pack_shipment): int8/int4 ship blockwise "
+         "payloads + f32 scale planes through the same "
+         "quantize_heads format the KV pool and re-paging use (~4x / "
+         "~7.5x fewer wire bytes vs fp32); none (default) ships the "
+         "exact scratch — the mode that preserves token byte-identity "
+         "to the colocated run.  A host-side wire transform: the "
+         "decode program is byte-identical at any value (registered "
+         "identity contract)",
+         choices=("none", "int8", "int4"),
+         identity="int8", identity_programs=("decode",)),
+    Flag("HETU_TPU_SERVE_HEDGE", "int", 0,
+         "frontend hedged re-dispatch (serving/frontend.py): a request "
+         "queued on its replica for more than this many router steps "
+         "is speculatively re-submitted to the next-best healthy "
+         "replica; the first replica to finish wins ('hedge_win' "
+         "serve event) and the loser's copy is withdrawn, deduped by "
+         "rid — duplicate results never reach the client, and loser "
+         "tokens are accounted as discarded work.  0 (default) = "
+         "never hedge.  Host-side routing policy only — the decode "
+         "program is byte-identical at any value (registered "
+         "identity contract)",
+         identity="2", identity_programs=("decode",)),
     Flag("HETU_TPU_PALLAS", "str", "auto",
          "Pallas fused-kernel layer routing (ops/pallas: flash attention, "
          "residual+RMS/LayerNorm, SwiGLU, rotary, blockwise quantize, "

@@ -1,0 +1,458 @@
+"""Disaggregated prefill/decode + fault-tolerant frontend (tier-1,
+CPU, seeded, hardware-free): token-identity goldens for the two-tier
+pipeline vs the colocated single engine — greedy AND sampled, clean and
+under the shipment storm + prefill kills; the at-least-once shipment
+protocol units (channel drop/dup/delay, the scheduler dedupe gate and
+its rollback, retry-budget exhaustion); the multi-replica frontend
+(health-checked failover, hedged re-dispatch with dedupe-by-rid,
+drain/rejoin, fleet-wide quotas); and the 5-seed flake checks for the
+`disagg-storm` / `frontend-partition` named chaos schedules."""
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from hetu_tpu import serving
+from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
+from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
+from hetu_tpu.obs.metrics import MetricsRegistry
+from hetu_tpu.serving.disagg import (DisaggCoordinator, PrefillWorker,
+                                     Shipment, ShipmentChannel,
+                                     pack_shipment, unpack_shipment)
+from hetu_tpu.serving.frontend import Frontend
+from hetu_tpu.serving.request import TenantQuota
+
+
+@pytest.fixture(scope="module")
+def tiny_llama():
+    cfg = LlamaConfig.tiny(remat=False, compute_dtype=jnp.float32,
+                           use_flash_attention=False)
+    model = LlamaLMHeadModel(cfg)
+    return model, model.init(jax.random.key(0))
+
+
+def _requests(vocab_size, *, sampling=None, n=8, seed=11):
+    classes = [serving.SLOClass("gold", priority=2),
+               serving.SLOClass("bulk")]
+    return serving.synthetic_requests(
+        n, vocab_size=vocab_size, prompt_lens=(3, 10), max_new=(4, 8),
+        slo_classes=classes, sampling=sampling, seed=seed)
+
+
+def _cfg(**kw):
+    base = dict(num_slots=2, page_size=8, max_len=32, prefill_chunk=8)
+    base.update(kw)
+    return serving.ServeConfig(**base)
+
+
+def _two_tier(model, params, *, plan=None, sampled=False, retry_budget=2,
+              **coord_kw):
+    decode = serving.ServingEngine(
+        model, params, _cfg(retry_budget=retry_budget,
+                            **({"sampling": True} if sampled else {})),
+        registry=MetricsRegistry())
+    worker = PrefillWorker(model, params, prefill_chunk=8, max_len=32,
+                           sampling=sampled, registry=decode._registry)
+    coord = DisaggCoordinator(worker, decode, plan=plan,
+                              ship_quant="none", **coord_kw)
+    return coord, decode
+
+
+# --------------------------------------------------- token identity
+@pytest.mark.parametrize("mode", ["greedy", "sampled"])
+def test_disagg_clean_token_identical_to_colocated(tiny_llama, mode):
+    """The handoff golden: prefill on one worker, decode on another,
+    KV shipped over the acked channel — every stream byte-identical to
+    the colocated single-engine run, greedy and sampled (the sampler is
+    keyed by (seed, absolute position), so the tier boundary cannot
+    perturb it)."""
+    model, params = tiny_llama
+    sampling = (serving.SamplingParams(temperature=0.8, top_k=16,
+                                       seed=77)
+                if mode == "sampled" else None)
+    base = serving.ServingEngine(
+        model, params,
+        _cfg(**({"sampling": True} if mode == "sampled" else {})),
+        registry=MetricsRegistry())
+    gold = {r.rid: r.tokens
+            for r in base.run(_requests(model.config.vocab_size,
+                                        sampling=sampling))}
+
+    coord, decode = _two_tier(model, params, sampled=mode == "sampled")
+    res = coord.run(_requests(model.config.vocab_size,
+                              sampling=sampling))
+    got = {r.rid: r.tokens for r in res}
+    assert set(got) == set(gold)
+    for rid in gold:
+        assert got[rid] == gold[rid], (mode, rid)
+    s = coord.summary()
+    assert s["adoptions"] == len(gold)
+    assert s["ship_sent"] >= len(gold)
+    assert s["ship_bytes"] > 0
+    decode.scheduler.check_invariants()
+
+
+@pytest.mark.parametrize("mode", ["greedy", "sampled"])
+def test_disagg_storm_survivors_token_identical(tiny_llama, mode):
+    """THE disagg acceptance scenario: the wire drops, duplicates and
+    delays shipments, drops acks (forcing retransmits the dedupe gate
+    absorbs), and the prefill tier is killed twice — once briefly, once
+    for a window that trips degraded colocated fallback.  Every
+    SURVIVING stream is byte-identical to the colocated run, greedy and
+    sampled, and the protocol counters prove each leg actually fired."""
+    model, params = tiny_llama
+    sampling = (serving.SamplingParams(temperature=0.8, top_k=16,
+                                       seed=77)
+                if mode == "sampled" else None)
+    base = serving.ServingEngine(
+        model, params,
+        _cfg(**({"sampling": True} if mode == "sampled" else {})),
+        registry=MetricsRegistry())
+    gold = {r.rid: r.tokens
+            for r in base.run(_requests(model.config.vocab_size,
+                                        sampling=sampling))}
+
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="shipment_drop", op="ship", after_calls=1,
+                  count=2, prob=1.0),
+        FaultSpec(kind="shipment_dup", op="ship", after_calls=4,
+                  count=2, prob=1.0),
+        FaultSpec(kind="shipment_delay", op="ship", after_calls=7,
+                  count=2, prob=1.0, delay_s=2.0),
+        FaultSpec(kind="shipment_drop", op="ack", after_calls=2,
+                  count=2, prob=1.0),
+        FaultSpec(kind="prefill_kill", at_step=6),
+        FaultSpec(kind="prefill_kill", at_step=9, count=4)])
+    coord, decode = _two_tier(model, params, plan=plan,
+                              sampled=mode == "sampled", retry_budget=3)
+    res = coord.run(_requests(model.config.vocab_size,
+                              sampling=sampling))
+    got = {r.rid: (r.tokens, r.finished_reason) for r in res}
+    assert set(got) == set(gold), "requests lost by the pipeline"
+    survivors = 0
+    for rid, (toks, reason) in got.items():
+        if reason in ("length", "eos"):
+            survivors += 1
+            assert toks == gold[rid], (mode, rid)
+    assert survivors > 0, "everything faulted — nothing was replayed"
+    s = coord.summary()
+    assert s["ship_dropped"] >= 2 and s["ship_duped"] >= 2
+    assert s["ship_delayed"] >= 2
+    assert s["ship_resends"] >= 1, "drop never forced a retransmit"
+    assert s["ship_dedups"] >= 1, "dup/retransmit never deduped"
+    assert s["degraded_steps"] > 0, "tier kills never tripped degraded"
+    snap = {c["name"]: c["value"]
+            for c in decode._registry.snapshot()["counters"]}
+    assert snap.get("serve.prefill_tier_kills", 0) == 2
+    assert snap.get("serve.degraded_entries", 0) == 2
+    assert snap.get("serve.ship_resends", 0) >= 1
+    decode.scheduler.check_invariants()
+    assert decode.scheduler.retries == {}, "retry ledger leaked"
+
+
+def test_disagg_dead_tier_colocates_everything_token_identical(
+        tiny_llama):
+    """Graceful degradation golden: the prefill tier is dead from step
+    zero and never comes back.  NOTHING ships — every request falls
+    back to colocated chunked prefill on the decode tier, and every
+    stream is STILL byte-identical to the single-engine run (the
+    fallback is the same math, just on the other tier)."""
+    model, params = tiny_llama
+    base = serving.ServingEngine(model, params, _cfg(),
+                                 registry=MetricsRegistry())
+    gold = {r.rid: r.tokens
+            for r in base.run(_requests(model.config.vocab_size))}
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="prefill_kill", at_step=0, count=100_000)])
+    coord, decode = _two_tier(model, params, plan=plan)
+    res = coord.run(_requests(model.config.vocab_size))
+    assert all(r.finished_reason in ("length", "eos") for r in res)
+    assert {r.rid: r.tokens for r in res} == gold
+    s = coord.summary()
+    assert s["colocated"] == len(gold) and s["adoptions"] == 0
+    assert s["ship_sent"] == 0 and s["degraded_steps"] > 0
+    decode.scheduler.check_invariants()
+
+
+@pytest.mark.parametrize("quant", ["int8", "int4"])
+def test_disagg_quantized_wire_completes_within_error(tiny_llama, quant):
+    """int8/int4 scale-plane shipping: NOT token-identical by contract
+    (the identity= flag contract restricts HETU_TPU_SERVE_SHIP_QUANT to
+    `none`), but the pipeline completes every request and the wire
+    actually shrank."""
+    model, params = tiny_llama
+    coord, decode = _two_tier(model, params)
+    dense = coord.run(_requests(model.config.vocab_size, n=4, seed=5))
+    dense_bytes = coord.summary()["ship_bytes"]
+
+    # _two_tier pins ship_quant="none"; build the quantized pair by hand
+    decodeq = serving.ServingEngine(model, params, _cfg(),
+                                    registry=MetricsRegistry())
+    workerq = PrefillWorker(model, params, prefill_chunk=8, max_len=32,
+                            registry=decodeq._registry)
+    coordq = DisaggCoordinator(workerq, decodeq, ship_quant=quant)
+    res = coordq.run(_requests(model.config.vocab_size, n=4, seed=5))
+    assert len(res) == len(dense) == 4
+    assert all(r.finished_reason in ("length", "eos") for r in res)
+    q_bytes = coordq.summary()["ship_bytes"]
+    assert 0 < q_bytes < dense_bytes
+    if quant == "int4":
+        assert q_bytes < dense_bytes / 4
+
+
+# ------------------------------------------------------ protocol units
+def test_shipment_pack_roundtrip_and_wire_bytes():
+    """pack/unpack across the three wire formats: `none` is lossless,
+    int8/int4 bounded by their quant grids, and the payload shrinks
+    monotonically (int4 ships nibble-packed halves + f32 scales)."""
+    rng = np.random.default_rng(0)
+    ks = rng.normal(size=(4, 6, 2, 16)).astype(np.float32)
+    vs = rng.normal(size=(4, 6, 2, 16)).astype(np.float32)
+    req = serving.Request(rid=7, prompt=np.ones(6, np.int32),
+                          max_new_tokens=4)
+    ships = {q: pack_shipment(3, req, 0, 6, ks, vs, quant=q)
+             for q in ("none", "int8", "int4")}
+    for q, ship in ships.items():
+        assert (ship.seq, ship.rid, ship.quant) == (3, 7, q)
+        bk, bv = unpack_shipment(ship)
+        assert bk.shape == ks.shape and bv.shape == vs.shape
+        grid = {"none": 1e-12, "int8": 1.0 / 254.0,
+                "int4": 1.0 / 14.0}[q]
+        bound = np.abs(ks).max(axis=-1, keepdims=True) * grid + 1e-6
+        assert (np.abs(bk - ks) <= bound).all(), q
+    assert (ships["none"].wire_bytes > ships["int8"].wire_bytes
+            > ships["int4"].wire_bytes)
+    with pytest.raises(ValueError):
+        pack_shipment(1, req, 0, 6, ks, vs, quant="fp8")
+
+
+def test_shipment_channel_drop_dup_delay_and_acks():
+    """The wire's chaos semantics are exact: a drop loses exactly that
+    send (False back to the sender), a dup delivers twice in one poll,
+    a delay defers by ceil(delay_s) steps, and acks ride the same
+    fault schedule under op="ack"."""
+    def mk(**kw):
+        return Shipment(seq=kw.pop("seq"), rid=0, attempt=0, t1=4,
+                        quant="none", ks=np.zeros(1, np.float32),
+                        vs=np.zeros(1, np.float32), **kw)
+
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="shipment_drop", op="ship", after_calls=0,
+                  count=1, prob=1.0),
+        FaultSpec(kind="shipment_dup", op="ship", after_calls=1,
+                  count=1, prob=1.0),
+        FaultSpec(kind="shipment_delay", op="ship", after_calls=2,
+                  count=1, prob=1.0, delay_s=3.0),
+        FaultSpec(kind="shipment_drop", op="ack", after_calls=0,
+                  count=1, prob=1.0)])
+    ch = ShipmentChannel(plan=plan)
+    assert not ch.send(mk(seq=1), step=0)           # eaten by the wire
+    assert ch.send(mk(seq=2), step=0)               # duplicated
+    assert ch.send(mk(seq=3), step=0)               # delayed 3 steps
+    assert ch.send(mk(seq=4), step=0)               # clean
+    ships, acks = ch.poll(step=1)
+    assert [s.seq for s in ships] == [2, 2, 4]
+    assert acks == []
+    ships, _ = ch.poll(step=4)   # due = send + 1 + ceil(delay_s) = 4
+    assert [s.seq for s in ships] == [3]
+    assert (ch.sent, ch.dropped, ch.duped, ch.delayed) == (4, 1, 1, 1)
+    assert not ch.send_ack(2, step=3)               # dropped ack
+    assert ch.send_ack(4, step=3)
+    _, acks = ch.poll(step=4)
+    assert acks == [4]
+    assert (ch.acks_sent, ch.acks_dropped) == (2, 1)
+    # requeue (no-capacity redelivery) never consults the fault plan
+    ch.requeue(mk(seq=9), step=4)
+    ships, _ = ch.poll(step=5)
+    assert [s.seq for s in ships] == [9]
+    assert ch.idle
+
+
+def test_scheduler_shipment_dedupe_gate_and_rollback():
+    """The at-least-once receiver contract in isolation: first apply
+    wins, redelivered seqs refuse, a live rid refuses even a FRESH seq,
+    unapply un-burns a seq so the same delivery can retry after a
+    capacity stall, and the seq set outlives ship_forget (late dups of
+    a finished request still dedupe)."""
+    from hetu_tpu.serving.kv_pool import PagePool
+    from hetu_tpu.serving.scheduler import Scheduler
+    pool = PagePool(num_pages=8, page_size=4, num_layers=1,
+                    num_kv_heads=1, head_dim=4)
+    sched = Scheduler(num_slots=1, pool=pool, max_len=16)
+    req = serving.Request(rid=1, prompt=np.ones(4, np.int32),
+                          max_new_tokens=4)
+    assert sched.apply_shipment(1, 10)
+    assert not sched.apply_shipment(1, 10)          # redelivery
+    adm = sched.admit_direct(req, 0.0)
+    assert adm is not None
+    assert not sched.apply_shipment(1, 11), "live rid must refuse"
+    # a second request stalls on the single slot: rollback un-burns
+    req2 = serving.Request(rid=2, prompt=np.ones(4, np.int32),
+                          max_new_tokens=4)
+    assert sched.apply_shipment(2, 12)
+    assert sched.admit_direct(req2, 0.0) is None
+    assert sched.last_stall == "no_slot"
+    sched.unapply_shipment(2, 12)
+    assert sched.apply_shipment(2, 12), "unapply must un-burn the seq"
+    sched.unapply_shipment(2, 12)
+    # double adoption of a LIVE rid is a hard error, not a silent alias
+    with pytest.raises(ValueError):
+        sched.admit_direct(req, 0.0)
+    sched.release(adm[0])
+    sched.ship_forget(1)
+    assert not sched.apply_shipment(1, 10), \
+        "late dup after finish must still dedupe"
+    sched.check_invariants()
+    assert pool.free_count == pool.num_pages
+
+
+def test_disagg_retry_budget_exhaustion_terminates(tiny_llama):
+    """A wire that eats EVERY shipment: each request burns its resends,
+    re-prefills under the retry budget, and terminates
+    ``retry_exhausted`` — a real terminal result (no infinite loop, no
+    leaked pages, empty retry ledger)."""
+    model, params = tiny_llama
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="shipment_drop", op="ship", after_calls=0,
+                  count=10_000, prob=1.0)])
+    coord, decode = _two_tier(model, params, plan=plan, retry_budget=1,
+                              ship_timeout=1, ship_retry=1)
+    res = coord.run(_requests(model.config.vocab_size, n=3, seed=21))
+    assert len(res) == 3
+    assert all(r.finished_reason == "retry_exhausted" for r in res)
+    assert all(r.tokens == [] for r in res)
+    assert coord.summary()["reprefills"] >= 3, \
+        "budget burned without ever re-prefilling"
+    decode.scheduler.check_invariants()
+    assert decode.scheduler.retries == {}, "retry ledger leaked"
+    snap = {c["name"]: c["value"]
+            for c in decode._registry.snapshot()["counters"]}
+    assert snap.get("serve.retry_exhausted", 0) == 3
+
+
+# ------------------------------------------------------------ frontend
+def test_frontend_failover_token_identical(tiny_llama):
+    """Replica 1 partitions away mid-run: the frontend health-checks it
+    out, fails its in-flight work over to the survivor, and every
+    stream still matches the single-engine golden byte-for-byte."""
+    model, params = tiny_llama
+    base = serving.ServingEngine(model, params, _cfg(),
+                                 registry=MetricsRegistry())
+    gold = {r.rid: r.tokens
+            for r in base.run(_requests(model.config.vocab_size, n=10))}
+    plan = FaultPlan(seed=0, faults=[
+        FaultSpec(kind="engine_kill", rank=1, at_step=3, count=4)])
+    engines = [serving.ServingEngine(model, params,
+                                     _cfg(retry_budget=2),
+                                     registry=MetricsRegistry())
+               for _ in range(2)]
+    fe = Frontend(engines, plan=plan, registry=MetricsRegistry())
+    res = fe.run(_requests(model.config.vocab_size, n=10))
+    got = {r.rid: (r.tokens, r.finished_reason) for r in res}
+    assert set(got) == set(gold)
+    for rid, (toks, reason) in got.items():
+        assert reason in ("length", "eos"), (rid, reason)
+        assert toks == gold[rid], rid
+    s = fe.summary()
+    assert s["reroutes"] >= 1, "the kill never rerouted anything"
+    for eng in engines:
+        eng.scheduler.check_invariants()
+
+
+def test_frontend_hedge_dedupe_token_identical(tiny_llama):
+    """Hedged re-dispatch on a congested replica: the duplicate copy
+    races on a second replica, whichever finishes first wins, the loser
+    is withdrawn (dedupe-by-rid: exactly ONE result per request), and
+    tokens still match the single-engine golden."""
+    model, params = tiny_llama
+    base = serving.ServingEngine(model, params, _cfg(),
+                                 registry=MetricsRegistry())
+    gold = {r.rid: r.tokens
+            for r in base.run(_requests(model.config.vocab_size,
+                                        n=12, seed=3))}
+    engines = [serving.ServingEngine(model, params, _cfg(num_slots=1),
+                                     registry=MetricsRegistry())
+               for _ in range(2)]
+    fe = Frontend(engines, hedge_after=2, registry=MetricsRegistry())
+    res = fe.run(_requests(model.config.vocab_size, n=12, seed=3))
+    got = {r.rid: r.tokens for r in res}
+    assert len(res) == len(got) == 12, "hedging duplicated a result"
+    for rid in gold:
+        assert got[rid] == gold[rid], rid
+    s = fe.summary()
+    assert s["hedges"] >= 1, "congestion never armed a hedge"
+    assert s["hedges"] >= s["hedge_wins"]
+
+
+def test_frontend_drain_rejoin_and_fleet_quota(tiny_llama):
+    """drain() takes a replica out of rotation (nothing new lands on
+    it; rejoin restores it), and a fleet-WIDE tenant quota caps live
+    requests across all replicas — the frontend holds the excess at
+    admission rather than letting per-replica quotas double the cap."""
+    model, params = tiny_llama
+    engines = [serving.ServingEngine(model, params, _cfg(),
+                                     registry=MetricsRegistry())
+               for _ in range(2)]
+    fe = Frontend(engines, registry=MetricsRegistry())
+    fe.drain(0)
+    res = fe.run(_requests(model.config.vocab_size, n=4, seed=7))
+    assert len(res) == 4
+    snap = {c["name"]: c["value"]
+            for c in engines[0]._registry.snapshot()["counters"]}
+    assert snap.get("serve.requests_done", 0) == 0, \
+        "drained replica still served work"
+    fe.rejoin(0)
+    assert not fe.replicas[0].draining
+
+    engines = [serving.ServingEngine(model, params, _cfg(),
+                                     registry=MetricsRegistry())
+               for _ in range(2)]
+    fe = Frontend(engines,
+                  quotas={"t0": TenantQuota("t0", max_slots=1)},
+                  registry=MetricsRegistry())
+    reqs = _requests(model.config.vocab_size, n=8, seed=13)
+    for r in reqs:
+        r.tenant = "t0"
+    res = fe.run(reqs)
+    assert len(res) == 8, "quota holds must release, not starve"
+    assert fe.quota_holds > 0, "fleet quota never held anything"
+
+
+# ------------------------------------------- named-schedule flake checks
+def test_chaos_disagg_storm_flake_checked(tmp_path):
+    """The disagg-storm schedule through the real two-tier pipeline at
+    five workload seeds: both tier kills fire, the wire mangles
+    shipments, and every surviving stream stays token-identical to the
+    colocated golden (the report's own pin)."""
+    from hetu_tpu.chaos.harness import named_plan, run_disagg_chaos_demo
+    for seed in range(5):
+        plan = named_plan("disagg-storm")
+        report = run_disagg_chaos_demo(
+            str(tmp_path / f"s{seed}"), plan, requests=10, rate=60.0,
+            burst=5, retry_budget=3, seed=seed)
+        assert report["completed"], f"seed {seed} lost requests"
+        assert report["token_identical"], \
+            f"seed {seed} diverged: {report['mismatched_rids']}"
+        assert report["faults"]["serve.prefill_tier_kills"] == 2
+        d = report["disagg"]
+        assert d["ship_dropped"] >= 1, f"seed {seed}: wire never bit"
+        assert report["slo"]["reconciliation"]["max_residual_s"] <= 1e-6
+
+
+def test_chaos_frontend_partition_flake_checked(tmp_path):
+    """The frontend-partition schedule at five workload seeds: replica
+    1 partitions away for a window, the frontend reroutes and rejoins
+    it, and survivors stay token-identical (the report's pin)."""
+    from hetu_tpu.chaos.harness import (named_plan,
+                                        run_frontend_chaos_demo)
+    for seed in range(5):
+        plan = named_plan("frontend-partition")
+        report = run_frontend_chaos_demo(
+            str(tmp_path / f"s{seed}"), plan, requests=10, rate=60.0,
+            burst=5, retry_budget=2, seed=seed)
+        assert report["completed"], f"seed {seed} lost requests"
+        assert report["token_identical"], \
+            f"seed {seed} diverged: {report['mismatched_rids']}"
+        fr = report["frontend"]
+        assert fr["reroutes"] >= 1, f"seed {seed}: kill missed work"

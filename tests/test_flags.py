@@ -98,6 +98,11 @@ def test_every_env_read_is_registered():
     for name in ("HETU_TPU_SERVE_RETRY", "HETU_TPU_SERVE_DEADLINE",
                  "HETU_TPU_SERVE_BROWNOUT", "HETU_TPU_SERVE_KV_REPAGE"):
         assert name in flags.REGISTRY
+    # the disaggregated prefill/decode fleet + fault-tolerant frontend
+    # (serving/disagg.py, serving/frontend.py, docs/serving.md)
+    for name in ("HETU_TPU_SERVE_DISAGG", "HETU_TPU_SERVE_SHIP_QUANT",
+                 "HETU_TPU_SERVE_HEDGE"):
+        assert name in flags.REGISTRY
 
 
 def test_identity_contract_table():
@@ -157,9 +162,20 @@ def test_identity_contract_table():
     for name in ("HETU_TPU_SERVE_RETRY", "HETU_TPU_SERVE_DEADLINE",
                  "HETU_TPU_SERVE_BROWNOUT", "HETU_TPU_SERVE_KV_REPAGE"):
         assert flags.identity_contract_programs(name) == ("decode",)
+    # the disaggregated fleet + frontend: all host-side orchestration
+    # (the tiers run the engine's own chunk/write/decode programs), so
+    # each is contracted at an ON value — disagg enabled, int8 wire,
+    # hedging armed — and restricted to the decode program.  The
+    # TOKEN-identity half (exact wire only) lives in tests/test_disagg.py
+    assert table["HETU_TPU_SERVE_DISAGG"] == "1"
+    assert table["HETU_TPU_SERVE_SHIP_QUANT"] == "int8"
+    assert table["HETU_TPU_SERVE_HEDGE"] == "2"
+    for name in ("HETU_TPU_SERVE_DISAGG", "HETU_TPU_SERVE_SHIP_QUANT",
+                 "HETU_TPU_SERVE_HEDGE"):
+        assert flags.identity_contract_programs(name) == ("decode",)
     # unrestricted contracts sweep everything
     assert flags.identity_contract_programs("HETU_TPU_PALLAS") is None
-    assert len(table) >= 26
+    assert len(table) >= 29
     # flags with NO contract must stay contract-free: these genuinely
     # change program shapes, so an identity entry would be a lie the
     # sweep turns into a tier-1 failure

@@ -102,14 +102,17 @@ def test_quantize_heads_roundtrip_error_bound():
 def test_scheduler_admit_evict_fuzz_invariants():
     """Randomized arrival/EOS churn — now with random engine kills
     (requeue_lost under a retry budget / retry_exhausted past it),
-    deadline expiries and brownout sheds of queued requests: the memory
-    invariants (no page aliasing, exact live+free partition, table
-    mirrors, retry counts within budget, refcounts exact after a
-    requeue) hold after every transition — AND so do the flight
-    recorder's span-event invariants (a RequestTracer rides the same
-    churn): every terminated request ends with exactly one terminal
-    span, spans are ordered/non-overlapping, and queued spans carry a
-    reserve-on-admit stall reason."""
+    deadline expiries, brownout sheds of queued requests, AND
+    disaggregated shipment churn (apply/unapply, duplicate deliveries,
+    out-of-order redeliveries, late dups after finish): the memory
+    invariants (no page aliasing — a double-delivered shipment NEVER
+    allocates, exact live+free partition, table mirrors, retry counts
+    within budget, refcounts exact after a requeue) hold after every
+    transition — AND so do the flight recorder's span-event invariants
+    (a RequestTracer rides the same churn): every terminated request
+    ends with exactly one terminal span, spans are ordered/
+    non-overlapping, and queued spans carry a reserve-on-admit stall
+    reason."""
     from hetu_tpu.serving.tracing import RequestTracer
     rng = np.random.default_rng(7)
     pool = _pool(num_pages=10, page_size=4)
@@ -120,10 +123,44 @@ def test_scheduler_admit_evict_fuzz_invariants():
     finished: set = set()
     requeues = 0
     now = 0.0
+    # disagg shipment books: channel-global seq, deliveries whose
+    # adoption stalled (awaiting redelivery), live adopted (rid, seq)
+    ship_seq = 0
+    pending: list = []              # (req, seq) awaiting redelivery
+    adopted_seq: dict = {}          # live rid -> its adopted seq
+    adoptions = redeliveries = dup_refused = late_dups = 0
+
+    def adopt(req, seq):
+        """Deliver one shipment through the real dedupe gate; False
+        leaves it in `pending` for an out-of-order redelivery."""
+        nonlocal adoptions, dup_refused
+        if not sched.apply_shipment(req.rid, seq):
+            return False
+        adm = sched.admit_direct(req, now)
+        if adm is None:
+            # no capacity: un-burn the seq so the SAME delivery can
+            # retry later without counting as a dedupe
+            sched.unapply_shipment(req.rid, seq)
+            tracer.on_stall([req.rid], sched.last_stall or "none")
+            pending.append((req, seq))
+            return False
+        slot_idx, st = adm
+        st.pos = req.prompt_len          # shipped KV: no local prefill
+        adoptions += 1
+        adopted_seq[req.rid] = seq
+        tracer.on_admit(req, slot_idx, now)
+        tracer.on_first_token(req, slot_idx, now, chunk=0)
+        # an immediate duplicate of the same seq must be refused — the
+        # second delivery never touches the pool (no aliasing; the
+        # invariant sweep below would catch it)
+        assert not sched.apply_shipment(req.rid, seq)
+        dup_refused += 1
+        return True
+
     for _ in range(400):
         now += 0.01                      # strictly monotone fake clock
         op = rng.random()
-        if op < 0.40:
+        if op < 0.34:
             plen = int(rng.integers(1, 10))
             mnew = int(rng.integers(1, 16 - plen + 1))
             req = Request(rid=rid, prompt=np.ones(plen, np.int32),
@@ -131,7 +168,18 @@ def test_scheduler_admit_evict_fuzz_invariants():
             sched.submit(req)
             tracer.on_submit(req)
             rid += 1
-        elif op < 0.72:
+        elif op < 0.44:
+            # a fresh KV shipment lands from the prefill tier: the
+            # request bypasses the FIFO queue via admit_direct
+            plen = int(rng.integers(1, 10))
+            mnew = int(rng.integers(1, 16 - plen + 1))
+            req = Request(rid=rid, prompt=np.ones(plen, np.int32),
+                          max_new_tokens=mnew, arrival_t=now)
+            tracer.on_submit(req)
+            ship_seq += 1
+            adopt(req, ship_seq)
+            rid += 1
+        elif op < 0.64:
             adm = sched.admit_next(now=now)
             if adm is not None:
                 slot_idx, st = adm
@@ -142,7 +190,17 @@ def test_scheduler_admit_evict_fuzz_invariants():
                 assert sched.last_stall in ("no_slot", "no_pages")
                 tracer.on_stall([r.rid for r in sched.queue],
                                 sched.last_stall)
-        elif op < 0.82:
+        elif op < 0.70:
+            # out-of-order redelivery of a stalled shipment; sometimes
+            # the sender timed out first and re-sent under a FRESH seq
+            if pending:
+                req, seq = pending.pop(int(rng.integers(len(pending))))
+                if rng.random() < 0.3:
+                    ship_seq += 1
+                    seq = ship_seq
+                redeliveries += 1
+                adopt(req, seq)
+        elif op < 0.80:
             # replica death on a random live slot: requeue under the
             # budget, terminate retry_exhausted past it
             live = sched.active_slots()
@@ -161,6 +219,8 @@ def test_scheduler_admit_evict_fuzz_invariants():
                                      e2e_s=now - req.arrival_t,
                                      evicted=True)
                     sched.retries.pop(req.rid, None)
+                    adopted_seq.pop(req.rid, None)
+                    sched.ship_forget(req.rid)
                     finished.add(req.rid)
         elif op < 0.88:
             # deadline expiry / brownout shed of a random queued request
@@ -184,17 +244,46 @@ def test_scheduler_admit_evict_fuzz_invariants():
                 tracer.on_finish(st.request, i, "eos", now,
                                  tokens=1, e2e_s=now - st.request.arrival_t)
                 finished.add(st.request.rid)
+                seq = adopted_seq.pop(st.request.rid, None)
+                if seq is not None:
+                    sched.ship_forget(st.request.rid)
+                    # a LATE duplicate of a finished request's shipment
+                    # still hits the dedupe gate (the seq set outlives
+                    # the per-rid apply history)
+                    assert not sched.apply_shipment(st.request.rid, seq)
+                    late_dups += 1
         sched.check_invariants()
     assert requeues > 0, "fuzz never exercised requeue_lost"
+    assert adoptions > 0, "fuzz never adopted a shipment"
+    assert dup_refused > 0 and late_dups > 0
+    assert redeliveries > 0, "fuzz never redelivered a stalled shipment"
     # drain: everything releasable, pool fully recovered
     now += 0.01
     for i in sched.active_slots():
         st = sched.slots[i]
         sched.release(i)
         sched.retries.pop(st.request.rid, None)
+        adopted_seq.pop(st.request.rid, None)
+        sched.ship_forget(st.request.rid)
         tracer.on_finish(st.request, i, "eos", now,
                          tokens=0, e2e_s=now - st.request.arrival_t)
         finished.add(st.request.rid)
+    # stalled shipments redeliver cleanly into the drained fleet
+    for req, seq in list(pending):
+        now += 0.01
+        assert sched.apply_shipment(req.rid, seq)
+        adm = sched.admit_direct(req, now)
+        assert adm is not None, "drained fleet must adopt the backlog"
+        slot_idx, st = adm
+        st.pos = req.prompt_len
+        tracer.on_admit(req, slot_idx, now)
+        tracer.on_first_token(req, slot_idx, now, chunk=0)
+        sched.release(slot_idx)
+        sched.ship_forget(req.rid)
+        tracer.on_finish(req, slot_idx, "eos", now, tokens=0,
+                         e2e_s=now - req.arrival_t)
+        finished.add(req.rid)
+        sched.check_invariants()
     sched.check_invariants()
     assert pool.free_count == pool.num_pages
 

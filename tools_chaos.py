@@ -8,7 +8,8 @@ recovery report.
 
 Named schedules (hetu_tpu/chaos/harness.py): kill-partition-corrupt,
 partition, corrupt, stall, slow, serve-burst, serve-preempt,
-serve-failover, serve-brownout, fleet-storm.  A path argument loads a
+serve-failover, serve-brownout, fleet-storm, disagg-storm,
+frontend-partition.  A path argument loads a
 FaultPlan JSON (docs/fault_tolerance.md has the schema — the same format
 the HETU_TPU_CHAOS flag takes for real runs).  `--schedule slow` pairs
 with HETU_TPU_TELEMETRY_PUSH/HETU_TPU_HEALTH to demo the cluster
@@ -46,6 +47,19 @@ sleeping.  Thousands of requests replay in seconds; the report's
 `fleet` key carries per-tenant attainment/goodput, quota stalls and the
 per-request cost ledger, and `slo` re-derives the same story from the
 simulator's RunLog.
+
+`--schedule disagg-storm` runs the DISAGGREGATED serving scenario: a
+PrefillWorker tier feeds a decode engine over the acked at-least-once
+shipment channel (`serving/disagg.py`) while the wire drops/duplicates/
+delays KV shipments and `prefill_kill` specs drop the tier mid-run —
+re-sent shipments dedupe on seq, lost ones re-prefill under the retry
+budget, and the dead tier degrades to colocated chunked prefill until
+its down-window passes.  The report's `token_identical` key pins every
+surviving stream against the colocated single-engine run.
+`--schedule frontend-partition` instead routes the trace through the
+multi-replica frontend (`serving/frontend.py`): replica 1 partitions
+away for a window, the frontend fails it over, drains+reroutes its
+queue and rejoins it after — again token-identical for survivors.
 
 The demo run is CPU-only and model-free (StubTrainer checkpoints real
 bytes through orbax; the control plane — reconnecting rpc client,
@@ -93,7 +107,9 @@ def main(argv=None) -> int:
 
     from hetu_tpu.chaos import FaultPlan
     from hetu_tpu.chaos.harness import (named_plan, run_chaos_demo,
+                                        run_disagg_chaos_demo,
                                         run_fleet_chaos_demo,
+                                        run_frontend_chaos_demo,
                                         run_serving_chaos_demo)
 
     if os.path.exists(args.schedule):
@@ -110,6 +126,16 @@ def main(argv=None) -> int:
             requests=args.requests or 5000,
             rate=args.rate or 2000.0,
             burst=args.burst or 16)
+    elif args.schedule == "disagg-storm":
+        # prefill/decode tiers with a mangled shipment wire; survivors
+        # must match the colocated run token-for-token
+        report = run_disagg_chaos_demo(
+            workdir, plan, requests=args.requests or 16,
+            rate=args.rate or 60.0, burst=args.burst or 6)
+    elif args.schedule == "frontend-partition":
+        report = run_frontend_chaos_demo(
+            workdir, plan, requests=args.requests or 16,
+            rate=args.rate or 60.0, burst=args.burst or 6)
     elif args.schedule in ("serve-burst", "serve-preempt",
                            "serve-failover", "serve-brownout"):
         # the serving scenario has its own knobs; the training demo's
@@ -138,7 +164,8 @@ def main(argv=None) -> int:
     if args.json_out:
         with open(args.json_out, "w") as f:
             f.write(out + "\n")
-    return 0 if report["completed"] else 1
+    return 0 if (report["completed"]
+                 and report.get("token_identical", True)) else 1
 
 
 if __name__ == "__main__":

@@ -106,6 +106,21 @@ def render_text(rep: dict) -> str:
                   f"{c['cost_page_s']:.3g} page-s, "
                   f"{c['cost_kv_byte_s']:.3g} KV byte-s, "
                   f"{c['cost_wire_bytes']:.0f} wire B")
+    dg = rep.get("disagg")
+    if dg:
+        sh = dg["shipments"]
+        ln.append(f"  disagg: {dg['adoptions']} adoptions over "
+                  f"{dg['prefill_slots']} prefill slots, "
+                  f"{dg['tier_prefill_chunks']} tier chunks; shipments "
+                  f"{sh['sent']} sent / {sh['dropped']} dropped / "
+                  f"{sh['duped']} duped / {sh['dedups']} deduped / "
+                  f"{sh['resends']} resent")
+        ln.append(f"  degraded: {dg['prefill_kills']} tier kills, "
+                  f"{dg['degraded_steps']} steps "
+                  f"({dg['degraded_s']:.3f}s), "
+                  f"{dg['colocated_prefills']} colocated prefills, "
+                  f"{dg['reprefills']} re-prefills, fallback "
+                  f"{'on' if dg['fallback'] else 'OFF (naive)'}")
     pc = rep.get("prefix_cache")
     if pc:
         ln.append(f"  prefix cache: {pc['hits']}/"
@@ -158,6 +173,28 @@ def main(argv=None) -> int:
                          "(HETU_TPU_SERVE_QUOTAS syntax)")
     ap.add_argument("--invariant-every", type=int, default=997,
                     help="check_invariants() every N sim steps")
+    ap.add_argument("--retry-budget", type=int, default=0,
+                    help="replica-death / re-prefill retries allowed "
+                         "per request before retry_exhausted")
+    # ---- disaggregated prefill/decode tiers (docs/serving.md)
+    ap.add_argument("--disagg", action="store_true",
+                    help="prefill on a separate tier running "
+                         "concurrently with decode; KV ships over an "
+                         "acked at-least-once wire")
+    ap.add_argument("--prefill-slots", type=int, default=0,
+                    help="prefill-tier width (0 = --slots)")
+    ap.add_argument("--ship-latency", type=float, default=500e-6,
+                    metavar="S", help="one-way shipment wire latency")
+    ap.add_argument("--ship-timeout", type=float, default=0.05,
+                    metavar="S",
+                    help="un-acked shipment retransmit timeout")
+    ap.add_argument("--ship-retry", type=int, default=2,
+                    help="shipment resends before re-prefilling")
+    ap.add_argument("--no-fallback", action="store_true",
+                    help="naive mode: a dead prefill tier makes "
+                         "arrivals wait instead of degrading to "
+                         "colocated chunked prefill (the comparison "
+                         "baseline)")
     # ---- service model
     ap.add_argument("--num-params", type=float, default=8e9)
     ap.add_argument("--layers", type=int, default=32)
@@ -210,7 +247,12 @@ def main(argv=None) -> int:
         max_len=args.max_len, prefill_chunk=args.prefill_chunk,
         num_pages=args.pages, prefix_cache=args.prefix_cache,
         preempt=args.preempt, quotas=parse_quotas(args.quotas),
-        invariant_every=args.invariant_every, sample=args.sample)
+        invariant_every=args.invariant_every, sample=args.sample,
+        retry_budget=args.retry_budget, disagg=args.disagg,
+        prefill_slots=args.prefill_slots,
+        ship_latency_s=args.ship_latency,
+        ship_timeout_s=args.ship_timeout, ship_retry=args.ship_retry,
+        fallback=not args.no_fallback)
 
     log_path = args.runlog
     if log_path is None and args.chrome_trace:

@@ -131,7 +131,8 @@ def summarize(records) -> dict:
             for k in ("goodput_tokens_per_s", "stall_breakdown",
                       "reconciliation", "spec_decode", "prefix_cache",
                       "preemptions", "tenants", "costs",
-                      "failover", "deadline", "brownout"):
+                      "failover", "deadline", "brownout",
+                      "disagg", "frontend"):
                 if rep.get(k) is not None:
                     srv[k] = rep[k]
         out["serving"] = srv

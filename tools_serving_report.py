@@ -31,7 +31,13 @@ budget, retry exhaustion, requests that finished after a retry),
 **deadline** (``deadline_exceeded`` terminations per class, tokens
 discarded) and **brownout** (sustained-pressure sheds per class) — the
 `tools_chaos.py` serve-failover / serve-brownout recovery reports carry
-the same sections.  Sampled RunLogs
+the same sections.  Disaggregated runs (HETU_TPU_SERVE_DISAGG /
+serving/disagg.py) add the **disagg** section (KV shipments + resends
+on the prefill->decode wire, re-prefills per class, degraded-mode
+colocated-fallback seconds) and frontend-routed runs
+(serving/frontend.py) the **frontend** section (replica down/drain/
+rejoin events, hedged re-dispatches, hedge wins) — the disagg-storm /
+frontend-partition recovery reports carry them too.  Sampled RunLogs
 (HETU_TPU_RUNLOG_SERVE_SAMPLE > 1) are re-weighted by the stamped
 ``sample_weight`` so totals and attainment stay unbiased.
 

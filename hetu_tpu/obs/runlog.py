@@ -25,11 +25,13 @@ Kind fields:
                   ratio/z) — the cluster straggler report transitions
     serve         event (admit | done | preempt | reshard | report |
                   failover | retry | evict | expired | shed | ship |
-                  degraded | replica | hedge | hedge_win) + the
-                  serving SLO fields (hetu_tpu/serving,
+                  degraded | replica | hedge | hedge_win | hedge_dupe |
+                  dispatch) + the serving SLO fields (hetu_tpu/serving,
                   docs/serving.md); every event also stamps `now`
                   (driver-clock seconds — the engine's virtual clock,
-                  matching span t0/t1); per-request events (admit/done/
+                  matching span t0/t1) and `clock` (the timestamp
+                  basis, driver | wall — `FleetTrace.stitch` refuses
+                  to mix bases); per-request events (admit/done/
                   preempt/retry/evict/expired/shed) carry `tenant` and,
                   on a sampled RunLog
                   (HETU_TPU_RUNLOG_SERVE_SAMPLE > 1), `sample_weight`
@@ -80,13 +82,25 @@ Kind fields:
                   re-dispatch fired (HETU_TPU_SERVE_HEDGE);
                   hedge_win: req, primary, hedge, tokens — the hedge
                   copy finished first (the primary's duplicate stream
-                  is withdrawn and its tokens discarded)
+                  is withdrawn and its tokens discarded);
+                  hedge_dupe: req, replica, tokens — a hedge LOSER ran
+                  to completion before withdrawal (the stitcher
+                  discounts its duplicate terminal);
+                  dispatch: req, tier (prefill | decode), replica,
+                  attempt, fallback/rerouted_from when applicable — a
+                  frontend/coordinator routing decision, the stitched
+                  DAG's dispatch edge (obs/spans.py FleetTrace)
     span          the serving flight recorder (HETU_TPU_SERVE_TRACE,
                   hetu_tpu/serving/tracing.py, schema owned by
                   obs/spans.py): span_schema (version), span (queued |
                   prefill | decode | reshard_pause | done | evicted |
-                  deadline_exceeded), trace (trace id), req, slot,
-                  slo_class, t0, t1
+                  deadline_exceeded | hedge_withdrawn), trace (trace
+                  id), req, slot, slo_class, t0, t1, clock (timestamp
+                  basis: driver | wall — every span record stamps it;
+                  stitch refuses mixed bases), tier (prefill | decode,
+                  only when stamped) and replica (engine index, only
+                  when stamped) — the hop identity fleet stitching
+                  keys on
                   (driver-clock seconds; spans of one request tile
                   [arrival, done] — durations sum to its e2e_s;
                   requeued attempts stamp attempt >= 2), plus

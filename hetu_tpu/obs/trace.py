@@ -64,6 +64,23 @@ class ChromeTrace:
             ev["args"] = args
         self.events.append(ev)
 
+    def add_flow(self, name: str, flow_id: Any, *, start_ts_us: float,
+                 finish_ts_us: float, start_pid: Any = 0,
+                 start_tid: Any = 0, finish_pid: Any = 0,
+                 finish_tid: Any = 0, cat: str = "flow"):
+        """Flow-event pair ("ph": "s" / "f") — Perfetto draws an arrow
+        from the slice enclosing the start point to the slice enclosing
+        the finish point, connecting lanes (tiers) causally.  The pair
+        is matched by (cat, id); `bp: "e"` binds the finish to the
+        ENCLOSING slice rather than the next one."""
+        self.events.append({"name": name, "ph": "s", "id": str(flow_id),
+                            "ts": float(start_ts_us), "pid": start_pid,
+                            "tid": start_tid, "cat": cat})
+        self.events.append({"name": name, "ph": "f", "bp": "e",
+                            "id": str(flow_id),
+                            "ts": float(finish_ts_us), "pid": finish_pid,
+                            "tid": finish_tid, "cat": cat})
+
     def add_counter(self, name: str, ts_us: float, values: Dict[str, float],
                     *, pid: Any = 0):
         """Counter event ("ph": "C") — Perfetto draws each series of
@@ -469,4 +486,86 @@ def serving_trace(records: Iterable[Dict[str, Any]], *,
                     if r.get(k) is not None}
         for name, v in counters.items():
             tr.add_counter(name, ts, {name: v}, pid=pid)
+    return tr
+
+
+# ---------------------------------------------------------------------------
+# stitched fleet render (FleetTrace DAGs -> flow-connected tier lanes)
+# ---------------------------------------------------------------------------
+
+def stitched_trace(fleet_traces, *, pid: Any = "fleet") -> ChromeTrace:
+    """Render stitched :class:`obs.spans.FleetTrace` DAGs as ONE
+    flow-connected multi-tier timeline (what ``tools_fleet.py
+    --chrome-trace`` writes when the run was traced):
+
+    * one lane per fleet hop identity (``prefill/0``, ``decode/1``, a
+      bare ``decode`` for unstamped single-engine runs) plus a
+      ``frontend`` lane — each hop's spans drawn as complete events,
+      terminals as instants,
+    * every causal edge drawn as a **flow arrow** ("ph": "s"/"f" pairs,
+      matched by id, finish bound to the enclosing slice via
+      ``bp: "e"``) connecting the lanes: dispatch (frontend -> hop
+      queued), ship/adopt (prefill -> decode), hedge fork/win/withdraw,
+      replay re-admissions and dead-tier fallbacks,
+
+    so the cross-tier causality that `FleetTrace.validate` checks
+    numerically is *visible* — follow the arrows from frontend through
+    prefill and shipment into the decode lane that produced the client
+    result.  Accepts the dict `FleetTrace.stitch` returns (or any
+    iterable of FleetTraces); timestamps are the spans' own (driver)
+    clock basis, so replayed virtual-clock runs draw deterministically."""
+    from hetu_tpu.obs.spans import TERMINAL_KINDS
+    tr = ChromeTrace()
+    tr.name_process(pid, "fleet (stitched)")
+    tr.name_thread(pid, "frontend", "frontend / client")
+    fts = (fleet_traces.values() if isinstance(fleet_traces, dict)
+           else list(fleet_traces))
+    fts = sorted(fts, key=lambda ft: ft.rid)
+    lanes: Dict[str, str] = {}      # hop trace id -> lane tid
+    for ft in fts:
+        for h in ft.hops:
+            lanes.setdefault(h.trace, ft.hop_label(h))
+    for tid in sorted(set(lanes.values())):
+        tr.name_thread(pid, tid, f"{tid} hop")
+
+    def lane_of(trace_id: Any) -> str:
+        return lanes.get(trace_id, "frontend")
+
+    def enclosing_ts(trace_id: Any, t_us: float) -> float:
+        """Nudge a flow endpoint inside the hop's span coverage so the
+        arrow binds to a slice (edges stamp the boundary instant, which
+        can fall exactly between two slices)."""
+        hop = hop_by_trace.get(trace_id)
+        if hop is None or not hop.spans:
+            return t_us
+        lo, hi = hop.spans[0].t0 * 1e6, hop.spans[-1].t1 * 1e6
+        return min(max(t_us, lo), hi)
+
+    flow_id = 0
+    for ft in fts:
+        hop_by_trace = {h.trace: h for h in ft.hops}
+        for h in ft.hops:
+            tid = lanes[h.trace]
+            for sp in h.spans:
+                args = dict(sp.attrs, slo_class=sp.slo_class,
+                            trace=sp.trace)
+                ts = sp.t0 * 1e6
+                if sp.kind in TERMINAL_KINDS:
+                    tr.add_instant(f"r{ft.rid} {sp.kind}", ts, pid=pid,
+                                   tid=tid, cat=sp.kind, args=args)
+                else:
+                    tr.add_complete(f"r{ft.rid} {sp.kind}", ts,
+                                    max(0.0, sp.t1 - sp.t0) * 1e6,
+                                    pid=pid, tid=tid, cat=sp.kind,
+                                    args=args)
+        for e in ft.edges:
+            t_us = float(e.get("t", 0.0)) * 1e6
+            src, dst = lane_of(e.get("src")), lane_of(e.get("dst"))
+            flow_id += 1
+            tr.add_flow(f"r{ft.rid} {e['kind']}", f"r{ft.rid}.{flow_id}",
+                        start_ts_us=enclosing_ts(e.get("src"), t_us),
+                        finish_ts_us=enclosing_ts(e.get("dst"), t_us),
+                        start_pid=pid, start_tid=src,
+                        finish_pid=pid, finish_tid=dst,
+                        cat=f"edge:{e['kind']}")
     return tr

@@ -376,6 +376,20 @@ class DisaggCoordinator:
         self.rank = rank
         self.channel = ShipmentChannel(plan=plan, rank=rank)
         self._registry = decode._registry
+        # the prefill TIER's flight recorder (only when the decode
+        # engine's own tracer is on — same HETU_TPU_SERVE_TRACE gate):
+        # each prefill incarnation of a request is its own hop trace
+        # (tier="prefill") of queued -> prefill -> done("shipped"), so
+        # FleetTrace.stitch sees the remote prompt work as a first-class
+        # node with a ship edge into the decode hop
+        self.pf_tracer = None
+        if decode.tracer is not None:
+            from hetu_tpu.serving.tracing import RequestTracer
+            self.pf_tracer = RequestTracer(
+                run_log=decode.run_log, registry=self._registry,
+                keep=True, tier="prefill", replica=rank,
+                clock=decode.clock_basis)
+        self._now = 0.0
         self._seq = 0
         self._arrivals: Deque[Request] = collections.deque()
         self._awaiting: Dict[int, _PendingShip] = {}
@@ -416,6 +430,7 @@ class DisaggCoordinator:
             p = self._awaiting.get(rid)
             if p is not None and p.shipment is None:
                 p.deadline = self._step_idx
+            self._pf_close(rid, self._now, reason="prefill_kill")
         return lost
 
     def _enter_degraded(self, now: float):
@@ -446,12 +461,65 @@ class DisaggCoordinator:
         if self.decode.tracer is not None:
             self.decode.tracer.on_stall([req.rid], "prefill_tier_down")
 
+    # --------------------------------------- prefill-tier hop tracing
+    def _pf_close(self, rid: int, now: float, *, reason: str):
+        """Close a still-open prefill hop with an ``evicted`` terminal
+        (the tier died / the request re-prefills) so the hop's spans
+        stay a complete, stitchable trace."""
+        if self.pf_tracer is None or rid not in self.pf_tracer._open:
+            return
+        st = self.pf_tracer._open[rid]
+        p = self._awaiting.get(rid)
+        req = p.request if p is not None else None
+        if req is None:
+            req = Request(rid=rid, prompt=np.zeros(1, np.int32),
+                          max_new_tokens=1)
+        now = max(now, st.last_t)
+        self.pf_tracer.on_finish(req, None, reason, now, tokens=0,
+                                 evicted=True)
+
+    def _pf_observe_admissions(self, now: float):
+        """Prefill-tier admissions happen inside the worker's step;
+        close the hop's queued span the first time we see the rid live
+        (its first chunk lands this same step)."""
+        if self.pf_tracer is None:
+            return
+        for rid, pf in self.prefill._live.items():
+            st = self.pf_tracer._open.get(rid)
+            if st is not None and st.phase == "queued":
+                self.pf_tracer.on_admit(pf.request, None, now)
+
+    def _pf_shipped(self, req: Request, now: float):
+        """The hop's terminal: the finished scratch went on the wire —
+        prefill span closes at the ship and the hop ends ``done``
+        (reason ``shipped``), the source node of the stitcher's
+        ship -> adopt edge."""
+        if self.pf_tracer is None or req.rid not in self.pf_tracer._open:
+            return
+        st = self.pf_tracer._open[req.rid]
+        if st.phase == "queued":     # admitted+finished in one step
+            self.pf_tracer.on_admit(req, None, now)
+        chunks = math.ceil(req.prompt_len / self.prefill.prefill_chunk)
+        self.pf_tracer.on_first_token(req, None, now, chunk=chunks)
+        self.pf_tracer.on_finish(req, None, "shipped", now, tokens=0)
+
     def _route(self, req: Request, now: float, attempt: int = 0):
         if self.degraded and self.fallback:
             self._awaiting.pop(req.rid, None)
+            if self.decode._sampled(req.rid):
+                self.decode._log_serve(event="dispatch", req=req.rid,
+                                       tier="decode", now=now,
+                                       fallback=True)
             self._fallback_submit(req, now)
             return
         self.prefill.submit(req, attempt=attempt)
+        if self.pf_tracer is not None:
+            self.pf_tracer.on_submit(req, at=now)
+        if self.decode._sampled(req.rid):
+            self.decode._log_serve(event="dispatch", req=req.rid,
+                                   tier="prefill", now=now,
+                                   **({"attempt": attempt}
+                                      if attempt else {}))
         p = self._awaiting.get(req.rid)
         if p is None:
             p = self._awaiting[req.rid] = _PendingShip(request=req)
@@ -476,6 +544,7 @@ class DisaggCoordinator:
         the full attempt history either way."""
         sched = self.decode.scheduler
         req = p.request
+        self._pf_close(rid, now, reason="reprefill")
         retries = sched.retries.get(rid, 0)
         if retries >= self.decode.config.retry_budget:
             self.prefill.drop(rid)
@@ -509,6 +578,7 @@ class DisaggCoordinator:
         adoption, ack/timeout processing, then one decode-engine step."""
         from hetu_tpu.chaos.inject import maybe_chaos_disagg
         step_idx = self._step_idx
+        self._now = now
         chaos = maybe_chaos_disagg(self.plan, self, step_idx,
                                    self.rank)
         down = chaos["prefill_down"]
@@ -527,13 +597,17 @@ class DisaggCoordinator:
             self._route(req, now)
 
         if not down:
-            for req, attempt, t1, ks, vs in self.prefill.step():
+            finished_pf = self.prefill.step()
+            self._pf_observe_admissions(now)
+            for req, attempt, t1, ks, vs in finished_pf:
                 self._seq += 1
                 ship = pack_shipment(self._seq, req, attempt, t1, ks,
                                      vs, quant=self.ship_quant)
                 p = self._awaiting.get(req.rid)
                 if p is None:       # dropped/terminated meanwhile
+                    self._pf_close(req.rid, now, reason="dropped")
                     continue
+                self._pf_shipped(req, now)
                 p.shipment = ship
                 p.deadline = step_idx + self.ship_timeout
                 self.ship_bytes += ship.wire_bytes

@@ -350,6 +350,11 @@ class ServingEngine:
         else:
             self._owns_runlog = False
         self.run_log = run_log
+        #: timestamp basis every serve event/span declares (the engine
+        #: drives a virtual DRIVER clock in run()/tests; a live server
+        #: embedding the engine on wall time sets "wall" so the fleet
+        #: stitcher refuses to mix the two)
+        self.clock_basis = "driver"
         # the flight recorder (HETU_TPU_SERVE_TRACE) and the serving
         # health detectors (HETU_TPU_HEALTH) — both host-side only, both
         # a single None check when their flag is unset; explicit
@@ -905,7 +910,11 @@ class ServingEngine:
 
     def _log_serve(self, **fields):
         """One serve event to every attached sink: the RunLog and (when
-        a TelemetrySource rides along) the cluster telemetry push."""
+        a TelemetrySource rides along) the cluster telemetry push.
+        Every record declares its ``clock`` basis (driver|wall — the
+        engine drives a virtual driver clock; see obs/spans.py) so the
+        fleet stitcher can refuse mixed-basis inputs."""
+        fields.setdefault("clock", self.clock_basis)
         rec = None
         if self.run_log is not None:
             rec = self.run_log.log("serve", **fields)

@@ -47,6 +47,7 @@ import math
 from typing import Any, Dict, List, Optional, Sequence
 
 from hetu_tpu.obs.metrics import Histogram
+from hetu_tpu.obs.spans import _EDGE_EVENTS, FleetTrace
 from hetu_tpu.serving.costs import COST_FIELDS, CostLedger, CostModel
 from hetu_tpu.serving.kv_pool import PagePool, kv_bytes_per_token
 from hetu_tpu.serving.request import Request, TenantQuota, rid_sampled
@@ -297,9 +298,22 @@ class FleetSimulator:
             self.sample = max(
                 1, flags.int_flag("HETU_TPU_RUNLOG_SERVE_SAMPLE"))
         # the real flight recorder over the SAMPLED requests (keep=True:
-        # the end-of-run reconciliation sweep reads the kept traces)
+        # the end-of-run reconciliation sweep reads the kept traces);
+        # stamped with its hop identity so the kept spans stitch
         self.tracer = RequestTracer(run_log=run_log, keep=True,
-                                    max_kept=1 << 20)
+                                    max_kept=1 << 20, tier="decode")
+        #: a SECOND flight recorder for the prefill tier (cfg.disagg):
+        #: a rid's prefill incarnations must be separate HOPS in the
+        #: stitched fleet trace, not collide with its decode trace.
+        #: In-memory only — the end-of-run stitch reads it directly;
+        #: the runlog keeps its established record stream.
+        self.pf_tracer = (RequestTracer(keep=True, max_kept=1 << 20,
+                                        tier="prefill", replica=0)
+                          if cfg.disagg else None)
+        #: the frontend/shipment EDGE events (dispatch/ship/retry/
+        #: admit), captured in memory so `FleetTrace.stitch` can build
+        #: the causal DAG without a runlog round-trip
+        self._events: List[Dict[str, Any]] = []
         # ---- exact accounting (per request, sampling-independent)
         self._buckets: Dict[tuple, _Bucket] = {}
         self._first_reason: Dict[int, str] = {}
@@ -383,6 +397,11 @@ class FleetSimulator:
         return b
 
     def _log(self, **fields):
+        if fields.get("event") in _EDGE_EVENTS:
+            # the stitcher's causal-edge vocabulary rides the same
+            # serve events the runlog gets — captured unconditionally
+            # so runlog-less sims still stitch
+            self._events.append(dict(fields))
         if self.run_log is not None:
             self.run_log.log("serve", **fields)
 
@@ -743,6 +762,13 @@ class FleetSimulator:
         self._pf_awaiting[req.rid] = {
             "req": req, "attempt": attempt, "deadline": math.inf,
             "shipped": False, "seq": None, "resends": 0}
+        if self.pf_tracer is not None and self._sampled(req.rid):
+            # open the prefill-tier HOP at routing time (not arrival:
+            # the decode hop's queued span already covers the wait)
+            self.pf_tracer.on_submit(req, at=now)
+            self._log(event="dispatch", req=req.rid, tier="prefill",
+                      now=now,
+                      **({"attempt": attempt} if attempt else {}))
 
     def _fallback_colocate(self, req: Request, now: float):
         """Colocated chunked prefill on the decode tier (graceful
@@ -754,26 +780,60 @@ class FleetSimulator:
         self.colocated += 1
         self._enter_seq.setdefault(req.rid, self._stall_seq)
         self._requeue_reason[req.rid] = "prefill_tier_down"
+        if self._sampled(req.rid):
+            self._log(event="dispatch", req=req.rid, tier="decode",
+                      fallback=True, now=now)
 
     def _kill_prefill_tier(self, now: float):
         """Chaos ``prefill_kill``: every queued and in-flight prefill
         on the tier is lost; their pending entries' timeouts fire THIS
         step, so the recovery path (re-prefill under the retry budget,
         or colocation while degraded) runs immediately."""
-        lost = list(self._pf_live) + [r.rid for r, _ in self._pf_queue]
+        lost = ([(rid, ent[0]) for rid, ent in self._pf_live.items()]
+                + [(r.rid, r) for r, _ in self._pf_queue])
         self._pf_live.clear()
         self._pf_queue.clear()
         self.prefill_kills += 1
-        for rid in lost:
+        for rid, req in lost:
             p = self._pf_awaiting.get(rid)
             if p is not None and not p["shipped"]:
                 p["deadline"] = now
                 self._pf_armed[rid] = None
+            self._pf_hop_evict(req, now, reason="prefill_kill")
+
+    def _pf_hop_evict(self, req: Request, now: float, *, reason: str):
+        """Close an OPEN prefill-tier hop ``evicted`` (a tier kill or a
+        re-prefill turnaround): the tracer tiles whatever phase was
+        open, so the discarded work still stitches and counts in the
+        fleet-wide span ledger.  A no-op when the hop already closed
+        (shipped) or the rid is unsampled."""
+        tr = self.pf_tracer
+        if tr is None or not self._sampled(req.rid) \
+                or not tr.is_open(req.rid):
+            return
+        tr.on_finish(req, None, reason, now, tokens=0, evicted=True)
+
+    def _pf_hop_ship(self, req: Request, now: float):
+        """Close the prefill-tier hop at the ship: the final chunk
+        boundary (the hop's ``last`` prefill span) plus the zero-token
+        ``shipped`` terminal — the stitcher's ship edge source."""
+        tr = self.pf_tracer
+        if tr is None or not self._sampled(req.rid) \
+                or not tr.is_open(req.rid):
+            return
+        C = self.cfg.prefill_chunk
+        tr.on_first_token(req, None, now,
+                          chunk=math.ceil(req.prompt_len / C))
+        tr.on_finish(req, None, "shipped", now, tokens=0)
 
     def _pf_send(self, rid: int, p: dict, now: float):
         """Put (or re-put) rid's shipment on the modeled wire, driving
         the chaos shipment_* kinds exactly like the real channel."""
         self.ship_sent += 1
+        if self._sampled(rid):
+            self._log(event="ship", req=rid, seq=p["seq"],
+                      attempt=p["attempt"], resend=p["resends"],
+                      now=now, **self._weight_fields())
         plan = self.fault_plan
         spec = plan.shipment_fault("ship") if plan is not None else None
         due = now + self.cfg.ship_latency_s
@@ -796,6 +856,7 @@ class FleetSimulator:
         — the same `scheduler.retries` ledger replica failover bills —
         or terminate ``retry_exhausted`` past it."""
         req = p["req"]
+        self._pf_hop_evict(req, now, reason="reprefill")
         retries = self.sched.retries.get(rid, 0)
         if retries >= self.cfg.retry_budget:
             self._pf_awaiting.pop(rid, None)
@@ -919,6 +980,9 @@ class FleetSimulator:
                 req, attempt = self._pf_queue.popleft()
                 if req.rid in self._pf_awaiting:
                     self._pf_live[req.rid] = [req, 0, attempt]
+                    if self.pf_tracer is not None \
+                            and self._sampled(req.rid):
+                        self.pf_tracer.on_admit(req, None, now)
             for rid in list(self._pf_live):
                 ent = self._pf_live[rid]
                 req, done, attempt = ent
@@ -932,7 +996,11 @@ class FleetSimulator:
                 del self._pf_live[rid]
                 p = self._pf_awaiting.get(rid)
                 if p is None:
-                    continue        # terminated while prefilling
+                    # terminated while prefilling: the hop's work is
+                    # discarded but must still tile and stitch
+                    self._pf_hop_evict(req, now, reason="dropped")
+                    continue
+                self._pf_hop_ship(req, now)
                 self._pf_seq += 1
                 p["shipped"] = True
                 p["seq"] = self._pf_seq
@@ -1186,8 +1254,40 @@ class FleetSimulator:
             if r is not None:
                 checked += 1
                 max_residual = max(max_residual, r)
-        return {"traces_checked": checked,
-                "max_residual_s": max_residual}
+        out = {"traces_checked": checked,
+               "max_residual_s": max_residual}
+        out.update(self._check_stitch())
+        return out
+
+    def _check_stitch(self) -> Dict[str, Any]:
+        """Stitch every kept hop (decode + prefill-tier) and captured
+        edge event into per-rid `FleetTrace`s, enforce the fleet-scope
+        tiling contract, and decompose every completed request's
+        critical path — the storm tests assert zero residual off this
+        block (docs/observability.md, Distributed tracing)."""
+        hops = list(self.tracer.completed)
+        if self.pf_tracer is not None:
+            hops += self.pf_tracer.completed
+        if not hops:
+            return {}
+        from hetu_tpu.obs.critpath import critical_path
+        fts = FleetTrace.stitch(traces=hops, events=self._events)
+        quantum = self.service.step_overhead_s
+        paths = 0
+        max_cp = 0.0
+        max_ttft = 0.0
+        for ft in fts.values():
+            ft.validate(step_quantum=quantum)
+            cp = critical_path(ft)
+            if cp is None:
+                continue
+            paths += 1
+            max_cp = max(max_cp, abs(cp["residual_s"]))
+            if cp["ttft_residual_s"] is not None:
+                max_ttft = max(max_ttft, abs(cp["ttft_residual_s"]))
+        return {"stitched": len(fts), "critical_paths": paths,
+                "max_critpath_residual_s": max_cp,
+                "max_ttft_residual_s": max_ttft}
 
     @staticmethod
     def _bucket_report(b: _Bucket, elapsed: float) -> Dict[str, Any]:

@@ -103,6 +103,15 @@ class Frontend:
                              f"got {hedge_after}")
         self.replicas = [_Replica(engine=e, idx=i)
                          for i, e in enumerate(engines)]
+        # stamp each replica's hop identity onto its tracer so every
+        # span records which engine of the fleet emitted it — the trace
+        # context (rid, attempt, tier, replica) the stitcher
+        # (obs/spans.FleetTrace) keys causal edges on
+        for i, r in enumerate(self.replicas):
+            tr = getattr(r.engine, "tracer", None)
+            if tr is not None:
+                tr.tier = "decode"
+                tr.replica = i
         self.plan = plan
         self.quotas = quotas or {}
         self.hedge_after = hedge_after
@@ -198,6 +207,8 @@ class Frontend:
             r.engine.submit(req)
             self._routed[req.rid] = _Routed(request=req, primary=r.idx,
                                             routed_step=self._step_idx)
+            self._log(event="dispatch", req=req.rid, replica=r.idx,
+                      tier="decode", now=now)
         self._held.extend(deferred)
 
     # ------------------------------------------------------------ hedge
@@ -237,6 +248,11 @@ class Frontend:
         if loser.engine.scheduler.drop_queued(rt.request):
             self.hedge_withdrawn += 1
             self._registry.inc("serve.hedge_withdrawn")
+            # the losing copy gets its TERMINAL span so fleet-wide
+            # span accounting closes over the discarded wait too
+            tr = getattr(loser.engine, "tracer", None)
+            if tr is not None:
+                tr.on_withdraw(rt.request, now, reason="hedge_loss")
         if winner == rt.hedged_to:
             self.hedge_wins += 1
             self._registry.inc("serve.hedge_wins")
@@ -261,16 +277,25 @@ class Frontend:
                 # requeued in-flight included — another replica replays
                 # them token-identically from the prompt)
                 sched = r.engine.scheduler
+                tracer = getattr(r.engine, "tracer", None)
                 pulled = []
                 while sched.queue:
                     pulled.append(sched.queue.popleft())
                 for req in pulled:
                     sched.retries.pop(req.rid, None)
+                    # close the dead replica's hop with a withdrawal
+                    # terminal: the rid's story continues on another
+                    # replica, but THIS hop's spans must still close
+                    if tracer is not None:
+                        tracer.on_withdraw(req, now, reason="rerouted")
                     alt = self._pick(exclude=r.idx)
                     if alt is None:
                         self._held.append(req)
                         continue
                     alt.engine.submit(req)
+                    self._log(event="dispatch", req=req.rid,
+                              replica=alt.idx, tier="decode", now=now,
+                              rerouted_from=r.idx)
                     rt = self._routed.get(req.rid)
                     if rt is not None:
                         rt.primary = alt.idx
@@ -304,6 +329,9 @@ class Frontend:
                     self._registry.inc("serve.hedge_dupes")
                     self._registry.inc("serve.hedge_discarded_tokens",
                                        value=len(res.tokens))
+                    self._log(event="hedge_dupe", req=rid,
+                              replica=r.idx, now=now,
+                              tokens=len(res.tokens))
                     continue
                 self._finished.add(rid)
                 rt = self._routed.pop(rid, None)

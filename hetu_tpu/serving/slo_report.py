@@ -28,6 +28,11 @@ The report answers the questions aggregate histograms cannot:
   span durations vs the recorded ``e2e_s`` (the acceptance property:
   within one engine-step quantum; exact by the tracer's tiling
   construction),
+* **critical path** — the stitched fleet traces (`FleetTrace.stitch`)
+  decomposed into exclusive latency segments (obs/critpath.py) and
+  rolled up per SLO class and tenant: where TTFT and e2e actually
+  went, summing to the recorded latencies with zero residual
+  (docs/observability.md, Distributed tracing),
 * **fault accounting** — the failover / deadline / brownout sections
   (docs/fault_tolerance.md): replica deaths and per-class retry counts
   (HETU_TPU_SERVE_RETRY), deadline expiries and the tokens they
@@ -58,7 +63,7 @@ from __future__ import annotations
 from typing import Any, Dict, Iterable, List, Optional
 
 from hetu_tpu.obs.metrics import percentile_of_sorted
-from hetu_tpu.obs.spans import RequestTrace, collect_traces
+from hetu_tpu.obs.spans import FleetTrace, RequestTrace, collect_traces
 from hetu_tpu.serving.costs import COST_FIELDS, aggregate_costs
 
 #: bump when the report dict shape changes incompatibly (pinned by the
@@ -101,6 +106,11 @@ def collect(records: Iterable[Dict[str, Any]]) -> Dict[str, Any]:
         "hedges": [r for r in serves
                    if r.get("event") in ("hedge", "hedge_win")],
         "traces": collect_traces(records),
+        # the stitched fleet DAG (obs/spans.py): EVERY (rid, trace) hop
+        # — hedge losers and prefill-tier incarnations included — plus
+        # the causal edges.  Raises ValueError on mixed clock bases:
+        # a driver-clock and a wall-clock log cannot share a timeline.
+        "stitched": FleetTrace.stitch(records),
         "anomalies": [r for r in records if r.get("kind") == "anomaly"],
     }
 
@@ -488,6 +498,140 @@ def stall_breakdown(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
             "queued_s": {k: round(v, 6) for k, v in waited.items()}}
 
 
+def critpath_report(collected: Dict[str, Any],
+                    rows: List[Dict[str, Any]]
+                    ) -> Optional[Dict[str, Any]]:
+    """Critical-path rollup (obs/critpath.py) over the stitched fleet
+    traces: where each class's and tenant's latency went, decomposed
+    into the exclusive frontend_queue / prefill / shipment_wait /
+    decode_queue / decode / reshard_pause / replay segments that sum to
+    e2e with zero residual.  None when the log has no stitchable spans
+    (HETU_TPU_SERVE_TRACE unset degrades gracefully)."""
+    from hetu_tpu.obs.critpath import critical_path, rollup
+    fts = collected.get("stitched") or {}
+    if not fts:
+        return None
+    tenants = {r["rid"]: r["tenant"] for r in rows}
+    paths: List[Dict[str, Any]] = []
+    for rid in sorted(fts):
+        cp = critical_path(fts[rid])
+        if cp is not None:
+            paths.append(dict(cp, tenant=tenants.get(rid, "default")))
+    if not paths:
+        return None
+    by_cls: Dict[str, List[Dict[str, Any]]] = {}
+    by_tenant: Dict[str, List[Dict[str, Any]]] = {}
+    for cp in paths:
+        by_cls.setdefault(cp["slo_class"], []).append(cp)
+        by_tenant.setdefault(cp["tenant"], []).append(cp)
+    out: Dict[str, Any] = {
+        "overall": rollup(paths),
+        "by_class": {k: rollup(v) for k, v in sorted(by_cls.items())},
+    }
+    if any(t != "default" for t in by_tenant):
+        out["by_tenant"] = {k: rollup(v)
+                            for k, v in sorted(by_tenant.items())}
+    return out
+
+
+#: bump when the request_tree dict shape changes incompatibly (pinned
+#: by the tools_serving_report --request --json smoke test)
+REQUEST_TREE_SCHEMA = 1
+
+
+def request_tree(collected: Dict[str, Any], rid: int
+                 ) -> Optional[Dict[str, Any]]:
+    """One rid's stitched hop tree (`tools_serving_report.py --request`):
+    every fleet hop with its span timeline, the causal edges labelled by
+    hop identity, the span-seconds/lifetime work ledger, and the
+    critical-path decomposition (None while the request is still in
+    flight).  None when the rid never recorded a span."""
+    from hetu_tpu.obs.critpath import critical_path
+    fts = collected.get("stitched") or {}
+    ft = fts.get(rid)
+    if ft is None:
+        return None
+    prim = ft.primary
+    label = {h.trace: ft.hop_label(h) for h in ft.hops}
+    hops = []
+    for h in ft.hops:
+        hops.append({
+            "hop": ft.hop_label(h),
+            "trace": h.trace,
+            "tier": h.tier,
+            "replica": h.replica,
+            "primary": prim is not None and h.trace == prim.trace,
+            "t0": h.spans[0].t0,
+            "t1": h.spans[-1].t1,
+            "lifetime_s": h.lifetime_s,
+            "attempts": len(h.attempts()),
+            "terminal": h.terminal.kind if h.terminal is not None
+            else None,
+            "spans": [{"kind": s.kind, "t0": s.t0, "t1": s.t1,
+                       "attempt": s.attempt,
+                       **({"reason": s.attrs["reason"]}
+                          if s.attrs.get("reason") is not None else {})}
+                      for s in h.spans],
+        })
+    edges = [dict(e, src=label.get(e.get("src"), e.get("src")),
+                  dst=label.get(e.get("dst"), e.get("dst")))
+             for e in ft.edges]
+    return {
+        "request_tree_schema": REQUEST_TREE_SCHEMA,
+        "rid": ft.rid,
+        "slo_class": ft.slo_class,
+        "clock": ft.clock,
+        "hops": hops,
+        "edges": edges,
+        "span_seconds": ft.span_seconds,
+        "lifetime_seconds": ft.lifetime_seconds,
+        "e2e_s": ft.e2e_s,
+        "critical_path": critical_path(ft),
+    }
+
+
+def render_request_tree(tree: Dict[str, Any]) -> str:
+    """The hop tree as text: hops indented with their span timelines
+    (the primary hop starred), the causal edges, and the critical path
+    with its dominant segment highlighted."""
+    ln = [f"request {tree['rid']} ({tree['slo_class']}, "
+          f"{tree['clock']} clock): {len(tree['hops'])} hop(s), "
+          f"fleet work {tree['span_seconds']:.4g} span-s"
+          + (f", e2e {tree['e2e_s']:.4g}s"
+             if tree.get("e2e_s") is not None else " (in flight)")]
+    for h in tree["hops"]:
+        star = "*" if h["primary"] else " "
+        ln.append(f" {star} {h['hop']:<12} "
+                  f"[{h['t0']:.4f} -> {h['t1']:.4f}] "
+                  f"{h['lifetime_s']:.4g}s, "
+                  f"{h['attempts']} attempt(s) -> "
+                  f"{h['terminal'] or 'OPEN'}")
+        for s in h["spans"]:
+            att = f" attempt={s['attempt']}" if s["attempt"] > 1 else ""
+            why = f" ({s['reason']})" if s.get("reason") else ""
+            ln.append(f"      {s['kind']:<16} "
+                      f"{s['t0']:.4f} -> {s['t1']:.4f} "
+                      f"({s['t1'] - s['t0']:.4g}s){att}{why}")
+    if tree["edges"]:
+        ln.append("  edges:")
+        for e in tree["edges"]:
+            ln.append(f"      {e['src']} --{e['kind']}--> {e['dst']} "
+                      f"@{e['t']:.4f}")
+    cp = tree.get("critical_path")
+    if cp is not None:
+        top = max(cp["segments"], key=lambda s: cp["segments"][s])
+        ln.append(f"  critical path (e2e {cp['e2e_s']:.4g}s"
+                  + (f", ttft {cp['ttft_s']:.4g}s"
+                     if cp.get("ttft_s") is not None else "")
+                  + f", residual {cp['residual_s']:.3g}s):")
+        for piece in cp["path"]:
+            mark = " <-- dominant" if piece["segment"] == top else ""
+            ln.append(f"      {piece['segment']:<16} "
+                      f"{piece['t0']:.4f} -> {piece['t1']:.4f} "
+                      f"({piece['t1'] - piece['t0']:.4g}s){mark}")
+    return "\n".join(ln)
+
+
 def reconciliation(rows: List[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     """The acceptance property's summary: span tiling vs recorded e2e
     across every traced request."""
@@ -543,6 +687,9 @@ def serving_report(records: Iterable[Dict[str, Any]], *,
     rec = reconciliation(rows)
     if rec is not None:
         out["reconciliation"] = rec
+    cp = critpath_report(collected, rows)
+    if cp is not None:
+        out["critical_path"] = cp
     spec = spec_decode_report(collected)
     if spec is not None:
         out["spec_decode"] = spec
@@ -656,6 +803,21 @@ def render_text(report: Dict[str, Any]) -> str:
         lines.append(
             f"span reconciliation: {rec['requests']} traced requests, "
             f"max |spans - e2e| = {rec['max_residual_s']:.3g}s")
+    cpr = report.get("critical_path")
+    if cpr:
+        tot = cpr["overall"]
+        parts = [f"{seg}={tot['mean_s'][seg]:.4g}s"
+                 for seg in tot["mean_s"] if tot["total_s"][seg] > 0]
+        lines.append(
+            f"critical path ({tot['requests']} stitched, mean s/req): "
+            + ", ".join(parts)
+            + f"; max residual {tot['max_residual_s']:.3g}s")
+        for cls, sec in cpr["by_class"].items():
+            top = max(sec["mean_s"], key=lambda s: sec["mean_s"][s])
+            lines.append(
+                f"  {cls}: dominant segment {top} "
+                f"({sec['mean_s'][top]:.4g}s mean of "
+                f"{sec['requests']} requests)")
     spec = report.get("spec_decode")
     if spec:
         lines.append(

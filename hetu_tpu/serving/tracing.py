@@ -14,7 +14,8 @@ closed —
     prefill  [prev_end, chunk_end]               one per chunk; last ends at TTFT
     decode   [prev_end, boundary]                split at evictions/reshard pauses
     reshard_pause [pause_t0, pause_t1]
-    done/evicted/deadline_exceeded [t, t]        zero-duration terminal
+    done/evicted/deadline_exceeded/hedge_withdrawn [t, t]
+                                                 zero-duration terminal
 
 so ``sum(durations) == done_t - arrival_t == e2e_s`` by construction
 (`slo_report` property-tests the reconciliation).
@@ -73,16 +74,31 @@ class RequestTracer:
     """
 
     def __init__(self, run_log=None, registry=None,
-                 keep: Optional[bool] = None, max_kept: int = 4096):
+                 keep: Optional[bool] = None, max_kept: int = 4096,
+                 tier: Optional[str] = None,
+                 replica: Optional[int] = None, clock: str = "driver"):
         self.run_log = run_log
         self.registry = registry
         self.keep = (run_log is None) if keep is None else keep
         self.max_kept = max_kept
+        #: hop identity (the fleet trace context): ``tier`` names the
+        #: pipeline stage this tracer records (prefill|decode; None =
+        #: a single colocated engine), ``replica`` the engine index
+        #: behind a routing frontend (the frontend stamps it), and
+        #: ``clock`` the timestamp basis every span declares
+        self.tier = tier
+        self.replica = replica
+        self.clock = clock
         self._open: Dict[int, _Open] = {}
         #: completed RequestTraces by rid (keep=True only; bounded to
         #: the newest ``max_kept`` so a long-lived runlog-less engine
         #: cannot grow without limit)
         self.traces: Dict[int, RequestTrace] = {}
+        #: every completed trace in completion order (keep=True; same
+        #: bound) — unlike ``traces`` a rid that carried SEVERAL hops
+        #: on this tracer (prefill re-prefills) keeps them all, which
+        #: is what `FleetTrace.stitch` wants
+        self.completed: List[RequestTrace] = []
         self._kept: Dict[int, RequestTrace] = {}
         self.spans_emitted = 0
 
@@ -93,7 +109,9 @@ class RequestTracer:
             # attempt-1 spans keep the pre-failover record shape
             attrs.setdefault("attempt", st.attempt)
         span = Span(kind=kind, t0=t0, t1=t1, rid=st.rid, trace=st.trace,
-                    slot=st.slot, slo_class=st.slo_class, attrs=attrs)
+                    slot=st.slot, slo_class=st.slo_class,
+                    clock=self.clock, tier=self.tier,
+                    replica=self.replica, attrs=attrs)
         self.spans_emitted += 1
         if self.registry is not None:
             self.registry.inc("serve.spans", span=kind)
@@ -107,14 +125,16 @@ class RequestTracer:
             tr.spans.append(span)
 
     # -------------------------------------------------------- lifecycle
-    def on_submit(self, req) -> str:
+    def on_submit(self, req, at: Optional[float] = None) -> str:
         """A request entered the queue; opens the queued span at its
-        arrival time.  Returns the assigned trace id."""
+        arrival time (or ``at`` — a prefill-tier hop opens at ROUTING
+        time, not arrival, so sibling hops don't double-open the same
+        wait).  Returns the assigned trace id."""
         trace = new_trace_id(req.rid)
         slo = getattr(req, "slo", None)
         self._open[req.rid] = _Open(
             req.rid, trace, slo.name if slo is not None else "default",
-            float(req.arrival_t))
+            float(req.arrival_t) if at is None else float(at))
         return trace
 
     def on_stall(self, rids: Iterable[int], reason: str):
@@ -254,10 +274,15 @@ class RequestTracer:
         """Emit the zero-duration terminal span and retire the trace."""
         self._emit(st, kind, now, now, **attrs)
         if self.keep and st.rid in self._kept:
-            self.traces[st.rid] = self._kept.pop(st.rid)
+            tr = self._kept.pop(st.rid)
+            self.traces[st.rid] = tr
+            self.completed.append(tr)
             while len(self.traces) > self.max_kept:
                 # dicts iterate in insertion order: drop the oldest
                 self.traces.pop(next(iter(self.traces)))
+            if len(self.completed) > self.max_kept:
+                del self.completed[: len(self.completed)
+                                   - self.max_kept]
 
     def on_finish(self, req, slot: int, reason: str, now: float, *,
                   tokens: Optional[int] = None, e2e_s=None,
@@ -311,6 +336,31 @@ class RequestTracer:
                        reason="deadline_exceeded", tokens=tokens,
                        e2e_s=e2e_s, chunks=st.chunks)
 
+    def on_withdraw(self, req, now: float, *,
+                    reason: str = "hedge_loss"):
+        """The frontend withdrew this copy of the request from this
+        replica — the losing side of a hedged dispatch
+        (``reason="hedge_loss"``), or a dead replica's queue being
+        re-routed (``reason="rerouted"``).  Close whatever phase is
+        open and emit the ``hedge_withdrawn`` terminal so fleet-wide
+        span accounting includes the discarded wait/work: stitched
+        span-seconds equal the sum of per-attempt lifetimes, losers
+        included."""
+        st = self._open.pop(req.rid, None)
+        if st is None:
+            return
+        if st.phase == "queued":
+            self._emit(st, "queued", st.last_t, now,
+                       reason=st.stall_reason)
+        elif st.phase == "prefill":
+            if now > st.last_t:
+                self._emit(st, "prefill", st.last_t, now,
+                           chunk=st.chunks, discarded=True)
+        else:
+            self._close_segment(st, now, end="withdraw")
+        self._finalize(st, "hedge_withdrawn", now, reason=reason,
+                       tokens=st.seg_tokens, chunks=st.chunks)
+
     def on_shed(self, req, now: float):
         """The brownout policy shed this still-queued request
         (HETU_TPU_SERVE_BROWNOUT): close its queued span with the
@@ -326,6 +376,11 @@ class RequestTracer:
                        chunks=st.chunks)
 
     # ------------------------------------------------------------ debug
+    def is_open(self, rid: int) -> bool:
+        """True while rid has an open (un-terminated) hop here — the
+        fleet sim's guard for idempotent hop closes."""
+        return rid in self._open
+
     def open_requests(self) -> List[int]:
         return sorted(self._open)
 

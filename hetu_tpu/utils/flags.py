@@ -322,11 +322,17 @@ REGISTRY: Dict[str, Flag] = {f.name: f for f in [
          "request's lifecycle as schema-versioned 'span' RunLog records "
          "— queued (with the scheduler's no_slot/no_pages stall "
          "attribution), one span per prefill chunk, decode segments "
-         "split at evictions/reshard pauses, terminal done/evicted — "
-         "under the driver's virtual clock, so replayed traces are "
-         "deterministic.  Pure host-side bookkeeping: the compiled "
-         "prefill/decode programs are byte-identical with the flag on "
-         "or off (registered identity contract)", identity="1"),
+         "split at evictions/reshard pauses, terminal "
+         "done/evicted/hedge_withdrawn — each span stamped with its "
+         "clock basis (driver|wall) and, on fleet tiers, tier/replica "
+         "trace context, so obs/spans.py FleetTrace.stitch can assemble "
+         "the per-engine hops plus frontend dispatch/hedge/ship events "
+         "into one causal per-request DAG and obs/critpath.py can "
+         "decompose TTFT/e2e with zero residual.  Pure host-side "
+         "bookkeeping: the compiled prefill/decode programs are "
+         "byte-identical with the flag on or off (registered identity "
+         "contract, decode program — reads are serving-confined)",
+         identity="1", identity_programs=("decode",)),
     Flag("HETU_TPU_SERVE_RETRY", "int", 0,
          "per-request retry budget after a serving replica death (chaos "
          "engine_kill): in-flight requests re-enter the queue with the "

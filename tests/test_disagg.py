@@ -385,6 +385,67 @@ def test_frontend_hedge_dedupe_token_identical(tiny_llama):
     assert s["hedges"] >= s["hedge_wins"]
 
 
+def test_frontend_hedge_traces_stitch(tiny_llama, tmp_path):
+    """The tentpole at small scale with REAL engines: a hedged
+    two-replica fleet's span hops + frontend serve events stitch into
+    per-rid causal DAGs — hedge fork edges present, every loser closed
+    (``hedge_withdrawn`` terminal, or run-to-completion dropped via the
+    ``hedge_dupe`` event), exactly one client terminal per rid,
+    span-seconds == sum of per-hop lifetimes INCLUDING the discarded
+    hedge work, and every rid's critical path sums to e2e with zero
+    residual."""
+    from hetu_tpu.obs.critpath import critical_path
+    from hetu_tpu.obs.runlog import RunLog
+    from hetu_tpu.obs.spans import FleetTrace
+    from hetu_tpu.serving.tracing import RequestTracer
+    model, params = tiny_llama
+    path = str(tmp_path / "hedge.jsonl")
+    log = RunLog(path)
+    engines = [serving.ServingEngine(model, params, _cfg(num_slots=1),
+                                     registry=MetricsRegistry(),
+                                     run_log=log if i == 0 else None,
+                                     tracer=RequestTracer(keep=True))
+               for i in range(2)]
+    fe = Frontend(engines, hedge_after=2, registry=MetricsRegistry())
+    res = fe.run(_requests(model.config.vocab_size, n=12, seed=3))
+    log.close()
+    assert fe.hedges >= 1, "congestion never armed a hedge"
+
+    recs = RunLog.read(path)
+    hops = engines[0].tracer.completed + engines[1].tracer.completed
+    fts = FleetTrace.stitch(recs, traces=hops)
+    assert set(fts) == {r.rid for r in res}
+    saw_fork = saw_closed_loser = False
+    for rid, ft in sorted(fts.items()):
+        ft.validate()
+        # the accounting identity holds with the losers' work included
+        assert ft.span_seconds == pytest.approx(ft.lifetime_seconds), rid
+        cp = critical_path(ft)
+        assert cp is not None, rid
+        assert abs(cp["residual_s"]) < 1e-9, rid
+        if cp["ttft_residual_s"] is not None:
+            assert abs(cp["ttft_residual_s"]) < 1e-9, rid
+        kinds = {e["kind"] for e in ft.edges}
+        assert "dispatch" in kinds, rid
+        if "hedge_fork" in kinds:
+            saw_fork = True
+            assert len(ft.hops) == 2, rid
+            prim = ft.primary
+            loser = next(h for h in ft.hops if h is not prim)
+            dupes = {ev.get("replica") for ev in ft.events
+                     if ev.get("event") == "hedge_dupe"}
+            if loser.terminal is not None \
+                    and loser.terminal.kind == "hedge_withdrawn":
+                saw_closed_loser = True
+                assert "hedge_withdraw" in kinds, rid
+            else:
+                # ran to completion: dropped as a hedge dupe
+                assert loser.replica in dupes, rid
+                saw_closed_loser = True
+    assert saw_fork, "no hedged rid reached the stitcher"
+    assert saw_closed_loser
+
+
 def test_frontend_drain_rejoin_and_fleet_quota(tiny_llama):
     """drain() takes a replica out of rotation (nothing new lands on
     it; rejoin restores it), and a fleet-WIDE tenant quota caps live

@@ -125,8 +125,13 @@ def test_identity_contract_table():
     assert table["HETU_TPU_PROFILE"] == "1"
     assert table["HETU_TPU_LINT"] == "1"
     # the serving flight recorder is host-side only: ON must be a no-op
-    # for the compiled programs
+    # for the compiled programs.  Since the distributed-tracing layer
+    # (PR 20) it also stamps clock/tier/replica trace context and the
+    # hedge_withdrawn terminal — still pure bookkeeping, and its reads
+    # are serving-confined, so the contract sweeps the decode program
     assert table["HETU_TPU_SERVE_TRACE"] == "1"
+    assert flags.identity_contract_programs(
+        "HETU_TPU_SERVE_TRACE") == ("decode",)
     # the numerics observatory changes the traced program when ON (the
     # stats ride the step outputs), so its contract is the OFF value
     assert table["HETU_TPU_NUMERICS"] == "0"
@@ -206,6 +211,20 @@ def test_doc_flag_drift():
     assert not undocumented, (
         f"registered flags documented nowhere in docs/*.md or README: "
         f"{undocumented}")
+    # the distributed-tracing doc surface (PR 20): the observability doc
+    # owns the "Distributed tracing" section, the serving doc and README
+    # point at it, and the CLI drill-down is documented where a reader
+    # debugging one slow request would look
+    obs_doc = (root / "docs" / "observability.md").read_text()
+    assert "## Distributed tracing" in obs_doc
+    for needle in ("FleetTrace.stitch", "hedge_withdrawn", "clock",
+                   "critical_path", "stitched_trace"):
+        assert needle in obs_doc, f"observability.md lost {needle!r}"
+    serving_doc = (root / "docs" / "serving.md").read_text()
+    assert "Distributed tracing" in serving_doc
+    assert "--request" in serving_doc
+    readme = (root / "README.md").read_text()
+    assert "FleetTrace.stitch" in readme and "--request" in readme
 
 
 def test_profile_flag_defaults_are_off_path():

@@ -387,11 +387,14 @@ def _disagg_config(**kw):
 
 def test_fleet_disagg_two_tier_10k_storm_invariants_and_accounting():
     """The two-tier robustness fuzz at 10^4: prefill tier + decode tier
-    with the shipment wire dropping/duplicating/delaying KV and the
-    tier killed twice mid-run.  Zero invariant violations, exact span
-    tiling, every request reaches a terminal state, and EMITTED ==
+    with the shipment wire dropping/duplicating/delaying KV, the tier
+    killed twice mid-run, one decode-replica kill, and a deadline'd
+    class expiring under pressure.  Zero invariant violations, exact
+    span tiling, every request reaches a terminal state, EMITTED ==
     FINISHED + discarded holds through re-prefills and colocated
-    fallback."""
+    fallback — and every rid STITCHES: the prefill/decode hops form one
+    causal DAG whose critical-path decomposition sums to e2e and TTFT
+    with zero residual (the tentpole acceptance bar)."""
     from hetu_tpu.chaos.plan import FaultPlan, FaultSpec
     n = 10_000
     svc, cost = _models()
@@ -406,16 +409,32 @@ def test_fleet_disagg_two_tier_10k_storm_invariants_and_accounting():
         # bursts), so the outage must span real virtual time for
         # arrivals to land inside it
         FaultSpec(kind="prefill_kill", at_step=100, count=5000),
-        FaultSpec(kind="prefill_kill", at_step=9000, count=1)])
+        FaultSpec(kind="prefill_kill", at_step=9000, count=1),
+        FaultSpec(kind="engine_kill", at_step=500, count=20)])
+    wl = _workload(n, slo_classes=[
+        SLOClass("gold", ttft_s=0.5, token_gap_s=0.25, priority=2),
+        SLOClass("bulk", deadline_s=0.05)])
     sim = FleetSimulator(
         svc, config=_config(disagg=True, prefill_slots=4,
-                            retry_budget=3),
+                            retry_budget=3, deadline=True),
         cost_model=cost, fault_plan=plan)
-    rep = sim.run(_workload(n))
+    rep = sim.run(wl)
 
     assert rep["invariants"]["ok"]
     assert rep["trace_check"]["max_residual_s"] < 1e-6
     assert rep["completed"] + rep["faults"]["faulted"] == n
+    # stitch completeness (sample=1): EVERY rid — replayed, expired,
+    # colocated, re-prefilled — assembles into a validated FleetTrace
+    # (_check_stitch raised otherwise), and every terminal rid's
+    # critical path reconciles with zero residual
+    tc = rep["trace_check"]
+    assert tc["stitched"] == n
+    assert tc["critical_paths"] == n
+    assert tc["max_critpath_residual_s"] < 1e-9
+    assert tc["max_ttft_residual_s"] < 1e-9
+    # the storm actually exercised the failure paths the DAG stitches
+    assert rep["faults"]["failovers"] == 1
+    assert rep["faults"]["deadline_exceeded"] > 0
     d = rep["disagg"]
     assert d["prefill_kills"] == 2
     assert d["shipments"]["dropped"] > 0 and d["shipments"]["duped"] > 0

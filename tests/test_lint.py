@@ -446,7 +446,11 @@ def test_identity_sweep_covers_every_contract_and_holds():
         # the PR 15 decoding subsystem (decode-program contracts)
         "HETU_TPU_SERVE_SAMPLE", "HETU_TPU_SPEC_DECODE",
         "HETU_TPU_SPEC_K", "HETU_TPU_SERVE_PREFIX_CACHE",
-        "HETU_TPU_SERVE_PREFIX_PAGES", "HETU_TPU_SERVE_PREEMPT"}
+        "HETU_TPU_SERVE_PREFIX_PAGES", "HETU_TPU_SERVE_PREEMPT",
+        # the distributed-tracing flight recorder (PR 20: clock basis,
+        # tier/replica trace context, hedge_withdrawn terminals — all
+        # host-side, decode-program contract)
+        "HETU_TPU_SERVE_TRACE"}
     all_programs = ("train", "decode", "moe", "moe_ep")
     want = set()
     for f in table:

@@ -111,8 +111,9 @@ def test_scheduler_admit_evict_fuzz_invariants():
     transition — AND so do the flight recorder's span-event invariants
     (a RequestTracer rides the same churn): every terminated request
     ends with exactly one terminal span, spans are ordered/
-    non-overlapping, and queued spans carry a reserve-on-admit stall
-    reason."""
+    non-overlapping, queued spans carry a reserve-on-admit stall
+    reason — and every churned request yields a STITCHABLE FleetTrace
+    with exact per-attempt tiling."""
     from hetu_tpu.serving.tracing import RequestTracer
     rng = np.random.default_rng(7)
     pool = _pool(num_pages=10, page_size=4)
@@ -296,6 +297,18 @@ def test_scheduler_admit_evict_fuzz_invariants():
         assert tr.reconcile(tr.terminal.attrs["e2e_s"]) <= 1e-9
     # still-queued requests hold open queued spans, not traces
     assert set(tracer.open_requests()) == {r.rid for r in sched.queue}
+
+    # ...and every churned request STITCHES: dup/late-dup/unapply/
+    # requeue traffic still assembles into a validated FleetTrace —
+    # exactly one client terminal, no orphan hops, per-attempt tiling
+    # exact (the fake clock has no step quantum to hide gaps behind)
+    from hetu_tpu.obs.spans import FleetTrace
+    fts = FleetTrace.stitch(traces=tracer.completed)
+    assert set(fts) == finished
+    for ft in fts.values():
+        ft.validate(step_quantum=0.0)
+    assert any(len(ft.primary.attempts()) > 1 for ft in fts.values()), \
+        "fuzz never stitched a multi-attempt (requeued) trace"
 
 
 def test_scheduler_rejects_impossible_requests():

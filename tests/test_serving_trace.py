@@ -18,8 +18,8 @@ from hetu_tpu import serving
 from hetu_tpu.models.llama import LlamaConfig, LlamaLMHeadModel
 from hetu_tpu.obs.metrics import MetricsRegistry
 from hetu_tpu.obs.runlog import RunLog
-from hetu_tpu.obs.spans import (STALL_REASONS, RequestTrace, Span,
-                                collect_traces)
+from hetu_tpu.obs.spans import (STALL_REASONS, FleetTrace, RequestTrace,
+                                Span, collect_traces)
 from hetu_tpu.serving import slo_report
 from hetu_tpu.serving.request import Request, SLOClass
 from hetu_tpu.serving.tracing import RequestTracer
@@ -538,6 +538,190 @@ def test_single_token_request_gap_is_vacuously_attained():
     rep = slo_report.serving_report([done])
     assert rep["classes"]["gold"]["attainment"]["slo"] == 1.0
     assert rep["classes"]["gold"]["goodput_tokens"] == 1
+
+
+# -------------------------------------------------- fleet stitch (PR 20)
+def _hop(rid, trace, spans, *, tier=None, replica=None, clock="driver",
+         slo="default"):
+    tr = RequestTrace(rid=rid, trace=trace, slo_class=slo)
+    for kind, t0, t1, attrs in spans:
+        tr.spans.append(Span(kind, t0, t1, rid=rid, trace=trace,
+                             slo_class=slo, clock=clock, tier=tier,
+                             replica=replica, attrs=attrs))
+    return tr
+
+
+def _disagg_fleet_trace():
+    """One rid through the two-tier pipeline: a prefill-tier hop that
+    ships, plus the decode hop that adopts the KV and finishes."""
+    pf = _hop(5, "pf.5", [("queued", 0.0, 1.0, {"reason": "none"}),
+                          ("prefill", 1.0, 3.0, {"chunk": 2}),
+                          ("done", 3.0, 3.0, {"reason": "shipped"})],
+              tier="prefill", replica=0)
+    dec = _hop(5, "d.5", [("queued", 0.0, 4.0, {"reason": "none"}),
+                          ("prefill", 4.0, 4.0, {"chunk": 0,
+                                                 "last": True}),
+                          ("decode", 4.0, 6.0, {"tokens": 5}),
+                          ("done", 6.0, 6.0, {"reason": "eos",
+                                              "tokens": 5})],
+               tier="decode")
+    events = [{"event": "dispatch", "req": 5, "tier": "prefill",
+               "now": 0.0},
+              {"event": "ship", "req": 5, "seq": 0, "now": 3.0},
+              {"event": "admit", "req": 5, "disagg": True, "now": 4.0}]
+    return FleetTrace.stitch(traces=[pf, dec], events=events)[5]
+
+
+def test_span_clock_basis_stamped_and_schema_pinned():
+    """Satellite: every span record carries its ``clock`` basis; the
+    hop-identity fields ride only when stamped (a colocated engine's
+    records keep their pre-fleet shape); the runlog schema docstring
+    documents the new rows."""
+    rec = Span("decode", 0.0, 1.0, rid=1, trace="t1").record()
+    assert rec["clock"] == "driver"
+    assert "tier" not in rec and "replica" not in rec
+    rec2 = Span("decode", 0.0, 1.0, rid=1, trace="t1", tier="prefill",
+                replica=3, clock="wall").record()
+    assert (rec2["clock"], rec2["tier"], rec2["replica"]) \
+        == ("wall", "prefill", 3)
+    back = Span.from_record(dict(rec2, kind="span", schema=1, t=0.0))
+    assert (back.clock, back.tier, back.replica) == ("wall", "prefill", 3)
+    assert "clock" not in back.attrs and "tier" not in back.attrs
+    with pytest.raises(ValueError, match="clock"):
+        Span("decode", 0, 1, rid=1, trace="t", clock="gps")
+    # the schema rows are doc-pinned: obs/runlog.py's record table names
+    # the clock basis, the hop-identity fields, the hedge_withdrawn
+    # terminal and the dispatch/hedge_dupe serve events
+    import hetu_tpu.obs.runlog as runlog_mod
+    for needle in ("clock", "hedge_withdrawn", "dispatch", "hedge_dupe",
+                   "replica"):
+        assert needle in runlog_mod.__doc__
+
+
+def test_stitch_refuses_mixed_clock_bases():
+    a = _hop(1, "ta", [("queued", 0, 1, {"reason": "none"}),
+                       ("done", 1, 1, {"reason": "eos"})])
+    b = _hop(1, "tb", [("queued", 0, 1, {"reason": "none"}),
+                       ("done", 1, 1, {"reason": "eos"})], clock="wall")
+    with pytest.raises(ValueError, match="mixed clock bases"):
+        FleetTrace.stitch(traces=[a, b])
+
+
+def test_fleet_stitch_disagg_edges_and_critical_path():
+    """The tentpole in miniature: a prefill hop + decode hop + the
+    frontend/shipment events stitch into one DAG whose edges name the
+    causal story and whose critical path sums exactly to e2e/TTFT."""
+    from hetu_tpu.obs.critpath import critical_path
+    ft = _disagg_fleet_trace()
+    ft.validate()
+    assert sorted(e["kind"] for e in ft.edges) \
+        == ["adopt", "dispatch", "ship"]
+    assert ft.primary.trace == "d.5"
+    assert ft.span_seconds == pytest.approx(ft.lifetime_seconds)
+    assert ft.span_seconds == pytest.approx(3.0 + 6.0)
+    cp = critical_path(ft)
+    segs = cp["segments"]
+    # the decode hop's queued 0->4 is carved by the pf hop's boundaries:
+    # 0-1 frontend_queue (pf admission wait), 1-3 remote prefill,
+    # 3-4 shipment wait; decode then runs 4->6
+    assert segs["frontend_queue"] == pytest.approx(1.0)
+    assert segs["prefill"] == pytest.approx(2.0)
+    assert segs["shipment_wait"] == pytest.approx(1.0)
+    assert segs["decode"] == pytest.approx(2.0)
+    assert sum(segs.values()) == pytest.approx(cp["e2e_s"])
+    assert abs(cp["residual_s"]) < 1e-9
+    # TTFT clips at the adopted last-chunk boundary (t=4): the same
+    # pieces minus decode
+    assert cp["ttft_s"] == pytest.approx(4.0)
+    assert abs(cp["ttft_residual_s"]) < 1e-9
+    assert cp["ttft_segments"]["decode"] == pytest.approx(0.0)
+
+
+def test_hedge_withdrawn_closes_loser_with_exact_accounting():
+    """Satellite: the losing hedge copy gets a ``hedge_withdrawn``
+    terminal, so stitched span-seconds equal the sum of per-hop
+    lifetimes INCLUDING the loser's discarded work — and the stitch
+    still sees exactly one client terminal."""
+    win = RequestTracer(keep=True, replica=0)
+    lose = RequestTracer(keep=True, replica=1)
+    req = Request(rid=9, prompt=np.ones(4, np.int32), max_new_tokens=4,
+                  arrival_t=0.0)
+    win.on_submit(req, at=0.0)
+    win.on_admit(req, 0, 1.0)
+    win.on_first_token(req, 0, 2.0, chunk=1)
+    win.on_finish(req, 0, "eos", 3.0, tokens=4, e2e_s=3.0)
+    lose.on_submit(req, at=1.5)
+    lose.on_admit(req, 1, 2.0)
+    lose.on_first_token(req, 1, 2.5, chunk=1)
+    lose.on_withdraw(req, 3.0, reason="hedge_lost")
+    events = [{"event": "hedge", "req": 9, "primary": 0, "hedge": 1,
+               "now": 1.5}]
+    ft = FleetTrace.stitch(traces=win.completed + lose.completed,
+                           events=events)[9]
+    ft.validate()
+    loser_hop = next(h for h in ft.hops if h.replica == 1)
+    assert loser_hop.terminal.kind == "hedge_withdrawn"
+    assert loser_hop.terminal.attrs["reason"] == "hedge_lost"
+    kinds = {e["kind"] for e in ft.edges}
+    assert {"hedge_fork", "hedge_withdraw"} <= kinds
+    assert ft.primary.replica == 0
+    assert ft.span_seconds == pytest.approx(ft.lifetime_seconds)
+    assert ft.span_seconds == pytest.approx(3.0 + 1.5)
+    assert ft.e2e_s == pytest.approx(3.0)
+
+
+def test_request_tree_schema_and_render():
+    """`tools_serving_report.py --request` shape pin: the stitched hop
+    tree's JSON schema, and the text render's primary-hop star +
+    highlighted critical path."""
+    ft = _disagg_fleet_trace()
+    recs = [dict(sp.record(), kind="span", schema=1, t=0.0)
+            for h in ft.hops for sp in h.spans]
+    recs += [dict(ev, kind="serve", schema=1, t=0.0)
+             for ev in ft.events]
+    tree = slo_report.request_tree(slo_report.collect(recs), 5)
+    assert tree["request_tree_schema"] == slo_report.REQUEST_TREE_SCHEMA
+    assert sorted(tree) == ["clock", "critical_path", "e2e_s", "edges",
+                            "hops", "lifetime_seconds",
+                            "request_tree_schema", "rid", "slo_class",
+                            "span_seconds"]
+    assert sorted(tree["hops"][0]) == [
+        "attempts", "hop", "lifetime_s", "primary", "replica", "spans",
+        "t0", "t1", "terminal", "tier", "trace"]
+    assert {h["hop"]: h["primary"] for h in tree["hops"]} \
+        == {"prefill/0": False, "decode": True}
+    # edges are labelled by hop identity, not raw trace ids
+    assert {(e["src"], e["dst"]) for e in tree["edges"]} \
+        == {("frontend", "prefill/0"), ("prefill/0", "decode"),
+            ("wire", "decode")}
+    txt = slo_report.render_request_tree(tree)
+    assert "* decode" in txt and "critical path" in txt
+    assert "--ship-->" in txt and "dominant" in txt
+    # the missing-rid path returns None (the CLI exits loudly)
+    assert slo_report.request_tree(slo_report.collect(recs), 404) is None
+
+
+def test_stitched_trace_emits_matched_flow_pairs():
+    """Satellite: the Chrome-trace fleet render draws every causal edge
+    as a ph "s"/"f" flow pair (matched by id, finish bound to the
+    enclosing slice) between the tier lanes."""
+    from hetu_tpu.obs.trace import stitched_trace
+    ft = _disagg_fleet_trace()
+    tr = stitched_trace({5: ft})
+    starts = [e for e in tr.events if e["ph"] == "s"]
+    finishes = [e for e in tr.events if e["ph"] == "f"]
+    assert len(starts) == len(ft.edges) == 3
+    assert sorted((e["cat"], e["id"]) for e in starts) \
+        == sorted((e["cat"], e["id"]) for e in finishes)
+    assert all(e["bp"] == "e" for e in finishes)
+    # the ship edge leaves the prefill lane and lands on the decode lane
+    ship_s = next(e for e in starts if e["cat"] == "edge:ship")
+    ship_f = next(e for e in finishes if e["cat"] == "edge:ship")
+    assert ship_s["tid"] == "prefill/0" and ship_f["tid"] == "decode"
+    lanes = {e["args"]["name"] for e in tr.events
+             if e.get("ph") == "M" and e["name"] == "thread_name"}
+    assert {"frontend / client", "prefill/0 hop", "decode hop"} <= lanes
+    json.dumps(tr.events)   # the file form is plain JSON
 
 
 def test_spans_collect_ignores_foreign_records():

@@ -25,7 +25,10 @@ peak occupancy, the per-request cost ledger rolled up per tenant
 and the ServiceModel constants used.  ``--json`` output is
 byte-identical for a fixed seed + arguments (the determinism golden in
 tests/test_fleet.py pins this); ``--chrome-trace`` renders the sampled
-requests' per-slot timeline via `obs/trace.py serving_trace` (open at
+requests' per-slot timeline via `obs/trace.py serving_trace` PLUS the
+stitched multi-tier view (`stitched_trace`): one lane per fleet hop
+(prefill/decode) with every causal edge — dispatch, KV ship/adopt,
+replay, fallback — drawn as a Perfetto flow arrow (open at
 https://ui.perfetto.dev).  See docs/serving.md.
 """
 from __future__ import annotations
@@ -267,11 +270,26 @@ def main(argv=None) -> int:
         run_log.close()
 
     if args.chrome_trace:
-        from hetu_tpu.obs.trace import serving_trace
-        serving_trace(RunLog.read(log_path),
-                      pid="fleet").save(args.chrome_trace)
+        from hetu_tpu.obs.trace import serving_trace, stitched_trace
+        tr = serving_trace(RunLog.read(log_path), pid="fleet")
+        # the stitched multi-tier view rides the same file under its own
+        # process: per-hop (prefill/decode) lanes with every causal edge
+        # drawn as a flow arrow.  Built from the sim's in-memory hops —
+        # prefill-tier spans deliberately never enter the RunLog stream.
+        hops = list(sim.tracer.completed)
+        if sim.pf_tracer is not None:
+            hops += sim.pf_tracer.completed
+        n_flows = 0
+        if hops:
+            from hetu_tpu.obs.spans import FleetTrace
+            fts = FleetTrace.stitch(traces=hops, events=sim._events)
+            st = stitched_trace(fts, pid="fleet-stitched")
+            n_flows = sum(1 for e in st.events if e.get("ph") == "s")
+            tr.events.extend(st.events)
+        tr.save(args.chrome_trace)
         print(f"chrome trace -> {args.chrome_trace} "
-              f"(1-in-{rep['sample']} requests)", file=sys.stderr)
+              f"(1-in-{rep['sample']} requests, {n_flows} flow edges)",
+              file=sys.stderr)
     if log_path:
         print(f"runlog -> {log_path}", file=sys.stderr)
 

@@ -129,8 +129,8 @@ def summarize(records) -> dict:
             srv["classes"] = rep["classes"]
             srv["slo_attainment"] = rep["slo_attainment"]
             for k in ("goodput_tokens_per_s", "stall_breakdown",
-                      "reconciliation", "spec_decode", "prefix_cache",
-                      "preemptions", "tenants", "costs",
+                      "reconciliation", "critical_path", "spec_decode",
+                      "prefix_cache", "preemptions", "tenants", "costs",
                       "failover", "deadline", "brownout",
                       "disagg", "frontend"):
                 if rep.get(k) is not None:

@@ -6,6 +6,7 @@ attainment, goodput and stall attribution from a serving RunLog.
     python tools_serving_report.py /tmp/serve.jsonl
     python tools_serving_report.py /tmp/serve.jsonl --json
     python tools_serving_report.py /tmp/serve.jsonl --per-request --json
+    python tools_serving_report.py /tmp/serve.jsonl --request 17
 
 Reads the ``serve`` events (admit/done/preempt/reshard/report plus the
 fault kinds failover/retry/evict/expired/shed) and — when the run
@@ -37,7 +38,13 @@ on the prefill->decode wire, re-prefills per class, degraded-mode
 colocated-fallback seconds) and frontend-routed runs
 (serving/frontend.py) the **frontend** section (replica down/drain/
 rejoin events, hedged re-dispatches, hedge wins) — the disagg-storm /
-frontend-partition recovery reports carry them too.  Sampled RunLogs
+frontend-partition recovery reports carry them too.  Traced runs also
+gain the **critical path** lines (stitched FleetTraces decomposed into
+exclusive latency segments per class/tenant, obs/critpath.py), and
+``--request RID`` drills into ONE request: its stitched hop tree
+(prefill/decode/hedge hops, causal edges, per-attempt span timelines)
+with the critical path and its dominant segment highlighted
+(``--json`` emits the pinned ``request_tree_schema`` shape).  Sampled RunLogs
 (HETU_TPU_RUNLOG_SERVE_SAMPLE > 1) are re-weighted by the stamped
 ``sample_weight`` so totals and attainment stay unbiased.
 
@@ -65,6 +72,11 @@ def main(argv=None) -> int:
     ap.add_argument("--per-request", action="store_true",
                     help="include the per-request rows (implies detail "
                          "in --json; appended as a table otherwise)")
+    ap.add_argument("--request", type=int, default=None, metavar="RID",
+                    help="print ONE request's stitched hop tree "
+                         "(fleet hops + causal edges + critical path) "
+                         "instead of the aggregate report; needs span "
+                         "records (HETU_TPU_SERVE_TRACE)")
     args = ap.parse_args(argv)
 
     from hetu_tpu.obs.runlog import RunLog
@@ -74,6 +86,17 @@ def main(argv=None) -> int:
     if not any(r.get("kind") in ("serve", "span") for r in records):
         print(f"no serving records in {args.runlog}", file=sys.stderr)
         return 1
+    if args.request is not None:
+        tree = slo_report.request_tree(slo_report.collect(records),
+                                       args.request)
+        if tree is None:
+            print(f"rid {args.request} has no stitchable spans in "
+                  f"{args.runlog} (sampled out, or "
+                  f"HETU_TPU_SERVE_TRACE unset?)", file=sys.stderr)
+            return 1
+        print(json.dumps(tree, indent=2) if args.json
+              else slo_report.render_request_tree(tree))
+        return 0
     rep = slo_report.serving_report(records, per_request=args.per_request)
     if args.json:
         print(json.dumps(rep, indent=2))
